@@ -1,0 +1,35 @@
+"""incubator_mxnet_tpu: a TPU-native deep-learning framework with the
+capability surface of Apache MXNet 1.x (reference: ciyongch/incubator-mxnet),
+re-designed from scratch for JAX/XLA/pjit/Pallas.
+
+Conventional import::
+
+    import incubator_mxnet_tpu as mx
+
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+
+Architecture notes (vs the reference, see SURVEY.md):
+  * no C ABI / ctypes layer — Python is the single frontend, XLA the executor
+  * no dependency engine — jax async dispatch + XLA scheduling subsume it
+  * no storage manager — PJRT owns device memory
+  * distribution = jax.sharding over a device Mesh, not parameter servers
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError, MXTPUError
+from .context import (Context, Device, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+                      current_context, current_device, num_gpus, num_tpus)
+from . import base
+from . import context
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+
+__all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
+           "tpu", "cpu_pinned", "cpu_shared", "current_context",
+           "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
+           "autograd", "random", "base", "context"]

@@ -1,0 +1,379 @@
+"""Autograd: tape-based reverse-mode differentiation over eager ops.
+
+TPU-native re-design of the reference imperative autograd (reference:
+src/imperative/imperative.cc Imperative::RecordOp/Backward;
+python/mxnet/autograd.py).  Where the reference appends NNVM nodes to a tape
+and later runs the NNVM ``Gradient`` pass, here each recorded op captures a
+jax VJP closure at call time (``_TapeNode``), and ``backward`` walks the tape
+in reverse topological order.  Higher-order gradients re-execute the VJP
+*through the recorder* (jax can differentiate a vjp), so ``grad(create_graph
+=True)`` composes — covering the reference's test_higher_order_grad.py cases.
+
+Train/predict mode scopes mirror the reference exactly
+(``record/pause/train_mode/predict_mode``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "backward", "grad", "mark_variables",
+           "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(is_record: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, train
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope: record ops for autograd (reference: autograd.record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """reference: MXAutogradMarkVariables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._require_grad = req != "null"
+        v._grad_req = req
+        v._grad = g
+        v._ag_node = None
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+class _TapeNode:
+    """One recorded op: primal fn + captured VJP + input arrays.
+
+    Keeping both the primal ``fun`` and the recorded-time ``vjp_fn`` gives a
+    fast first-order path (use the stored closure) and a correct higher-order
+    path (re-derive the VJP through the recorder when create_graph=True) —
+    the analog of the reference CachedOp "inlining" for 2nd order
+    (reference: src/imperative/cached_op.cc)."""
+
+    __slots__ = ("fun", "inputs", "vjp_fn", "out_is_tuple", "name",
+                 "out_avals", "freed", "custom")
+
+    def __init__(self, fun, inputs, vjp_fn, out_is_tuple, name,
+                 custom=False):
+        self.fun = fun
+        self.inputs = list(inputs)
+        self.vjp_fn = vjp_fn
+        self.out_is_tuple = out_is_tuple
+        self.name = name
+        self.out_avals = []
+        self.freed = False
+        # custom: vjp comes from a user autograd.Function; its primal ``fun``
+        # is a placeholder, so create_graph must NOT re-derive through it
+        # (the stored python backward is used; grads are then first-order
+        # only through this node — same limitation as the reference's
+        # mx.autograd.Function).
+        self.custom = custom
+
+
+def _toposort(head_nodes) -> List[_TapeNode]:
+    """Reverse-topological order (outputs first)."""
+    order: List[_TapeNode] = []
+    perm, temp = set(), set()
+
+    def visit(n: _TapeNode):
+        if id(n) in perm:
+            return
+        stack = [(n, iter([inp._ag_node for inp in n.inputs
+                           if inp._ag_node is not None]))]
+        temp.add(id(n))
+        while stack:
+            node, it = stack[-1]
+            child = next(it, None)
+            if child is None:
+                stack.pop()
+                temp.discard(id(node))
+                if id(node) not in perm:
+                    perm.add(id(node))
+                    order.append(node)
+            elif id(child) not in perm and id(child) not in temp:
+                temp.add(id(child))
+                stack.append((child, iter([inp._ag_node for inp in child.inputs
+                                           if inp._ag_node is not None])))
+    for n in head_nodes:
+        visit(n)
+    return list(reversed(order))  # heads first
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True, create_graph: bool = False,
+             variables=None):
+    """Core reverse pass (reference: Imperative::Backward).
+
+    heads: list of NDArray to differentiate.  Gradients are accumulated into
+    the ``.grad`` buffers of marked variables per their grad_req; if
+    ``variables`` is given, returns grads w.r.t. those arrays instead
+    (autograd.grad semantics).
+    """
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray, _invoke
+
+    heads = list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = list(head_grads)
+
+    head_nodes = [h._ag_node for h in heads if h._ag_node is not None]
+    if not head_nodes and not any(h._require_grad for h in heads):
+        raise MXNetError(
+            "cannot differentiate: outputs are not on the recorded graph "
+            "(did you forget autograd.record() / attach_grad()?)")
+
+    # pending cotangents keyed by (node id, out idx); NDArray-valued when
+    # create_graph so the second-order pass can record through them.
+    pending: dict = {}
+    leaf_acc: dict = {}   # id(ndarray) -> cotangent
+    var_ids = {id(v) for v in variables} if variables is not None else None
+
+    for h, g in zip(heads, head_grads):
+        if g is None:
+            gval = NDArray(jnp.ones(h.shape, h.dtype), ctx=h.ctx)
+        elif isinstance(g, NDArray):
+            gval = g
+        else:
+            gval = NDArray(jnp.asarray(g, h.dtype), ctx=h.ctx)
+        if h._ag_node is not None:
+            key = (id(h._ag_node), h._ag_idx)
+            pending[key] = gval if key not in pending else pending[key] + gval
+        if h._require_grad or (var_ids and id(h) in var_ids):
+            k = id(h)
+            leaf_acc[k] = gval if k not in leaf_acc else leaf_acc[k] + gval
+
+    order = _toposort(head_nodes)
+
+    for node in order:
+        outs = [pending.pop((id(node), i), None)
+                for i in range(len(node.out_avals))]
+        if all(o is None for o in outs):
+            continue
+        cots = []
+        for (shape, dtype), o in zip(node.out_avals, outs):
+            if o is None:
+                cots.append(NDArray(jnp.zeros(shape, dtype)))
+            else:
+                cots.append(o)
+
+        if node.freed:
+            raise MXNetError(
+                "graph already freed: call backward(retain_graph=True) to "
+                "backprop through the same graph twice")
+
+        if create_graph and not node.custom:
+            # re-derive the vjp *through the recorder*: gradient of gradient
+            # sees the dependency on both primals and cotangents.
+            import jax
+            fun, n_in = node.fun, len(node.inputs)
+
+            def vjp_apply(*args, _fun=fun, _n=n_in, _tup=node.out_is_tuple):
+                primals, cot = args[:_n], args[_n:]
+                _, vjp_fn = jax.vjp(_fun, *primals)
+                gs = vjp_fn(tuple(cot) if _tup else cot[0])
+                return tuple(gs) if len(gs) > 1 else gs[0]
+
+            res = _invoke(vjp_apply, node.inputs + cots,
+                          name=f"vjp[{node.name}]")
+            in_grads = res if isinstance(res, list) else [res]
+        else:
+            cot_data = tuple(c._data for c in cots)
+            gs = node.vjp_fn(cot_data if node.out_is_tuple else cot_data[0])
+            in_grads = [NDArray(g, ctx=inp.ctx)
+                        for g, inp in zip(gs, node.inputs)]
+
+        for inp, g in zip(node.inputs, in_grads):
+            if inp._ag_node is not None:
+                key = (id(inp._ag_node), inp._ag_idx)
+                pending[key] = g if key not in pending else pending[key] + g
+            if inp._require_grad or (var_ids and id(inp) in var_ids):
+                k = id(inp)
+                leaf_acc[k] = g if k not in leaf_acc else leaf_acc[k] + g
+
+        if not retain_graph and not create_graph:
+            node.vjp_fn = None
+            node.freed = True
+
+    # deposit into .grad buffers per grad_req
+    if variables is None:
+        seen = set()
+        stack_arrays = []
+        def collect(n):
+            for inp in n.inputs:
+                if id(inp) not in seen:
+                    seen.add(id(inp))
+                    stack_arrays.append(inp)
+        for n in order:
+            collect(n)
+        for h in heads:
+            if id(h) not in seen:
+                seen.add(id(h)); stack_arrays.append(h)
+        for arr in stack_arrays:
+            if arr._require_grad and id(arr) in leaf_acc:
+                acc = leaf_acc[id(arr)]
+                if arr._grad_req == "add" and arr._grad is not None:
+                    arr._grad._set_data(arr._grad._data + acc._data)
+                else:
+                    if arr._grad is None:
+                        arr._grad = NDArray(acc._data, ctx=arr.ctx)
+                    else:
+                        arr._grad._set_data(acc._data.astype(arr._grad.dtype))
+        return None
+
+    out = []
+    for v in variables:
+        g = leaf_acc.get(id(v))
+        if g is None:
+            g = NDArray(jnp.zeros(v.shape, v.dtype), ctx=v.ctx)
+        out.append(g)
+    return out
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode: bool = True):
+    """Compute gradients of heads w.r.t. variables, returning them (without
+    touching ``.grad`` buffers) — reference: mx.autograd.grad.  With
+    ``create_graph=True`` the returned arrays are themselves recorded, so a
+    second ``backward`` gives higher-order gradients."""
+    single = False
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    if retain_graph is None:
+        retain_graph = create_graph
+    with _RecordingStateScope(True if create_graph else None, train_mode):
+        gs = backward(heads, head_grads, retain_graph=retain_graph,
+                      create_graph=create_graph, variables=variables)
+    return gs[0] if single else gs
+
+
+def get_symbol(x):
+    raise MXNetError("get_symbol: tape-to-Symbol export is not supported; "
+                     "use HybridBlock.export for deployable graphs")
+
+
+class Function:
+    """Custom differentiable function (reference: mx.autograd.Function,
+    python/mxnet/autograd.py).  Subclass and implement forward/backward."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *out_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single_out = isinstance(outputs, NDArray)
+        outs = [outputs] if single_out else list(outputs)
+
+        if is_recording():
+            fwd_self = self
+
+            class _Node(_TapeNode):
+                __slots__ = ()
+
+            def fake_fun(*xs):  # placeholder; custom backward used instead
+                return tuple(o._data for o in outs)
+
+            node = _Node(fun=fake_fun,
+                         inputs=[i for i in inputs if isinstance(i, NDArray)],
+                         vjp_fn=None, out_is_tuple=not single_out,
+                         name=type(self).__name__, custom=True)
+            node.out_avals = [(o.shape, o.dtype) for o in outs]
+
+            def custom_vjp(cot):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                with pause():
+                    gs = fwd_self.backward(
+                        *[NDArray(c) for c in cots])
+                if isinstance(gs, NDArray):
+                    gs = (gs,)
+                return tuple(g._data for g in gs)
+
+            node.vjp_fn = custom_vjp
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_idx = i
+        return outputs

@@ -1,0 +1,138 @@
+"""Base utilities: errors, env-var config plane, registries.
+
+TPU-native re-design of the reference's dmlc-core base layer
+(reference: 3rdparty/dmlc-core/include/dmlc/logging.h ``CHECK``/``dmlc::Error``;
+src/c_api/c_api.cc TLS last-error).  There is no C ABI here: the Python layer
+*is* the frontend, and JAX/XLA is the executor, so errors are plain Python
+exceptions and the "env var config plane" (reference:
+docs/static_site/src/pages/api/faq/env_var.md) maps MXNET_* names onto this
+framework's knobs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "MXNetError",
+    "MXTPUError",
+    "check_call",
+    "getenv",
+    "getenv_int",
+    "getenv_bool",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "registry",
+]
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework.
+
+    Name kept for API parity with the reference's ``mxnet.base.MXNetError``
+    (reference: python/mxnet/base.py).  Async errors: because jax dispatches
+    eagerly-but-asynchronously, device-side failures surface at the next
+    blocking call (``wait_to_read``/``asnumpy``) exactly like the reference
+    engine's deferred exception_ptr rethrow
+    (reference: src/engine/threaded_engine.cc ThrowException).
+    """
+
+
+# Alias under the new framework's own name.
+MXTPUError = MXNetError
+
+
+def check_call(ret):
+    """Parity shim for the ctypes-era ``check_call``; a no-op here since there
+    is no C ABI return code to check (reference: python/mxnet/base.py)."""
+    return ret
+
+
+# ---------------------------------------------------------------------------
+# Environment-variable config plane.
+#
+# The reference reads MXNET_* env vars via dmlc::GetEnv at use sites.  We keep
+# the same names working (MXNET_*) and add MXTPU_* equivalents that win when
+# both are set.  See docs/env_var.md for the supported list.
+# ---------------------------------------------------------------------------
+
+def getenv(name: str, default=None):
+    """Read a config env var.  ``name`` is the canonical MXNET_* name; the
+    MXTPU_* spelling takes precedence when present."""
+    alt = name.replace("MXNET_", "MXTPU_", 1) if name.startswith("MXNET_") else None
+    if alt is not None and alt in os.environ:
+        return os.environ[alt]
+    return os.environ.get(name, default)
+
+
+def getenv_int(name: str, default: int = 0) -> int:
+    v = getenv(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise MXNetError(f"env var {name} must be an int, got {v!r}")
+
+
+def getenv_bool(name: str, default: bool = False) -> bool:
+    v = getenv(name)
+    if v is None or v == "":
+        return default
+    return str(v).lower() not in ("0", "false", "off", "no", "")
+
+
+# ---------------------------------------------------------------------------
+# Lightweight name->object registry, the stand-in for the reference's
+# dmlc registry + NNVM op registry (reference: 3rdparty/tvm/nnvm op registry,
+# python/mxnet/registry.py).
+# ---------------------------------------------------------------------------
+
+class _Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._store: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, obj=None, *, allow_override: bool = False):
+        def _do(o):
+            key = name.lower()
+            with self._lock:
+                if key in self._store and not allow_override:
+                    raise MXNetError(
+                        f"{self.kind} '{name}' is already registered")
+                self._store[key] = o
+            return o
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name: str):
+        try:
+            return self._store[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'; registered: "
+                f"{sorted(self._store)}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._store
+
+    def keys(self):
+        return sorted(self._store)
+
+
+_registries: dict[str, _Registry] = {}
+
+
+def registry(kind: str) -> _Registry:
+    """Get (or create) the global registry for ``kind`` ('optimizer',
+    'initializer', 'metric', 'kvstore', ...)."""
+    if kind not in _registries:
+        _registries[kind] = _Registry(kind)
+    return _registries[kind]

@@ -1,0 +1,16 @@
+"""``mx.nd``: the eager NDArray API (reference: python/mxnet/ndarray/).
+
+Where the reference code-generates op wrappers at import time from C-API op
+introspection (python/mxnet/ndarray/register.py), here the ops are plain
+Python functions in ``ops.py`` re-exported into this namespace — same surface,
+no codegen step needed."""
+from .ndarray import (NDArray, array, zeros, ones, empty, full, arange, eye,
+                      linspace, from_jax, concatenate, waitall)
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+from . import random  # noqa: F401
+from . import ops as op  # alias: mx.nd.op.xxx parity
+
+__all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
+            "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
+            "op"] + list(_ops_all))

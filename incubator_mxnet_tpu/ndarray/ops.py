@@ -1,0 +1,979 @@
+"""The operator corpus, eager namespace (``mx.nd.*``).
+
+TPU-native re-design of the reference operator layer (reference:
+src/operator/ — tensor/elemwise_*, broadcast, reductions, matrix ops,
+indexing, nn activation/softmax, sequence ops; registered via
+NNVM_REGISTER_OP and dispatched through Imperative::Invoke).  Here every op
+is a thin pure function over jax arrays funneled through
+``ndarray._invoke`` which handles async dispatch + autograd recording.
+Gradients come from jax's VJP of the same pure function — the analog of the
+reference's per-op FGradient registrations, but derived automatically.
+
+Naming/behavior follows python/mxnet/ndarray (e.g. comparison ops return
+float arrays; ``dot`` contracts last axis of lhs with first of rhs;
+reductions accept axis/keepdims; ``topk`` mirrors the ret_typ variants).
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _invoke, array as _array
+
+__all__: list = []  # populated at bottom
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lax():
+    from jax import lax
+    return lax
+
+
+def _nd(x, ref: Optional[NDArray] = None) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    ctx = ref.ctx if ref is not None else None
+    return _array(_np.asarray(x), ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (+ broadcast_* aliases, reference:
+# src/operator/tensor/elemwise_binary_broadcast_op_basic.cc)
+# ---------------------------------------------------------------------------
+def _binary(name, fn, differentiable=True):
+    def op(lhs, rhs):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return _invoke(fn, [lhs, rhs], name=name,
+                           differentiable=differentiable)
+        if isinstance(lhs, NDArray):
+            return _invoke(lambda x: fn(x, rhs), [lhs], name=name,
+                           differentiable=differentiable)
+        if isinstance(rhs, NDArray):
+            return _invoke(lambda y: fn(lhs, y), [rhs], name=name,
+                           differentiable=differentiable)
+        raise TypeError(f"{name}: at least one NDArray operand required")
+    op.__name__ = name
+    return op
+
+
+def _cmp_fn(jfn):
+    # reference comparison ops return float arrays, not bool
+    def fn(a, b):
+        jnp = _jnp()
+        out_dt = a.dtype if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.float32
+        return jfn(a, b).astype(out_dt)
+    return fn
+
+
+add = _binary("add", lambda a, b: a + b)
+subtract = _binary("subtract", lambda a, b: a - b)
+multiply = _binary("multiply", lambda a, b: a * b)
+divide = _binary("divide", lambda a, b: a / b)
+floor_divide = _binary("floor_divide", lambda a, b: a // b, differentiable=False)
+mod = _binary("mod", lambda a, b: a % b)
+power = _binary("power", lambda a, b: a ** b)
+maximum = _binary("maximum", lambda a, b: _jnp().maximum(a, b))
+minimum = _binary("minimum", lambda a, b: _jnp().minimum(a, b))
+hypot = _binary("hypot", lambda a, b: _jnp().hypot(a, b))
+arctan2 = _binary("arctan2", lambda a, b: _jnp().arctan2(a, b))
+equal = _binary("equal", _cmp_fn(lambda a, b: a == b), differentiable=False)
+not_equal = _binary("not_equal", _cmp_fn(lambda a, b: a != b), differentiable=False)
+greater = _binary("greater", _cmp_fn(lambda a, b: a > b), differentiable=False)
+greater_equal = _binary("greater_equal", _cmp_fn(lambda a, b: a >= b), differentiable=False)
+lesser = _binary("lesser", _cmp_fn(lambda a, b: a < b), differentiable=False)
+lesser_equal = _binary("lesser_equal", _cmp_fn(lambda a, b: a <= b), differentiable=False)
+logical_and = _binary("logical_and", _cmp_fn(lambda a, b: (a != 0) & (b != 0)), differentiable=False)
+logical_or = _binary("logical_or", _cmp_fn(lambda a, b: (a != 0) | (b != 0)), differentiable=False)
+logical_xor = _binary("logical_xor", _cmp_fn(lambda a, b: (a != 0) ^ (b != 0)), differentiable=False)
+
+# broadcast_* spellings are first-class names in the reference
+broadcast_add = broadcast_plus = add
+broadcast_sub = broadcast_minus = subtract
+broadcast_mul = multiply
+broadcast_div = divide
+broadcast_mod = mod
+broadcast_power = power
+broadcast_maximum = maximum
+broadcast_minimum = minimum
+broadcast_hypot = hypot
+broadcast_equal = equal
+broadcast_not_equal = not_equal
+broadcast_greater = greater
+broadcast_greater_equal = greater_equal
+broadcast_lesser = lesser
+broadcast_lesser_equal = lesser_equal
+broadcast_logical_and = logical_and
+broadcast_logical_or = logical_or
+broadcast_logical_xor = logical_xor
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+elemwise_div = divide
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (reference: src/operator/tensor/elemwise_unary_op_basic.cc)
+# ---------------------------------------------------------------------------
+def _unary(name, fn, differentiable=True):
+    def op(data, **kw):
+        return _invoke(lambda x: fn(x, **kw), [_nd(data)], name=name,
+                       differentiable=differentiable)
+    op.__name__ = name
+    return op
+
+
+abs = _unary("abs", lambda x: _jnp().abs(x))
+sign = _unary("sign", lambda x: _jnp().sign(x))
+negative = _unary("negative", lambda x: -x)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+square = _unary("square", lambda x: x * x)
+sqrt = _unary("sqrt", lambda x: _jnp().sqrt(x))
+rsqrt = _unary("rsqrt", lambda x: 1.0 / _jnp().sqrt(x))
+cbrt = _unary("cbrt", lambda x: _jnp().cbrt(x))
+rcbrt = _unary("rcbrt", lambda x: 1.0 / _jnp().cbrt(x))
+exp = _unary("exp", lambda x: _jnp().exp(x))
+expm1 = _unary("expm1", lambda x: _jnp().expm1(x))
+log = _unary("log", lambda x: _jnp().log(x))
+log10 = _unary("log10", lambda x: _jnp().log10(x))
+log2 = _unary("log2", lambda x: _jnp().log2(x))
+log1p = _unary("log1p", lambda x: _jnp().log1p(x))
+sin = _unary("sin", lambda x: _jnp().sin(x))
+cos = _unary("cos", lambda x: _jnp().cos(x))
+tan = _unary("tan", lambda x: _jnp().tan(x))
+arcsin = _unary("arcsin", lambda x: _jnp().arcsin(x))
+arccos = _unary("arccos", lambda x: _jnp().arccos(x))
+arctan = _unary("arctan", lambda x: _jnp().arctan(x))
+sinh = _unary("sinh", lambda x: _jnp().sinh(x))
+cosh = _unary("cosh", lambda x: _jnp().cosh(x))
+tanh = _unary("tanh", lambda x: _jnp().tanh(x))
+arcsinh = _unary("arcsinh", lambda x: _jnp().arcsinh(x))
+arccosh = _unary("arccosh", lambda x: _jnp().arccosh(x))
+arctanh = _unary("arctanh", lambda x: _jnp().arctanh(x))
+degrees = _unary("degrees", lambda x: _jnp().degrees(x))
+radians = _unary("radians", lambda x: _jnp().radians(x))
+floor = _unary("floor", lambda x: _jnp().floor(x))
+ceil = _unary("ceil", lambda x: _jnp().ceil(x))
+trunc = _unary("trunc", lambda x: _jnp().trunc(x))
+round = _unary("round", lambda x: _jnp().round(x))
+rint = _unary("rint", lambda x: _jnp().rint(x))
+fix = _unary("fix", lambda x: _jnp().trunc(x))
+logical_not = _unary("logical_not", lambda x: (x == 0).astype(_jnp().float32),
+                     differentiable=False)
+isnan = _unary("isnan", lambda x: _jnp().isnan(x), differentiable=False)
+isinf = _unary("isinf", lambda x: _jnp().isinf(x), differentiable=False)
+isfinite = _unary("isfinite", lambda x: _jnp().isfinite(x), differentiable=False)
+
+
+def _special(name):
+    def fn(x):
+        import jax.scipy.special as sp
+        return getattr(sp, name)(x)
+    return fn
+
+
+gamma = _unary("gamma", lambda x: _jnp().exp(_special("gammaln")(x)))
+gammaln = _unary("gammaln", _special("gammaln"))
+digamma = _unary("digamma", _special("digamma"))
+erf = _unary("erf", _special("erf"))
+erfinv = _unary("erfinv", _special("erfinv"))
+
+
+def identity(data):
+    return _invoke(lambda x: x, [_nd(data)], name="identity")
+
+
+copy = identity
+
+
+def stop_gradient(data):
+    """reference: BlockGrad (src/operator/tensor/elemwise_unary_op_basic.cc)."""
+    d = _nd(data)
+    return _invoke(lambda x: x, [d], name="stop_gradient", differentiable=False)
+
+
+BlockGrad = stop_gradient
+
+
+def cast(data, dtype):
+    d = _nd(data)
+    return _invoke(lambda x: x.astype(dtype), [d], name="cast")
+
+
+Cast = cast
+
+
+def zeros_like(data):
+    return _invoke(lambda x: _jnp().zeros_like(x), [_nd(data)],
+                   name="zeros_like", differentiable=False)
+
+
+def ones_like(data):
+    return _invoke(lambda x: _jnp().ones_like(x), [_nd(data)],
+                   name="ones_like", differentiable=False)
+
+
+def full_like(data, fill_value):
+    return _invoke(lambda x: _jnp().full_like(x, fill_value), [_nd(data)],
+                   name="full_like", differentiable=False)
+
+
+def shape_array(data):
+    return _array(_np.asarray(_nd(data).shape, dtype=_np.int64))
+
+
+def size_array(data):
+    return _array(_np.asarray([_nd(data).size], dtype=_np.int64))
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: src/operator/nn/activation.cc, leaky_relu.cc,
+# softmax.cc)
+# ---------------------------------------------------------------------------
+relu = _unary("relu", lambda x: _jnp().maximum(x, 0))
+sigmoid = _unary("sigmoid", lambda x: _jax_nn("sigmoid")(x))
+softsign = _unary("softsign", lambda x: x / (1 + _jnp().abs(x)))
+softrelu = _unary("softrelu", lambda x: _jax_nn("softplus")(x))
+softplus = softrelu
+erf_gelu = _unary("erf_gelu", lambda x: _jax_nn("gelu")(x, approximate=False))
+
+
+def _jax_nn(name):
+    import jax.nn
+    return getattr(jax.nn, name)
+
+
+def gelu(data, approximate=False):
+    return _invoke(lambda x: _jax_nn("gelu")(x, approximate=approximate),
+                   [_nd(data)], name="gelu")
+
+
+def leaky_relu(data, act_type="leaky", slope=0.25, gamma=None, **kw):
+    """reference: LeakyReLU op (src/operator/leaky_relu.cc): leaky/elu/selu/
+    gelu variants."""
+    jnp = _jnp()
+    d = _nd(data)
+    if act_type == "leaky":
+        return _invoke(lambda x: jnp.where(x > 0, x, slope * x), [d],
+                       name="leaky_relu")
+    if act_type == "elu":
+        return _invoke(lambda x: jnp.where(x > 0, x, slope * jnp.expm1(x)),
+                       [d], name="elu")
+    if act_type == "selu":
+        return _invoke(lambda x: _jax_nn("selu")(x), [d], name="selu")
+    if act_type == "gelu":
+        return _invoke(lambda x: _jax_nn("gelu")(x, approximate=False), [d],
+                       name="gelu")
+    if act_type == "prelu":
+        g = _nd(gamma, d)
+        return _invoke(lambda x, gm: jnp.where(x > 0, x, gm * x), [d, g],
+                       name="prelu")
+    raise MXNetError(f"LeakyReLU: unknown act_type {act_type}")
+
+
+LeakyReLU = leaky_relu
+
+
+def Activation(data, act_type="relu"):
+    table = {"relu": relu, "sigmoid": sigmoid, "tanh": tanh,
+             "softrelu": softrelu, "softsign": softsign,
+             "log_sigmoid": lambda d: _invoke(
+                 lambda x: _jax_nn("log_sigmoid")(x), [_nd(d)],
+                 name="log_sigmoid"),
+             "mish": lambda d: _invoke(
+                 lambda x: x * _jnp().tanh(_jax_nn("softplus")(x)), [_nd(d)],
+                 name="mish")}
+    if act_type not in table:
+        raise MXNetError(f"Activation: unknown act_type {act_type}")
+    return table[act_type](data)
+
+
+def softmax(data, axis=-1, temperature=None, length=None):
+    """reference: src/operator/nn/softmax.cc (with optional masking by valid
+    ``length`` along ``axis``)."""
+    jnp = _jnp()
+    d = _nd(data)
+    if length is not None:
+        ln = _nd(length, d)
+
+        def fn(x, lv):
+            t = x / temperature if temperature else x
+            idx = jnp.arange(x.shape[axis])
+            shp = [1] * x.ndim
+            shp[axis] = x.shape[axis]
+            mask = idx.reshape(shp) < jnp.expand_dims(lv, axis=axis)
+            t = jnp.where(mask, t, -jnp.inf)
+            out = _jax_nn("softmax")(t, axis=axis)
+            return jnp.where(mask, out, 0.0)
+        return _invoke(fn, [d, ln], name="softmax")
+
+    def fn(x):
+        t = x / temperature if temperature else x
+        return _jax_nn("softmax")(t, axis=axis)
+    return _invoke(fn, [d], name="softmax")
+
+
+def log_softmax(data, axis=-1, temperature=None):
+    def fn(x):
+        t = x / temperature if temperature else x
+        return _jax_nn("log_softmax")(t, axis=axis)
+    return _invoke(fn, [_nd(data)], name="log_softmax")
+
+
+def softmax_cross_entropy(data, label):
+    """reference: src/operator/loss_binary_op.cc softmax_cross_entropy:
+    summed CE over the batch, integer labels."""
+    d, l = _nd(data), _nd(label)
+
+    def fn(x, y):
+        jnp = _jnp()
+        logp = _jax_nn("log_softmax")(x, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[..., None], axis=-1)
+        return -picked.sum()
+    return _invoke(fn, [d, l], name="softmax_cross_entropy")
+
+
+def smooth_l1(data, scalar=1.0):
+    def fn(x):
+        jnp = _jnp()
+        s2 = scalar * scalar
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                         jnp.abs(x) - 0.5 / s2)
+    return _invoke(fn, [_nd(data)], name="smooth_l1")
+
+
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    jnp = _jnp()
+
+    def fn(x):
+        if mode == "instance":
+            ax = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            ax = (1,)
+        else:
+            ax = tuple(range(x.ndim))
+        n = jnp.sqrt((x * x).sum(axis=ax, keepdims=True) + eps)
+        return x / n
+    return _invoke(fn, [_nd(data)], name="l2_normalization")
+
+
+L2Normalization = l2_normalization
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: src/operator/tensor/broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(name, jname, differentiable=True):
+    def op(data, axis=None, keepdims=False, **kw):
+        ax = _norm_axis(axis)
+        return _invoke(
+            lambda x: getattr(_jnp(), jname)(x, axis=ax, keepdims=keepdims),
+            [_nd(data)], name=name, differentiable=differentiable)
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", "sum")
+nansum = _reduce("nansum", "nansum")
+mean = _reduce("mean", "mean")
+prod = _reduce("prod", "prod")
+nanprod = _reduce("nanprod", "nanprod")
+max = _reduce("max", "max")
+min = _reduce("min", "min")
+sum_axis = sum
+max_axis = max
+min_axis = min
+
+
+def argmax(data, axis=None, keepdims=False):
+    """Returns float indices, matching the reference."""
+    def fn(x):
+        jnp = _jnp()
+        r = jnp.argmax(x, axis=axis)
+        if keepdims and axis is not None:
+            r = jnp.expand_dims(r, axis)
+        return r.astype(jnp.float32)
+    return _invoke(fn, [_nd(data)], name="argmax", differentiable=False)
+
+
+def argmin(data, axis=None, keepdims=False):
+    def fn(x):
+        jnp = _jnp()
+        r = jnp.argmin(x, axis=axis)
+        if keepdims and axis is not None:
+            r = jnp.expand_dims(r, axis)
+        return r.astype(jnp.float32)
+    return _invoke(fn, [_nd(data)], name="argmin", differentiable=False)
+
+
+def argmax_channel(data):
+    return argmax(data, axis=1)
+
+
+def norm(data, ord=2, axis=None, keepdims=False):
+    def fn(x):
+        jnp = _jnp()
+        ax = _norm_axis(axis)
+        if ord == 1:
+            return jnp.abs(x).sum(axis=ax, keepdims=keepdims)
+        return jnp.sqrt((x * x).sum(axis=ax, keepdims=keepdims))
+    return _invoke(fn, [_nd(data)], name="norm")
+
+
+def cumsum(data, axis=None, dtype=None):
+    return _invoke(lambda x: _jnp().cumsum(x, axis=axis, dtype=dtype),
+                   [_nd(data)], name="cumsum")
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (reference: src/operator/tensor/dot.cc, la ops)
+# ---------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """reference ``dot``: contract lhs's last axis with rhs's first axis
+    (after optional transposes)."""
+    l, r = _nd(lhs), _nd(rhs)
+
+    def fn(a, b):
+        jnp = _jnp()
+        if transpose_a:
+            a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+        if transpose_b:
+            b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        return jnp.tensordot(a, b, axes=1)
+    return _invoke(fn, [l, r], name="dot")
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """reference: batch_dot (src/operator/tensor/dot.cc) — batched matmul
+    over leading dims; the attention workhorse.  Maps directly onto the MXU."""
+    l, r = _nd(lhs), _nd(rhs)
+
+    def fn(a, b):
+        jnp = _jnp()
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return _invoke(fn, [l, r], name="batch_dot")
+
+
+def matmul(lhs, rhs):
+    return _invoke(lambda a, b: _jnp().matmul(a, b), [_nd(lhs), _nd(rhs)],
+                   name="matmul")
+
+
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    def fn(a, b):
+        jnp = _jnp()
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+    return _invoke(fn, [_nd(A), _nd(B)], name="linalg_gemm2")
+
+
+def khatri_rao(*args):
+    mats = [_nd(a) for a in args]
+
+    def fn(*ms):
+        jnp = _jnp()
+        out = ms[0]
+        for m in ms[1:]:
+            out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+        return out
+    return _invoke(fn, mats, name="khatri_rao")
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+def reshape(data, shape=None, reverse=False, **kw):
+    """reference: reshape op with special codes 0/-1/-2/-3/-4; ``reverse=True``
+    applies the codes right-to-left (matching the reference's semantics for
+    trailing-dim-anchored reshapes)."""
+    d = _nd(data)
+    if not reverse:
+        return d.reshape(shape)
+    spec = list(shape)
+    if -4 in spec:
+        raise MXNetError("reshape: reverse=True with -4 is not supported")
+    from .ndarray import _expand_reshape
+    new_shape = _expand_reshape(d.shape[::-1], spec[::-1])[::-1]
+    return d.reshape(new_shape)
+
+
+def reshape_like(data, other):
+    return _nd(data).reshape(_nd(other).shape)
+
+
+def flatten(data):
+    return _nd(data).flatten()
+
+
+Flatten = flatten
+
+
+def transpose(data, axes=None):
+    ax = tuple(axes) if axes else None
+    return _invoke(lambda x: _jnp().transpose(x, ax), [_nd(data)],
+                   name="transpose")
+
+
+def swapaxes(data, dim1=0, dim2=0):
+    return _invoke(lambda x: _jnp().swapaxes(x, dim1, dim2), [_nd(data)],
+                   name="swapaxes")
+
+
+SwapAxis = swapaxes
+
+
+def expand_dims(data, axis):
+    return _invoke(lambda x: _jnp().expand_dims(x, axis), [_nd(data)],
+                   name="expand_dims")
+
+
+def squeeze(data, axis=None):
+    ax = _norm_axis(axis)
+    return _invoke(lambda x: _jnp().squeeze(x, axis=ax), [_nd(data)],
+                   name="squeeze")
+
+
+def broadcast_to(data, shape):
+    shape = tuple(shape)
+    d = _nd(data)
+    # reference semantics: 0 in target shape means "keep source dim"
+    tgt = tuple(s if s != 0 else d.shape[i] for i, s in enumerate(shape))
+    return _invoke(lambda x: _jnp().broadcast_to(x, tgt), [d],
+                   name="broadcast_to")
+
+
+def broadcast_axis(data, axis=None, size=None):
+    d = _nd(data)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+    tgt = list(d.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return broadcast_to(d, tgt)
+
+
+broadcast_axes = broadcast_axis
+
+
+def broadcast_like(lhs, rhs):
+    return broadcast_to(lhs, _nd(rhs).shape)
+
+
+def concat(*data, dim=1):
+    arrs = [_nd(d) for d in (data[0] if len(data) == 1 and
+                             isinstance(data[0], (list, tuple)) else data)]
+    return _invoke(lambda *xs: _jnp().concatenate(xs, axis=dim), arrs,
+                   name="concat")
+
+
+Concat = concat
+
+
+def stack(*data, axis=0):
+    arrs = [_nd(d) for d in (data[0] if len(data) == 1 and
+                             isinstance(data[0], (list, tuple)) else data)]
+    return _invoke(lambda *xs: _jnp().stack(xs, axis=axis), arrs, name="stack")
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    d = _nd(data)
+
+    def fn(x):
+        jnp = _jnp()
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    out = _invoke(fn, [d], name="split")
+    return out if num_outputs > 1 else out[0]
+
+
+SliceChannel = split
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    d = _nd(data)
+    ios = indices_or_sections
+
+    def fn(x):
+        jnp = _jnp()
+        parts = jnp.split(x, ios if isinstance(ios, int) else list(ios),
+                          axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return _invoke(fn, [d], name="split_v2")
+
+
+def slice(data, begin, end, step=None):
+    """reference: slice op — begin/end may contain None."""
+    d = _nd(data)
+    begin = tuple(begin) if isinstance(begin, (list, tuple)) else (begin,)
+    end = tuple(end) if isinstance(end, (list, tuple)) else (end,)
+    step = tuple(step) if step else (None,) * len(begin)
+    key = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return _invoke(lambda x: x[key], [d], name="slice")
+
+
+def slice_axis(data, axis, begin, end):
+    d = _nd(data)
+    if end is None:
+        end = d.shape[axis]
+    key = [builtins.slice(None)] * d.ndim
+    key[axis] = builtins.slice(begin, end)
+    key = tuple(key)
+    return _invoke(lambda x: x[key], [d], name="slice_axis")
+
+
+def slice_like(data, shape_like, axes=None):
+    d, s = _nd(data), _nd(shape_like)
+    axes = axes if axes is not None else range(d.ndim)
+    key = [builtins.slice(None)] * d.ndim
+    for a in axes:
+        key[a] = builtins.slice(0, s.shape[a])
+    key = tuple(key)
+    return _invoke(lambda x: x[key], [d], name="slice_like")
+
+
+def tile(data, reps):
+    return _invoke(lambda x: _jnp().tile(x, tuple(reps)), [_nd(data)],
+                   name="tile")
+
+
+def repeat(data, repeats, axis=None):
+    return _invoke(lambda x: _jnp().repeat(x, repeats, axis=axis), [_nd(data)],
+                   name="repeat")
+
+
+def flip(data, axis):
+    ax = _norm_axis(axis)
+    return _invoke(lambda x: _jnp().flip(x, axis=ax), [_nd(data)], name="flip")
+
+
+reverse = flip
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0):
+    """reference: src/operator/pad.cc — pad_width is the flat
+    (before,after)-per-axis tuple."""
+    d = _nd(data)
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(d.ndim)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+
+    def fn(x):
+        jnp = _jnp()
+        if jmode == "constant":
+            return jnp.pad(x, pw, mode="constant",
+                           constant_values=constant_value)
+        return jnp.pad(x, pw, mode=jmode)
+    return _invoke(fn, [d], name="pad")
+
+
+Pad = pad
+
+
+def diag(data, k=0):
+    d = _nd(data)
+
+    def fn(x):
+        jnp = _jnp()
+        if x.ndim == 1:
+            return jnp.diag(x, k)
+        return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+    return _invoke(fn, [d], name="diag")
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+def take(a, indices, axis=0, mode="clip"):
+    d, idx = _nd(a), _nd(indices, _nd(a))
+
+    def fn(x, i):
+        jnp = _jnp()
+        i = i.astype(jnp.int32)
+        if mode == "clip":
+            i = jnp.clip(i, 0, x.shape[axis] - 1)
+        elif mode == "wrap":
+            i = i % x.shape[axis]
+        return jnp.take(x, i, axis=axis)
+    return _invoke(fn, [d, idx], name="take")
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    d, idx = _nd(data), _nd(index, _nd(data))
+
+    def fn(x, i):
+        jnp = _jnp()
+        i = jnp.clip(i.astype(jnp.int32), 0, x.shape[axis] - 1)
+        picked = jnp.take_along_axis(x, jnp.expand_dims(i, axis), axis=axis)
+        return picked if keepdims else jnp.squeeze(picked, axis=axis)
+    return _invoke(fn, [d, idx], name="pick")
+
+
+def gather_nd(data, indices):
+    d, idx = _nd(data), _nd(indices, _nd(data))
+
+    def fn(x, i):
+        jnp = _jnp()
+        i = i.astype(jnp.int32)
+        # reference layout: indices shape (M, ...), first axis indexes dims
+        return x[tuple(i[k] for k in range(i.shape[0]))]
+    return _invoke(fn, [d, idx], name="gather_nd")
+
+
+def scatter_nd(data, indices, shape):
+    d, idx = _nd(data), _nd(indices, _nd(data))
+    shape = tuple(shape)
+
+    def fn(x, i):
+        jnp = _jnp()
+        i = i.astype(jnp.int32)
+        out = jnp.zeros(shape, x.dtype)
+        return out.at[tuple(i[k] for k in range(i.shape[0]))].set(x)
+    return _invoke(fn, [d, idx], name="scatter_nd")
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    idx = _nd(indices)
+
+    def fn(i):
+        jnp = _jnp()
+        oh = _jax_nn("one_hot")(i.astype(jnp.int32), depth)
+        return (oh * (on_value - off_value) + off_value).astype(dtype)
+    return _invoke(fn, [idx], name="one_hot", differentiable=False)
+
+
+def where(condition, x, y):
+    c, a, b = _nd(condition), _nd(x), _nd(y)
+    return _invoke(lambda cc, aa, bb: _jnp().where(cc != 0, aa, bb), [c, a, b],
+                   name="where")
+
+
+def boolean_mask(data, index, axis=0):
+    # data-dependent shape: materialize on host (documented XLA limitation)
+    d, i = _nd(data), _nd(index)
+    mask = i.asnumpy().astype(bool)
+    return _array(_np.compress(mask, d.asnumpy(), axis=axis), ctx=d.ctx)
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """reference: Embedding op (src/operator/tensor/indexing_op.cc)."""
+    idx, w = _nd(data), _nd(weight)
+    return _invoke(
+        lambda i, ww: _jnp().take(ww, i.astype(_jnp().int32), axis=0),
+        [idx, w], name="Embedding")
+
+
+embedding = Embedding
+
+
+# ---------------------------------------------------------------------------
+# sorting (reference: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+def sort(data, axis=-1, is_ascend=True):
+    def fn(x):
+        jnp = _jnp()
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return _invoke(fn, [_nd(data)], name="sort")
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    def fn(x):
+        jnp = _jnp()
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(dtype)
+    return _invoke(fn, [_nd(data)], name="argsort", differentiable=False)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    """reference: topk — ret_typ in {value, indices, mask, both}."""
+    d = _nd(data)
+
+    def prep(x):
+        jnp = _jnp()
+        from jax import lax
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idxs = lax.top_k(-xm if is_ascend else xm, k)
+        if is_ascend:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idxs, -1, axis)
+
+    if ret_typ == "value":
+        return _invoke(lambda x: prep(x)[0], [d], name="topk")
+    if ret_typ == "indices":
+        return _invoke(lambda x: prep(x)[1].astype(dtype), [d], name="topk",
+                       differentiable=False)
+    if ret_typ == "both":
+        def fn(x):
+            v, i = prep(x)
+            return v, i.astype(dtype)
+        return _invoke(fn, [d], name="topk")
+    if ret_typ == "mask":
+        def fn(x):
+            jnp = _jnp()
+            _, i = prep(x)
+            im = jnp.moveaxis(i, axis, -1)
+            oh = _jax_nn("one_hot")(im, x.shape[axis]).sum(-2)
+            return jnp.moveaxis(oh, -1, axis).astype(x.dtype)
+        return _invoke(fn, [d], name="topk", differentiable=False)
+    raise MXNetError(f"topk: unknown ret_typ {ret_typ}")
+
+
+def clip(data, a_min=None, a_max=None):
+    return _invoke(lambda x: _jnp().clip(x, a_min, a_max), [_nd(data)],
+                   name="clip")
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: src/operator/sequence_mask.cc / _last / _reverse —
+# the era's long-sequence handling; see SURVEY §5.7)
+# ---------------------------------------------------------------------------
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    d = _nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return identity(d)
+    sl = _nd(sequence_length, d)
+
+    def fn(x, l):
+        jnp = _jnp()
+        T = x.shape[axis]
+        idx = jnp.arange(T)
+        shp = [1] * x.ndim
+        shp[axis] = T
+        bshp = [1] * x.ndim
+        bshp[1 - axis] = x.shape[1 - axis]
+        mask = idx.reshape(shp) < l.reshape(bshp)
+        return jnp.where(mask, x, value)
+    return _invoke(fn, [d, sl], name="SequenceMask")
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0):
+    d = _nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return slice_axis(d, axis, d.shape[axis] - 1, d.shape[axis]).squeeze(
+            axis=axis)
+    sl = _nd(sequence_length, d)
+
+    def fn(x, l):
+        jnp = _jnp()
+        last = (l.astype(jnp.int32) - 1)
+        xm = jnp.moveaxis(x, axis, 0)         # (T, B, ...)
+        return jnp.take_along_axis(
+            xm, last.reshape((1, -1) + (1,) * (xm.ndim - 2)), axis=0)[0]
+    return _invoke(fn, [d, sl], name="SequenceLast")
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0):
+    d = _nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return flip(d, axis)
+    sl = _nd(sequence_length, d)
+
+    def fn(x, l):
+        jnp = _jnp()
+        T = x.shape[axis]
+        xm = jnp.moveaxis(x, axis, 0)
+        idx = jnp.arange(T)[:, None]
+        li = l.astype(jnp.int32)[None, :]
+        rev = jnp.where(idx < li, li - 1 - idx, idx)
+        out = jnp.take_along_axis(
+            xm, rev.reshape(rev.shape + (1,) * (xm.ndim - 2)), axis=0)
+        return jnp.moveaxis(out, 0, axis)
+    return _invoke(fn, [d, sl], name="SequenceReverse")
+
+
+sequence_mask = SequenceMask
+sequence_last = SequenceLast
+sequence_reverse = SequenceReverse
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def add_n(*args):
+    """reference: ElementWiseSum/add_n."""
+    arrs = [_nd(a) for a in (args[0] if len(args) == 1 and
+                             isinstance(args[0], (list, tuple)) else args)]
+    def fn(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return _invoke(fn, arrs, name="add_n")
+
+
+ElementWiseSum = add_n
+
+
+def dropout(data, p=0.5, mode="training", axes=None):
+    """Eager dropout; gluon.nn.Dropout handles train/test mode."""
+    from .. import random as _random
+    d = _nd(data)
+    if p <= 0 or mode != "training":
+        return identity(d)
+    key = _random.new_key(d.ctx)
+
+    def fn(x):
+        import jax
+        jnp = _jnp()
+        shape = x.shape if axes is None else tuple(
+            x.shape[i] if i in axes else 1 for i in range(x.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return _invoke(fn, [d], name="dropout")
+
+
+Dropout = dropout
+
+
+def linalg_norm(data, **kw):
+    return norm(data, **kw)
+
+
+def make_loss(data):
+    return identity(data)
+
+
+def batch_take(a, indices):
+    d, i = _nd(a), _nd(indices)
+
+    def fn(x, idx):
+        jnp = _jnp()
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    return _invoke(fn, [d, i], name="batch_take")
+
+
+__all__ = [n for n in dir() if not n.startswith("_") and n not in
+           ("annotations", "builtins", "Optional", "NDArray", "MXNetError")]

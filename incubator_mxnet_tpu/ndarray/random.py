@@ -1,0 +1,146 @@
+"""``mx.nd.random`` namespace (reference: src/operator/random/sample_op.cc;
+python/mxnet/ndarray/random.py).  Samplers draw keys from the per-context
+stream in ``incubator_mxnet_tpu.random``."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import random as _random
+from ..context import current_context
+from .ndarray import NDArray, _invoke, _place
+
+__all__ = ["uniform", "normal", "randn", "randint", "poisson", "exponential",
+           "gamma", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "bernoulli"]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = jax.random.uniform(key, _shape(shape), dtype=_np.dtype(dtype),
+                             minval=low, maxval=high)
+    return _place(out, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = loc + scale * jax.random.normal(key, _shape(shape),
+                                          dtype=_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = jax.random.randint(key, _shape(shape), low, high,
+                             dtype=_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = jax.random.poisson(key, lam, _shape(shape)).astype(_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = scale * jax.random.exponential(key, _shape(shape),
+                                         dtype=_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = beta * jax.random.gamma(key, alpha, _shape(shape),
+                                  dtype=_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key1 = _random.new_key(ctx)
+    key2 = _random.new_key(ctx)
+    lam = jax.random.gamma(key1, k, _shape(shape)) * (1 - p) / p
+    out = jax.random.poisson(key2, lam).astype(_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key1 = _random.new_key(ctx)
+    key2 = _random.new_key(ctx)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(key1, r, _shape(shape)) * (1 - p) / p
+    out = jax.random.poisson(key2, lam).astype(_np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    import jax
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    out = jax.random.bernoulli(key, prob, _shape(shape)).astype(
+        _np.dtype(dtype))
+    return _place(out, ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample category indices from probability rows (reference:
+    sample_multinomial)."""
+    import jax
+    from .ndarray import array as _array
+    d = data if isinstance(data, NDArray) else _array(_np.asarray(data))
+    ctx = d.ctx
+    key = _random.new_key(ctx)
+    n = 1 if shape is None else int(_np.prod(_shape(shape)))
+
+    def fn(p):
+        import jax.numpy as jnp
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if p.ndim == 1:
+            out = jax.random.categorical(key, logits, shape=(n,))
+            return (out[0] if shape is None else
+                    out.reshape(_shape(shape))).astype(dtype)
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(p.shape[0], n))
+        if shape is None:
+            out = out[:, 0]
+        else:
+            out = out.reshape((p.shape[0],) + _shape(shape))
+        return out.astype(dtype)
+    return _invoke(fn, [d], name="multinomial", differentiable=False)
+
+
+def shuffle(data, **kw):
+    import jax
+    d = data
+    key = _random.new_key(d.ctx)
+    return _invoke(lambda x: jax.random.permutation(key, x, axis=0), [d],
+                   name="shuffle", differentiable=False)

@@ -1,0 +1,62 @@
+"""Stateful-looking RNG over jax's functional keys.
+
+Re-design of the reference RNG resources (reference: src/resource.cc
+ResourceRequest::kRandom, src/common/random_generator.h;
+python/mxnet/random.py ``mx.random.seed``).  The reference keeps per-device
+stateful generators; here each Context owns a key *stream*: ``seed()`` resets
+the stream, every consumer splits the next key off it.  Deterministic given a
+seed, parallel-safe, and jit-friendly (keys are values)."""
+from __future__ import annotations
+
+import threading
+
+from .context import Context, current_context
+
+__all__ = ["seed", "new_key", "current_key"]
+
+_lock = threading.Lock()
+_streams: dict = {}
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state: int, ctx="all"):
+    """Seed the RNG (reference: mx.random.seed(seed, ctx='all'))."""
+    global _streams
+    import jax
+    with _lock:
+        if ctx == "all":
+            _streams.clear()
+            _streams[None] = jax.random.PRNGKey(seed_state)
+        else:
+            _streams[Context(ctx)] = jax.random.PRNGKey(seed_state)
+
+
+def _stream_key(ctx):
+    # per-context stream if seeded per-context, else the global stream
+    if ctx in _streams:
+        return ctx
+    return None
+
+
+def new_key(ctx=None):
+    """Split the next key off the context's stream."""
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with _lock:
+        k = _stream_key(ctx)
+        if k not in _streams:
+            _streams[k] = jax.random.PRNGKey(_DEFAULT_SEED)
+        cur = _streams[k]
+        nxt, use = jax.random.split(cur)
+        _streams[k] = nxt
+        return use
+
+
+def current_key(ctx=None):
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with _lock:
+        k = _stream_key(ctx)
+        if k not in _streams:
+            _streams[k] = jax.random.PRNGKey(_DEFAULT_SEED)
+        return _streams[k]

@@ -1,0 +1,186 @@
+"""Autograd tests (model: reference tests/python/unittest/test_autograd.py
+and test_higher_order_grad.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def aeq(a, b, rtol=1e-5, atol=1e-6):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    aeq(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(4, 2).astype(np.float32))
+    x.attach_grad(); w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = nd.relu(y).sum()
+    z.backward()
+    mask = (x.asnumpy() @ w.asnumpy()) > 0
+    aeq(x.grad, mask.astype(np.float32) @ w.asnumpy().T, rtol=1e-4)
+    aeq(w.grad, x.asnumpy().T @ mask.astype(np.float32), rtol=1e-4)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    aeq(x.grad, [30.0, 300.0])
+
+
+def test_grad_req_add_and_null():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    aeq(x.grad, 4 * x.asnumpy())  # accumulated twice
+
+    z = nd.array([1.0])
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        w = z * 2
+    # ok: no grad flows anywhere, backward on a head with some taped input
+    assert z.grad is not None
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    aeq(x.grad, [4.0])  # only d(z)/dx via the explicit x factor
+    x2 = nd.array([2.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = nd.stop_gradient(x2 * x2) * x2
+    y2.backward()
+    aeq(x2.grad, [4.0])
+
+
+def test_retain_graph():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    aeq(x.grad, [6.0])
+    y.backward()
+    aeq(x.grad, [6.0])
+    with pytest.raises(mx.MXNetError):
+        y.backward()  # graph freed now
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g = autograd.grad(y, x)
+    aeq(g, 3 * x.asnumpy() ** 2)
+    assert np.all(x.grad.asnumpy() == 0)  # .grad untouched by grad()
+
+
+def test_higher_order():
+    # d^3/dx^3 sin(x) = -cos(x), via nested grad
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True)
+    g2.backward()
+    aeq(x.grad, -np.cos(x.asnumpy()), rtol=1e-4)
+
+
+def test_mul_inputs_second_order():
+    # f = x^2 * y ; d2f/dx2 = 2y ; cross term d/dy(df/dx) = 2x
+    x, y = nd.array([3.0]), nd.array([5.0])
+    x.attach_grad(); y.attach_grad()
+    with autograd.record():
+        f = x * x * y
+        gx = autograd.grad(f, x, create_graph=True)
+    gx.backward()
+    aeq(x.grad, [10.0])   # 2y
+    aeq(y.grad, [6.0])    # 2x
+
+
+def test_train_vs_predict_mode():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_no_record_no_tape():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # outside record
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    aeq(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    aeq(g, [4.0])
+
+
+def test_int_inputs_dont_break_grad():
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    idx = nd.array([0, 2]).astype("int32")
+    x.attach_grad()
+    with autograd.record():
+        y = nd.take(x, idx, axis=0).sum()
+    y.backward()
+    expect = np.zeros((4, 3), np.float32)
+    expect[[0, 2]] = 1
+    aeq(x.grad, expect)

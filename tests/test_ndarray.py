@@ -1,0 +1,234 @@
+"""NDArray tests (model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def test_creation():
+    assert nd.zeros((2, 3)).shape == (2, 3)
+    assert nd.ones(4).asnumpy().sum() == 4
+    assert nd.full((2, 2), 7).asnumpy()[0, 0] == 7
+    assert nd.arange(5).shape == (5,)
+    assert nd.arange(0, 4, repeat=2).shape == (8,)
+    assert nd.eye(3).asnumpy()[1, 1] == 1
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.dtype("float32")  # list input defaults to float32
+    b = nd.array(np.float64([1.5]))  # float64 downcast to float32 by default
+    assert b.dtype == np.dtype("float32")
+    c = nd.array(np.array([1, 2], np.int8))
+    assert c.dtype == np.dtype("int8")  # numpy input keeps dtype
+
+
+def test_arith_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    assert_almost_equal(a + b, np.array([[11, 22], [13, 24]], np.float32))
+    assert_almost_equal(a - 1, np.array([[0, 1], [2, 3]], np.float32))
+    assert_almost_equal(2 / a, 2 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(a % 3, a.asnumpy() % 3)
+    assert_almost_equal(nd.maximum(a, 2.5), np.maximum(a.asnumpy(), 2.5))
+    assert_almost_equal(-a, -a.asnumpy())
+
+
+def test_comparisons_are_float():
+    a = nd.array([1.0, 2.0, 3.0])
+    e = a == 2.0
+    assert e.dtype == np.dtype("float32")
+    assert_almost_equal(e, [0, 1, 0])
+    assert_almost_equal(a > 1.5, [0, 1, 1])
+
+
+def test_inplace_ops():
+    a = nd.ones((3,))
+    a += 2
+    assert_almost_equal(a, [3, 3, 3])
+    a *= 2
+    assert_almost_equal(a, [6, 6, 6])
+    a[1] = 0
+    assert_almost_equal(a, [6, 0, 6])
+    a[:] = 5
+    assert_almost_equal(a, [5, 5, 5])
+
+
+def test_indexing():
+    a = nd.arange(12).reshape(3, 4)
+    assert a[1].shape == (4,)
+    assert a[1, 2].asscalar() == 6
+    assert a[0:2].shape == (2, 4)
+    assert a[:, 1::2].shape == (3, 2)
+    idx = nd.array([0, 2])
+    assert nd.take(a, idx, axis=0).shape == (2, 4)
+    got = a[nd.array([0, 2]).astype("int32"), :]
+    assert got.shape == (2, 4)
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape(0, -1).shape == (2, 12)
+    assert a.reshape(-2,).shape == (2, 3, 4)
+    assert a.reshape(-3, 0).shape == (6, 4)
+    assert a.reshape(0, -4, 3, 1, 0).shape == (2, 3, 1, 4)
+    assert a.reshape(6, -1).shape == (6, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert_almost_equal(a.sum(), a.asnumpy().sum())
+    assert_almost_equal(a.sum(axis=1), a.asnumpy().sum(1))
+    assert_almost_equal(a.mean(axis=(0, 2)), a.asnumpy().mean((0, 2)))
+    assert_almost_equal(a.max(axis=2, keepdims=True),
+                        a.asnumpy().max(2, keepdims=True))
+    assert a.argmax(axis=1).dtype == np.dtype("float32")
+    assert_almost_equal(nd.norm(a), np.sqrt((a.asnumpy() ** 2).sum()))
+
+
+def test_dot_and_batch_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy() @ b.asnumpy())
+    assert_almost_equal(nd.dot(a, b.T.copy(), transpose_b=True),
+                        a.asnumpy() @ b.asnumpy())
+    x = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    y = nd.array(np.random.rand(2, 4, 5).astype(np.float32))
+    assert_almost_equal(nd.batch_dot(x, y),
+                        np.matmul(x.asnumpy(), y.asnumpy()))
+
+
+def test_shape_ops():
+    a = nd.arange(6).reshape(2, 3)
+    assert nd.transpose(a).shape == (3, 2)
+    assert nd.expand_dims(a, 1).shape == (2, 1, 3)
+    assert nd.concat(a, a, dim=0).shape == (4, 3)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3)
+    parts = nd.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    sq = nd.split(a, 3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2,)
+    assert nd.tile(a, (2, 2)).shape == (4, 6)
+    assert nd.repeat(a, 2, axis=0).shape == (4, 3)
+    assert nd.flip(a, 1).asnumpy()[0, 0] == 2
+    assert nd.slice(a, (0, 1), (2, 3)).shape == (2, 2)
+    assert nd.slice_axis(a, 1, 0, 2).shape == (2, 2)
+    assert nd.pad(a.reshape(1, 1, 2, 3), mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).shape == (1, 1, 4, 5)
+    assert nd.broadcast_to(nd.ones((1, 3)), (4, 3)).shape == (4, 3)
+    assert nd.broadcast_axis(nd.ones((1, 3)), axis=0, size=5).shape == (5, 3)
+    assert nd.where(a > 2, a, nd.zeros_like(a)).asnumpy()[0, 0] == 0
+
+
+def test_activations():
+    x = nd.array([-2.0, 0.0, 2.0])
+    assert_almost_equal(nd.relu(x), [0, 0, 2])
+    assert_almost_equal(nd.sigmoid(x), 1 / (1 + np.exp([2.0, 0, -2.0])),
+                        rtol=1e-4)
+    assert_almost_equal(nd.softmax(x).sum(), 1.0)
+    assert_almost_equal(nd.log_softmax(x), np.log(nd.softmax(x).asnumpy()),
+                        rtol=1e-4)
+    assert_almost_equal(nd.leaky_relu(x, slope=0.1), [-0.2, 0, 2])
+    assert_almost_equal(nd.Activation(x, "tanh"), np.tanh(x.asnumpy()),
+                        rtol=1e-4)
+
+
+def test_softmax_with_length():
+    x = nd.array(np.random.rand(2, 5).astype(np.float32))
+    ln = nd.array([3, 5])
+    out = nd.softmax(x, axis=-1, length=ln).asnumpy()
+    assert out[0, 3:].sum() == 0
+    np.testing.assert_allclose(out.sum(-1), [1, 1], rtol=1e-5)
+
+
+def test_ordering():
+    x = nd.array([3.0, 1.0, 2.0])
+    assert_almost_equal(nd.sort(x), [1, 2, 3])
+    assert_almost_equal(nd.sort(x, is_ascend=False), [3, 2, 1])
+    assert_almost_equal(nd.argsort(x), [1, 2, 0])
+    assert_almost_equal(nd.topk(x, k=2), [0, 2])       # indices, descending
+    assert_almost_equal(nd.topk(x, k=2, ret_typ="value"), [3, 2])
+    v, i = nd.topk(x, k=1, ret_typ="both")
+    assert v.asscalar() == 3 and i.asscalar() == 0
+
+
+def test_pick_onehot_gather():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert_almost_equal(nd.pick(x, nd.array([0, 1])), [1, 4])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    assert_almost_equal(oh, [[1, 0, 0], [0, 0, 1]])
+    g = nd.gather_nd(x, nd.array([[0, 1], [0, 1]]))
+    assert_almost_equal(g, [1, 4])
+
+
+def test_sequence_ops():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))  # (T,B,C)
+    sl = nd.array([2, 3])
+    m = nd.SequenceMask(x, sl, use_sequence_length=True, value=-1)
+    assert m.asnumpy()[2, 0, 0] == -1 and m.asnumpy()[2, 1, 0] == 10
+    last = nd.SequenceLast(x, sl, use_sequence_length=True)
+    assert last.shape == (2, 2)
+    np.testing.assert_allclose(last.asnumpy()[0], x.asnumpy()[1, 0])
+    rev = nd.SequenceReverse(x, sl, use_sequence_length=True)
+    np.testing.assert_allclose(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+
+
+def test_cast_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.dtype("float16")
+    c = a.copyto(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context == mx.cpu(0)
+    a2 = nd.zeros((2, 2))
+    a.copyto(a2)
+    assert_almost_equal(a2, np.ones((2, 2)))
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert bool(a)
+    assert a.item() == 3.5
+    with pytest.raises(mx.MXNetError):
+        nd.ones((2,)).asscalar()
+
+
+def test_random():
+    mx.random.seed(7)
+    u1 = nd.random.uniform(shape=(100,))
+    mx.random.seed(7)
+    u2 = nd.random.uniform(shape=(100,))
+    assert_almost_equal(u1, u2)  # deterministic under same seed
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.mean().asscalar())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    # shape-check the rest of the sampler family
+    assert nd.random.poisson(2.0, shape=(5,)).shape == (5,)
+    assert nd.random.exponential(1.0, shape=(5,)).shape == (5,)
+    assert nd.random.gamma(2.0, 2.0, shape=(5,)).shape == (5,)
+
+
+def test_add_n_and_misc():
+    a, b, c = nd.ones((2,)), nd.ones((2,)) * 2, nd.ones((2,)) * 3
+    assert_almost_equal(nd.add_n(a, b, c), [6, 6])
+    assert_almost_equal(nd.clip(nd.array([-1.0, 5.0]), 0, 1), [0, 1])
+    assert nd.shape_array(a).asnumpy()[0] == 2
+    assert nd.stop_gradient(a) is not None
+    assert_almost_equal(nd.smooth_l1(nd.array([0.5, 2.0])), [0.125, 1.5])
+
+
+def test_waitall_and_async_error_surfacing():
+    nd.waitall()
+    # async error should surface at sync point as MXNetError
+    with pytest.raises(Exception):
+        bad = nd.dot(nd.ones((2, 3)), nd.ones((2, 3)))  # shape mismatch
+        bad.wait_to_read()
