@@ -8,9 +8,11 @@ from .ndarray import (NDArray, array, zeros, ones, empty, full, arange, eye,
                       linspace, from_jax, concatenate, waitall)
 from .ops import *  # noqa: F401,F403
 from .ops import __all__ as _ops_all
+from .nn import *  # noqa: F401,F403
+from .nn import __all__ as _nn_all
 from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
 
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
             "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
-            "op"] + list(_ops_all))
+            "op"] + list(_ops_all) + list(_nn_all))

@@ -1,0 +1,240 @@
+"""NN operator numerics (reference test model: tests/python/unittest/
+test_operator.py conv/pool/norm/rnn sections, checked against torch-CPU as
+the independent oracle the reference uses NumPy refs for)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import incubator_mxnet_tpu as mx
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_fully_connected():
+    x, w, b = _rand(4, 7), _rand(5, 7), _rand(5)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w),
+                               mx.nd.array(b), num_hidden=5)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fully_connected_flatten():
+    x, w = _rand(4, 3, 5), _rand(6, 15)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                               num_hidden=6)
+    np.testing.assert_allclose(out.asnumpy(), x.reshape(4, -1) @ w.T,
+                               rtol=1e-5, atol=1e-5)
+    out2 = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(_rand(6, 5)),
+                                no_bias=True, num_hidden=6, flatten=False)
+    assert out2.shape == (4, 3, 6)
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (2, 1), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_conv2d_vs_torch(stride, pad, dilate, groups):
+    x = _rand(2, 4, 9, 8)
+    w = _rand(6, 4 // groups, 3, 3)
+    b = _rand(6)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), stride=stride, pad=pad,
+                            dilate=dilate, num_filter=6, num_group=groups)
+    ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                   torch.from_numpy(b), stride=stride, padding=pad,
+                   dilation=dilate, groups=groups).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_conv3d():
+    x1, w1 = _rand(2, 3, 10), _rand(5, 3, 3)
+    o1 = mx.nd.Convolution(mx.nd.array(x1), mx.nd.array(w1), no_bias=True,
+                           kernel=(3,), num_filter=5)
+    r1 = F.conv1d(torch.from_numpy(x1), torch.from_numpy(w1)).numpy()
+    np.testing.assert_allclose(o1.asnumpy(), r1, rtol=1e-4, atol=1e-4)
+
+    x3, w3 = _rand(1, 2, 5, 6, 7), _rand(4, 2, 2, 2, 2)
+    o3 = mx.nd.Convolution(mx.nd.array(x3), mx.nd.array(w3), no_bias=True,
+                           kernel=(2, 2, 2), num_filter=4)
+    r3 = F.conv3d(torch.from_numpy(x3), torch.from_numpy(w3)).numpy()
+    np.testing.assert_allclose(o3.asnumpy(), r3, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("stride,pad,adj", [
+    ((1, 1), (0, 0), (0, 0)),
+    ((2, 2), (1, 1), (0, 0)),
+    ((2, 2), (1, 1), (1, 1)),
+])
+def test_deconv2d_vs_torch(stride, pad, adj):
+    x = _rand(2, 4, 5, 6)
+    w = _rand(4, 3, 3, 3)   # (in, out, kh, kw)
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                              kernel=(3, 3), stride=stride, pad=pad, adj=adj,
+                              num_filter=3)
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=stride, padding=pad,
+                             output_padding=adj).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool2d_vs_torch(ptype):
+    x = _rand(2, 3, 8, 9)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type=ptype)
+    t = torch.from_numpy(x)
+    ref = (F.max_pool2d(t, 2, 2) if ptype == "max"
+           else F.avg_pool2d(t, 2, 2)).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pool_global_and_full_convention():
+    x = _rand(2, 3, 7, 7)
+    g = mx.nd.Pooling(mx.nd.array(x), pool_type="avg", global_pool=True)
+    np.testing.assert_allclose(g.asnumpy(),
+                               x.mean(axis=(2, 3), keepdims=True),
+                               rtol=1e-5, atol=1e-5)
+    # full (ceil) convention: 7 with k=2,s=2 -> ceil -> 4
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", pooling_convention="full")
+    assert out.shape == (2, 3, 4, 4)
+
+
+def test_batchnorm_train_and_global():
+    x, g, b = _rand(4, 3, 5, 5), _rand(3), _rand(3)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          mx.nd.array(mm), mx.nd.array(mv), fix_gamma=False)
+    ref = F.batch_norm(torch.from_numpy(x), None, None,
+                       torch.from_numpy(g), torch.from_numpy(b),
+                       training=True, eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+    out2 = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                           mx.nd.array(mm), mx.nd.array(mv),
+                           use_global_stats=True, fix_gamma=False)
+    ref2 = F.batch_norm(torch.from_numpy(x), torch.from_numpy(mm),
+                        torch.from_numpy(mv), torch.from_numpy(g),
+                        torch.from_numpy(b), training=False,
+                        eps=1e-5).numpy()
+    np.testing.assert_allclose(out2.asnumpy(), ref2, rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_vs_torch():
+    x, g, b = _rand(4, 6, 8), _rand(8), _rand(8)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b))
+    ref = F.layer_norm(torch.from_numpy(x), (8,), torch.from_numpy(g),
+                       torch.from_numpy(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_groupnorm_vs_torch():
+    x, g, b = _rand(2, 6, 4, 4), _rand(6), _rand(6)
+    out = mx.nd.GroupNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          num_groups=3)
+    ref = F.group_norm(torch.from_numpy(x), 3, torch.from_numpy(g),
+                       torch.from_numpy(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def _torch_lstm_ref(x, params, h0, c0, H, num_layers=1, bidirectional=False):
+    rnn = torch.nn.LSTM(x.shape[2], H, num_layers=num_layers,
+                        bidirectional=bidirectional)
+    # copy our flat-vector slices into torch's parameter tensors
+    ndir = 2 if bidirectional else 1
+    ng = 4
+    off = 0
+    with torch.no_grad():
+        for layer in range(num_layers):
+            in_sz = x.shape[2] if layer == 0 else H * ndir
+            for d in range(ndir):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                wi = params[off:off + ng * H * in_sz].reshape(ng * H, in_sz)
+                off += ng * H * in_sz
+                wh = params[off:off + ng * H * H].reshape(ng * H, H)
+                off += ng * H * H
+                getattr(rnn, "weight_ih" + sfx).copy_(torch.from_numpy(wi))
+                getattr(rnn, "weight_hh" + sfx).copy_(torch.from_numpy(wh))
+        for layer in range(num_layers):
+            for d in range(ndir):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                bi = params[off:off + ng * H]; off += ng * H
+                bh = params[off:off + ng * H]; off += ng * H
+                getattr(rnn, "bias_ih" + sfx).copy_(torch.from_numpy(bi))
+                getattr(rnn, "bias_hh" + sfx).copy_(torch.from_numpy(bh))
+    out, (hn, cn) = rnn(torch.from_numpy(x), (torch.from_numpy(h0),
+                                              torch.from_numpy(c0)))
+    return out.detach().numpy(), hn.detach().numpy(), cn.detach().numpy()
+
+
+@pytest.mark.parametrize("layers,bidir", [(1, False), (2, False), (1, True)])
+def test_rnn_lstm_vs_torch(layers, bidir):
+    T, N, C, H = 5, 3, 4, 6
+    ndir = 2 if bidir else 1
+    x = _rand(T, N, C)
+    psize = mx.nd.rnn_param_size("lstm", C, H, layers, bidir)
+    params = _rand(psize)
+    h0 = np.zeros((layers * ndir, N, H), np.float32)
+    c0 = np.zeros((layers * ndir, N, H), np.float32)
+    out, hn, cn = mx.nd.RNN(mx.nd.array(x), mx.nd.array(params),
+                            mx.nd.array(h0), mx.nd.array(c0), state_size=H,
+                            num_layers=layers, bidirectional=bidir,
+                            mode="lstm", state_outputs=True)
+    # torch LSTM gate order [i,f,g,o] matches cuDNN/MXNet
+    rout, rhn, rcn = _torch_lstm_ref(x, params, h0, c0, H, layers, bidir)
+    np.testing.assert_allclose(out.asnumpy(), rout, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(hn.asnumpy(), rhn, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(cn.asnumpy(), rcn, rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_gru_shapes_and_grad():
+    T, N, C, H = 4, 2, 3, 5
+    x = mx.nd.array(_rand(T, N, C))
+    psize = mx.nd.rnn_param_size("gru", C, H)
+    params = mx.nd.array(_rand(psize))
+    params.attach_grad()
+    h0 = mx.nd.zeros((1, N, H))
+    with mx.autograd.record():
+        out = mx.nd.RNN(x, params, h0, state_size=H, mode="gru")
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (T, N, H)
+    assert params.grad is not None
+    assert float(mx.nd.abs(params.grad).sum().asscalar()) > 0
+
+
+def test_conv_grad_matches_torch():
+    x, w = _rand(2, 3, 6, 6), _rand(4, 3, 3, 3)
+    mxx, mxw = mx.nd.array(x), mx.nd.array(w)
+    mxx.attach_grad(); mxw.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Convolution(mxx, mxw, no_bias=True, kernel=(3, 3),
+                                num_filter=4)
+        loss = (out * out).sum()
+    loss.backward()
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tw = torch.from_numpy(w).requires_grad_(True)
+    tout = F.conv2d(tx, tw)
+    (tout * tout).sum().backward()
+    np.testing.assert_allclose(mxx.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(mxw.grad.asnumpy(), tw.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_softmax_output_backward():
+    x = mx.nd.array(_rand(4, 5))
+    label = mx.nd.array(np.array([0, 1, 2, 3], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        p = mx.nd.SoftmaxOutput(x, label)
+    p.backward()
+    pn = p.asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(x.grad.asnumpy(), pn - onehot, rtol=1e-5,
+                               atol=1e-5)
