@@ -28,8 +28,12 @@ from . import ndarray
 from . import ndarray as nd
 from . import autograd
 from . import random
+from . import initializer
+from . import initializer as init
+from . import gluon
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
-           "autograd", "random", "base", "context"]
+           "autograd", "random", "base", "context", "initializer", "init",
+           "gluon"]

@@ -1,0 +1,9 @@
+"""Gluon: the define-by-run frontend (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import utils
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "nn", "loss", "utils"]

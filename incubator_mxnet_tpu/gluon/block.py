@@ -1,0 +1,471 @@
+"""Gluon Block / HybridBlock (reference: python/mxnet/gluon/block.py).
+
+TPU-native re-design of the define-by-run module system:
+
+* ``Block`` — pure imperative container, same registration/naming/param
+  collection semantics as the reference.
+* ``HybridBlock.hybridize()`` — the reference traces one forward into an
+  nnvm graph executed by CachedOp (reference: src/imperative/cached_op.cc).
+  Here ``hybridize`` traces the SAME eager code under ``jax.jit``: one
+  compiled XLA program per (input shapes/dtypes, train-mode) key.  The whole
+  forward becomes a single fused program — strictly stronger than the
+  reference's op-bulking.  Autograd sees the jitted call as one tape node
+  whose VJP is jax's VJP of the compiled function.
+* BatchNorm-style running statistics are functional under the trace: layers
+  route updates through ``update_aux``, which a trace collector turns into
+  extra outputs of the compiled program, written back after each call
+  (the reference mutates aux NDArrays inside the op instead).
+* RNG under the trace flows through ``mx.random.trace_stream`` so dropout
+  gets a fresh, traced key argument per call.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import autograd as _ag
+from .. import random as _random
+from ..ndarray import ndarray as _ndmod
+from ..ndarray.ndarray import NDArray, _invoke
+from .parameter import (Parameter, ParameterDict,
+                        DeferredInitializationError)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "update_aux"]
+
+_naming = threading.local()
+_trace = threading.local()
+
+
+def _counters():
+    if not hasattr(_naming, "counters"):
+        _naming.counters = [{}]   # stack of per-scope counters
+        _naming.prefixes = [""]
+    return _naming
+
+
+def _gen_prefix(hint: str) -> str:
+    st = _counters()
+    cnt = st.counters[-1]
+    i = cnt.get(hint, 0)
+    cnt[hint] = i + 1
+    return f"{st.prefixes[-1]}{hint}{i}_"
+
+
+class _NameScope:
+    """Prefix scope entered during child construction (reference:
+    block.py _BlockScope)."""
+
+    def __init__(self, prefix):
+        self._prefix = prefix
+
+    def __enter__(self):
+        st = _counters()
+        st.prefixes.append(self._prefix)
+        st.counters.append({})
+        return self
+
+    def __exit__(self, *exc):
+        st = _counters()
+        st.prefixes.pop()
+        st.counters.pop()
+        return False
+
+
+def update_aux(param: Parameter, new_value):
+    """Write a new value into an auxiliary (non-differentiable) parameter —
+    running stats etc.  Eagerly sets the data; under a hybridize trace the
+    value is collected and becomes an output of the compiled program."""
+    coll = getattr(_trace, "collector", None)
+    jval = new_value._data if isinstance(new_value, NDArray) else new_value
+    if coll is not None:
+        coll[id(param)] = jval
+    else:
+        param._data._set_data(jval.astype(param._data.dtype))
+
+
+class Block:
+    """Base container (reference: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix = (prefix if prefix is not None
+                        else _gen_prefix(self._alias()))
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+        self._scope = _NameScope(self._prefix)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    def name_scope(self):
+        """Context manager giving children this block's name prefix."""
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All params of self + descendants, optionally regex-filtered
+        (reference: Block.collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            ret.update({k: v for k, v in self._params.items()
+                        if pat.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+                self.__dict__.get("_params", ParameterDict())._params[
+                    value.name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """No-op on plain Blocks except propagation (reference behavior)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # ------------------------------------------------------------------
+    # serialization (reference: save_parameters uses structural names from
+    # _collect_params_with_prefix, e.g. "features.0.weight")
+    # ------------------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + n: p for n, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename):
+        from ..ndarray import utils as nd_utils
+        params = self._collect_params_with_prefix()
+        nd_utils.save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError(f"{filename} does not contain a parameter dict")
+        # strip legacy "arg:"/"aux:" prefixes (reference checkpoint compat)
+        loaded = {k.split(":", 1)[-1] if k.startswith(("arg:", "aux:"))
+                  else k: v for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        if not any(k in params for k in loaded) and loaded:
+            # fall back to full-name (prefixed) matching
+            byname = {p.name: p for p in self.collect_params().values()}
+            params = byname
+        for name, p in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(
+                        f"Parameter {name} missing in {filename}")
+                continue
+            p.set_data(loaded[name])
+        if not ignore_extra:
+            for k in loaded:
+                if k not in params:
+                    raise MXNetError(
+                        f"Parameter {k} from {filename} not found in Block")
+
+    save_params = save_parameters      # deprecated aliases kept for parity
+    load_params = load_parameters
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: Block.summary)."""
+        rows = []
+
+        def walk(block, indent=0):
+            pcount = sum(int(_np.prod(p.shape)) if p.shape else 0
+                         for p in block._reg_params.values())
+            rows.append((("  " * indent) + block.__class__.__name__,
+                         block.name, pcount))
+            for c in block._children.values():
+                walk(c, indent + 1)
+        walk(self)
+        total = sum(r[2] for r in rows)
+        lines = [f"{'Layer':<40}{'Name':<28}{'Params':>12}", "-" * 80]
+        lines += [f"{r[0]:<40}{r[1]:<28}{r[2]:>12}" for r in rows]
+        lines += ["-" * 80, f"{'Total params':<68}{total:>12}"]
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}("
+        for name, child in self._children.items():
+            c = repr(child).replace("\n", "\n  ")
+            s += f"\n  ({name}): {c}"
+        return s + ("\n)" if self._children else ")")
+
+
+# ---------------------------------------------------------------------------
+class _CachedGraph:
+    """The CachedOp analog: per-(shape/dtype/mode) jitted executables
+    (reference: src/imperative/cached_op.cc CachedOp)."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False):
+        self.block = block
+        self.static_alloc = static_alloc
+        self.static_shape = static_shape
+        self._cache = {}
+
+    def _key(self, arrs, training, recording):
+        return (tuple((a.shape, str(a.dtype)) for a in arrs), training,
+                recording)
+
+    def _param_lists(self):
+        params = list(self.block.collect_params().values())
+        trainable = [p for p in params if p.grad_req != "null"]
+        aux = [p for p in params if p.grad_req == "null"]
+        return trainable, aux
+
+    def __call__(self, *args):
+        import jax
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        trainable, aux = self._param_lists()
+        training = _ag.is_training()
+        key = self._key(inputs, training, False)
+
+        if key not in self._cache:
+            block = self.block
+            n_in, n_tr = len(inputs), len(trainable)
+            aux_ids = [id(p) for p in aux]
+
+            def pure(in_vals, tr_vals, aux_vals, rng_key):
+                all_params = trainable + aux
+                all_vals = list(tr_vals) + list(aux_vals)
+                saved = [p._data._data for p in all_params]
+                coll = {}
+                try:
+                    for p, v in zip(all_params, all_vals):
+                        p._data._set_data(v)
+                    _trace.collector = coll
+                    with _ag.pause(train_mode=training), \
+                            _random.trace_stream(rng_key):
+                        nds = [NDArray(v, ctx=i.ctx)
+                               for v, i in zip(in_vals, inputs)]
+                        out = block._forward_eager(*nds)
+                finally:
+                    _trace.collector = None
+                    for p, v in zip(all_params, saved):
+                        p._data._set_data(v)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                out_vals = tuple(o._data for o in outs)
+                new_aux = tuple(coll.get(i, v)
+                                for i, v in zip(aux_ids, aux_vals))
+                return out_vals, new_aux
+
+            self._cache[key] = jax.jit(pure)
+        jitted = self._cache[key]
+
+        aux_vals = tuple(p.data()._data for p in aux)
+        rng_key = _random.new_key()
+        n_out_holder = {}
+
+        def call_fn(*arrs):
+            ins = arrs[:len(inputs)]
+            trs = arrs[len(inputs):]
+            out_vals, new_aux = jitted(ins, trs, aux_vals, rng_key)
+            n_out_holder["n"] = len(out_vals)
+            return tuple(out_vals) + tuple(new_aux)
+
+        res = _invoke(call_fn,
+                      list(inputs) + [p.data() for p in trainable],
+                      name=f"CachedOp[{self.block.name}]")
+        res = res if isinstance(res, list) else [res]
+        n_out = n_out_holder["n"]
+        outs, new_aux = res[:n_out], res[n_out:]
+        for p, v in zip(aux, new_aux):
+            p._data._set_data(v._data)
+        if len(outs) == 1:
+            return outs[0]
+        return tuple(outs)
+
+
+class HybridBlock(Block):
+    """Block whose forward is written against the dual eager/traced API
+    (reference: gluon.HybridBlock with hybrid_forward(F, x, ...))."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = None
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._cached_graph = (_CachedGraph(self, static_alloc, static_shape)
+                              if active else None)
+        # children run inline inside this block's trace; their own caches
+        # stay whatever the user set, we only propagate when deactivating
+        for child in self._children.values():
+            if not active:
+                child.hybridize(False, **kwargs)
+
+    def infer_shape(self, *args):
+        """Layer-specific deferred-shape completion hook.  Layers with
+        in_units/in_channels=0 params override this (reference: HybridBlock
+        infer_shape via symbolic inference)."""
+        raise MXNetError(
+            f"{self.__class__.__name__} has deferred-shape parameters but "
+            "does not implement infer_shape; initialize with explicit "
+            "input dims")
+
+    def _params_kwargs(self):
+        kw = {}
+        for name, p in self._reg_params.items():
+            kw[name] = p.data()
+        return kw
+
+    def _forward_eager(self, *args):
+        from .. import ndarray as F
+        try:
+            kw = self._params_kwargs()
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            kw = self._params_kwargs()
+        return self.hybrid_forward(F, *args, **kw)
+
+    def forward(self, *args):
+        if self._active and self._cached_graph is not None \
+                and getattr(_trace, "collector", None) is None:
+            # ensure deferred shapes are settled before tracing
+            for p in self.collect_params().values():
+                if p._deferred_init is not None:
+                    return self._forward_eager(*args)
+            return self._cached_graph(*args)
+        return self._forward_eager(*args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Serialize for deployment (reference: HybridBlock.export →
+        json+params pair).  Graph json comes from the symbol layer."""
+        from ..ndarray import utils as nd_utils
+        params = self._collect_params_with_prefix()
+        nd_utils.save(f"{path}-{epoch:04d}.params",
+                      {"arg:" + k: v.data() for k, v in params.items()})
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True)
+        return self(x, *args)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a Block from a Symbol graph (reference: gluon.SymbolBlock).
+    Implemented with the symbol layer; see incubator_mxnet_tpu/symbol."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        from ..ndarray import utils as nd_utils
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            loaded = nd_utils.load(param_file)
+            loaded = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+            for name, v in loaded.items():
+                p = Parameter(name, shape=v.shape, dtype=v.dtype)
+                p.set_data(v)
+                ret._params._params[name] = p
+                ret._reg_params[name] = p
+        return ret
+
+    def _forward_eager(self, *args):
+        bindings = {n: a for n, a in zip(
+            [i.name for i in self._inputs], args)}
+        for name, p in self._params.items():
+            bindings[name] = p.data()
+        return self._outputs.eval_dict(bindings)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,364 @@
+"""Loss layers (reference: python/mxnet/gluon/loss.py).
+
+Each loss is a HybridBlock; ``weight`` rescales, ``batch_axis`` is the axis
+averaged over last, sample_weight broadcasts in — all matching the
+reference's ``_apply_weighting`` semantics.  CTCLoss is a log-semiring
+``lax.scan`` over the extended label sequence (the reference wraps warp-ctc /
+cudnn CTC; reference: src/operator/nn/ctc_loss.cc).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return x.reshape(y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}(batch_axis={self._batch_axis}, "
+                f"w={self._weight})")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def _mean_all_but_batch(self, F, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return F.mean(loss, axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(label - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """reference: SigmoidBCELoss — numerically stable log-sum-exp form."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        label = _reshape_like(F, label, pred)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = F.relu(pred) - pred * label + \
+                    F.Activation(-F.abs(pred), act_type="softrelu")
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = F.relu(pred) - pred * label + log_weight * \
+                    (F.Activation(-F.abs(pred), act_type="softrelu")
+                     + F.relu(-pred))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(F.log(pred + eps) * label
+                         + F.log(1. - pred + eps) * (1. - label))
+            else:
+                loss = -(F.log(pred + eps) * label * pos_weight
+                         + F.log(1. - pred + eps) * (1. - label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """reference: SoftmaxCELoss — sparse_label picks, dense does -sum."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        else:
+            label = _reshape_like(F, label, pred)
+            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(loss))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.relu(self._margin - pred * label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise MXNetError(f"bad label_format {label_format}")
+        self._label_format = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = F.relu(pred) - pred * label + \
+            F.Activation(-F.abs(pred), act_type="softrelu")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return self._mean_all_but_batch(F, loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative,
+                       sample_weight=None):
+        positive = _reshape_like(F, positive, pred)
+        negative = _reshape_like(F, negative, pred)
+        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
+                     axis=self._batch_axis + 1 if pred.ndim > 1 else ())
+        loss = F.relu(loss + self._margin)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        target = _reshape_like(F, target, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - target * pred
+        else:
+            loss = pred - target * F.log(pred + epsilon)
+        if self._compute_full:
+            # Stirling approximation for log(target!)
+            stirling = (target * F.log(target + 1e-12) - target
+                        + 0.5 * F.log(2 * _np.pi * (target + 1e-12)))
+            stirling = F.where(target <= 1, F.zeros_like(stirling), stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        input1 = _reshape_like(F, input1, input2)
+        cos = (F.sum(input1 * input2, axis=-1)
+               / (F.norm(input1, axis=-1) * F.norm(input2, axis=-1) + 1e-12))
+        label = label.reshape(cos.shape)
+        loss = F.where(label == 1, 1.0 - cos,
+                       F.relu(cos - self._margin))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification (reference: gluon.loss.CTCLoss,
+    layout TNC, blank label first or last).
+
+    Implemented as a log-semiring forward (alpha) recursion with
+    ``lax.scan`` over time — static shapes, one fused XLA loop, replacing
+    the reference's warp-ctc binding.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"bad layout {layout}")
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        from ..ndarray.ndarray import _invoke
+
+        if self._layout == "NTC":
+            pred = pred.transpose((1, 0, 2))    # -> TNC
+        if self._label_layout == "TN":
+            label = label.transpose((1, 0))
+
+        T, N, C = pred.shape
+        L = label.shape[1]
+        inputs = [pred, label]
+        has_pl = pred_lengths is not None
+        has_ll = label_lengths is not None
+        if has_pl:
+            inputs.append(pred_lengths)
+        if has_ll:
+            inputs.append(label_lengths)
+
+        def ctc(p, lab, *rest):
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            idx = 0
+            pl = rest[idx].astype(jnp.int32) if has_pl else \
+                jnp.full((N,), T, jnp.int32)
+            idx += int(has_pl)
+            ll = rest[idx].astype(jnp.int32) if has_ll else \
+                jnp.full((N,), L, jnp.int32)
+
+            logp = jax.nn.log_softmax(p, axis=-1)
+            blank = 0
+            # extended label seq: blank, l1, blank, l2, ... blank (len 2L+1)
+            S = 2 * L + 1
+            lab = lab.astype(jnp.int32)
+            ext = jnp.full((N, S), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(lab)
+            ext_valid = jnp.arange(S)[None, :] < (2 * ll + 1)[:, None]
+
+            # can-skip mask: alpha[s] may come from s-2 when ext[s] != blank
+            # and ext[s] != ext[s-2]
+            ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                             constant_values=-1)[:, :S]
+            can_skip = (ext != blank) & (ext != ext_m2)
+
+            neg_inf = -1e30
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(ll > 0,
+                          jnp.take_along_axis(
+                              logp[0], ext[:, 1:2], axis=1)[:, 0],
+                          neg_inf))
+
+            def lse(a, b):
+                m = jnp.maximum(a, b)
+                return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+            def step(alpha, logp_t):
+                a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                               constant_values=neg_inf)[:, :S]
+                a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                               constant_values=neg_inf)[:, :S]
+                a = lse(alpha, a_m1)
+                a = jnp.where(can_skip, lse(a, a_m2), a)
+                emit = jnp.take_along_axis(logp_t, ext, axis=1)
+                new = a + emit
+                new = jnp.where(ext_valid, new, neg_inf)
+                return new, new
+
+            _, alphas = lax.scan(step, alpha0, logp[1:])
+            alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+            # pick alpha at t = pl-1, s in {2ll, 2ll-1}
+            a_final = jnp.take_along_axis(
+                alphas, (pl - 1)[None, :, None], axis=0)[0]  # (N, S)
+            end1 = jnp.take_along_axis(a_final, (2 * ll)[:, None],
+                                       axis=1)[:, 0]
+            end2 = jnp.take_along_axis(
+                a_final, jnp.maximum(2 * ll - 1, 0)[:, None], axis=1)[:, 0]
+            end2 = jnp.where(ll > 0, end2, neg_inf)
+            return -lse(end1, end2)
+
+        loss = _invoke(ctc, inputs, name="CTCLoss")
+        return _apply_weighting(F, loss, self._weight, sample_weight)

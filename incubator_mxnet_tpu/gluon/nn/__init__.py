@@ -1,0 +1,10 @@
+"""``mx.gluon.nn`` neural-network layers (reference:
+python/mxnet/gluon/nn/__init__.py)."""
+from .activations import *  # noqa: F401,F403
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *  # noqa: F401,F403
+from .activations import __all__ as _a
+from .basic_layers import __all__ as _b
+from .conv_layers import __all__ as _c
+
+__all__ = list(_a) + list(_b) + list(_c)

@@ -1,0 +1,381 @@
+"""Basic Gluon layers (reference: python/mxnet/gluon/nn/basic_layers.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import Block, HybridBlock, update_aux
+from ... import autograd as _ag
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+           "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Flatten",
+           "Lambda", "HybridLambda", "HybridConcatenate", "Concatenate",
+           "Identity"]
+
+
+class Sequential(Block):
+    """Stack of Blocks run in order (reference: nn.Sequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        # a plain Sequential of HybridBlocks: hybridize children
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Stack of HybridBlocks; hybridizes into ONE fused XLA program
+    (reference: nn.HybridSequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference: nn.Dense).  weight: (units,
+    in_units); in_units=0 defers to first forward."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype=_np.float32, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._act_type = activation
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x, *args):
+        in_units = (int(_np.prod(x.shape[1:])) if self._flatten
+                    else x.shape[-1])
+        self.weight.shape = (self._units, in_units)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
+                               num_hidden=self._units,
+                               flatten=self._flatten)
+        if self._act_type is not None:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} -> "
+                f"{self._units}, "
+                f"{self._act_type if self._act_type else 'linear'})")
+
+
+class Dropout(HybridBlock):
+    """reference: nn.Dropout — active only in train mode."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        if self._rate > 0 and _ag.is_training():
+            return F.dropout(x, p=self._rate,
+                             axes=self._axes if self._axes else None)
+        return F.identity(x)
+
+
+class Embedding(HybridBlock):
+    """reference: nn.Embedding — weight (input_dim, output_dim)."""
+
+    def __init__(self, input_dim, output_dim, dtype=_np.float32,
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+
+class BatchNorm(HybridBlock):
+    """reference: nn.BatchNorm.  Running stats are aux params updated
+    functionally (trace-safe) via ``update_aux``; momentum semantics match
+    the reference: moving = moving*momentum + batch*(1-momentum)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,),
+                init=gamma_initializer,
+                grad_req="write" if scale else "null",
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                grad_req="write" if center else "null",
+                allow_deferred_init=True)
+            self.running_mean = self.params.get(
+                "running_mean", shape=(in_channels,),
+                init=running_mean_initializer, grad_req="null",
+                allow_deferred_init=True, differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", shape=(in_channels,),
+                init=running_variance_initializer, grad_req="null",
+                allow_deferred_init=True, differentiable=False)
+
+    def infer_shape(self, x, *args):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p.shape = (ch,)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        use_batch_stats = _ag.is_training() and not self._use_global_stats
+        if use_batch_stats:
+            out, mean, var = F.BatchNorm(
+                x, gamma, beta, eps=self._epsilon,
+                fix_gamma=not self._scale, axis=self._axis,
+                output_mean_var=True)
+            m = self._momentum
+            update_aux(self.running_mean,
+                       (running_mean * m + mean * (1 - m))._data)
+            update_aux(self.running_var,
+                       (running_var * m + var * (1 - m))._data)
+            return out
+        return F.BatchNorm(
+            x, gamma, beta, running_mean, running_var, eps=self._epsilon,
+            fix_gamma=not self._scale, use_global_stats=True,
+            axis=self._axis)
+
+
+class LayerNorm(HybridBlock):
+    """reference: nn.LayerNorm."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                grad_req="write" if scale else "null",
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                grad_req="write" if center else "null",
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        ch = x.shape[self._axis]
+        self.gamma.shape = (ch,)
+        self.beta.shape = (ch,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                grad_req="write" if scale else "null",
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                grad_req="write" if center else "null",
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                grad_req="write" if scale else "null",
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                grad_req="write" if center else "null",
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.identity(x)
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (reference: nn.Lambda)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as F
+            function = getattr(F, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        self._func_name = function if isinstance(function, str) else None
+        self._func = function
+
+    def hybrid_forward(self, F, *args):
+        if self._func_name is not None:
+            return getattr(F, self._func_name)(*args)
+        return self._func(F, *args)
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input, concat outputs (reference 2.x-era
+    contrib Concurrent; kept for model-zoo building)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Concatenate(Block):
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x):
+        from ... import ndarray as F
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)

@@ -1,0 +1,385 @@
+"""Gluon Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py).
+
+TPU-native re-design: the reference keeps one NDArray copy per device
+(``list_data``) and aggregates gradients via KVStore; here a Parameter owns a
+SINGLE logical NDArray — multi-device placement is a *sharding* of that one
+array over a mesh (jax.sharding), not replication, so ``list_data`` returns
+the one logical array per requested ctx.  Deferred shape inference
+(``shape=(0,...)`` until first forward) matches the reference.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context, cpu
+from .. import initializer as init_mod
+from ..ndarray import ndarray as _ndmod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its deferred shape was known
+    (reference: gluon/parameter.py same name)."""
+
+
+class Parameter:
+    """A weight/bias/state tensor of a Block.
+
+    grad_req: 'write' | 'add' | 'null'.  A shape containing 0 defers
+    allocation until the first forward infers the full shape (reference:
+    Parameter._finish_deferred_init).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data: Optional[NDArray] = None
+        self._ctx_list = None
+        self._deferred_init = None   # (initializer, ctx, default_init)
+        if stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid stype {stype!r}")
+        self._stype = stype
+
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # merge: 0 means unknown (reference shape merging semantics)
+        if len(self._shape) != len(new_shape) or any(
+                s != 0 and s != n for s, n in zip(self._shape, new_shape)):
+            raise MXNetError(
+                f"inconsistent shape for Parameter {self.name}: "
+                f"{self._shape} vs {new_shape}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        if not self._differentiable:
+            req = "null"
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._require_grad = False
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def _shape_known(self) -> bool:
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Allocate & fill data (reference: Parameter.initialize)."""
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, list(ctx), default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize Parameter {self.name}: shape "
+                f"{self._shape} unknown; set allow_deferred_init=True "
+                "or provide in_units/in_channels")
+        self._init_impl(init, default_init)
+
+    def _init_impl(self, init, default_init):
+        ctx0 = self._ctx_list[0] if self._ctx_list else current_context()
+        initializer = init_mod.create(
+            init if init is not None else
+            (self.init if self.init is not None else default_init))
+        arr = _ndmod.zeros(self._shape, ctx=ctx0, dtype=self.dtype)
+        initializer(init_mod.InitDesc(self.name), arr)
+        self._data = arr
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape}")
+        init, ctx, default_init = self._deferred_init
+        self._ctx_list = ctx
+        self._init_impl(init, default_init)
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} was not initialized yet: its shape "
+                "is deferred to the first forward. Run a forward pass first")
+        raise MXNetError(
+            f"Parameter {self.name} has not been initialized. "
+            "Call .initialize() on the Block first")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad_req == "null":
+            raise MXNetError(
+                f"Parameter {self.name} has grad_req='null': no gradient")
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return self._ctx_list or [self._data.ctx]
+
+    def set_data(self, data):
+        """Overwrite the value keeping grad buffer (reference: set_data)."""
+        if isinstance(data, NDArray):
+            src = data._data
+        else:
+            src = _np.asarray(data)
+        if self._data is None:
+            if self._shape_known() or self._deferred_init is None:
+                self.shape = tuple(src.shape)
+                self._ctx_list = self._ctx_list or [current_context()]
+                arr = _ndmod.array(_np.asarray(src), ctx=self._ctx_list[0],
+                                   dtype=self.dtype)
+                self._data = arr
+                if self._grad_req != "null":
+                    self._data.attach_grad(self._grad_req)
+                self._deferred_init = None
+                return
+            self._check_initialized()
+        import jax.numpy as jnp
+        self._data._set_data(jnp.asarray(src, dtype=self._data.dtype))
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype):
+        self.dtype = _np.dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data.grad is not None
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    def var(self):
+        from ..symbol import var as _svar
+        return _svar(self.name, shape=self.shape, dtype=self.dtype,
+                     lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={_np.dtype(self.dtype).name})")
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(
+                value.asnumpy() if isinstance(value, NDArray) else value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0), differentiable=False)
+
+    def _init_impl(self, init, default_init):
+        ctx0 = self._ctx_list[0] if self._ctx_list else current_context()
+        self._data = _ndmod.array(self.value, ctx=ctx0, dtype=self.dtype)
+        self._deferred_init = None
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with a shared prefix
+    (reference: gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Create-or-retrieve prefix+name (reference: ParameterDict.get)."""
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    param.shape = v
+                elif k == "init" and v is not None:
+                    param.init = v
+                elif hasattr(param, k) and v is not None:
+                    pass  # keep the first definition (shared param case)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        full = self._prefix + name
+        param = self._get_impl(full)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant named {full}")
+            param = Constant(full, value)
+            self._params[full] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate Parameter {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import utils as nd_utils
+        arg_dict = {}
+        for name, p in self.items():
+            weight = p.data()
+            if not name.startswith(strip_prefix):
+                raise MXNetError(
+                    f"Parameter {name} does not start with prefix "
+                    f"{strip_prefix}")
+            arg_dict[name[len(strip_prefix):]] = weight
+        nd_utils.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(
+                        f"Parameter {name} missing in file {filename}")
+        for name, v in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter {name} in file {filename} is not in "
+                        "this ParameterDict")
+                continue
+            self._params[name].set_data(v)
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self.values())
+        return f"ParameterDict(prefix={self._prefix!r}\n{s}\n)"

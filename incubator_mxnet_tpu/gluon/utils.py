@@ -1,0 +1,64 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import ndarray as _ndmod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0,
+               even_split=True):
+    """Split along batch axis into num_slice chunks
+    (reference: gluon.utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place on each ctx (reference: gluon.utils.split_and_load).
+
+    TPU-native note: with a single logical mesh the idiomatic path is one
+    sharded array, but the per-ctx list API is preserved for parity."""
+    if not isinstance(data, NDArray):
+        data = _ndmod.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale so joint L2 norm <= max_norm (reference:
+    gluon.utils.clip_global_norm)."""
+    if not arrays:
+        raise MXNetError("no arrays to clip")
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf found in clip_global_norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total

@@ -1,0 +1,253 @@
+"""Weight initializers (reference: python/mxnet/initializer.py).
+
+Each initializer is a callable object writing into an NDArray; string aliases
+(``init='xavier'``) resolve through the registry exactly like the reference's
+``mx.init.register`` mechanism.  RNG flows through ``mx.random`` so
+``mx.random.seed`` reproduces initializations.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "InitDesc", "register", "create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercased class name
+    (reference: mx.init.register decorator)."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs) -> "Initializer":
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _REGISTRY:
+            raise MXNetError(f"unknown initializer {init!r}; "
+                             f"registered: {sorted(_REGISTRY)}")
+        return _REGISTRY[name](**kwargs)
+    raise TypeError(f"cannot create Initializer from {type(init)}")
+
+
+class InitDesc(str):
+    """Parameter-name string carrying init attrs (reference:
+    python/mxnet/initializer.py InitDesc)."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer.  Subclasses implement ``_init_weight``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, name, arr):
+        """Dispatch by parameter name suffix (reference
+        Initializer.__call__ legacy pattern)."""
+        if not isinstance(name, str):
+            name = str(name)
+        if name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_one(name, arr)
+        elif name.endswith("beta"):
+            self._init_zero(name, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif (name.endswith("running_var") or name.endswith("moving_var")
+              or name.endswith("moving_avg")):
+            self._init_one(name, arr)
+        else:
+            self._init_weight(name, arr)
+
+    init_weight = __call__
+
+    # -- helpers -----------------------------------------------------------
+    def _set(self, arr, np_value):
+        arr[:] = _np.asarray(np_value, dtype=arr.dtype)
+
+    def _init_zero(self, name, arr):
+        self._set(arr, _np.zeros(arr.shape))
+
+    def _init_one(self, name, arr):
+        self._set(arr, _np.ones(arr.shape))
+
+    def _init_bias(self, name, arr):
+        self._set(arr, _np.zeros(arr.shape))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _rng(self):
+        from . import random as mxrand
+        return mxrand.numpy_rng()
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, _np.zeros(arr.shape))
+
+
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, _np.ones(arr.shape))
+
+
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, _np.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, self._rng().uniform(-self.scale, self.scale,
+                                           arr.shape))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, self._rng().normal(0.0, self.sigma, arr.shape))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        rng = self._rng()
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """reference: python/mxnet/initializer.py Xavier — factor_type
+    avg|in|out, rnd_type uniform|gaussian."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier requires >=2D weight, got {shape} for {name}")
+        hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"invalid factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        rng = self._rng()
+        if self.rnd_type == "uniform":
+            self._set(arr, rng.uniform(-scale, scale, shape))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, rng.normal(0, scale, shape))
+        else:
+            raise MXNetError(f"invalid rnd_type {self.rnd_type}")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Xavier.__init__(self, "gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution)."""
+
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.shape, dtype=_np.float32)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, others 0 (reference LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias  # [i, f, g, o] order
+        self._set(arr, b)

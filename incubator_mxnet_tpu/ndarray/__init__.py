@@ -12,7 +12,10 @@ from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
 from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
+from . import utils  # noqa: F401
+from .utils import save, load, load_frombuffer  # noqa: F401
 
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
             "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
-            "op"] + list(_ops_all) + list(_nn_all))
+            "op", "utils", "save", "load", "load_frombuffer"]
+           + list(_ops_all) + list(_nn_all))

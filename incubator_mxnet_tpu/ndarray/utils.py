@@ -1,0 +1,150 @@
+"""mx.nd.save / mx.nd.load — reference-compatible binary serialization.
+
+Byte-level re-implementation of the reference format so checkpoints move
+between frameworks (reference: src/c_api/c_api.cc MXNDArraySave — list magic
+0x112; src/ndarray/ndarray.cc NDArray::Save — NDARRAY_V2_MAGIC 0xF993fac9,
+storage type, dmlc TShape (int32 ndim + int64 dims), Context (int32
+dev_type/dev_id), int32 mshadow type flag, raw buffer).  Pure Python struct
+packing — no dmlc.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from .ndarray import NDArray, array as _array
+
+__all__ = ["save", "load", "load_frombuffer"]
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+_V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (reference: 3rdparty/mshadow/mshadow/base.h)
+_TYPE_TO_FLAG = {
+    _np.dtype(_np.float32): 0, _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2, _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4, _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6, _np.dtype(_np.bool_): 7,
+}
+_FLAG_TO_TYPE = {v: k for k, v in _TYPE_TO_FLAG.items()}
+_BF16_FLAG = 12  # mshadow kBfloat16 (oneDNN builds)
+
+
+def _dtype_flag(dt) -> int:
+    import jax.numpy as jnp
+    if _np.dtype(dt) == _np.dtype(jnp.bfloat16):
+        return _BF16_FLAG
+    try:
+        return _TYPE_TO_FLAG[_np.dtype(dt)]
+    except KeyError:
+        raise MXNetError(f"cannot serialize dtype {dt}")
+
+
+def _flag_dtype(flag: int):
+    if flag == _BF16_FLAG:
+        import jax.numpy as jnp
+        return _np.dtype(jnp.bfloat16)
+    try:
+        return _FLAG_TO_TYPE[flag]
+    except KeyError:
+        raise MXNetError(f"unknown mshadow type flag {flag}")
+
+
+def _save_ndarray(buf: bytearray, arr: NDArray):
+    np_data = arr.asnumpy()
+    buf += struct.pack("<I", _V2_MAGIC)
+    buf += struct.pack("<i", 0)                      # stype: dense
+    buf += struct.pack("<i", np_data.ndim)           # TShape ndim
+    buf += struct.pack(f"<{np_data.ndim}q", *np_data.shape)
+    buf += struct.pack("<ii", 1, 0)                  # Context: cpu(0)
+    buf += struct.pack("<i", _dtype_flag(np_data.dtype))
+    buf += np_data.tobytes()
+
+
+def _load_ndarray(mv: memoryview, off: int):
+    (magic,) = struct.unpack_from("<I", mv, off); off += 4
+    if magic in (_V2_MAGIC, _V3_MAGIC):
+        (stype,) = struct.unpack_from("<i", mv, off); off += 4
+        if stype != 0:
+            raise MXNetError(
+                "loading sparse NDArray is not supported yet (stype="
+                f"{stype})")
+        (ndim,) = struct.unpack_from("<i", mv, off); off += 4
+        shape = struct.unpack_from(f"<{ndim}q", mv, off); off += 8 * ndim
+    elif magic == _V1_MAGIC:
+        (ndim,) = struct.unpack_from("<i", mv, off); off += 4
+        shape = struct.unpack_from(f"<{ndim}q", mv, off); off += 8 * ndim
+    else:
+        # legacy V0: the "magic" was actually ndim (uint32 dims)
+        ndim = magic
+        shape = struct.unpack_from(f"<{ndim}I", mv, off); off += 4 * ndim
+    _dev_type, _dev_id = struct.unpack_from("<ii", mv, off); off += 8
+    (flag,) = struct.unpack_from("<i", mv, off); off += 4
+    dt = _flag_dtype(flag)
+    n = int(_np.prod(shape)) if ndim else 1
+    nbytes = n * dt.itemsize
+    data = _np.frombuffer(mv, dtype=dt, count=n, offset=off).reshape(shape)
+    off += nbytes
+    return _array(_np.array(data), dtype=dt), off
+
+
+def save(fname: str, data):
+    """Save NDArray / list / dict-of-str→NDArray (reference: mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data, names = [data], []
+    elif isinstance(data, (list, tuple)):
+        if not all(isinstance(a, NDArray) for a in data):
+            raise MXNetError("save expects NDArray elements")
+        data, names = list(data), []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        data = list(data.values())
+    else:
+        raise MXNetError(f"cannot save {type(data)}")
+
+    buf = bytearray()
+    buf += struct.pack("<QQ", _LIST_MAGIC, 0)
+    buf += struct.pack("<Q", len(data))
+    for arr in data:
+        _save_ndarray(buf, arr)
+    buf += struct.pack("<Q", len(names))
+    for name in names:
+        b = name.encode("utf-8")
+        buf += struct.pack("<Q", len(b)) + b
+    with open(fname, "wb") as f:
+        f.write(bytes(buf))
+
+
+def load_frombuffer(raw: bytes) -> Union[List[NDArray], Dict[str, NDArray]]:
+    mv = memoryview(raw)
+    header, _reserved = struct.unpack_from("<QQ", mv, 0)
+    if header != _LIST_MAGIC:
+        raise MXNetError("invalid NDArray file format (bad magic)")
+    off = 16
+    (n,) = struct.unpack_from("<Q", mv, off); off += 8
+    arrays = []
+    for _ in range(n):
+        arr, off = _load_ndarray(mv, off)
+        arrays.append(arr)
+    (n_names,) = struct.unpack_from("<Q", mv, off); off += 8
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack_from("<Q", mv, off); off += 8
+        names.append(bytes(mv[off:off + ln]).decode("utf-8")); off += ln
+    if n_names == 0:
+        return arrays
+    if n_names != n:
+        raise MXNetError("corrupt NDArray file: names/arrays mismatch")
+    return dict(zip(names, arrays))
+
+
+def load(fname: str):
+    """Load NDArray file (reference: mx.nd.load)."""
+    with open(fname, "rb") as f:
+        return load_frombuffer(f.read())

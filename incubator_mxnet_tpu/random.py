@@ -12,11 +12,33 @@ import threading
 
 from .context import Context, current_context
 
-__all__ = ["seed", "new_key", "current_key"]
+__all__ = ["seed", "new_key", "current_key", "numpy_rng", "trace_stream"]
 
 _lock = threading.Lock()
 _streams: dict = {}
 _DEFAULT_SEED = 0
+_tls = threading.local()
+
+
+class trace_stream:
+    """Scope that redirects ``new_key`` to split off a *traced* base key —
+    used while tracing a hybridized block under jit so dropout/samplers
+    consume a key that is an argument of the compiled program rather than a
+    baked-in constant (fresh randomness per call, XLA-visible)."""
+
+    def __init__(self, base_key):
+        self._base = base_key
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append([self._base])
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+        return False
 
 
 def seed(seed_state: int, ctx="all"):
@@ -41,6 +63,11 @@ def _stream_key(ctx):
 def new_key(ctx=None):
     """Split the next key off the context's stream."""
     import jax
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        nxt, use = jax.random.split(stack[-1][0])
+        stack[-1][0] = nxt
+        return use
     ctx = ctx if ctx is not None else current_context()
     with _lock:
         k = _stream_key(ctx)
@@ -50,6 +77,18 @@ def new_key(ctx=None):
         nxt, use = jax.random.split(cur)
         _streams[k] = nxt
         return use
+
+
+def numpy_rng(ctx=None):
+    """A numpy Generator advanced off the context's key stream — host-side
+    randomness (initializers, data aug) that still obeys ``mx.random.seed``."""
+    import numpy as _np
+    key = new_key(ctx)
+    # fold the 2x uint32 key into a 64-bit numpy seed
+    import numpy as np
+    kv = np.asarray(key, dtype=np.uint32).reshape(-1)
+    s = int(kv[0]) << 32 | int(kv[-1])
+    return _np.random.default_rng(s)
 
 
 def current_key(ctx=None):

