@@ -2,8 +2,9 @@
 from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock, SymbolBlock
 from . import nn
+from . import rnn
 from . import loss
 from . import utils
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "nn", "loss", "utils"]
+           "SymbolBlock", "nn", "rnn", "loss", "utils"]

@@ -1,0 +1,7 @@
+"""``mx.gluon.rnn`` (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_layer import *  # noqa: F401,F403
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn_layer import __all__ as _l
+from .rnn_cell import __all__ as _c
+
+__all__ = list(_l) + list(_c)

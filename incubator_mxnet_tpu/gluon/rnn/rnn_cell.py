@@ -1,0 +1,329 @@
+"""Recurrent cells (reference: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are fine-grained single-step modules; ``unroll`` runs T steps.  The
+eager unroll is a Python loop (each step an async XLA dispatch); under
+``hybridize()`` the whole unrolled graph compiles to one program, so the
+loop cost vanishes — the TPU answer to the reference's per-step engine
+pushes.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Run the cell over ``length`` steps (reference:
+        RecurrentCell.unroll)."""
+        from ... import ndarray as F
+        self.reset()
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        steps = F.split(inputs, length, axis=axis, squeeze_axis=True) \
+            if length > 1 else [inputs.squeeze(axis=axis)]
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            outputs = [F.where((valid_length > t).reshape(-1, 1),
+                               o, F.zeros_like(o))
+                       for t, o in enumerate(outputs)]
+        if merge_outputs is False:
+            return outputs, states
+        stacked = F.stack(*outputs, axis=axis)
+        return stacked, states
+
+    def forward(self, x, states):
+        self._counter += 1
+        return self._fwd(x, states)
+
+    def _fwd(self, x, states):
+        # cells execute eagerly; they trace inline when unrolled inside a
+        # hybridized parent block
+        return self._forward_eager(x, states)
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ngates * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ngates * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ngates * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ngates * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        ng_h = self.i2h_weight.shape[0]
+        self.i2h_weight.shape = (ng_h, x.shape[-1])
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h_prev, c_prev = states
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * H)
+        h2h = F.FullyConnected(h_prev, h2h_weight, h2h_bias,
+                               num_hidden=4 * H)
+        gates = i2h + h2h
+        sl = F.split(gates, 4, axis=1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = F.tanh(sl[2])
+        o = F.sigmoid(sl[3])
+        c = f * c_prev + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prev = states[0] if isinstance(states, (list, tuple)) else states
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * H)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * H)
+        i2h_sl = F.split(i2h, 3, axis=1)
+        h2h_sl = F.split(h2h, 3, axis=1)
+        r = F.sigmoid(i2h_sl[0] + h2h_sl[0])
+        z = F.sigmoid(i2h_sl[1] + h2h_sl[1])
+        n = F.tanh(i2h_sl[2] + r * h2h_sl[2])
+        out = (1 - z) * n + z * prev
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference: SequentialRNNCell)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def _fwd(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, new = cell(x, states[p:p + n])
+            p += n
+            next_states.extend(new)
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _fwd(self, x, states):
+        from ... import ndarray as F
+        from ... import autograd as ag
+        if self._rate > 0 and ag.is_training():
+            x = F.dropout(x, p=self._rate,
+                          axes=self._axes if self._axes else None)
+        return x, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def _fwd(self, x, states):
+        from ... import ndarray as F
+        from ... import autograd as ag
+        out, new_states = self.base_cell(x, states)
+        if ag.is_training():
+            def mask(p, like):
+                return F.dropout(F.ones_like(like), p=p) * (1 - p)
+            if self._zo > 0:
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(out)
+                m = mask(self._zo, out)
+                out = F.where(m, out, prev)
+            if self._zs > 0:
+                new_states = [F.where(mask(self._zs, ns), ns, s)
+                              for ns, s in zip(new_states, states)]
+        self._prev_output = out
+        return out, new_states
+
+
+class ResidualCell(_ModifierCell):
+    def _fwd(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        return out + x, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, **kwargs)
+                + self._children["r_cell"].begin_state(batch_size, **kwargs))
+
+    def _fwd(self, x, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        batch = inputs.shape[layout.find("N")]
+        axis = layout.find("T")
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, merge_outputs=True,
+            valid_length=valid_length)
+        rev = F.flip(inputs, axis=axis) if valid_length is None else \
+            F.SequenceReverse(inputs.transpose((1, 0, 2))
+                              if layout == "NTC" else inputs,
+                              sequence_length=valid_length,
+                              use_sequence_length=True)
+        if valid_length is not None and layout == "NTC":
+            rev = rev.transpose((1, 0, 2))
+        r_out, r_states = r_cell.unroll(
+            length, rev, begin_state[nl:], layout, merge_outputs=True,
+            valid_length=valid_length)
+        r_out = F.flip(r_out, axis=axis)
+        out = F.concat(l_out, r_out, dim=2)
+        return out, l_states + r_states

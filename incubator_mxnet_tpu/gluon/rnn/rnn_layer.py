@@ -1,0 +1,177 @@
+"""Gluon recurrent layers over the fused RNN op (reference:
+python/mxnet/gluon/rnn/rnn_layer.py).
+
+Parameters are registered per (layer, direction) as
+``{l|r}{i}_i2h_weight / _h2h_weight / _i2h_bias / _h2h_bias`` exactly like
+the reference, and concatenated into the fused op's flat vector at forward
+time — so checkpoints are interchangeable and the compute is a single
+``lax.scan`` program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        ng = _GATES[mode]
+
+        with self.name_scope():
+            for layer in range(num_layers):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self._dir
+                for d, prefix in zip(range(self._dir), ("l", "r")):
+                    name = f"{prefix}{layer}"
+                    setattr(self, f"{name}_i2h_weight", self.params.get(
+                        f"{name}_i2h_weight",
+                        shape=(ng * hidden_size, in_sz),
+                        init=i2h_weight_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{name}_h2h_weight", self.params.get(
+                        f"{name}_h2h_weight",
+                        shape=(ng * hidden_size, hidden_size),
+                        init=h2h_weight_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{name}_i2h_bias", self.params.get(
+                        f"{name}_i2h_bias", shape=(ng * hidden_size,),
+                        init=i2h_bias_initializer,
+                        allow_deferred_init=True))
+                    setattr(self, f"{name}_h2h_bias", self.params.get(
+                        f"{name}_h2h_bias", shape=(ng * hidden_size,),
+                        init=h2h_bias_initializer,
+                        allow_deferred_init=True))
+
+    def _param_names(self):
+        names = []
+        for layer in range(self._num_layers):
+            for prefix in ("l", "r")[:self._dir]:
+                names.append(f"{prefix}{layer}")
+        return names
+
+    def infer_shape(self, x, *args):
+        in_axis = 2 if self._layout == "TNC" else 2
+        input_size = x.shape[in_axis]
+        ng = _GATES[self._mode]
+        for layer in range(self._num_layers):
+            in_sz = input_size if layer == 0 \
+                else self._hidden_size * self._dir
+            for prefix in ("l", "r")[:self._dir]:
+                getattr(self, f"{prefix}{layer}_i2h_weight").shape = \
+                    (ng * self._hidden_size, in_sz)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial hidden states (reference: _RNNLayer.begin_state)."""
+        from ... import ndarray as F
+        if func is None:
+            func = F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs)
+                          if "shape" in info else func(**kwargs))
+        return states
+
+    def hybrid_forward(self, F, x, *states, **params):
+        if self._layout == "NTC":
+            x = x.transpose((1, 0, 2))
+        batch = x.shape[1]
+        if not states:
+            states = self._auto_states(F, batch)
+        elif len(states) == 1 and isinstance(states[0], (list, tuple)):
+            states = tuple(states[0])
+
+        # flatten params in fused-op order: all weights, then all biases
+        names = self._param_names()
+        ws, bs = [], []
+        for n in names:
+            ws.append(params[f"{n}_i2h_weight"].reshape(-1))
+            ws.append(params[f"{n}_h2h_weight"].reshape(-1))
+        for n in names:
+            bs.append(params[f"{n}_i2h_bias"])
+            bs.append(params[f"{n}_h2h_bias"])
+        flat = F.concat(*(ws + bs), dim=0)
+
+        out = F.RNN(x, flat, *states, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        output, out_states = out[0], list(out[1:])
+        if self._layout == "NTC":
+            output = output.transpose((1, 0, 2))
+        return output, out_states
+
+    def _auto_states(self, F, batch):
+        return tuple(
+            F.zeros(info["shape"])
+            for info in self.state_info(batch))
+
+    def __call__(self, x, *states):
+        out, out_states = super().__call__(x, *states)
+        if states:
+            return out, out_states
+        return out
+
+
+class RNN(_RNNLayer):
+    """Elman RNN (reference: gluon.rnn.RNN; activation relu|tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """reference: gluon.rnn.LSTM (gate order i, f, g, o)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """reference: gluon.rnn.GRU (gate order r, z, n)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,122 @@
+"""Gluon RNN layer/cell tests (reference model: tests/python/unittest/
+test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon import rnn
+
+
+@pytest.mark.parametrize("cls,nstates", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                         (rnn.RNN, 1)])
+def test_rnn_layer_forward(cls, nstates):
+    layer = cls(7, num_layers=2, input_size=5)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(3, 4, 5).astype(np.float32))  # TNC
+    out = layer(x)
+    assert out.shape == (3, 4, 7)
+    states = layer.begin_state(batch_size=4)
+    assert len(states) == nstates
+    out, new_states = layer(x, *states)
+    assert out.shape == (3, 4, 7)
+    assert len(new_states) == nstates
+    assert new_states[0].shape == (2, 4, 7)
+
+
+def test_rnn_layer_ntc_layout_and_bidirectional():
+    layer = rnn.LSTM(6, layout="NTC", bidirectional=True, input_size=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(2, 5, 4).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (2, 5, 12)
+
+
+def test_rnn_layer_deferred_input_size():
+    layer = rnn.GRU(8)
+    layer.initialize()
+    out = layer(mx.nd.ones((3, 2, 6)))
+    assert out.shape == (3, 2, 8)
+    assert layer.l0_i2h_weight.shape == (24, 6)
+
+
+def test_rnn_layer_matches_fused_op():
+    """The layer's per-(layer,dir) params concatenated must reproduce the
+    flat-vector fused op exactly."""
+    H, C = 4, 3
+    layer = rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(6, 2, C).astype(np.float32))
+    out = layer(x).asnumpy()
+
+    flat = np.concatenate([
+        layer.l0_i2h_weight.data().asnumpy().ravel(),
+        layer.l0_h2h_weight.data().asnumpy().ravel(),
+        layer.l0_i2h_bias.data().asnumpy(),
+        layer.l0_h2h_bias.data().asnumpy()])
+    h0 = mx.nd.zeros((1, 2, H))
+    c0 = mx.nd.zeros((1, 2, H))
+    ref = mx.nd.RNN(x, mx.nd.array(flat), h0, c0, state_size=H,
+                    mode="lstm").asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_layer_grad():
+    layer = rnn.LSTM(5, input_size=3)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(4, 2, 3).astype(np.float32))
+    with mx.autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_lstm_cell_and_unroll():
+    cell = rnn.LSTMCell(6, input_size=4)
+    cell.initialize()
+    x = mx.nd.ones((2, 3, 4))  # NTC
+    out, states = cell.unroll(3, x, layout="NTC")
+    assert out.shape == (2, 3, 6)
+    assert len(states) == 2
+
+
+def test_sequential_cell_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(5, input_size=4))
+    stack.add(rnn.GRUCell(3, input_size=5))
+    stack.initialize()
+    out, states = stack.unroll(4, mx.nd.ones((2, 4, 4)), layout="NTC")
+    assert out.shape == (2, 4, 3)
+    assert len(states) == 3  # 2 lstm + 1 gru
+
+
+def test_cell_matches_layer_single_step():
+    """LSTMCell unroll must match fused LSTM layer given shared weights."""
+    H, C, T, N = 4, 3, 5, 2
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    x_np = np.random.randn(T, N, C).astype(np.float32)
+    out_c, _ = cell.unroll(T, mx.nd.array(x_np.transpose(1, 0, 2)),
+                           layout="NTC")
+
+    layer = rnn.LSTM(H, input_size=C)
+    layer.initialize()
+    layer.l0_i2h_weight.set_data(cell.i2h_weight.data())
+    layer.l0_h2h_weight.set_data(cell.h2h_weight.data())
+    layer.l0_i2h_bias.set_data(cell.i2h_bias.data())
+    layer.l0_h2h_bias.set_data(cell.h2h_bias.data())
+    out_l = layer(mx.nd.array(x_np))
+    np.testing.assert_allclose(out_c.asnumpy().transpose(1, 0, 2),
+                               out_l.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_residual_and_dropout_cells():
+    base = rnn.GRUCell(4, input_size=4)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    out, _ = res.unroll(3, mx.nd.ones((2, 3, 4)), layout="NTC")
+    assert out.shape == (2, 3, 4)
+
+    dc = rnn.DropoutCell(0.3)
+    out, states = dc(mx.nd.ones((2, 4)), [])
+    assert out.shape == (2, 4)
