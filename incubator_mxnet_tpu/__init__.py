@@ -31,9 +31,13 @@ from . import random
 from . import initializer
 from . import initializer as init
 from . import gluon
+from . import optimizer
+from . import lr_scheduler
+from . import kvstore
+from . import kvstore as kv
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
            "autograd", "random", "base", "context", "initializer", "init",
-           "gluon"]
+           "gluon", "optimizer", "lr_scheduler", "kvstore", "kv"]

@@ -1,10 +1,11 @@
 """Gluon: the define-by-run frontend (reference: python/mxnet/gluon/)."""
 from .parameter import Parameter, Constant, ParameterDict
 from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
 from . import nn
 from . import rnn
 from . import loss
 from . import utils
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "nn", "rnn", "loss", "utils"]
+           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "utils"]

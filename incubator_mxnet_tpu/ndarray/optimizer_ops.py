@@ -1,0 +1,307 @@
+"""Optimizer update ops (reference: src/operator/optimizer_op.cc —
+sgd_update, sgd_mom_update, adam_update, mp_* multi-precision variants,
+signsgd/signum, ftrl, rmsprop, nag, lamb phase1/2).
+
+Update rules match the reference's kernels term for term (tested against
+hand NumPy in tests/test_optimizer.py).  Each op mutates ``weight`` (and
+state arrays) in place via functional buffer replacement — one fused XLA
+computation per call.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray
+
+__all__ = ["sgd_update", "sgd_mom_update", "nag_mom_update", "adam_update",
+           "rmsprop_update", "rmspropalex_update", "ftrl_update",
+           "signsgd_update", "signum_update", "mp_sgd_update",
+           "mp_sgd_mom_update", "lamb_update_phase1", "lamb_update_phase2",
+           "adagrad_update", "adadelta_update", "sgld_update"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _prep_grad(g, rescale_grad, clip_gradient, wd=0.0, w=None):
+    jnp = _jnp()
+    g = g * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and w is not None:
+        g = g + wd * w
+    return g
+
+
+def sgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True, out=None):
+    w, g = weight._data, grad._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_w = w - lr * g
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
+                   momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True, out=None):
+    w, g, m = weight._data, grad._data, mom._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_m = momentum * m - lr * g
+    new_w = w + new_m
+    mom._set_data(new_m.astype(m.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def nag_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
+                   momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    """Nesterov (reference: nag_mom_update kernel)."""
+    w, g, m = weight._data, grad._data, mom._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_m = momentum * m + g
+    new_w = w - lr * (g + momentum * new_m)
+    mom._set_data(new_m.astype(m.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def adam_update(weight: NDArray, grad: NDArray, mean: NDArray, var: NDArray,
+                lr, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                out=None):
+    """reference: adam_update — lr is expected pre-scaled by
+    sqrt(1-beta2^t)/(1-beta1^t) as the python Adam class does."""
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    m, v = mean._data, var._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_m = beta1 * m + (1 - beta1) * g
+    new_v = beta2 * v + (1 - beta2) * g * g
+    new_w = w - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    mean._set_data(new_m.astype(m.dtype))
+    var._set_data(new_v.astype(v.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def rmsprop_update(weight: NDArray, grad: NDArray, n: NDArray, lr,
+                   gamma1=0.95, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, clip_weights=-1.0, out=None):
+    jnp = _jnp()
+    w, g, nn = weight._data, grad._data, n._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_n = (1 - gamma1) * g * g + gamma1 * nn
+    new_w = w - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    n._set_data(new_n.astype(nn.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def rmspropalex_update(weight: NDArray, grad: NDArray, n: NDArray,
+                       g_mean: NDArray, delta: NDArray, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, out=None):
+    """Centered RMSProp (Graves 2013; reference: rmspropalex_update)."""
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    nn, gm, d = n._data, g_mean._data, delta._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_n = (1 - gamma1) * g * g + gamma1 * nn
+    new_gm = (1 - gamma1) * g + gamma1 * gm
+    new_d = gamma2 * d - lr * g / jnp.sqrt(new_n - new_gm * new_gm + epsilon)
+    new_w = w + new_d
+    if clip_weights and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    n._set_data(new_n.astype(nn.dtype))
+    g_mean._set_data(new_gm.astype(gm.dtype))
+    delta._set_data(new_d.astype(d.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def ftrl_update(weight: NDArray, grad: NDArray, z: NDArray, n: NDArray, lr,
+                lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, out=None):
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    zz, nn = z._data, n._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None)
+    new_z = zz + g - (jnp.sqrt(nn + g * g) - jnp.sqrt(nn)) / lr * w
+    new_n = nn + g * g
+    new_w = (jnp.sign(new_z) * lamda1 - new_z) / \
+        ((beta + jnp.sqrt(new_n)) / lr + wd) * (jnp.abs(new_z) > lamda1)
+    z._set_data(new_z.astype(zz.dtype))
+    n._set_data(new_n.astype(nn.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def signsgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None)
+    new_w = w - lr * (jnp.sign(g) + wd * w)
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def signum_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
+                  momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  wd_lh=0.0, out=None):
+    jnp = _jnp()
+    w, g, m = weight._data, grad._data, mom._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_m = momentum * m - (1 - momentum) * g
+    new_w = w + lr * (jnp.sign(new_m) - wd_lh * w)
+    mom._set_data(new_m.astype(m.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def mp_sgd_update(weight: NDArray, grad: NDArray, weight32: NDArray, lr,
+                  wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  lazy_update=True, out=None):
+    """Multi-precision: fp32 master weights, low-precision model weights
+    (reference: mp_sgd_update)."""
+    jnp = _jnp()
+    w32, g = weight32._data, grad._data.astype(jnp.float32)
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w32)
+    new_w32 = w32 - lr * g
+    weight32._set_data(new_w32)
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w32.astype(weight._data.dtype))
+    return tgt
+
+
+def mp_sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray,
+                      weight32: NDArray, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True, out=None):
+    jnp = _jnp()
+    w32, g, m = weight32._data, grad._data.astype(jnp.float32), mom._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w32)
+    new_m = momentum * m - lr * g
+    new_w32 = w32 + new_m
+    mom._set_data(new_m)
+    weight32._set_data(new_w32)
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w32.astype(weight._data.dtype))
+    return tgt
+
+
+def lamb_update_phase1(weight: NDArray, grad: NDArray, mean: NDArray,
+                       var: NDArray, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    """reference: lamb_update_phase1 — returns the raw update direction."""
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    m, v = mean._data, var._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None)
+    new_m = beta1 * m + (1 - beta1) * g
+    new_v = beta2 * v + (1 - beta2) * g * g
+    mean._set_data(new_m.astype(m.dtype))
+    var._set_data(new_v.astype(v.dtype))
+    if bias_correction:
+        mhat = new_m / (1 - beta1 ** t)
+        vhat = new_v / (1 - beta2 ** t)
+    else:
+        mhat, vhat = new_m, new_v
+    upd = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w
+    return NDArray(upd, ctx=weight.ctx)
+
+
+def lamb_update_phase2(weight: NDArray, g: NDArray, r1: NDArray,
+                       r2: NDArray, lr, lower_bound=-1.0, upper_bound=-1.0,
+                       out=None):
+    """reference: lamb_update_phase2 — trust-ratio scaled step."""
+    jnp = _jnp()
+    w = weight._data
+    r1v, r2v = r1._data, r2._data
+    if lower_bound and lower_bound > 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound and upper_bound > 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    new_w = w - lr * ratio * g._data
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def adagrad_update(weight: NDArray, grad: NDArray, history: NDArray, lr,
+                   epsilon=1e-7, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    jnp = _jnp()
+    w, g, h = weight._data, grad._data, history._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None)
+    new_h = h + g * g
+    new_w = w - lr * (g / jnp.sqrt(new_h + epsilon) + wd * w)
+    history._set_data(new_h.astype(h.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def adadelta_update(weight: NDArray, grad: NDArray, acc_g: NDArray,
+                    acc_delta: NDArray, rho=0.9, epsilon=1e-5, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    jnp = _jnp()
+    w, g = weight._data, grad._data
+    ag, ad = acc_g._data, acc_delta._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    new_ag = rho * ag + (1 - rho) * g * g
+    delta = jnp.sqrt(ad + epsilon) / jnp.sqrt(new_ag + epsilon) * g
+    new_ad = rho * ad + (1 - rho) * delta * delta
+    new_w = w - delta
+    acc_g._set_data(new_ag.astype(ag.dtype))
+    acc_delta._set_data(new_ad.astype(ad.dtype))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt
+
+
+def sgld_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, out=None):
+    """Stochastic Gradient Langevin Dynamics (reference: sgld_update)."""
+    import jax
+    jnp = _jnp()
+    from .. import random as _random
+    w, g = weight._data, grad._data
+    g = _prep_grad(g, rescale_grad,
+                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    key = _random.new_key(weight.ctx)
+    noise = jax.random.normal(key, w.shape, dtype=w.dtype) * \
+        jnp.sqrt(jnp.asarray(lr, w.dtype))
+    new_w = w - lr / 2 * g + noise
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w.astype(w.dtype))
+    return tgt

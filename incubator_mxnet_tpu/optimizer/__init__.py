@@ -1,0 +1,5 @@
+"""``mx.optimizer`` (reference: python/mxnet/optimizer/)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import __all__ as _a
+
+__all__ = list(_a)

@@ -1,0 +1,558 @@
+"""Optimizers (reference: python/mxnet/optimizer/optimizer.py).
+
+Update rules delegate to the optimizer ops in
+``ndarray/optimizer_ops.py`` (reference kernels: src/operator/optimizer_op.cc)
+so the Python classes stay thin — hyperparameter bookkeeping (lr scheduling,
+per-param lr/wd multipliers, update counts, multi-precision master weights)
+matching the reference class for class.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import ndarray as _ndmod
+from ..ndarray.ndarray import NDArray
+from ..ndarray import optimizer_ops as _oo
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "Adamax", "Nadam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LAMB",
+           "SGLD", "DCASGD", "Test", "create", "register", "get_updater",
+           "Updater"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}; "
+                         f"registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: Optimizer).  State is created lazily per
+    parameter index; ``update(index, weight, grad, state)`` applies one
+    step."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+
+    create_optimizer = staticmethod(create)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = weight.astype(_np.float32)
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32, inner = state
+            self.update(index, w32, grad.astype(_np.float32), inner)
+            weight._set_data(w32._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- hyperparams -------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(lr={self.learning_rate})"
+
+
+def _zeros_like(weight, dtype=None):
+    import jax.numpy as jnp
+    return NDArray(jnp.zeros(weight.shape,
+                             dtype or weight._data.dtype), ctx=weight.ctx)
+
+
+@register
+class SGD(Optimizer):
+    """reference: SGD — mom = momentum*mom - lr*(grad + wd*w); w += mom."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _oo.sgd_mom_update(weight, grad, state, momentum=self.momentum,
+                               **kw)
+        else:
+            _oo.sgd_update(weight, grad, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32, mom = state
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            self._update_count(index)
+            kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+            if mom is not None:
+                _oo.mp_sgd_mom_update(weight, grad, mom, w32,
+                                      momentum=self.momentum, **kw)
+            else:
+                _oo.mp_sgd_update(weight, grad, w32, **kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _oo.nag_mom_update(weight, grad, state, momentum=self.momentum,
+                               **kw)
+        else:
+            _oo.sgd_update(weight, grad, **kw)
+
+
+@register
+class Adam(Optimizer):
+    """reference: Adam — bias-corrected lr passed into adam_update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _oo.adam_update(weight, grad, mean, var, lr=lr, beta1=self.beta1,
+                        beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0)
+
+
+@register
+class Adamax(Optimizer):
+    """reference: Adamax (infinity-norm Adam)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        m, u = state
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_m = self.beta1 * m._data + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        m._set_data(new_m)
+        u._set_data(new_u)
+        weight._set_data(weight._data - lr * new_m / (new_u + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    """reference: Nadam (Adam + Nesterov momentum schedule)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 **
+                                   (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        grad_prime = g / (1. - self.m_schedule)
+        new_m = self.beta1 * m._data + (1. - self.beta1) * g
+        new_v = self.beta2 * v._data + (1. - self.beta2) * g * g
+        m_t_prime = new_m / (1. - m_schedule_next)
+        v_t_prime = new_v / (1. - self.beta2 ** t)
+        m_t_bar = (1. - momentum_t) * grad_prime + \
+            momentum_t_1 * m_t_prime
+        m._set_data(new_m)
+        v._set_data(new_v)
+        weight._set_data(
+            weight._data - lr * m_t_bar
+            / (jnp.sqrt(v_t_prime) + self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        _oo.adagrad_update(weight, grad, state, lr=lr,
+                           epsilon=self.float_stable_eps, wd=wd,
+                           rescale_grad=self.rescale_grad,
+                           clip_gradient=self.clip_gradient or -1.0)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0,
+                  clip_weights=self.clip_weights or -1.0)
+        if self.centered:
+            n, g_mean, delta = state
+            _oo.rmspropalex_update(weight, grad, n, g_mean, delta,
+                                   gamma2=self.gamma2, **kw)
+        else:
+            _oo.rmsprop_update(weight, grad, state, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        _oo.adadelta_update(weight, grad, acc_g, acc_delta, rho=self.rho,
+                            epsilon=self.epsilon, wd=wd,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=self.clip_gradient or -1.0)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        _oo.ftrl_update(weight, grad, z, n, lr=lr, lamda1=self.lamda1,
+                        beta=self.beta, wd=wd,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _oo.signum_update(weight, grad, state, momentum=self.momentum,
+                              wd_lh=self.wd_lh, **kw)
+        else:
+            _oo.signsgd_update(weight, grad, **kw)
+
+
+SignSGD = Signum
+
+
+@register
+class LAMB(Optimizer):
+    """reference: LAMB (1.6+) — layerwise trust-ratio adaptive Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight, _np.float32),
+                _zeros_like(weight, _np.float32))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = _oo.lamb_update_phase1(
+            weight, grad, mean, var, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, t=t, bias_correction=self.bias_correction,
+            wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0)
+        r1 = NDArray(jnp.linalg.norm(weight._data.ravel()), ctx=weight.ctx)
+        r2 = NDArray(jnp.linalg.norm(g._data.ravel()), ctx=weight.ctx)
+        _oo.lamb_update_phase2(weight, g, r1, r2, lr=lr,
+                               lower_bound=self.lower_bound or -1.0,
+                               upper_bound=self.upper_bound or -1.0)
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        _oo.sgld_update(weight, grad, lr=lr, wd=wd,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: DCASGD).  Kept for API
+    parity; delay compensation is moot in SPMD execution."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_zeros_like(weight), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        d = -lr * (g + wd * weight._data + self.lamda * g * g *
+                   (weight._data - prev._data))
+        if mom is not None:
+            new_mom = self.momentum * mom._data + d
+            mom._set_data(new_mom)
+            d = new_mom
+        prev._set_data(weight._data)
+        weight._set_data(weight._data + d)
+
+
+@register
+class Test(Optimizer):
+    """reference: Test optimizer (w -= lr*grad, used in unit tests)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(
+            weight._data - self.lr * grad._data * self.rescale_grad)
+
+
+class Updater:
+    """KVStore server-side updater wrapper (reference: get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+    def set_states(self, states):
+        import pickle
+        obj = pickle.loads(states)
+        if isinstance(obj, tuple):
+            self.states, self.optimizer = obj
+        else:
+            self.states = obj
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,218 @@
+"""Optimizer update-rule tests vs hand NumPy references (reference model:
+tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import optimizer as opt
+
+
+def _setup(shape=(4, 5), seed=3):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal(shape).astype(np.float32)
+    g = rng.standard_normal(shape).astype(np.float32)
+    return w, g
+
+
+def test_sgd_matches_numpy():
+    w, g = _setup()
+    o = opt.create("sgd", learning_rate=0.1, wd=0.01)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    ref = w - 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w, g = _setup()
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.0)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    mom = np.zeros_like(w)
+    cur = w.copy()
+    for _ in range(3):
+        o.update(0, mw, mg, state)
+        mom = 0.9 * mom - 0.1 * g
+        cur = cur + mom
+    np.testing.assert_allclose(mw.asnumpy(), cur, rtol=1e-5)
+
+
+def test_nag_matches_numpy():
+    w, g = _setup()
+    o = opt.create("nag", learning_rate=0.05, momentum=0.9)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    mom = 0.9 * np.zeros_like(w) + g
+    ref = w - 0.05 * (g + 0.9 * mom)
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w, g = _setup()
+    o = opt.create("adam", learning_rate=0.01)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    cur = w.copy()
+    for t in range(1, 4):
+        o.update(0, mw, mg, state)
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        cur = cur - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(mw.asnumpy(), cur, rtol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    w, g = _setup()
+    o = opt.create("rmsprop", learning_rate=0.01, gamma1=0.9)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    n = 0.1 * g * g
+    ref = w - 0.01 * g / np.sqrt(n + 1e-8)
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    w, g = _setup()
+    o = opt.create("adagrad", learning_rate=0.05)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    h = g * g
+    ref = w - 0.05 * g / np.sqrt(h + 1e-7)
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-5)
+
+
+def test_signum_signsgd():
+    w, g = _setup()
+    o = opt.create("signum", learning_rate=0.01, momentum=0.0)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    o.update(0, mw, mg, o.create_state(0, mw))
+    ref = w - 0.01 * np.sign(g)
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-6)
+
+
+def test_lamb_runs_and_descends():
+    w, g = _setup()
+    o = opt.create("lamb", learning_rate=0.01)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    before = float((mx.nd.array(g) * mw).sum().asscalar())
+    o.update(0, mw, mg, state)
+    after = float((mx.nd.array(g) * mw).sum().asscalar())
+    assert after < before  # moved against the gradient
+
+
+def test_multi_precision_sgd():
+    w = np.random.randn(3, 3).astype(np.float16)
+    g = np.random.randn(3, 3).astype(np.float16)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    mw, mg = mx.nd.array(w, dtype=np.float16), mx.nd.array(g,
+                                                           dtype=np.float16)
+    state = o.create_state_multi_precision(0, mw)
+    w32, mom = state
+    assert w32.dtype == np.float32
+    o.update_multi_precision(0, mw, mg, state)
+    assert mw.dtype == np.float16
+    ref = w.astype(np.float32) - 0.1 * g.astype(np.float32)
+    np.testing.assert_allclose(w32.asnumpy(), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_lr_scheduler_factor():
+    import incubator_mxnet_tpu.lr_scheduler as lrs
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_lr_scheduler_warmup_cosine():
+    import incubator_mxnet_tpu.lr_scheduler as lrs
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0,
+                            warmup_steps=10)
+    assert s(5) == pytest.approx(0.5)
+    assert s(10) == pytest.approx(1.0)
+    assert s(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_optimizer_with_scheduler():
+    import incubator_mxnet_tpu.lr_scheduler as lrs
+    o = opt.create("sgd", learning_rate=1.0,
+                   lr_scheduler=lrs.FactorScheduler(step=1, factor=0.1))
+    w = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    o.update(0, w, g, None)
+    assert o.num_update == 1
+
+
+def test_trainer_step():
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+    net = nn.Dense(1, in_units=2)
+    net.initialize(init=mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = mx.nd.array(np.array([[1.0, 2.0]], np.float32))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    # w (ones) -= 0.5 * [1, 2]; b (zeros) -= 0.5
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               [[0.5, 0.0]], rtol=1e-6)
+    np.testing.assert_allclose(net.bias.data().asnumpy(), [-0.5],
+                               rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    x = mx.nd.ones((1, 2))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    tr.step(1)
+    f = str(tmp_path / "opt.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.01})
+    tr2.load_states(f)
+    assert tr2._optimizer._index_update_count == \
+        tr._optimizer._index_update_count
+
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+    # aggregation
+    kv.push(3, [mx.nd.ones((2, 3)), mx.nd.ones((2, 3)) * 2])
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 3.0))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((4,)))
+    kv.set_optimizer(opt.create("test", learning_rate=0.1))
+    kv.push("w", mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), 0.9),
+                               rtol=1e-6)
+
+
+def test_kvstore_dist_async_refused():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("dist_async")
