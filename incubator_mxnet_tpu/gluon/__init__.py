@@ -4,8 +4,9 @@ from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn
 from . import rnn
+from . import data
 from . import loss
 from . import utils
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "rnn", "loss", "utils"]
+           "SymbolBlock", "Trainer", "nn", "rnn", "data", "loss", "utils"]

@@ -1,0 +1,10 @@
+"""``mx.gluon.data`` (reference: python/mxnet/gluon/data/)."""
+from .dataset import *  # noqa: F401,F403
+from .sampler import *  # noqa: F401,F403
+from .dataloader import *  # noqa: F401,F403
+from . import vision  # noqa: F401
+from .dataset import __all__ as _d
+from .sampler import __all__ as _s
+from .dataloader import __all__ as _l
+
+__all__ = list(_d) + list(_s) + list(_l) + ["vision"]

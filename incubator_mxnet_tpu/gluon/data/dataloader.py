@@ -1,0 +1,103 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native re-design of the worker model: the reference forks
+multiprocessing workers that build batches in POSIX shared memory
+(cpu_shared context, reference: src/storage/cpu_shared_storage_manager.h)
+and passes fds over sockets.  Here host batches are numpy until the single
+``device_put`` at the end, so worker parallelism is a prefetching thread
+pool (decode/augment is numpy/PIL releasing the GIL) — no fd plumbing, and
+the jax transfer guard keeps device placement on the main thread.
+``num_workers>0`` controls the prefetch pool size with the same API.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import ndarray as _ndmod
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from . import sampler as _sampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ...ndarray import ops as _ops
+        return _ops.stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    if arr.dtype == _np.int64:
+        arr = arr.astype(_np.int32)
+    return _ndmod.array(arr, dtype=arr.dtype)
+
+
+default_mp_batchify_fn = default_batchify_fn  # shm path not needed
+
+
+class DataLoader:
+    """Mini-batch iterator over a Dataset (reference: DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required unless batch_sampler is given")
+            if sampler is None:
+                sampler = (_sampler.RandomSampler(len(dataset)) if shuffle
+                           else _sampler.SequentialSampler(len(dataset)))
+            elif shuffle:
+                raise MXNetError("shuffle is mutually exclusive w/ sampler")
+            batch_sampler = _sampler.BatchSampler(
+                sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch are mutually "
+                "exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # prefetching pool: keep `prefetch` batch futures in flight
+        with ThreadPoolExecutor(self._num_workers) as pool:
+            batches = iter(self._batch_sampler)
+            inflight = []
+            try:
+                for _ in range(max(1, self._prefetch)):
+                    inflight.append(pool.submit(self._make_batch,
+                                                next(batches)))
+            except StopIteration:
+                pass
+            while inflight:
+                fut = inflight.pop(0)
+                try:
+                    inflight.append(pool.submit(self._make_batch,
+                                                next(batches)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

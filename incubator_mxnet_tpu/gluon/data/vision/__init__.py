@@ -1,0 +1,7 @@
+"""``mx.gluon.data.vision`` (reference:
+python/mxnet/gluon/data/vision/)."""
+from . import transforms  # noqa: F401
+from .datasets import *  # noqa: F401,F403
+from .datasets import __all__ as _d
+
+__all__ = list(_d) + ["transforms"]

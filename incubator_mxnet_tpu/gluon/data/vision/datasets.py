@@ -1,0 +1,186 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py).
+
+Readers parse the standard on-disk formats (MNIST idx, CIFAR binary) from a
+``root`` directory.  Downloading is environment-dependent; with no network
+the constructor raises a clear error pointing at ``root``.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ....base import MXNetError
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset"]
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"bad idx image magic in {path}")
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"bad idx label magic in {path}")
+        return _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        from ....ndarray import ndarray as _ndmod
+        img = _ndmod.array(self._data[idx], dtype=_np.uint8)
+        label = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """reference: gluon.data.vision.MNIST (idx format under root)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _find(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise MXNetError(
+            f"{base} not found under {self._root}; download is unavailable "
+            "in this environment — place the standard files there")
+
+    def _get_data(self):
+        imgs, labels = (self._train_files if self._train
+                        else self._test_files)
+        self._data = _read_idx_images(self._find(imgs))
+        self._label = _read_idx_labels(self._find(labels))
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """reference: gluon.data.vision.CIFAR10 (binary batches under root)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as f:
+            raw = _np.frombuffer(f.read(), dtype=_np.uint8)
+        rec = raw.reshape(-1, 3073)
+        labels = rec[:, 0].astype(_np.int32)
+        data = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, labels
+
+    def _get_data(self):
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)]
+                 if self._train else ["test_batch.bin"])
+        data, labels = [], []
+        for n in names:
+            p = os.path.join(self._root, n)
+            if not os.path.exists(p):
+                p2 = os.path.join(self._root, "cifar-10-batches-bin", n)
+                if os.path.exists(p2):
+                    p = p2
+                else:
+                    raise MXNetError(
+                        f"{n} not found under {self._root}; download is "
+                        "unavailable — place CIFAR-10 binary batches there")
+            d, l = self._read_batch(p)
+            data.append(d)
+            labels.append(l)
+        self._data = _np.concatenate(data)
+        self._label = _np.concatenate(labels)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root="~/.mxnet/datasets/cifar100",
+                 fine_label=False, train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as f:
+            raw = _np.frombuffer(f.read(), dtype=_np.uint8)
+        rec = raw.reshape(-1, 3074)
+        labels = rec[:, 1 if self._fine else 0].astype(_np.int32)
+        data = rec[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, labels
+
+    def _get_data(self):
+        name = "train.bin" if self._train else "test.bin"
+        p = os.path.join(self._root, name)
+        if not os.path.exists(p):
+            raise MXNetError(f"{name} not found under {self._root}")
+        self._data, self._label = self._read_batch(p)
+
+
+class ImageFolderDataset(Dataset):
+    """A dataset of images arranged root/category/image.jpg
+    (reference: ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png", ".bmp")
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith(self._exts):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

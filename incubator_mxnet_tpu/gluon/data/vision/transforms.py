@@ -1,0 +1,245 @@
+"""Vision transforms (reference:
+python/mxnet/gluon/data/vision/transforms.py).  Operate on HWC uint8/float
+NDArrays; ToTensor converts to CHW float32/255."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "CropResize"]
+
+
+class Compose(Sequential):
+    """Chain transforms (reference: transforms.Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, "float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        from ....ndarray import ndarray as _ndmod
+        mean = _np.asarray(self._mean, _np.float32).reshape(-1, 1, 1)
+        std = _np.asarray(self._std, _np.float32).reshape(-1, 1, 1)
+        return (x - _ndmod.array(mean)) / _ndmod.array(std)
+
+
+def _resize_np(img, size, interp="bilinear"):
+    """Bilinear resize on HWC numpy (no cv2 dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        # short-edge resize keeping aspect (reference Resize(int))
+        if h < w:
+            new_h, new_w = size, int(w * size / h)
+        else:
+            new_h, new_w = int(h * size / w), size
+    else:
+        new_w, new_h = size  # reference order (w, h)
+    ys = _np.linspace(0, h - 1, new_h)
+    xs = _np.linspace(0, w - 1, new_w)
+    y0 = _np.floor(ys).astype(int)
+    x0 = _np.floor(xs).astype(int)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img.astype(_np.float32)
+    out = (img[y0][:, x0] * (1 - wy) * (1 - wx)
+           + img[y0][:, x1] * (1 - wy) * wx
+           + img[y1][:, x0] * wy * (1 - wx)
+           + img[y1][:, x1] * wy * wx)
+    return out
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from ....ndarray import ndarray as _ndmod
+        img = x.asnumpy()
+        dtype = img.dtype
+        out = _resize_np(img, self._size)
+        if dtype == _np.uint8:
+            out = _np.clip(_np.rint(out), 0, 255).astype(_np.uint8)
+        return _ndmod.array(out, dtype=out.dtype)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        from ....ndarray import ndarray as _ndmod
+        img = x.asnumpy()
+        w, h = self._size
+        hh, ww = img.shape[:2]
+        y0 = max(0, (hh - h) // 2)
+        x0 = max(0, (ww - w) // 2)
+        out = img[y0:y0 + h, x0:x0 + w]
+        if out.shape[:2] != (h, w):
+            out = _resize_np(out, (w, h)).astype(img.dtype)
+        return _ndmod.array(out, dtype=out.dtype)
+
+
+class CropResize(Block):
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._args = (x, y, width, height)
+        self._size = size
+
+    def forward(self, data):
+        from ....ndarray import ndarray as _ndmod
+        x0, y0, w, h = self._args
+        img = data.asnumpy()[y0:y0 + h, x0:x0 + w]
+        if self._size:
+            img = _resize_np(img, self._size).astype(img.dtype)
+        return _ndmod.array(img, dtype=img.dtype)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from ....ndarray import ndarray as _ndmod
+        from .... import random as mxrand
+        rng = mxrand.numpy_rng()
+        img = x.asnumpy()
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = rng.uniform(*self._scale) * area
+            ar = rng.uniform(*self._ratio)
+            new_w = int(round(_np.sqrt(target_area * ar)))
+            new_h = int(round(_np.sqrt(target_area / ar)))
+            if new_w <= w and new_h <= h:
+                x0 = rng.integers(0, w - new_w + 1)
+                y0 = rng.integers(0, h - new_h + 1)
+                crop = img[y0:y0 + new_h, x0:x0 + new_w]
+                out = _resize_np(crop, self._size).astype(_np.float32)
+                if img.dtype == _np.uint8:
+                    out = _np.clip(_np.rint(out), 0, 255).astype(_np.uint8)
+                return _ndmod.array(out, dtype=out.dtype)
+        # fallback: center crop
+        return CenterCrop(self._size)(x)
+
+
+class _RandomFlip(Block):
+    def __init__(self, axis):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from .... import random as mxrand
+        if mxrand.numpy_rng().random() < 0.5:
+            return x.flip(axis=self._axis)
+        return x
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    def __init__(self):
+        super().__init__(1)
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    def __init__(self):
+        super().__init__(0)
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        from .... import random as mxrand
+        f = 1.0 + mxrand.numpy_rng().uniform(-self._b, self._b)
+        return (x.astype(_np.float32) * f).clip(0, 255).astype(x.dtype)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        from .... import random as mxrand
+        f = 1.0 + mxrand.numpy_rng().uniform(-self._c, self._c)
+        xf = x.astype(_np.float32)
+        mean = xf.mean()
+        return ((xf - mean) * f + mean).clip(0, 255).astype(x.dtype)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        from .... import random as mxrand
+        f = 1.0 + mxrand.numpy_rng().uniform(-self._s, self._s)
+        xf = x.astype(_np.float32)
+        gray = xf.mean(axis=-1, keepdims=True)
+        return (gray + (xf - gray) * f).clip(0, 255).astype(x.dtype)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: RandomLighting)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from .... import random as mxrand
+        from ....ndarray import ndarray as _ndmod
+        rng = mxrand.numpy_rng()
+        alpha = rng.normal(0, self._alpha, 3).astype(_np.float32)
+        noise = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        out = x.asnumpy().astype(_np.float32) + noise
+        return _ndmod.array(out, dtype=_np.float32)

@@ -1,0 +1,114 @@
+"""End-to-end training convergence smoke tests (reference model:
+tests/python/train/test_mlp.py, test_conv.py — small models must reach an
+accuracy threshold in a few epochs)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _synthetic_classification(n=512, dim=16, classes=4, seed=0):
+    """Gaussian blobs — linearly separable-ish."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)).astype(np.float32) * 3
+    y = rng.integers(0, classes, n)
+    X = centers[y] + rng.standard_normal((n, dim)).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def _accuracy(net, X, y):
+    out = net(mx.nd.array(X))
+    pred = out.argmax(axis=1).asnumpy()
+    return (pred == y).mean()
+
+
+def test_mlp_convergence():
+    X, y = _synthetic_classification()
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=64, shuffle=True)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(5):
+        for xb, yb in loader:
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+    assert _accuracy(net, X, y) > 0.9
+
+
+def test_lenet_convergence():
+    """LeNet on synthetic 'digit' images: class k = bright kxk corner
+    block.  (reference: example/gluon/mnist workalike at toy scale.)"""
+    rng = np.random.default_rng(1)
+    n, classes = 256, 3
+    y = rng.integers(0, classes, n)
+    X = rng.standard_normal((n, 1, 12, 12)).astype(np.float32) * 0.3
+    for i, c in enumerate(y):
+        X[i, 0, : 2 * (c + 1), : 2 * (c + 1)] += 2.0
+    y = y.astype(np.float32)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(16, kernel_size=3, activation="relu"),
+                nn.MaxPool2D(2, 2),
+                nn.Flatten(),
+                nn.Dense(32, activation="relu"),
+                nn.Dense(classes))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.003})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=32, shuffle=True)
+    for epoch in range(6):
+        for xb, yb in loader:
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+    assert _accuracy(net, X, y) > 0.9
+
+
+def test_lstm_sequence_classification():
+    """Sequence task: classify by which half has larger mean."""
+    rng = np.random.default_rng(2)
+    n, T, C = 256, 8, 4
+    X = rng.standard_normal((n, T, C)).astype(np.float32)
+    y = (X[:, : T // 2].mean(axis=(1, 2))
+         > X[:, T // 2:].mean(axis=(1, 2))).astype(np.float32)
+
+    class Net(nn.HybridSequential):
+        pass
+
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+    net = nn.HybridSequential()
+    with net.name_scope():
+        lstm = grnn.LSTM(16, layout="NTC", input_size=C)
+        net.add(lstm, nn.HybridLambda(
+            lambda F, x: x.slice_axis(1, x.shape[1] - 1,
+                                      x.shape[1]).squeeze(axis=1)),
+            nn.Dense(2))
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=64, shuffle=True)
+    for epoch in range(8):
+        for xb, yb in loader:
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+    assert _accuracy(net, X, y) > 0.8
