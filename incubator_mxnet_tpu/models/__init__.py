@@ -1,0 +1,8 @@
+"""Model collection (reference analog: gluon model_zoo + the GluonNLP
+model scripts that are the judged workloads — BASELINE.md)."""
+from . import bert  # noqa: F401
+from .bert import (BERTModel, BERTEncoder, BERTForPretrain,
+                   bert_base, bert_large, bert_tiny)
+
+__all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
+           "bert_base", "bert_large", "bert_tiny"]

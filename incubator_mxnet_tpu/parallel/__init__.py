@@ -1,0 +1,26 @@
+"""``mx.parallel``: TPU-native distribution (SURVEY §2.4, §5.8).
+
+The reference's distribution surface (DataParallelExecutorGroup, KVStore
+local/device/nccl/dist_sync, ps-lite, Horovod hooks) re-designed as mesh +
+shardings + one compiled step:
+
+  make_mesh / mesh_scope      device mesh with named axes
+  SPMDTrainer                 whole train step (fwd+bwd+psum+opt) in one jit
+  shard_params                regex→PartitionSpec tensor parallelism
+  ring_attention              sequence parallelism over the mesh (beyond
+                              reference parity)
+  distributed.initialize      multi-host bootstrap (DMLC_* env compat)
+"""
+from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
+                   replicated, shard_spec, named_sharding,
+                   device_put_sharded)
+from .spmd import SPMDTrainer, shard_params, data_sharding
+from .ring import ring_attention, local_flash_attention
+from . import optim
+from . import distributed
+
+__all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
+           "replicated", "shard_spec", "named_sharding",
+           "device_put_sharded", "SPMDTrainer", "shard_params",
+           "data_sharding", "ring_attention", "local_flash_attention",
+           "optim", "distributed"]

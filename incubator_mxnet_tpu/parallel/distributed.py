@@ -1,0 +1,100 @@
+"""Multi-host bootstrap (reference: the DMLC_* env-var topology of ps-lite —
+3rdparty/ps-lite van.cc, tools/launch.py — re-mapped onto
+``jax.distributed``).
+
+One process per host; after ``initialize()``, ``jax.devices()`` spans the
+pod and a Mesh built from it gives DP/TP/SP over ICI+DCN.  Reference env
+vars are honored so reference launch scripts keep working:
+
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> coordinator address
+  DMLC_NUM_WORKER                      -> num_processes
+  DMLC_WORKER_ID (or DMLC_RANK)        -> process_id
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["initialize", "shutdown", "rank", "num_workers",
+           "local_device_count", "global_device_count", "barrier"]
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None):
+    """Connect this process to the job (reference analog: ps-lite Van
+    connect to DMLC_PS_ROOT_URI + barrier)."""
+    global _initialized
+    import jax
+    if _initialized:
+        return
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None:
+        nw = os.environ.get("DMLC_NUM_WORKER")
+        num_processes = int(nw) if nw else None
+    if process_id is None:
+        pid = os.environ.get("DMLC_WORKER_ID", os.environ.get("DMLC_RANK"))
+        process_id = int(pid) if pid else None
+    if coordinator_address is None and num_processes in (None, 1):
+        _initialized = True  # single-host: nothing to do
+        return
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    import jax
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized = False
+
+
+def rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def barrier(name: str = "barrier"):
+    """Block until all processes arrive (reference: ps Postoffice barrier).
+    Implemented as a tiny psum across the global mesh."""
+    import jax
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return
+    from jax.sharding import NamedSharding, PartitionSpec, Mesh
+    import numpy as np
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("all",))
+    x = jnp.zeros(len(devs))
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("all")))
+    jnp.sum(xs).block_until_ready()

@@ -1,0 +1,102 @@
+"""Device-mesh management (SURVEY §2.4: the TPU-native replacement for the
+reference's multi-device Context lists + KVStore comm topology —
+src/kvstore/comm.h, comm_tree.h).
+
+A Mesh names axes ('data', 'model', 'seq', 'pipe', 'expert'...) over the
+device grid; shardings are NamedSharding(PartitionSpec) over those axes and
+XLA compiles the collectives (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "current_mesh", "mesh_scope", "replicated",
+           "shard_spec", "named_sharding", "device_put_sharded",
+           "local_mesh"]
+
+_tls = threading.local()
+
+
+def make_mesh(axes: Dict[str, int], devices=None):
+    """Create a ``jax.sharding.Mesh`` with named axes.
+
+    axes: ordered dict-like {axis_name: size}; -1 for one axis means "all
+    remaining devices".  devices defaults to ``jax.devices()``.
+    """
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n_unknown = sum(1 for s in sizes if s == -1)
+    if n_unknown > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    known = int(_np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if n_unknown:
+        if len(devices) % known:
+            raise MXNetError(
+                f"{len(devices)} devices not divisible by {known}")
+        sizes = [len(devices) // known if s == -1 else s for s in sizes]
+    total = int(_np.prod(sizes)) if sizes else 1
+    if total > len(devices):
+        raise MXNetError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, have "
+            f"{len(devices)}")
+    grid = _np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def local_mesh(axes: Dict[str, int]):
+    """Mesh over this process's local devices only."""
+    import jax
+    return make_mesh(axes, jax.local_devices())
+
+
+def current_mesh():
+    return getattr(_tls, "mesh", None)
+
+
+class mesh_scope:
+    """``with mesh_scope(mesh):`` sets the ambient mesh used by the
+    parallel helpers (and KVStore('tpu'))."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "mesh", None)
+        _tls.mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _tls.mesh = self._prev
+        return False
+
+
+def shard_spec(*axes):
+    """PartitionSpec shorthand: shard_spec('data', None) etc."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*axes)
+
+
+def replicated(mesh=None):
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def named_sharding(mesh, *axes):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*axes))
+
+
+def device_put_sharded(array, mesh, *axes):
+    """Place (a jax array or numpy) with the given PartitionSpec axes."""
+    import jax
+    return jax.device_put(array, named_sharding(mesh, *axes))

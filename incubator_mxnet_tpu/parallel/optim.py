@@ -1,0 +1,129 @@
+"""Functional optimizer cores for the compiled SPMD train step.
+
+The imperative ``mx.optimizer`` classes (reference parity layer) mutate
+NDArrays eagerly; inside one jitted+sharded train step the update must be a
+pure function of (params, grads, state, step).  These mirror the same
+update rules as ndarray/optimizer_ops.py (reference:
+src/operator/optimizer_op.cc) in pytree form — the analog of the
+reference's "server-side optimizer" (update_on_kvstore), except the
+"server" is the compiled program itself (SURVEY §2.4).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+from ..base import MXNetError
+
+__all__ = ["FunctionalOptimizer", "sgd", "adam", "lamb", "create"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class FunctionalOptimizer(NamedTuple):
+    """(init_fn, update_fn) pair.
+
+    init(params) -> state;
+    update(params, grads, state, step) -> (new_params, new_state)
+    where step is a traced int32 scalar (1-based).
+    """
+    init: Any
+    update: Any
+
+
+def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None):
+    import jax
+
+    def init(params):
+        if momentum == 0.0:
+            return {}
+        return {"mom": jax.tree.map(lambda p: _jnp().zeros_like(p), params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        if momentum == 0.0:
+            new_p = jax.tree.map(lambda w, g: w - lr * (g + wd * w),
+                                 params, grads)
+            return new_p, state
+        new_mom = jax.tree.map(
+            lambda m, g, w: momentum * m - lr * (g + wd * w),
+            state["mom"], grads, params)
+        new_p = jax.tree.map(lambda w, m: w + m, params, new_mom)
+        return new_p, {"mom": new_mom}
+    return FunctionalOptimizer(init, update)
+
+
+def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+         lr_schedule=None):
+    import jax
+    jnp = _jnp()
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p)  # noqa: E731
+        return {"m": jax.tree.map(z, params),
+                "v": jax.tree.map(z, params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        t = step.astype(jnp.float32)
+        coef = jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
+        new_m = jax.tree.map(lambda m, g: beta1 * m + (1 - beta1) * g,
+                             state["m"], grads)
+        new_v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
+                             state["v"], grads)
+        new_p = jax.tree.map(
+            lambda w, m, v, g: w - lr * coef * m / (jnp.sqrt(v) + epsilon)
+            - lr * wd * w,
+            params, new_m, new_v, grads)
+        return new_p, {"m": new_m, "v": new_v}
+    return FunctionalOptimizer(init, update)
+
+
+def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
+         lr_schedule=None):
+    """LAMB with per-tensor trust ratio (reference: LAMB optimizer +
+    lamb_update_phase1/2)."""
+    import jax
+    jnp = _jnp()
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p)  # noqa: E731
+        return {"m": jax.tree.map(z, params),
+                "v": jax.tree.map(z, params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        t = step.astype(jnp.float32)
+        new_m = jax.tree.map(lambda m, g: beta1 * m + (1 - beta1) * g,
+                             state["m"], grads)
+        new_v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
+                             state["v"], grads)
+
+        def upd(w, m, v):
+            mhat = m / (1 - beta1 ** t)
+            vhat = v / (1 - beta2 ** t)
+            u = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w
+            r1 = jnp.linalg.norm(w.ravel())
+            r2 = jnp.linalg.norm(u.ravel())
+            ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+            return w - lr * ratio * u
+        new_p = jax.tree.map(upd, params, new_m, new_v)
+        return new_p, {"m": new_m, "v": new_v}
+    return FunctionalOptimizer(init, update)
+
+
+_REGISTRY = {"sgd": sgd, "adam": adam, "lamb": lamb}
+
+
+def create(name, **kwargs) -> FunctionalOptimizer:
+    if isinstance(name, FunctionalOptimizer):
+        return name
+    if name not in _REGISTRY:
+        raise MXNetError(
+            f"unknown functional optimizer {name!r} "
+            f"(have {sorted(_REGISTRY)}); momentum= maps onto sgd")
+    if name == "sgd" and "momentum" not in kwargs:
+        kwargs.setdefault("momentum", 0.0)
+    return _REGISTRY[name](**kwargs)

@@ -1,0 +1,192 @@
+"""SPMD / mesh / ring-attention tests on the virtual 8-device CPU mesh
+(the reference's no-cluster distributed test trick, SURVEY §4:
+tests/nightly/dist_sync_kvstore.py via the dmlc 'local' tracker →
+here XLA_FORCE_HOST_PLATFORM_DEVICE_COUNT=8)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_make_mesh():
+    import jax
+    mesh = parallel.make_mesh({"data": -1})
+    assert mesh.devices.size == len(jax.devices()) == 8
+    mesh2 = parallel.make_mesh({"data": 4, "model": 2})
+    assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
+
+
+def test_mesh_scope():
+    mesh = parallel.make_mesh({"data": -1})
+    assert parallel.current_mesh() is None
+    with parallel.mesh_scope(mesh):
+        assert parallel.current_mesh() is mesh
+    assert parallel.current_mesh() is None
+
+
+def test_device_put_sharded():
+    import jax
+    mesh = parallel.make_mesh({"data": -1})
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    xs = parallel.device_put_sharded(x, mesh, "data")
+    assert len(xs.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(xs), x)
+
+
+def test_spmd_trainer_data_parallel_step():
+    mesh = parallel.make_mesh({"data": -1})
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.ones((8, 8)))  # settle shapes
+    tr = parallel.SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.1,
+                                      "momentum": 0.9}, mesh=mesh)
+    X = np.random.randn(16, 8).astype(np.float32)
+    y = np.random.randint(0, 4, 16).astype(np.float32)
+    losses = [float(tr.step(X, y)) for _ in range(5)]
+    assert losses[-1] < losses[0]  # learning
+    tr.sync_to_block()
+    out = net(mx.nd.array(X))
+    assert out.shape == (16, 4)
+
+
+def test_spmd_matches_single_device_math():
+    """DP over 8 shards must produce the same update as 1 device (sync SGD
+    semantics — the dist_sync_kvstore.py analytic-aggregate assertion)."""
+    mesh = parallel.make_mesh({"data": -1})
+    net = nn.Dense(2, in_units=4)
+    net.initialize(init=mx.init.One())
+    net(mx.nd.ones((1, 4)))
+    tr = parallel.SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 0.1}, mesh=mesh)
+    X = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 2).astype(np.float32)
+    tr.step(X, y)
+    tr.sync_to_block()
+    w_spmd = net.weight.data().asnumpy().copy()
+
+    # single-device reference via the imperative trainer
+    net2 = nn.Dense(2, in_units=4)
+    net2.initialize(init=mx.init.One())
+    t2 = gluon.Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    with mx.autograd.record():
+        loss = loss_fn(net2(mx.nd.array(X)), mx.nd.array(y))
+    loss.backward()
+    t2.step(8)  # mean loss => rescale 1/8... SPMD uses mean over batch
+    # SPMD loss is mean over all samples; imperative backward of vector
+    # loss sums head grads (ones), so trainer.step(batch) divides by 8 —
+    # identical math.
+    np.testing.assert_allclose(w_spmd, net2.weight.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_tensor_parallel_rules():
+    from jax.sharding import PartitionSpec as P
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=8, activation="relu"),
+                nn.Dense(8, in_units=8))
+    net.initialize()
+    net(mx.nd.ones((4, 8)))
+    rules = [(r"dense0_weight", P("model", None))]
+    tr = parallel.SPMDTrainer(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.01},
+        mesh=mesh, sharding_rules=rules)
+    X = np.random.randn(8, 8).astype(np.float32)
+    y = np.random.randn(8, 8).astype(np.float32)
+    l0 = float(tr.step(X, y))
+    l1 = float(tr.step(X, y))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    # the dense0 weight should actually be sharded over 'model'
+    w_sharding = tr._tr_vals[0].sharding
+    assert "model" in str(w_sharding.spec)
+
+
+def test_spmd_aux_state_flows():
+    """BatchNorm running stats must update through the compiled step."""
+    mesh = parallel.make_mesh({"data": -1})
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(mx.nd.ones((8, 4)))
+    rm_before = net.collect_params()[
+        net.prefix + "batchnorm0_running_mean"].data().asnumpy().copy()
+    tr = parallel.SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 0.01}, mesh=mesh)
+    X = np.random.randn(16, 4).astype(np.float32) + 3
+    y = np.random.randn(16, 2).astype(np.float32)
+    tr.step(X, y)
+    tr.sync_to_block()
+    rm_after = net.collect_params()[
+        net.prefix + "batchnorm0_running_mean"].data().asnumpy()
+    assert not np.allclose(rm_before, rm_after)
+
+
+def test_ring_attention_matches_local():
+    import jax
+    mesh = parallel.make_mesh({"seq": -1})
+    B, H, T, D = 2, 4, 32, 8  # T sharded 8 ways -> 4 per device
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    out = parallel.ring_attention(jax.numpy.asarray(q),
+                                  jax.numpy.asarray(k),
+                                  jax.numpy.asarray(v), mesh=mesh)
+    ref = parallel.local_flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_causal():
+    import jax
+    mesh = parallel.make_mesh({"seq": -1})
+    B, H, T, D = 1, 2, 16, 4
+    rng = np.random.default_rng(1)
+    q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+    out = parallel.ring_attention(jax.numpy.asarray(q),
+                                  jax.numpy.asarray(k),
+                                  jax.numpy.asarray(v), mesh=mesh,
+                                  causal=True)
+    ref = parallel.local_flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_under_jit_and_grad():
+    import jax
+    import jax.numpy as jnp
+    mesh = parallel.make_mesh({"seq": -1})
+    B, H, T, D = 1, 1, 16, 4
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(parallel.ring_attention(q, k, v, mesh=mesh) ** 2)
+
+    g = jax.jit(jax.grad(loss))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            parallel.local_flash_attention(q, k, v) ** 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_distributed_single_host_noop():
+    from incubator_mxnet_tpu.parallel import distributed
+    distributed.initialize()  # no coordinator: single-host no-op
+    assert distributed.rank() == 0
+    assert distributed.num_workers() == 1
+    distributed.barrier()
