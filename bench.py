@@ -23,7 +23,7 @@ BASELINE_SAMPLES_PER_SEC_PER_CHIP = 12.5
 def main():
     import jax
     import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu import parallel
     from incubator_mxnet_tpu.models import bert as bert_mod
 
     dev = jax.devices()[0]
@@ -55,27 +55,8 @@ def main():
 
     mesh = parallel.make_mesh({"data": 1}, devices=[dev])
 
-    class PretrainLoss(gluon.HybridBlock):
-        def __init__(self):
-            super().__init__()
-            with self.name_scope():
-                self.ce = gluon.loss.SoftmaxCrossEntropyLoss()
-
-        def hybrid_forward(self, F, mlm_scores, labels):
-            return self.ce(mlm_scores.reshape(-1, V), labels.reshape(-1))
-
-    class MLMOnly(gluon.HybridBlock):
-        def __init__(self, inner):
-            super().__init__(prefix="")
-            with self.name_scope():
-                self.inner = inner
-
-        def hybrid_forward(self, F, input_ids, token_types):
-            mlm, _ = self.inner(input_ids, token_types)
-            return mlm
-
     trainer = parallel.SPMDTrainer(
-        MLMOnly(net), PretrainLoss(), "adam",
+        bert_mod.BERTMLMOnly(net), bert_mod.MLMPretrainLoss(V), "adam",
         {"learning_rate": 1e-4}, mesh=mesh, data_axis="data")
 
     x_ids = rng.integers(0, V, (B, T)).astype(np.int32)

@@ -36,10 +36,13 @@ from . import lr_scheduler
 from . import kvstore
 from . import kvstore as kv
 from . import parallel
+from . import symbol
+from . import symbol as sym
+from .executor import Executor
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
            "autograd", "random", "base", "context", "initializer", "init",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
-           "parallel"]
+           "parallel", "symbol", "sym", "Executor"]

@@ -1,0 +1,259 @@
+"""Executor: the bound, compiled form of a Symbol (reference:
+src/executor/graph_executor.cc GraphExecutor + python/mxnet/executor.py).
+
+TPU-native re-design: ``bind`` does not run memory-planning / op-exec
+attachment passes — it closes the symbol graph over a pure function and
+``jax.jit``s it.  XLA buffer assignment subsumes PlanMemory, XLA fusion
+subsumes op bulking, and autodiff is ``jax.vjp`` of the same function
+(subsuming the nnvm Gradient pass).  Forward and backward are each one
+compiled program; backward recomputes forward inside the compiled region
+(rematerialization — the XLA-idiomatic trade, cheaper than keeping every
+intermediate live in HBM).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None, _shapes_hint=None):
+        from . import autograd  # noqa: F401  (scope helpers used in _run)
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._out_names = symbol.list_outputs()
+
+        self.arg_arrays = self._canon_arrays(args, self._arg_names, "args")
+        self.aux_arrays = self._canon_arrays(aux_states, self._aux_names,
+                                             "aux_states", allow_empty=True)
+
+        # grad_req: str | list | dict
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self._arg_names}
+
+        if args_grad is None:
+            args_grad = {}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._arg_names, args_grad))
+        self.grad_arrays = []
+        for n, a in zip(self._arg_names, self.arg_arrays):
+            if self._grad_req.get(n, "null") == "null":
+                self.grad_arrays.append(None)
+            elif n in args_grad:
+                self.grad_arrays.append(args_grad[n])
+            else:
+                self.grad_arrays.append(nd.zeros(a.shape, ctx=self._ctx,
+                                                 dtype=a.dtype))
+
+        self.outputs: List[NDArray] = []
+        self._fwd_cache: Dict[bool, object] = {}
+        self._bwd_cache = None
+        self._last_primals = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        type_dict = type_dict or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {n: nd.zeros(s, ctx=ctx,
+                            dtype=type_dict.get(n, _np.float32))
+                for n, s in zip(arg_names, arg_shapes)}
+        # moving_var-style aux start at the reference's init values when the
+        # user never writes them: mean 0, var 1
+        aux = {}
+        for n, s in zip(aux_names, aux_shapes):
+            init = nd.ones if n.endswith("_var") else nd.zeros
+            aux[n] = init(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
+        return cls(symbol, ctx, args=args, grad_req=grad_req,
+                   aux_states=aux)
+
+    def _canon_arrays(self, vals, names, what, allow_empty=False):
+        if vals is None:
+            if allow_empty:
+                vals = {}
+            else:
+                raise MXNetError(f"bind: {what} is required")
+        if isinstance(vals, dict):
+            missing = [n for n in names if n not in vals]
+            if missing and not allow_empty:
+                raise MXNetError(f"bind: {what} missing entries for "
+                                 f"{missing}")
+            out = []
+            for n in names:
+                v = vals.get(n)
+                if v is None:
+                    raise MXNetError(f"bind: {what} missing '{n}'")
+                out.append(self._as_nd(v))
+            return out
+        vals = list(vals)
+        if len(vals) != len(names):
+            raise MXNetError(
+                f"bind: {what} has {len(vals)} entries, expected "
+                f"{len(names)} ({names})")
+        return [self._as_nd(v) for v in vals]
+
+    def _as_nd(self, v) -> NDArray:
+        if isinstance(v, NDArray):
+            return v
+        return nd.array(v, ctx=self._ctx)
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self) -> Dict[str, Optional[NDArray]]:
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._out_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in (arg_params or {}).items():
+            if n in self._arg_names:
+                self.arg_arrays[self._arg_names.index(n)] = self._as_nd(v)
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown arg '{n}'")
+        for n, v in (aux_params or {}).items():
+            if n in self._aux_names:
+                self.aux_arrays[self._aux_names.index(n)] = self._as_nd(v)
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown aux '{n}'")
+
+    # ------------------------------------------------------------------
+    # compiled graph functions
+    # ------------------------------------------------------------------
+    def _pure_fn(self, is_train: bool):
+        """(arg_vals, aux_vals, key) -> (outputs, new_aux) as jax arrays."""
+        from .symbol.symbol import eval_graph
+        from . import autograd as ag
+        from . import random as _random
+        symbol = self._symbol
+        arg_names, aux_names = self._arg_names, self._aux_names
+
+        def run(arg_vals, aux_vals, key):
+            values = {n: NDArray(a) for n, a in zip(arg_names, arg_vals)}
+            values.update(
+                {n: NDArray(a) for n, a in zip(aux_names, aux_vals)})
+            aux_sink: Dict[str, object] = {}
+            with ag.pause(train_mode=is_train), _random.trace_stream(key):
+                outs = eval_graph(symbol, values, is_train, aux_sink)
+            new_aux = []
+            for n, a in zip(aux_names, aux_vals):
+                upd = aux_sink.get(n)
+                new_aux.append(upd._data if isinstance(upd, NDArray)
+                               else (upd if upd is not None else a))
+            return tuple(o._data for o in outs), tuple(new_aux)
+        return run
+
+    def _fwd(self, is_train: bool):
+        if is_train not in self._fwd_cache:
+            import jax
+            self._fwd_cache[is_train] = jax.jit(self._pure_fn(is_train))
+        return self._fwd_cache[is_train]
+
+    def _bwd(self):
+        if self._bwd_cache is None:
+            import jax
+            run = self._pure_fn(True)
+            diff_idx = [i for i, n in enumerate(self._arg_names)
+                        if self._grad_req.get(n, "null") != "null"]
+
+            def bwd(arg_vals, aux_vals, key, cotangents):
+                def f(*diff_vals):
+                    full = list(arg_vals)
+                    for k, v in zip(diff_idx, diff_vals):
+                        full[k] = v
+                    outs, _ = run(tuple(full), aux_vals, key)
+                    return outs
+                diff_vals = [arg_vals[k] for k in diff_idx]
+                _, vjp_fn = jax.vjp(f, *diff_vals)
+                return vjp_fn(tuple(cotangents))
+            self._bwd_cache = (jax.jit(bwd), diff_idx)
+        return self._bwd_cache
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for n, v in kwargs.items():
+            if n not in self._arg_names:
+                raise MXNetError(f"forward: unknown input '{n}'")
+            self.arg_arrays[self._arg_names.index(n)] = self._as_nd(v)
+        from . import random as _random
+        key = _random.new_key(self._ctx)
+        arg_vals = tuple(a._data for a in self.arg_arrays)
+        aux_vals = tuple(a._data for a in self.aux_arrays)
+        outs, new_aux = self._fwd(bool(is_train))(arg_vals, aux_vals, key)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if is_train:
+            self._last_primals = (arg_vals, aux_vals, key)
+            for a, v in zip(self.aux_arrays, new_aux):
+                a._data = v
+        return self.outputs
+
+    def backward(self, out_grads=None) -> None:
+        if self._last_primals is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        arg_vals, aux_vals, key = self._last_primals
+        if out_grads is None:
+            import jax.numpy as jnp
+            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [self._as_nd(g)._data for g in out_grads]
+        bwd, diff_idx = self._bwd()
+        grads = bwd(arg_vals, aux_vals, key, tuple(cots))
+        for k, g in zip(diff_idx, grads):
+            name = self._arg_names[k]
+            if self._grad_req[name] == "add":
+                self.grad_arrays[k]._data = self.grad_arrays[k]._data + g
+            else:
+                self.grad_arrays[k]._data = g
+
+    # ------------------------------------------------------------------
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **new_shapes):
+        """Rebind with new input shapes (reference: Executor::Reshape).
+        Compilation is per-shape under XLA; the jit cache keys on shapes, so
+        this just re-allocates the changed inputs."""
+        args = {}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        for n, s, old in zip(self._arg_names, arg_shapes, self.arg_arrays):
+            if tuple(s) != tuple(old.shape):
+                args[n] = nd.zeros(s, ctx=self._ctx, dtype=old.dtype)
+            else:
+                args[n] = old
+        aux = {}
+        for n, s, old in zip(self._aux_names, aux_shapes, self.aux_arrays):
+            aux[n] = old if tuple(s) == tuple(old.shape) else \
+                nd.zeros(s, ctx=self._ctx, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, args=args,
+                        grad_req=self._grad_req, aux_states=aux)
+
+    def __repr__(self):
+        return (f"<Executor {self._symbol.name}: "
+                f"{len(self._arg_names)} args, {len(self._aux_names)} aux>")

@@ -24,12 +24,14 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..gluon import nn
+from ..gluon import loss as loss_mod
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import NDArray, _invoke
 
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
-           "BERTEncoder", "BERTModel", "BERTForPretrain", "bert_tiny",
-           "bert_base", "bert_large", "tp_rules"]
+           "BERTEncoder", "BERTModel", "BERTForPretrain", "MLMPretrainLoss",
+           "BERTMLMOnly", "bert_tiny", "bert_base", "bert_large",
+           "tp_rules"]
 
 
 def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
@@ -56,11 +58,19 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
             from jax.sharding import PartitionSpec as P
             from jax import shard_map
             spec = P(None, None, seq_axis, None)
-            out = shard_map(
-                partial(_ring_body, axis_name=seq_axis, scale=scale,
-                        causal=False),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False)(qh, kh, vh)
+            body = partial(_ring_body, axis_name=seq_axis, scale=scale,
+                           causal=False)
+            if rest:
+                # valid_length mask is sequence-sharded like K/V and
+                # rotates around the ring with them
+                out = shard_map(
+                    body, mesh=mesh,
+                    in_specs=(spec, spec, spec, P(None, seq_axis)),
+                    out_specs=spec, check_vma=False)(qh, kh, vh, rest[0])
+            else:
+                out = shard_map(
+                    body, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False)(qh, kh, vh)
         else:
             s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
             if rest:
@@ -79,7 +89,7 @@ class MultiHeadAttention(HybridBlock):
                  mesh=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
-            raise MXNetError("units must divide num_heads")
+            raise MXNetError("num_heads must divide units")
         self._units = units
         self._num_heads = num_heads
         self._seq_axis = seq_axis
@@ -215,6 +225,36 @@ class BERTForPretrain(HybridBlock):
         mlm_scores = self.mlm_decoder(self.mlm_ln(h))
         nsp_scores = self.nsp_classifier(pooled)
         return mlm_scores, nsp_scores
+
+
+class MLMPretrainLoss(HybridBlock):
+    """Masked-LM cross-entropy over flattened (B*T, V) scores — the loss
+    head bench.py and the driver's multichip dryrun both train with."""
+
+    def __init__(self, vocab_size, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.ce = loss_mod.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, mlm_scores, labels):
+        return self.ce(mlm_scores.reshape(-1, self._vocab_size),
+                       labels.reshape(-1))
+
+
+class BERTMLMOnly(HybridBlock):
+    """Wrap BERTForPretrain to expose only the MLM scores (single-output
+    step function for SPMDTrainer)."""
+
+    def __init__(self, inner, **kwargs):
+        kwargs.setdefault("prefix", "")
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.inner = inner
+
+    def hybrid_forward(self, F, input_ids, token_types):
+        mlm_scores, _nsp_scores = self.inner(input_ids, token_types)
+        return mlm_scores
 
 
 def tp_rules(model_axis="model"):

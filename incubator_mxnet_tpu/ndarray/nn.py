@@ -31,7 +31,9 @@ __all__ = ["FullyConnected", "fully_connected", "Convolution", "convolution",
            "Deconvolution", "deconvolution", "Pooling", "pooling",
            "BatchNorm", "batch_norm", "LayerNorm", "layer_norm",
            "InstanceNorm", "instance_norm", "GroupNorm", "group_norm",
-           "RNN", "rnn", "rnn_param_size", "SoftmaxOutput", "softmax_output"]
+           "RNN", "rnn", "rnn_param_size", "SoftmaxOutput", "softmax_output",
+           "LinearRegressionOutput", "MAERegressionOutput",
+           "LogisticRegressionOutput", "UpSampling"]
 
 
 def _jnp():
@@ -532,6 +534,69 @@ def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
     so.defvjp(so_fwd, so_bwd)
     return _invoke(lambda x, lab: so(x, lab), [data, label],
                    name="SoftmaxOutput")
+
+
+# ---------------------------------------------------------------------------
+# Regression output heads (reference: src/operator/regression_output-inl.h).
+# Forward is the prediction (identity / sigmoid); backward is the analytic
+# loss gradient scaled by grad_scale, with the head cotangent ignored —
+# modeled as custom-VJP functions like SoftmaxOutput above.
+# ---------------------------------------------------------------------------
+def _regression_output(name, fwd_fn, grad_fn):
+    def op(data, label, grad_scale=1.0, **_ignored):
+        import jax
+        jnp = _jnp()
+
+        @jax.custom_vjp
+        def ro(x, lab):
+            return fwd_fn(jnp, x)
+
+        def ro_fwd(x, lab):
+            out = fwd_fn(jnp, x)
+            return out, (out, lab)
+
+        def ro_bwd(resid, g):
+            out, lab = resid
+            lab = lab.reshape(out.shape).astype(out.dtype)
+            # reference scales by grad_scale / num_output where num_output
+            # is the per-example output count (regression_output-inl.h)
+            num_output = out.size // out.shape[0] if out.ndim > 0 else 1
+            gx = grad_fn(jnp, out, lab) * (grad_scale / num_output)
+            return gx, jnp.zeros(resid[1].shape, resid[1].dtype)
+
+        ro.defvjp(ro_fwd, ro_bwd)
+        return _invoke(lambda x, lab: ro(x, lab), [data, label], name=name)
+    op.__name__ = name
+    return op
+
+
+LinearRegressionOutput = _regression_output(
+    "LinearRegressionOutput", lambda jnp, x: x,
+    lambda jnp, out, lab: out - lab)
+MAERegressionOutput = _regression_output(
+    "MAERegressionOutput", lambda jnp, x: x,
+    lambda jnp, out, lab: jnp.sign(out - lab))
+LogisticRegressionOutput = _regression_output(
+    "LogisticRegressionOutput",
+    lambda jnp, x: 1.0 / (1.0 + jnp.exp(-x)),
+    lambda jnp, out, lab: out - lab)
+
+
+def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,
+               **_ignored):
+    """Nearest-neighbor upsampling (reference: src/operator/upsampling.cc).
+    Only the ``nearest`` sample_type of the reference is supported; bilinear
+    maps to jax.image.resize."""
+    d = data[0]
+
+    def fn(x):
+        jnp = _jnp()
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        import jax
+        n, c, h, w = x.shape
+        return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+    return _invoke(fn, [d], name="UpSampling")
 
 
 # lower-case aliases (the reference registers both spellings)

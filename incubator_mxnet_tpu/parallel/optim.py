@@ -69,14 +69,17 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         t = step.astype(jnp.float32)
         coef = jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
+        # wd folds into the gradient BEFORE the moment updates, matching the
+        # eager adam_update (ndarray/optimizer_ops.py / reference
+        # src/operator/optimizer_op-inl.h AdamUpdate) — not AdamW-style
+        geff = jax.tree.map(lambda g, w: g + wd * w, grads, params)
         new_m = jax.tree.map(lambda m, g: beta1 * m + (1 - beta1) * g,
-                             state["m"], grads)
+                             state["m"], geff)
         new_v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
-                             state["v"], grads)
+                             state["v"], geff)
         new_p = jax.tree.map(
-            lambda w, m, v, g: w - lr * coef * m / (jnp.sqrt(v) + epsilon)
-            - lr * wd * w,
-            params, new_m, new_v, grads)
+            lambda w, m, v: w - lr * coef * m / (jnp.sqrt(v) + epsilon),
+            params, new_m, new_v)
         return new_p, {"m": new_m, "v": new_v}
     return FunctionalOptimizer(init, update)
 

@@ -43,8 +43,13 @@ def local_flash_attention(q, k, v, scale=None, causal=False,
     return o / jnp.maximum(l, 1e-30)
 
 
-def _ring_body(q, k, v, axis_name, scale, causal):
-    """Per-shard ring schedule (runs inside shard_map)."""
+def _ring_body(q, k, v, kv_mask=None, *, axis_name, scale, causal):
+    """Per-shard ring schedule (runs inside shard_map).
+
+    ``kv_mask``: optional (B, T_local) key-validity indicator (>0 = valid),
+    sequence-sharded like K/V; it rotates around the ring with them so
+    padded keys stay masked on every device.  q/k/v are (B, H, T_local, D)
+    when a mask is given, else any (..., T_local, D)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -59,7 +64,7 @@ def _ring_body(q, k, v, axis_name, scale, causal):
     l = jnp.zeros(q.shape[:-1], jnp.float32)
 
     def body(step, carry):
-        o, m, l, k_cur, v_cur = carry
+        o, m, l, k_cur, v_cur, mask_cur = carry
         src = (my - step) % n                # whose K/V block we hold now
         s = jnp.einsum("...qd,...kd->...qk", q, k_cur).astype(jnp.float32) \
             * scale
@@ -67,6 +72,8 @@ def _ring_body(q, k, v, axis_name, scale, causal):
             qpos = my * t_local + jnp.arange(t_local)[:, None]
             kpos = src * t_local + jnp.arange(t_local)[None, :]
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        if mask_cur is not None:
+            s = jnp.where(mask_cur[:, None, None, :] > 0, s, -jnp.inf)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -77,9 +84,11 @@ def _ring_body(q, k, v, axis_name, scale, causal):
             jnp.einsum("...qk,...kd->...qd", p.astype(v_cur.dtype), v_cur)
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return o_new, m_new, l_new, k_next, v_next
+        mask_next = (None if mask_cur is None
+                     else lax.ppermute(mask_cur, axis_name, perm))
+        return o_new, m_new, l_new, k_next, v_next, mask_next
 
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
+    o, m, l, *_ = lax.fori_loop(0, n, body, (o, m, l, k, v, kv_mask))
     return (o / jnp.maximum(l, 1e-30)[..., None].astype(o.dtype))
 
 

@@ -107,10 +107,9 @@ class SPMDTrainer:
         self._aux_vals = tuple(
             jax.device_put(p.data()._data, s)
             for p, s in zip(self._aux, self._aux_shardings))
+        # zeros_like inside opt.init makes each state leaf inherit its
+        # param's sharding (XLA propagates NamedSharding through zeros_like)
         self._opt_state = self._opt.init(self._tr_vals)
-        # optimizer state inherits each param's sharding
-        self._opt_state = jax.tree.map(
-            lambda leaf: leaf, self._opt_state)
         self._step_count = 0
         self._jit_cache = {}
 
@@ -124,7 +123,7 @@ class SPMDTrainer:
         return {p.name: v
                 for p, v in zip(self._trainable, self._tr_vals)}
 
-    def _build_step(self, n_inputs):
+    def _build_step(self):
         import jax
         import jax.numpy as jnp
         net, loss_blk, opt = self._net, self._loss, self._opt
@@ -161,8 +160,6 @@ class SPMDTrainer:
         import jax
         if isinstance(arr, NDArray):
             arr = arr._data
-        elif isinstance(arr, _np.ndarray):
-            pass
         return jax.device_put(
             arr, mesh_mod.named_sharding(self._mesh, self._data_axis))
 
@@ -174,7 +171,7 @@ class SPMDTrainer:
         sharded = tuple(self._shard_batch(b) for b in batch)
         key = self._build_key(sharded)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_step(len(sharded))
+            self._jit_cache[key] = self._build_step()
         self._step_count += 1
         step_arr = jnp.asarray(self._step_count, jnp.int32)
         rng = _random.new_key()
@@ -191,9 +188,19 @@ class SPMDTrainer:
         Parameters, gathered onto each Parameter's own device so eager
         execution keeps working."""
         import jax
+
+        def fetch(v):
+            # multi-host: shards on other processes are not addressable;
+            # allgather over DCN first (single-host path is a plain copy)
+            if getattr(v, "is_fully_addressable", True):
+                return _np.asarray(v)
+            from jax.experimental import multihost_utils
+            return _np.asarray(
+                multihost_utils.process_allgather(v, tiled=True))
+
         for p, v in zip(self._trainable, self._tr_vals):
             dev = p.data().ctx.jax_device()
-            p._data._set_data(jax.device_put(_np.asarray(v), dev))
+            p._data._set_data(jax.device_put(fetch(v), dev))
         for p, v in zip(self._aux, self._aux_vals):
             dev = p.data().ctx.jax_device()
-            p._data._set_data(jax.device_put(_np.asarray(v), dev))
+            p._data._set_data(jax.device_put(fetch(v), dev))

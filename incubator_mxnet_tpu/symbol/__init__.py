@@ -1,0 +1,36 @@
+"""``mx.sym`` / ``mx.symbol`` — the symbolic graph API.
+
+Every eager ``mx.nd`` op is available symbolically under the same name
+(reference: both namespaces are generated from the same C-API op registry;
+here the symbol wrappers resolve through ``op_registry`` into the same pure
+functions, so eager and symbolic execution are numerically identical by
+construction).
+"""
+from __future__ import annotations
+
+from .symbol import (Symbol, var, Variable, Group, load, load_json,
+                     apply_op)
+from . import op_registry
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "apply_op"]
+
+
+def __getattr__(name: str):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    try:
+        op_registry.get(name)
+    except Exception:
+        raise AttributeError(f"module 'symbol' has no op '{name}'")
+
+    def op(*args, **kwargs):
+        return apply_op(name, *args, **kwargs)
+    op.__name__ = name
+    op.__qualname__ = name
+    globals()[name] = op  # cache
+    return op
+
+
+def __dir__():
+    return sorted(set(__all__) | set(op_registry.known_ops()))

@@ -1,0 +1,304 @@
+"""Symbolic op registry: metadata binding symbol-graph nodes to the eager
+``nd`` op corpus.
+
+Reference analog: the nnvm ``Op`` registry attributes — ``FListInputNames``,
+``FInferShape``, ``FMutateInputs`` (aux states), ``FNumOutputs``
+(reference: 3rdparty/tvm/nnvm/include/nnvm/op.h and the
+``NNVM_REGISTER_OP(...).set_attr(...)`` sites under src/operator/).  The
+TPU-native design needs far less: shape/type inference is ``jax.eval_shape``
+over the same pure function the eager path runs, so the registry only
+carries (a) ordered tensor-input names, (b) which inputs are auxiliary
+states, (c) how to derive parameter shapes from the data shape (for
+``simple_bind``'s partial inference), and (d) train/eval rewrites
+(Dropout→identity, BatchNorm→global stats) that the reference encodes as
+per-op ``is_train`` kernel branches.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """Metadata for one symbolic op."""
+
+    def __init__(self, name: str, fn: Callable,
+                 arg_names: Optional[List[str]] = None,
+                 varargs: bool = False,
+                 aux_names: Sequence[str] = (),
+                 param_shape_fn: Optional[Callable] = None,
+                 required_fn: Optional[Callable] = None,
+                 num_outputs_fn: Optional[Callable] = None,
+                 special: Optional[str] = None):
+        self.name = name
+        self.fn = fn
+        self.varargs = varargs
+        if arg_names is None and not varargs:
+            arg_names = _tensor_args_from_signature(fn)
+        self.arg_names = arg_names or []
+        self.aux_names = tuple(aux_names)
+        self.param_shape_fn = param_shape_fn
+        self.required_fn = required_fn
+        self.num_outputs_fn = num_outputs_fn
+        self.special = special
+
+    # ---- creation-time helpers -------------------------------------------
+    def required_args(self, attrs: dict) -> List[str]:
+        if self.required_fn is not None:
+            return self.required_fn(attrs)
+        return list(self.arg_names)
+
+    def num_outputs(self, attrs: dict) -> int:
+        if self.num_outputs_fn is not None:
+            return self.num_outputs_fn(attrs)
+        return 1
+
+    # ---- evaluation ------------------------------------------------------
+    def call(self, inputs: list, node, is_train: bool, aux_sink: dict):
+        """Run the op on NDArray inputs (eager or under a jit/eval_shape
+        trace).  ``aux_sink`` collects auxiliary-state updates by var name."""
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        if self.special == "dropout":
+            if not is_train:
+                from ..ndarray import ops as _ops
+                return _ops.identity(inputs[0])
+            return self.fn(*inputs, **attrs)
+        if self.special == "batchnorm":
+            return self._call_batchnorm(inputs, node, attrs, is_train,
+                                        aux_sink)
+        if self.varargs:
+            return self.fn(*inputs, **attrs)
+        kwargs = dict(zip(self.arg_names, inputs))
+        kwargs.update(attrs)
+        return self.fn(**kwargs)
+
+    def _call_batchnorm(self, inputs, node, attrs, is_train, aux_sink):
+        from ..ndarray import nn as _nn
+        momentum = attrs.get("momentum", 0.9)
+        use_global = attrs.get("use_global_stats", False)
+        want_mean_var = attrs.get("output_mean_var", False)
+        attrs = {k: v for k, v in attrs.items() if k != "output_mean_var"}
+        if not is_train or use_global:
+            attrs["use_global_stats"] = True
+            res = _nn.BatchNorm(*inputs, output_mean_var=True, **attrs)
+        else:
+            # training: batch stats; fold the running-stat EMA update into
+            # the same compiled step (reference mutates aux in the kernel)
+            data, gamma, beta, mmean, mvar = inputs
+            res = _nn.BatchNorm(data, gamma, beta, output_mean_var=True,
+                                **{k: v for k, v in attrs.items()
+                                   if k != "use_global_stats"})
+            out, bmean, bvar = res
+            if aux_sink is not None and len(node.inputs) >= 5:
+                mm_node = node.inputs[3][0]
+                mv_node = node.inputs[4][0]
+                aux_sink[mm_node.name] = momentum * mmean \
+                    + (1.0 - momentum) * bmean
+                aux_sink[mv_node.name] = momentum * mvar \
+                    + (1.0 - momentum) * bvar
+        if want_mean_var:
+            return list(res)
+        return res[0]
+
+
+def _tensor_args_from_signature(fn) -> List[str]:
+    """Leading no-default parameters are the tensor inputs; everything from
+    the first defaulted parameter on is an attr.  Matches the generic nd ops
+    where tensor args come first (data, lhs/rhs, ...) and attrs carry
+    defaults."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return ["data"]
+    names = []
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            break
+        if p.default is not inspect.Parameter.empty:
+            break
+        names.append(p.name)
+    return names or ["data"]
+
+
+def register(name: str, **kw) -> None:
+    from .. import ndarray as _nd
+    fn = kw.pop("fn", None) or getattr(_nd, name)
+    _REGISTRY[name] = OpDef(name, fn, **kw)
+
+
+def get(name: str) -> OpDef:
+    if name not in _REGISTRY:
+        _autoregister(name)
+    if name not in _REGISTRY:
+        raise MXNetError(f"symbol op '{name}' is not registered")
+    return _REGISTRY[name]
+
+
+def known_ops() -> List[str]:
+    from .. import ndarray as _nd
+    seen = set(_REGISTRY)
+    for n in dir(_nd):
+        if not n.startswith("_") and callable(getattr(_nd, n, None)):
+            seen.add(n)
+    return sorted(seen)
+
+
+def _autoregister(name: str) -> None:
+    """Generic fallback: any eager ``nd`` op becomes a symbol op with
+    signature-derived input names (the analog of the reference generating
+    symbol wrappers from the same C-API op registry the ndarray wrappers
+    come from)."""
+    from .. import ndarray as _nd
+    fn = getattr(_nd, name, None)
+    if fn is None or not callable(fn) or inspect.isclass(fn):
+        return
+    try:
+        sig = inspect.signature(fn)
+        varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
+                      for p in sig.parameters.values())
+    except (TypeError, ValueError):
+        varargs = False
+    _REGISTRY[name] = OpDef(name, fn, varargs=varargs)
+
+
+# ---------------------------------------------------------------------------
+# parameter-shape inference (reference: each op's FInferShape filling
+# unknown in-shapes backward from the data shape)
+# ---------------------------------------------------------------------------
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _fc_shapes(attrs, ds):
+    nh = int(attrs["num_hidden"])
+    flat = attrs.get("flatten", True)
+    c = _prod(ds[1:]) if flat else ds[-1]
+    return {"weight": (nh, int(c)), "bias": (nh,)}
+
+
+def _conv_shapes(attrs, ds):
+    kernel = tuple(attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1))
+    return {"weight": (nf, int(ds[1]) // g) + kernel, "bias": (nf,)}
+
+
+def _deconv_shapes(attrs, ds):
+    kernel = tuple(attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    g = int(attrs.get("num_group", 1))
+    return {"weight": (int(ds[1]), nf // g) + kernel, "bias": (nf,)}
+
+
+def _norm_axis_shapes(axis_default):
+    def fn(attrs, ds):
+        ax = int(attrs.get("axis", axis_default))
+        c = int(ds[ax])
+        return {"gamma": (c,), "beta": (c,),
+                "moving_mean": (c,), "moving_var": (c,)}
+    return fn
+
+
+def _emb_shapes(attrs, ds):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _rnn_shapes(attrs, ds):
+    from ..ndarray.nn import rnn_param_size
+    t, n, c = ds
+    h = int(attrs["state_size"])
+    nl = int(attrs.get("num_layers", 1))
+    bi = attrs.get("bidirectional", False)
+    ndir = 2 if bi else 1
+    mode = attrs.get("mode", "lstm")
+    psize = rnn_param_size(mode, int(c), h, num_layers=nl, bidirectional=bi)
+    return {"parameters": (psize,), "state": (nl * ndir, int(n), h),
+            "state_cell": (nl * ndir, int(n), h)}
+
+
+def _no_bias_required(base):
+    def fn(attrs):
+        names = list(base)
+        if attrs.get("no_bias", False) and "bias" in names:
+            names.remove("bias")
+        return names
+    return fn
+
+
+def _rnn_required(attrs):
+    names = ["data", "parameters", "state"]
+    if attrs.get("mode", "lstm") == "lstm":
+        names.append("state_cell")
+    return names
+
+
+def _bn_outputs(attrs):
+    return 3 if attrs.get("output_mean_var", False) else 1
+
+
+def _rnn_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+def _register_layer_ops():
+    from ..ndarray import nn as _nn
+    for spelled in ("FullyConnected", "fully_connected"):
+        register(spelled, fn=_nn.FullyConnected,
+                 arg_names=["data", "weight", "bias"],
+                 param_shape_fn=_fc_shapes,
+                 required_fn=_no_bias_required(["data", "weight", "bias"]))
+    for spelled in ("Convolution", "convolution"):
+        register(spelled, fn=_nn.Convolution,
+                 arg_names=["data", "weight", "bias"],
+                 param_shape_fn=_conv_shapes,
+                 required_fn=_no_bias_required(["data", "weight", "bias"]))
+    for spelled in ("Deconvolution", "deconvolution"):
+        register(spelled, fn=_nn.Deconvolution,
+                 arg_names=["data", "weight", "bias"],
+                 param_shape_fn=_deconv_shapes,
+                 required_fn=_no_bias_required(["data", "weight", "bias"]))
+    for spelled in ("BatchNorm", "batch_norm"):
+        register(spelled, fn=_nn.BatchNorm,
+                 arg_names=["data", "gamma", "beta", "moving_mean",
+                            "moving_var"],
+                 aux_names=("moving_mean", "moving_var"),
+                 param_shape_fn=_norm_axis_shapes(1),
+                 num_outputs_fn=_bn_outputs,
+                 special="batchnorm")
+    for spelled in ("LayerNorm", "layer_norm"):
+        register(spelled, fn=_nn.LayerNorm,
+                 arg_names=["data", "gamma", "beta"],
+                 param_shape_fn=_norm_axis_shapes(-1))
+    for spelled in ("InstanceNorm", "instance_norm"):
+        register(spelled, fn=_nn.InstanceNorm,
+                 arg_names=["data", "gamma", "beta"],
+                 param_shape_fn=_norm_axis_shapes(1))
+    for spelled in ("RNN", "rnn"):
+        register(spelled, fn=_nn.RNN,
+                 arg_names=["data", "parameters", "state", "state_cell"],
+                 param_shape_fn=_rnn_shapes,
+                 required_fn=_rnn_required,
+                 num_outputs_fn=_rnn_outputs)
+    from ..ndarray import ops as _ops
+    register("Embedding", fn=_ops.Embedding,
+             arg_names=["data", "weight"],
+             param_shape_fn=_emb_shapes)
+    for spelled in ("Dropout", "dropout"):
+        register(spelled, fn=_ops.dropout, arg_names=["data"],
+                 special="dropout")
+
+
+_register_layer_ops()

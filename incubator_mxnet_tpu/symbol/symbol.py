@@ -1,0 +1,651 @@
+"""Symbol: the lazy graph-building API (reference:
+python/mxnet/symbol/symbol.py + the nnvm Graph it drives).
+
+TPU-native re-design: a Symbol is a lightweight Python DAG over the same
+eager op corpus ``mx.nd`` uses.  There is no separate graph IR, op
+registry, or C++ executor — binding a Symbol jit-compiles one pure function
+over the graph (XLA owns memory planning, fusion, and scheduling, replacing
+the reference's PlanMemory/AttachOpExecs passes; see
+src/executor/graph_executor.cc).  Shape/type inference is ``jax.eval_shape``
+over the same function instead of per-op FInferShape.
+
+JSON serialization follows the nnvm schema (``nodes``/``arg_nodes``/
+``heads``; reference: 3rdparty/tvm/nnvm/src/core/graph.cc SaveJSON +
+src/nnvm/legacy_json_util.cc) so ``prefix-symbol.json`` checkpoints remain
+interchangeable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import op_registry
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+_counters = threading.local()
+
+
+def _next_name(hint: str) -> str:
+    if not hasattr(_counters, "tbl"):
+        _counters.tbl = {}
+    n = _counters.tbl.get(hint, 0)
+    _counters.tbl[hint] = n + 1
+    return f"{hint}{n}"
+
+
+class _SymNode:
+    """One graph node.  ``op`` is None for variables (JSON op 'null')."""
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op: Optional[str], name: str, attrs: dict,
+                 inputs: List[Tuple["_SymNode", int]], num_outputs: int = 1):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+
+class Symbol:
+    """A handle to one or more outputs of the symbolic graph."""
+
+    def __init__(self, outputs: List[Tuple[_SymNode, int]]):
+        self._outputs = list(outputs)
+
+    # ------------------------------------------------------------------
+    # graph structure
+    # ------------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return "grouped_symbol"
+
+    def _topo(self) -> List[_SymNode]:
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for src, _ in node.inputs:
+                visit(src)
+            order.append(node)
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _var_nodes(self):
+        args, auxs = [], []
+        for n in self._topo():
+            if n.is_variable:
+                (auxs if n.attrs.get("__is_aux__") else args).append(n)
+        return args, auxs
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._var_nodes()[0]]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._var_nodes()[1]]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.num_outputs == 1:
+                names.append(f"{node.name}_output")
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    def list_inputs(self) -> List[str]:
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    @property
+    def outputs(self) -> List["Symbol"]:
+        return [Symbol([o]) for o in self._outputs]
+
+    def get_internals(self) -> "Symbol":
+        outs = []
+        for n in self._topo():
+            for i in range(n.num_outputs):
+                outs.append((n, i))
+        return Symbol(outs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            matches = [i for i, nm in enumerate(self.list_outputs())
+                       if nm == index or nm.rsplit("_output", 1)[0] == index]
+            if not matches:
+                raise MXNetError(f"no output named {index!r}")
+            index = matches[0]
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return iter(self.outputs)
+
+    # ------------------------------------------------------------------
+    # attributes
+    # ------------------------------------------------------------------
+    def attr(self, key: str) -> Optional[str]:
+        v = self._outputs[0][0].attrs.get(key)
+        return None if v is None else str(v)
+
+    def list_attr(self) -> Dict[str, str]:
+        return {k: str(v)
+                for k, v in self._outputs[0][0].attrs.items()
+                if not k.startswith("__input")}
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for n in self._topo():
+            if n.attrs:
+                out[n.name] = {k: str(v) for k, v in n.attrs.items()
+                               if not k.startswith("__input")}
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attrs.update(kwargs)
+
+    # ------------------------------------------------------------------
+    # arithmetic sugar (maps onto the same elemwise ops as mx.nd)
+    # ------------------------------------------------------------------
+    def __add__(self, other):
+        return _apply_binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _apply_binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _apply_binary("broadcast_sub", "_rminus_scalar", self, other,
+                             reverse=True)
+
+    def __mul__(self, other):
+        return _apply_binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _apply_binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _apply_binary("broadcast_div", "_rdiv_scalar", self, other,
+                             reverse=True)
+
+    def __pow__(self, other):
+        return _apply_binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # ------------------------------------------------------------------
+    # inference
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer(
+            *args, partial=False, **kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer(*args, partial=True, **kwargs)
+
+    def infer_type(self, *args, **kwargs):
+        """Propagate dtypes through the graph.  Needs shapes to trace
+        (dtype promotion can be shape-free, but we reuse the abstract
+        evaluator); variables without a ``__shape__`` attr fall back to a
+        scalar placeholder, which is dtype-accurate for every registered
+        op."""
+        dtypes = dict(kwargs)
+        arg_names = self.list_arguments()
+        if args:
+            dtypes.update({n: d for n, d in zip(arg_names, args)
+                           if d is not None})
+        # shape placeholders: use declared shapes where present
+        known = {}
+        for n in self._topo():
+            if n.is_variable and n.attrs.get("__shape__") is not None:
+                known[n.name] = tuple(n.attrs["__shape__"])
+        try:
+            avals = _abstract_eval(self, known, dtypes, partial=True)
+        except MXNetError:
+            avals = None
+        if avals is None:
+            arg_dt = [dtypes.get(n, _np.float32) for n in arg_names]
+            return arg_dt, None, None
+        node_avals, var_avals = avals
+        arg_nodes, aux_nodes = self._var_nodes()
+        arg_dt = [var_avals.get(n.name, (None, dtypes.get(
+            n.name, _np.float32)))[1] for n in arg_nodes]
+        aux_dt = [var_avals.get(n.name, (None, _np.float32))[1]
+                  for n in aux_nodes]
+        out_dt = []
+        for node, idx in self._outputs:
+            na = node_avals.get(id(node))
+            out_dt.append(None if na is None else na[idx][1])
+        return arg_dt, out_dt, aux_dt
+
+    def _infer(self, *args, partial=False, type_dict=None, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, tuple] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = tuple(v)
+        type_dict = type_dict or {}
+        avals = _abstract_eval(self, known, type_dict, partial=partial)
+        if avals is None:
+            return None, None, None
+        node_avals, var_avals = avals
+        arg_nodes, aux_nodes = self._var_nodes()
+        arg_shapes = [var_avals.get(n.name, (None, None))[0]
+                      for n in arg_nodes]
+        aux_shapes = [var_avals.get(n.name, (None, None))[0]
+                      for n in aux_nodes]
+        out_shapes = []
+        for node, idx in self._outputs:
+            na = node_avals.get(id(node))
+            out_shapes.append(None if na is None else na[idx][0])
+        if not partial and (any(s is None for s in arg_shapes)
+                            or any(s is None for s in out_shapes)):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError(
+                f"infer_shape: could not infer shapes for {missing}; "
+                "provide them explicitly")
+        return arg_shapes, out_shapes, aux_shapes
+
+    # ------------------------------------------------------------------
+    # serialization (nnvm JSON schema)
+    # ------------------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        node_idx = {id(n): i for i, n in enumerate(nodes)}
+        jnodes, arg_nodes = [], []
+        for i, n in enumerate(nodes):
+            if n.is_variable:
+                arg_nodes.append(i)
+            jn = {
+                "op": "null" if n.is_variable else n.op,
+                "name": n.name,
+                "inputs": [[node_idx[id(src)], oi, 0]
+                           for src, oi in n.inputs],
+            }
+            attrs = {k: _attr_str(v) for k, v in n.attrs.items()
+                     if not k.startswith("__input")}
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        heads = [[node_idx[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(jnodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10700]},
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # binding / evaluation
+    # ------------------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **_ignored):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **shapes):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, **shapes)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, args=kwargs, grad_req="null")
+        return ex.forward()
+
+    def _compose_input_map(self):
+        """name -> variable node, for graph evaluation."""
+        return {n.name: n for n in self._topo() if n.is_variable}
+
+
+# ---------------------------------------------------------------------------
+# graph evaluation (shared by Executor.forward and shape inference)
+# ---------------------------------------------------------------------------
+def eval_graph(symbol: Symbol, var_values: Dict[str, object],
+               is_train: bool, aux_sink: Optional[dict] = None):
+    """Evaluate the DAG with NDArray (or traced-NDArray) leaf values.
+    Returns list of NDArray outputs, one per symbol head."""
+    vals: Dict[int, list] = {}
+    for node in symbol._topo():
+        if node.is_variable:
+            if node.name not in var_values:
+                raise MXNetError(f"bind: missing value for input "
+                                 f"'{node.name}'")
+            vals[id(node)] = [var_values[node.name]]
+            continue
+        opdef = op_registry.get(node.op)
+        ins = [vals[id(src)][oi] for src, oi in node.inputs]
+        out = opdef.call(ins, node, is_train, aux_sink)
+        vals[id(node)] = out if isinstance(out, (list, tuple)) else [out]
+    return [vals[id(n)][oi] for n, oi in symbol._outputs]
+
+
+def _abstract_eval(symbol: Symbol, known_shapes: Dict[str, tuple],
+                   type_dict: Dict[str, object], partial: bool):
+    """Forward shape/dtype propagation: walk the graph, fill unknown
+    parameter shapes from each op's param_shape_fn, get node output avals
+    via jax.eval_shape on the op's pure function."""
+    import jax
+    from ..ndarray.ndarray import NDArray
+
+    node_avals: Dict[int, list] = {}
+    var_avals: Dict[str, tuple] = {}
+
+    def var_aval(node):
+        if node.name in var_avals:
+            return var_avals[node.name]
+        shape = known_shapes.get(node.name)
+        if shape is None and node.attrs.get("__shape__") is not None:
+            shape = tuple(node.attrs["__shape__"])
+        if shape is None:
+            return None
+        dt = type_dict.get(node.name, node.attrs.get("__dtype__",
+                                                     _np.float32))
+        var_avals[node.name] = (tuple(shape), _np.dtype(dt))
+        return var_avals[node.name]
+
+    for node in symbol._topo():
+        if node.is_variable:
+            a = var_aval(node)
+            node_avals[id(node)] = None if a is None else [a]
+            continue
+        opdef = op_registry.get(node.op)
+        in_avals = []
+        unknown = []
+        for pos, (src, oi) in enumerate(node.inputs):
+            a = node_avals.get(id(src))
+            if a is None:
+                unknown.append((pos, src))
+                in_avals.append(None)
+            else:
+                in_avals.append(a[oi])
+        if unknown and opdef.param_shape_fn is not None \
+                and in_avals and in_avals[0] is not None:
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            try:
+                pshapes = opdef.param_shape_fn(attrs, in_avals[0][0])
+            except Exception:
+                pshapes = {}
+            data_dt = in_avals[0][1]
+            for pos, src in list(unknown):
+                pname = opdef.arg_names[pos] if pos < len(
+                    opdef.arg_names) else None
+                if src.is_variable and pname in pshapes:
+                    var_avals[src.name] = (tuple(pshapes[pname]),
+                                           _np.dtype(type_dict.get(
+                                               src.name, data_dt)))
+                    node_avals[id(src)] = [var_avals[src.name]]
+                    in_avals[pos] = var_avals[src.name]
+                    unknown = [(p, s) for p, s in unknown if p != pos]
+        if unknown:
+            if partial:
+                node_avals[id(node)] = None
+                continue
+            names = [s.name for _, s in unknown]
+            raise MXNetError(
+                f"infer_shape: inputs {names} of op '{node.name}' "
+                f"({node.op}) have unknown shapes")
+
+        def f(*arrs, _opdef=opdef, _node=node):
+            nds = [NDArray(a) for a in arrs]
+            out = _opdef.call(nds, _node, True, {})
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in outs)
+
+        try:
+            specs = [jax.ShapeDtypeStruct(s, d) for s, d in in_avals]
+            out_avals = jax.eval_shape(f, *specs)
+        except Exception as e:
+            raise MXNetError(
+                f"infer_shape failed at node '{node.name}' ({node.op}): "
+                f"{e}") from e
+        node_avals[id(node)] = [(tuple(o.shape), _np.dtype(o.dtype))
+                                for o in out_avals]
+    return node_avals, var_avals
+
+
+# ---------------------------------------------------------------------------
+# construction API
+# ---------------------------------------------------------------------------
+def var(name: str, attr: Optional[dict] = None, shape=None, dtype=None,
+        lr_mult=None, wd_mult=None, init=None, stype=None,
+        **kwargs) -> Symbol:
+    """Create a symbolic variable (reference: symbol.var / sym.Variable)."""
+    attrs = dict(attr or {})
+    attrs.update(kwargs)
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = dtype
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        attrs["__init__"] = str(init)
+    node = _SymNode(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    g = json.loads(json_str)
+    nodes: List[_SymNode] = []
+    for jn in g["nodes"]:
+        attrs = {k: _attr_parse(v)
+                 for k, v in (jn.get("attrs") or jn.get("param")
+                              or {}).items()}
+        op = jn["op"]
+        inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
+        if op == "null":
+            node = _SymNode(None, jn["name"], attrs, [])
+        else:
+            opdef = op_registry.get(op)
+            node = _SymNode(op, jn["name"], attrs, inputs,
+                            num_outputs=opdef.num_outputs(attrs))
+            for pos in range(len(inputs)):
+                pname = (opdef.arg_names[pos]
+                         if pos < len(opdef.arg_names) else None)
+                if pname in opdef.aux_names and inputs[pos][0].is_variable:
+                    inputs[pos][0].attrs["__is_aux__"] = True
+        nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi, *_ in g["heads"]]
+    return Symbol(heads)
+
+
+def _attr_str(v) -> str:
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def _attr_parse(s: str):
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+# ---------------------------------------------------------------------------
+# symbolic op application
+# ---------------------------------------------------------------------------
+def _as_symbol(x) -> Optional[Symbol]:
+    return x if isinstance(x, Symbol) else None
+
+
+def apply_op(opname: str, *args, name: Optional[str] = None,
+             attr: Optional[dict] = None, **kwargs) -> Symbol:
+    """Create a graph node applying ``opname``.  Symbol-valued arguments are
+    tensor inputs; the rest are attrs.  Missing required tensor inputs are
+    auto-created as variables named ``{node}_{arg}`` (matching the
+    reference's auto-named weights in the symbolic API)."""
+    opdef = op_registry.get(opname)
+    node_name = name or _next_name(opname.lower().replace(".", "_"))
+    attrs = dict(attr or {})
+    named_inputs: Dict[str, Symbol] = {}
+    pos_inputs: List[Symbol] = []
+
+    for i, a in enumerate(args):
+        s = _as_symbol(a)
+        if s is None:
+            # positional non-symbol: map onto attr by signature position
+            if not opdef.varargs and i < len(opdef.arg_names):
+                attrs[opdef.arg_names[i]] = a
+            continue
+        if opdef.varargs:
+            pos_inputs.append(s)
+        elif i < len(opdef.arg_names):
+            named_inputs[opdef.arg_names[i]] = s
+        else:
+            pos_inputs.append(s)
+    for k, v in kwargs.items():
+        s = _as_symbol(v)
+        if s is not None:
+            named_inputs[k] = s
+        elif v is not None:
+            attrs[k] = v
+
+    if opdef.varargs:
+        inputs = [(s._outputs[0]) for s in pos_inputs]
+        node = _SymNode(opname, node_name, attrs,
+                        [(n, i) for n, i in inputs],
+                        num_outputs=opdef.num_outputs(attrs))
+        return _node_symbol(node)
+
+    inputs = []
+    required = [n for n in opdef.required_args(attrs) if n not in attrs]
+    for argn in opdef.arg_names:
+        if argn in named_inputs:
+            s = named_inputs[argn]
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    f"{opname}: input '{argn}' must be a single-output "
+                    "symbol")
+            entry = s._outputs[0]
+            if argn in opdef.aux_names and entry[0].is_variable:
+                entry[0].attrs["__is_aux__"] = True
+            inputs.append(entry)
+        elif argn in required:
+            vattrs = {}
+            if argn in opdef.aux_names:
+                vattrs["__is_aux__"] = True
+            vnode = _SymNode(None, f"{node_name}_{argn}", vattrs, [])
+            inputs.append((vnode, 0))
+        # optional & not given: stop appending further positions only if
+        # nothing later is present
+    node = _SymNode(opname, node_name, attrs, inputs,
+                    num_outputs=opdef.num_outputs(attrs))
+    return _node_symbol(node)
+
+
+def _node_symbol(node: _SymNode) -> Symbol:
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+def _apply_binary(broadcast_op, scalar_op, lhs, rhs, reverse=False):
+    if isinstance(rhs, Symbol):
+        base = broadcast_op.replace("broadcast_", "")
+        mapping = {"sub": "subtract", "mul": "multiply", "div": "divide",
+                   "add": "add", "power": "power"}
+        return apply_op(mapping.get(base, base), lhs, rhs)
+    # scalar path: lower onto a dedicated scalar op (reference registers
+    # _plus_scalar etc. as distinct ops)
+    return _scalar_binary(scalar_op, lhs, float(rhs))
+
+
+_SCALAR_FNS = {
+    "_plus_scalar": lambda jnp, x, c: x + c,
+    "_minus_scalar": lambda jnp, x, c: x - c,
+    "_rminus_scalar": lambda jnp, x, c: c - x,
+    "_mul_scalar": lambda jnp, x, c: x * c,
+    "_div_scalar": lambda jnp, x, c: x / c,
+    "_rdiv_scalar": lambda jnp, x, c: c / x,
+    "_power_scalar": lambda jnp, x, c: x ** c,
+}
+
+
+def _ensure_scalar_ops_registered():
+    from ..ndarray.ndarray import NDArray, _invoke
+    for nm, fn in _SCALAR_FNS.items():
+        if nm in op_registry._REGISTRY:
+            continue
+
+        def make(fn):
+            def op(data, scalar=0.0, **_ig):
+                import jax.numpy as jnp
+                return _invoke(lambda x: fn(jnp, x, scalar), [data],
+                               name="scalar_op")
+            return op
+        op_registry._REGISTRY[nm] = op_registry.OpDef(
+            nm, make(fn), arg_names=["data"])
+
+
+def _scalar_binary(scalar_op, lhs, scalar):
+    _ensure_scalar_ops_registered()
+    return apply_op(scalar_op, lhs, scalar=scalar)

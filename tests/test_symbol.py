@@ -1,0 +1,177 @@
+"""Symbol + Executor tests (model: reference
+tests/python/unittest/test_symbol.py and test_executor.py)."""
+import json
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    h = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(data=h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(data=h, label=label, name="softmax")
+
+
+def test_list_arguments_auto_params():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(4, 32), softmax_label=(4,))
+    assert arg_shapes == [(4, 32), (16, 32), (16,), (10, 16), (10,), (4,)]
+    assert out_shapes == [(4, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    c = sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                        pad=(1, 1), name="conv0")
+    arg_shapes, out_shapes, _ = c.infer_shape(data=(2, 3, 8, 8))
+    assert arg_shapes[1] == (8, 3, 3, 3)
+    assert out_shapes == [(2, 8, 8, 8)]
+
+
+def test_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 32), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    for n, arr in ex.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            arr._data = arr._data + rng.randn(*arr.shape).astype(
+                np.float32) * 0.1
+    outs = ex.forward(is_train=True,
+                      data=rng.randn(4, 32).astype(np.float32),
+                      softmax_label=np.array([1, 2, 3, 4], np.float32))
+    p = outs[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(4), rtol=1e-5)
+    ex.backward()
+    for name in ("fc1_weight", "fc2_weight", "fc1_bias"):
+        assert np.abs(ex.grad_dict[name].asnumpy()).sum() > 0
+
+
+def test_softmaxoutput_grad_semantics():
+    """Backward of SoftmaxOutput is (p - onehot)/1 regardless of head
+    cotangent (reference: src/operator/softmax_output.cc)."""
+    data = sym.var("data")
+    label = sym.var("label")
+    out = sym.SoftmaxOutput(data=data, label=label, name="sm")
+    x = np.random.randn(3, 5).astype(np.float32)
+    lab = np.array([0, 2, 4], np.float32)
+    ex = out.bind(mx.cpu(), args={"data": nd.array(x),
+                                  "label": nd.array(lab)},
+                  grad_req={"data": "write", "label": "null"})
+    p = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    onehot = np.eye(5, dtype=np.float32)[lab.astype(int)]
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), p - onehot,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_req_add_and_null():
+    x = sym.var("x")
+    # accumulate twice with grad_req='add'
+    s = sym.sum(x * 3.0)
+    ex = s.bind(mx.cpu(), args={"x": nd.ones((4,))}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               np.full(4, 6.0), rtol=1e-6)
+
+
+def test_batchnorm_aux_states():
+    data = sym.var("data")
+    b = sym.BatchNorm(data=data, momentum=0.5, name="bn0")
+    assert b.list_auxiliary_states() == ["bn0_moving_mean",
+                                         "bn0_moving_var"]
+    ex = b.simple_bind(ctx=mx.cpu(), data=(8, 4))
+    x = np.random.randn(8, 4).astype(np.float32) * 2 + 1
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn0_moving_mean"].asnumpy()
+    # one EMA step from 0 with momentum .5 → 0.5 * batch mean
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-4,
+                               atol=1e-4)
+    # eval mode uses moving stats (no batch normalization of new data)
+    out_eval = ex.forward(is_train=False, data=x)[0].asnumpy()
+    assert np.abs(out_eval.mean()) > 1e-3  # not zero-centered
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    g = json.loads(js)
+    assert "nodes" in g and "arg_nodes" in g and "heads" in g
+    ops = [n["op"] for n in g["nodes"]]
+    assert "FullyConnected" in ops and "null" in ops
+    out2 = sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    ex = out2.simple_bind(ctx=mx.cpu(), data=(2, 32), softmax_label=(2,))
+    assert ex.forward()[0].shape == (2, 10)
+
+
+def test_group_and_internals():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    d = c * 2.0
+    g = sym.Group([c, d])
+    assert len(g.list_outputs()) == 2
+    internals = d.get_internals()
+    assert len(internals.list_outputs()) >= 3
+
+
+def test_getitem_by_name():
+    a = sym.var("a")
+    c = sym.relu(a, name="act0")
+    d = sym.Group([c, c * 1.0])
+    got = d["act0_output"]
+    assert got.list_outputs() == ["act0_output"]
+
+
+def test_variable_shape_attr():
+    x = sym.var("x", shape=(3, 2))
+    y = x * 2.0
+    _, out_shapes, _ = y.infer_shape()
+    assert out_shapes == [(3, 2)]
+
+
+def test_eval_convenience():
+    x = sym.var("x")
+    y = x + 1.0
+    out = y.eval(ctx=mx.cpu(), x=nd.ones((2, 2)))
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 2), 2.0))
+
+
+def test_rnn_symbol_shapes():
+    data = sym.var("data")
+    r = sym.RNN(data=data, state_size=8, num_layers=1, mode="lstm",
+                state_outputs=True, name="rnn0")
+    assert len(r.list_outputs()) == 3
+    arg_shapes, out_shapes, _ = r.infer_shape(data=(5, 2, 4))
+    assert out_shapes[0] == (5, 2, 8)
+    assert out_shapes[1] == (1, 2, 8)
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 32), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 32), softmax_label=(8,))
+    o = ex2.forward(is_train=False,
+                    data=np.zeros((8, 32), np.float32),
+                    softmax_label=np.zeros((8,), np.float32))
+    assert o[0].shape == (8, 10)
