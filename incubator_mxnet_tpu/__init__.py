@@ -39,10 +39,17 @@ from . import parallel
 from . import symbol
 from . import symbol as sym
 from .executor import Executor
+from . import io
+from . import metric
+from . import callback
+from . import model
+from . import module
+from . import module as mod
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
            "autograd", "random", "base", "context", "initializer", "init",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
-           "parallel", "symbol", "sym", "Executor"]
+           "parallel", "symbol", "sym", "Executor", "io", "metric",
+           "callback", "model", "module", "mod"]

@@ -1,0 +1,368 @@
+"""Evaluation metrics (reference: python/mxnet/metric.py).
+
+Same class surface and update semantics as the reference EvalMetric family;
+computation happens in NumPy after an explicit device sync (metrics are the
+reference's per-batch sync point too — its Module.fit calls update with
+NDArrays and forces WaitToRead).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "Perplexity", "Loss", "PearsonCorrelation", "CustomMetric",
+           "create", "np"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def _register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _as_numpy(x) -> _np.ndarray:
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class EvalMetric:
+    """Base class (reference: metric.EvalMetric)."""
+
+    def __init__(self, name: str, output_names: Optional[Sequence[str]] = None,
+                 label_names: Optional[Sequence[str]] = None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def update_dict(self, label: dict, pred: dict):
+        if self.output_names is not None:
+            pred = [pred[n] for n in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[n] for n in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_config(self):
+        return {"metric": self.__class__.__name__, "name": self.name,
+                "output_names": self.output_names,
+                "label_names": self.label_names}
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@_register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _to_list(labels), _to_list(preds)
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(_np.int64)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(_np.int64).reshape(-1)
+            label = label.reshape(-1)
+            if pred.shape != label.shape:
+                raise MXNetError(
+                    f"Accuracy: shape mismatch {pred.shape} vs "
+                    f"{label.shape}")
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@_register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+        if top_k <= 1:
+            raise MXNetError("use Accuracy for top_k=1")
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(_np.int64).reshape(-1)
+            topk = _np.argsort(pred, axis=1)[:, -self.top_k:]
+            self.sum_metric += float(
+                (topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += len(label)
+
+
+@_register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.F1; average='macro' over resets)."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        self.average = average
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).reshape(-1).astype(_np.int64)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(axis=-1)
+            else:
+                pred = (pred.reshape(-1) > 0.5).astype(_np.int64)
+            pred = pred.reshape(-1)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        self.sum_metric = f1 * self.num_inst
+
+
+@_register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            label = label.reshape(pred.shape)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@_register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            label = label.reshape(pred.shape)
+            self.sum_metric += float(((label - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@_register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            label = label.reshape(pred.shape)
+            self.sum_metric += float(
+                _np.sqrt(((label - pred) ** 2).mean()))
+            self.num_inst += 1
+
+
+@_register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label).ravel().astype(_np.int64)
+            pred = _as_numpy(pred)
+            pred = pred.reshape(-1, pred.shape[-1])
+            prob = pred[_np.arange(label.shape[0]), label]
+            self.sum_metric += float(-_np.log(prob + self.eps).sum())
+            self.num_inst += label.shape[0]
+
+
+@_register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@_register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        super().__init__(name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        loss = 0.0
+        num = 0
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label).ravel().astype(_np.int64)
+            pred = _as_numpy(pred)
+            pred = pred.reshape(-1, pred.shape[-1])
+            prob = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                prob = _np.where(ignore, 1.0, prob)
+                num -= int(ignore.sum())
+            loss += float(-_np.log(_np.maximum(prob, 1e-10)).sum())
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(_np.exp(self.sum_metric / self.num_inst)))
+
+
+@_register
+class Loss(EvalMetric):
+    """Mean of a loss output (reference: metric.Loss)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _labels, preds):
+        for pred in _to_list(preds):
+            pred = _as_numpy(pred)
+            self.sum_metric += float(pred.sum())
+            self.num_inst += pred.size
+
+
+@_register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred).ravel()
+            if len(label) < 2:
+                continue
+            r = _np.corrcoef(label, pred)[0, 1]
+            self.sum_metric += float(r)
+            self.num_inst += 1
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str)
+                            else metric)
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.extend(n if isinstance(n, list) else [n])
+            values.extend(v if isinstance(v, list) else [v])
+        return names, values
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            out = self._feval(label, pred)
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += out
+                self.num_inst += 1
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (reference: metric.np)."""
+    return CustomMetric(numpy_feval, name=name,
+                        allow_extra_outputs=allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs) -> EvalMetric:
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    name = str(metric).lower()
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "nll_loss": "negativeloglikelihood",
+               "top_k_accuracy": "topkaccuracy", "top_k_acc": "topkaccuracy"}
+    name = aliases.get(name, name)
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown metric {metric!r} "
+                         f"(have {sorted(_REGISTRY)})")
+    return _REGISTRY[name](*args, **kwargs)

@@ -1,0 +1,46 @@
+"""Checkpoint save/load for the symbolic world (reference:
+python/mxnet/model.py save_checkpoint/load_checkpoint — the
+``prefix-symbol.json`` + ``prefix-%04d.params`` twin-artifact format with
+``arg:``/``aux:`` key prefixes, shared with Module.save_checkpoint and
+Gluon's HybridBlock.export)."""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .base import MXNetError
+from . import ndarray as nd
+from .symbol import Symbol, load as _sym_load
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "BatchEndParam"]
+
+from .callback import BatchEndParam  # noqa: E402  (re-export, ref parity)
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict, remove_amp_cast: bool = True) -> None:
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError(f"invalid param key {k!r} (want arg:/aux:)")
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    symbol = _sym_load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,6 @@
+"""``mx.mod`` — symbol-era training API (reference: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

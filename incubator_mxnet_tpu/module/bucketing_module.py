@@ -1,0 +1,152 @@
+"""BucketingModule: variable-length sequence training (reference:
+python/mxnet/module/bucketing_module.py).
+
+One Module per bucket key, parameters shared by reference.  On TPU this is
+the RIGHT shape for XLA too: each bucket is one static-shape compiled
+program (compile-per-bucket, cached), exactly how the reference amortizes
+executors per bucket.  Long-context beyond bucketing is the ring-attention
+SP path (``parallel.ring``), which the reference lacks."""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=logging, context=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict[object, Module] = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+        self._initializer = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _gen_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names=data_names,
+                      label_names=label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training=for_training,
+                 inputs_need_grad=inputs_need_grad, grad_req=grad_req)
+        self._buckets[self._default_bucket_key] = mod
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+        self._grad_req = grad_req
+        self._inputs_need_grad = inputs_need_grad
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        if not self.binded:
+            raise MXNetError("switch_bucket: call bind first")
+        if bucket_key == self._curr_bucket_key:
+            return
+        if bucket_key not in self._buckets:
+            mod = self._gen_module(bucket_key)
+            mod.bind(data_shapes, label_shapes,
+                     for_training=self.for_training,
+                     inputs_need_grad=self._inputs_need_grad,
+                     grad_req=self._grad_req)
+            if self.params_initialized:
+                arg, aux = self.get_params()
+                mod.init_params(initializer=self._initializer,
+                                arg_params=arg, aux_params=aux,
+                                allow_missing=False, force_init=True)
+                if self._curr_module.optimizer_initialized:
+                    mod._optimizer = self._curr_module._optimizer
+                    mod._updater_states = self._curr_module._updater_states
+                    mod.optimizer_initialized = True
+            self._buckets[bucket_key] = mod
+        else:
+            mod = self._buckets[bucket_key]
+            if self.params_initialized:
+                # pull current params from the previously-active bucket
+                arg, aux = self._curr_module.get_params()
+                mod.set_params(arg, aux)
+                mod._updater_states = self._curr_module._updater_states
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        self._initializer = initializer
+        self._curr_module.init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater_states = self._curr_module._updater_states
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._curr_bucket_key
+        self.switch_bucket(key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # states dict is shared by reference; params live per-module, so
+        # propagate lazily on the next switch (see switch_bucket)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)

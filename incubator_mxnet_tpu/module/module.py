@@ -1,0 +1,295 @@
+"""Module: executor-backed symbolic training (reference:
+python/mxnet/module/module.py).
+
+TPU-native notes: the reference's Module owns a DataParallelExecutorGroup
+slicing each batch over a ctx list; here one jit-compiled Executor runs the
+program and multi-device data parallelism is the SPMD path
+(``parallel.SPMDTrainer``) rather than per-device executor replicas — the
+API surface (bind/init_params/init_optimizer/forward/backward/update) is
+preserved."""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..initializer import InitDesc
+from ..io import DataDesc
+from ..model import save_checkpoint as _save_checkpoint, \
+    load_checkpoint as _load_checkpoint
+from ..ndarray.ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+def _canon_shapes(shapes) -> List[DataDesc]:
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            dtype = s[2] if len(s) > 2 else _np.float32
+            out.append(DataDesc(name, shape, dtype))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if isinstance(context, (list, tuple)):
+            if len(context) > 1:
+                logger.warning(
+                    "Module: multi-context DP is the SPMD path on TPU; "
+                    "using the first context (use parallel.SPMDTrainer "
+                    "for multi-chip)")
+            context = context[0] if context else None
+        self._context = context if context is not None else current_context()
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._exec = None
+        self._optimizer = None
+        self._updater_states: Dict[int, object] = {}
+        self._data_shapes: List[DataDesc] = []
+        self._label_shapes: List[DataDesc] = []
+        self._grad_req = "write"
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = _load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg_params, aux_params = self.get_params()
+        _save_checkpoint(prefix, epoch, self._symbol, arg_params,
+                         aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        if not self.binded:
+            raise MXNetError("output_shapes: not bound")
+        return list(zip(self.output_names,
+                        [o.shape for o in self._exec.outputs])) \
+            if self._exec.outputs else []
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             shared_module=None):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self._data_shapes = _canon_shapes(data_shapes)
+        self._label_shapes = _canon_shapes(label_shapes)
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({d.name: d.shape for d in self._label_shapes})
+        type_dict = {d.name: d.dtype for d in
+                     self._data_shapes + self._label_shapes}
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and for_training \
+                    and n not in self._fixed_param_names:
+                req[n] = grad_req
+            elif n in self._data_names and inputs_need_grad:
+                req[n] = "write"
+            else:
+                req[n] = "null"
+        from ..executor import Executor
+        self._exec = Executor.simple_bind(
+            self._symbol, self._context, grad_req=req,
+            type_dict=type_dict, **shape_kwargs)
+        self.binded = True
+        if getattr(self, "_preloaded_params", None) is not None:
+            arg_params, aux_params = self._preloaded_params
+            self.set_params(arg_params, aux_params)
+            self._preloaded_params = None
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params: call bind first")
+        attr_dict = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = self._as_jax(arg_params[name], arr)
+            elif initializer is not None:
+                desc = InitDesc(name, attr_dict.get(name, {}))
+                initializer(desc, arr)
+            elif not allow_missing:
+                raise MXNetError(f"init_params: no value for '{name}' and "
+                                 "no initializer")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._data = self._as_jax(aux_params[name], arr)
+            elif initializer is not None:
+                desc = InitDesc(name, attr_dict.get(name, {}))
+                initializer(desc, arr)
+        if arg_params:
+            extra = [k for k in arg_params if k not in self._param_names
+                     and k not in self._data_names
+                     and k not in self._label_names]
+            if extra and not allow_extra:
+                raise MXNetError(f"init_params: extra arg_params {extra}")
+        self.params_initialized = True
+
+    def _as_jax(self, v, like: NDArray):
+        v = v if isinstance(v, NDArray) else nd.array(v, ctx=self._context)
+        if tuple(v.shape) != tuple(like.shape):
+            raise MXNetError(
+                f"param shape mismatch: got {v.shape}, expected "
+                f"{like.shape}")
+        return v._data.astype(like.dtype)
+
+    def get_params(self) -> Tuple[Dict, Dict]:
+        if not self.binded:
+            raise MXNetError("get_params: not bound")
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+        else:
+            params = dict(optimizer_params) \
+                if not isinstance(optimizer_params, dict) \
+                else dict(optimizer_params)
+            self._optimizer = opt_mod.create(optimizer, **params)
+        self._updater_states = {}
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if not self.binded or not self.params_initialized:
+            raise MXNetError("forward: bind and init_params first")
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data or []):
+            feeds[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step per parameter (reference: Module.update
+        → kvstore push/pull or Updater; 'local' kvstore on one chip is a
+        direct update — the multi-chip gradient mean is the SPMD psum
+        path)."""
+        if not self.optimizer_initialized:
+            raise MXNetError("update: call init_optimizer first")
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if i not in self._updater_states:
+                self._updater_states[i] = \
+                    self._optimizer.create_state(i, weight)
+            self._optimizer.update(i, weight, grad,
+                                   self._updater_states[i])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not getattr(self, "_inputs_need_grad", False):
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------
+    def save_optimizer_states(self, fname):
+        import pickle
+        states = {i: (None if s is None else
+                      _state_to_numpy(s))
+                  for i, s in self._updater_states.items()}
+        with open(fname, "wb") as f:
+            pickle.dump(states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        self._updater_states = {
+            i: (None if s is None else _state_from_numpy(s, self._context))
+            for i, s in states.items()}
+
+
+def _state_to_numpy(state):
+    if isinstance(state, (list, tuple)):
+        return type(state)(_state_to_numpy(s) for s in state)
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    return state
+
+
+def _state_from_numpy(state, ctx):
+    if isinstance(state, (list, tuple)):
+        return type(state)(_state_from_numpy(s, ctx) for s in state)
+    if isinstance(state, _np.ndarray):
+        return nd.array(state, ctx=ctx)
+    return state

@@ -376,6 +376,12 @@ def _abstract_eval(symbol: Symbol, known_shapes: Dict[str, tuple],
     via jax.eval_shape on the op's pure function."""
     import jax
     from ..ndarray.ndarray import NDArray
+    from .. import random as _random
+
+    # ops that draw RNG keys (dropout) split from this local stream during
+    # the eval_shape trace — splitting the global stream there would store
+    # a tracer into global state (leak); one key serves every node
+    _infer_key = jax.random.PRNGKey(0)
 
     node_avals: Dict[int, list] = {}
     var_avals: Dict[str, tuple] = {}
@@ -444,7 +450,8 @@ def _abstract_eval(symbol: Symbol, known_shapes: Dict[str, tuple],
 
         try:
             specs = [jax.ShapeDtypeStruct(s, d) for s, d in in_avals]
-            out_avals = jax.eval_shape(f, *specs)
+            with _random.trace_stream(_infer_key):
+                out_avals = jax.eval_shape(f, *specs)
         except Exception as e:
             raise MXNetError(
                 f"infer_shape failed at node '{node.name}' ({node.op}): "

@@ -1,0 +1,248 @@
+"""Module / BucketingModule / io / metric / callback tests (model:
+reference tests/python/unittest/test_module.py, test_metric.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, metric, mod, nd, sym
+
+
+def _toy(n=200, d=16, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d)
+    y = (X @ w > 0).astype(np.float32)
+    return X, y
+
+
+def _mlp_sym():
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    net = sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+    return sym.SoftmaxOutput(data=net, label=label, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# io
+# ---------------------------------------------------------------------------
+def test_ndarrayiter_basic():
+    X = np.arange(20).reshape(10, 2).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = io.NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_discard():
+    X = np.zeros((10, 2), np.float32)
+    it = io.NDArrayIter(X, None, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    X = np.arange(12).astype(np.float32).reshape(12, 1)
+    it = io.NDArrayIter(X, None, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_resize_iter():
+    X = np.zeros((8, 2), np.float32)
+    base = io.NDArrayIter(X, None, batch_size=4)
+    it = io.ResizeIter(base, size=5)
+    assert len(list(it)) == 5
+
+
+# ---------------------------------------------------------------------------
+# metric
+# ---------------------------------------------------------------------------
+def test_accuracy():
+    m = metric.Accuracy()
+    m.update([nd.array([0, 1, 1])],
+             [nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])])
+    assert m.get() == ("accuracy", pytest.approx(2.0 / 3.0))
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    m.update([nd.array([1, 2])], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mse_rmse_mae():
+    lab = nd.array([1.0, 2.0, 3.0])
+    pred = nd.array([1.0, 2.0, 5.0])
+    for name, want in [("mse", 4.0 / 3), ("rmse", (4.0 / 3) ** 0.5),
+                       ("mae", 2.0 / 3)]:
+        m = metric.create(name)
+        m.update([lab], [pred])
+        assert m.get()[1] == pytest.approx(want, rel=1e-6)
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    m.update([nd.array([0, 0])], [pred])
+    want = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert m.get()[1] == pytest.approx(want, rel=1e-5)
+
+
+def test_composite_and_custom():
+    comp = metric.create(["acc", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+    cm = metric.np(lambda l, p: float(np.sum(l == p)), name="matches")
+    cm.update([nd.array([1, 2])], [nd.array([1, 3])])
+    assert cm.get()[1] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Module
+# ---------------------------------------------------------------------------
+def test_module_fit_and_score():
+    X, y = _toy()
+    train = io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    val = io.NDArrayIter(X, y, batch_size=20)
+    m = mod.Module(_mlp_sym(), context=mx.cpu())
+    m.fit(train, num_epoch=10, optimizer="sgd",
+          optimizer_params={"learning_rate": 0.5,
+                            "rescale_grad": 1.0 / 20})
+    score = m.score(val, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy()
+    train = io.NDArrayIter(X, y, batch_size=20)
+    m = mod.Module(_mlp_sym(), context=mx.cpu())
+    m.fit(train, num_epoch=3, optimizer="sgd",
+          optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "ck")
+    m.save_checkpoint(prefix, 3)
+    assert os.path.exists(f"{prefix}-symbol.json")
+    assert os.path.exists(f"{prefix}-0003.params")
+    val = io.NDArrayIter(X, y, batch_size=20)
+    m2 = mod.Module.load(prefix, 3)
+    m2.bind(val.provide_data, val.provide_label, for_training=False)
+    s1 = m.score(val, "acc")[0][1]
+    s2 = m2.score(val, "acc")[0][1]
+    assert s1 == pytest.approx(s2)
+
+
+def test_module_predict_strips_pad():
+    X, y = _toy(n=50)
+    it = io.NDArrayIter(X, y, batch_size=16)  # 50 = 3*16 + 2 → pad 14
+    m = mod.Module(_mlp_sym(), context=mx.cpu())
+    m.bind(it.provide_data, it.provide_label, for_training=False)
+    m.init_params(initializer=mx.init.Uniform(0.1))
+    out = m.predict(it)
+    assert out.shape == (50, 2)
+
+
+def test_module_fixed_params():
+    X, y = _toy()
+    it = io.NDArrayIter(X, y, batch_size=20)
+    m = mod.Module(_mlp_sym(), context=mx.cpu(),
+                   fixed_param_names=["fc1_weight"])
+    m.bind(it.provide_data, it.provide_label, for_training=True)
+    m.init_params(initializer=mx.init.Uniform(0.1))
+    m.init_optimizer(optimizer="sgd",
+                     optimizer_params={"learning_rate": 1.0})
+    before = m.get_params()[0]["fc1_weight"].asnumpy()
+    batch = next(iter(it))
+    m.forward_backward(batch)
+    m.update()
+    after = m.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(before, after)
+
+
+def test_module_input_grads():
+    X, y = _toy()
+    it = io.NDArrayIter(X, y, batch_size=20)
+    m = mod.Module(_mlp_sym(), context=mx.cpu())
+    m.bind(it.provide_data, it.provide_label, for_training=True,
+           inputs_need_grad=True)
+    m.init_params(initializer=mx.init.Uniform(0.1))
+    batch = next(iter(it))
+    m.forward_backward(batch)
+    g = m.get_input_grads()[0]
+    assert g.shape == (20, 16)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# BucketingModule
+# ---------------------------------------------------------------------------
+def test_bucketing_module():
+    """Variable-length 'sequence sum' problem with two buckets."""
+    def sym_gen(seq_len):
+        # parameters must have identical shapes across buckets (shared), so
+        # pool over the variable axis before the dense layers — the same
+        # contract the reference's RNN bucketing relies on
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        pooled = sym.sum(data, axis=1, keepdims=True)
+        net = sym.FullyConnected(data=pooled, num_hidden=8, name="fc1")
+        net = sym.Activation(data=net, act_type="relu", name="relu1")
+        net = sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+        net = sym.SoftmaxOutput(data=net, label=label, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    bm = mod.BucketingModule(sym_gen, default_bucket_key=8,
+                             context=mx.cpu())
+    descs8 = [io.DataDesc("data", (4, 8))]
+    lab8 = [io.DataDesc("softmax_label", (4,))]
+    bm.bind(descs8, lab8, for_training=True)
+    bm.init_params(initializer=mx.init.Uniform(0.1))
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    rng = np.random.RandomState(0)
+    for step in range(4):
+        L = 8 if step % 2 == 0 else 4
+        Xb = rng.randn(4, L).astype(np.float32)
+        yb = (Xb.sum(axis=1) > 0).astype(np.float32)
+        batch = io.DataBatch(
+            data=[nd.array(Xb)], label=[nd.array(yb)], bucket_key=L,
+            provide_data=[io.DataDesc("data", (4, L))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+    assert len(bm._buckets) == 2
+    arg, _ = bm.get_params()
+    assert "fc2_weight" in arg
+
+
+# ---------------------------------------------------------------------------
+# callbacks
+# ---------------------------------------------------------------------------
+def test_speedometer_runs():
+    from incubator_mxnet_tpu.callback import Speedometer, BatchEndParam
+    sp = Speedometer(batch_size=4, frequent=2, auto_reset=False)
+    m = metric.Accuracy()
+    m.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
+    for i in range(5):
+        sp(BatchEndParam(epoch=0, nbatch=i, eval_metric=m, locals=None))
+
+
+def test_do_checkpoint_callback(tmp_path):
+    from incubator_mxnet_tpu.callback import do_checkpoint
+    prefix = str(tmp_path / "cb")
+    cb = do_checkpoint(prefix, period=1)
+    s = _mlp_sym()
+    cb(0, s, {"fc1_weight": nd.ones((2, 2))}, {})
+    assert os.path.exists(f"{prefix}-symbol.json")
+    assert os.path.exists(f"{prefix}-0001.params")
+    from incubator_mxnet_tpu.model import load_checkpoint
+    s2, arg, aux = load_checkpoint(prefix, 1)
+    np.testing.assert_array_equal(arg["fc1_weight"].asnumpy(),
+                                  np.ones((2, 2)))
