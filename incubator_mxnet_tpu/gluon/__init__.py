@@ -7,6 +7,7 @@ from . import rnn
 from . import data
 from . import loss
 from . import utils
+from . import model_zoo
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "rnn", "data", "loss", "utils"]
+           "SymbolBlock", "Trainer", "nn", "rnn", "data", "loss", "utils", "model_zoo"]

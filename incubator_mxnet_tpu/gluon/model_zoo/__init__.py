@@ -1,0 +1,6 @@
+"""Model zoo for pre-defined architectures (reference:
+python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision
+from .vision import get_model
+
+__all__ = ["vision", "get_model"]

@@ -1,0 +1,34 @@
+"""Pretrained-weight lookup (reference:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+This build has no network access: weights are loaded from local disk only
+(``root``, default ``~/.mxnet/models`` like the reference); a missing file
+raises instead of downloading.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+from ...context import cpu
+
+__all__ = ["load_pretrained", "get_model_file", "DEFAULT_ROOT"]
+
+DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
+
+
+def get_model_file(name, root=DEFAULT_ROOT):
+    """Return the local path of ``name``'s .params file or raise
+    (reference: model_store.get_model_file, minus the download path)."""
+    path = os.path.expanduser(os.path.join(root or DEFAULT_ROOT,
+                                           f"{name}.params"))
+    if not os.path.exists(path):
+        raise MXNetError(
+            f"Pretrained weights for {name} not found at {path}; this build "
+            "has no network access — place a .params file there manually.")
+    return path
+
+
+def load_pretrained(net, name, root=DEFAULT_ROOT, ctx=None):
+    net.load_parameters(get_model_file(name, root), ctx=ctx or cpu())
+    return net

@@ -1,0 +1,80 @@
+"""Vision model zoo (reference:
+python/mxnet/gluon/model_zoo/vision/__init__.py).
+
+Every architecture family the reference ships: ResNet V1/V2 (18/34/50/101/152),
+VGG (11/13/16/19, +_bn), AlexNet, DenseNet (121/161/169/201), SqueezeNet
+(1.0/1.1), Inception V3, MobileNet V1 (4 multipliers) / V2 (4 multipliers) /
+V3 (small/large).
+
+``pretrained=True`` requires weights on local disk (``root=``) — this build
+has no network access, so absent files raise rather than download.
+"""
+from .resnet import *
+from .vgg import *
+from .alexnet import *
+from .densenet import *
+from .squeezenet import *
+from .inception import *
+from .mobilenet import *
+
+from .resnet import __all__ as _resnet_all
+from .vgg import __all__ as _vgg_all
+from .alexnet import __all__ as _alexnet_all
+from .densenet import __all__ as _densenet_all
+from .squeezenet import __all__ as _squeezenet_all
+from .inception import __all__ as _inception_all
+from .mobilenet import __all__ as _mobilenet_all
+
+from ....base import MXNetError
+
+__all__ = (_resnet_all + _vgg_all + _alexnet_all + _densenet_all
+           + _squeezenet_all + _inception_all + _mobilenet_all
+           + ["get_model"])
+
+
+# curated factory table (reference: model_zoo/vision/__init__.py models
+# dict).  Keys use the reference's spellings (dots: 'squeezenet1.0',
+# 'mobilenetv2_1.0'), plus python-identifier aliases for convenience.
+_MODELS = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+    "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+    "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn,
+    "alexnet": alexnet,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "squeezenet1_0": squeezenet1_0, "squeezenet1_1": squeezenet1_1,
+    "inceptionv3": inception_v3, "inception_v3": inception_v3,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenet1_0": mobilenet1_0, "mobilenet0_75": mobilenet0_75,
+    "mobilenet0_5": mobilenet0_5, "mobilenet0_25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0,
+    "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5,
+    "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "mobilenet_v2_1_0": mobilenet_v2_1_0,
+    "mobilenet_v2_0_75": mobilenet_v2_0_75,
+    "mobilenet_v2_0_5": mobilenet_v2_0_5,
+    "mobilenet_v2_0_25": mobilenet_v2_0_25,
+    "mobilenetv3_small": mobilenet_v3_small,
+    "mobilenetv3_large": mobilenet_v3_large,
+    "mobilenet_v3_small": mobilenet_v3_small,
+    "mobilenet_v3_large": mobilenet_v3_large,
+}
+
+
+def get_model(name, **kwargs):
+    """Return a model by name (reference: model_zoo/vision get_model)."""
+    name = name.lower()
+    if name not in _MODELS:
+        raise MXNetError(
+            f"Model '{name}' is not supported. Available: "
+            f"{sorted(_MODELS.keys())}")
+    return _MODELS[name](**kwargs)

@@ -1,0 +1,329 @@
+"""MobileNet V1/V2/V3 (reference:
+python/mxnet/gluon/model_zoo/vision/mobilenet.py; V3 per Howard et al. 2019).
+
+Depthwise convs map to XLA grouped convolution (feature_group_count), which
+the TPU compiler lowers efficiently; ReLU6/hard-swish fuse into the conv
+epilogue.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...block import HybridBlock
+from ..model_store import load_pretrained
+
+__all__ = ["MobileNet", "MobileNetV2", "MobileNetV3",
+           "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+           "mobilenet_v2_0_25", "mobilenet_v3_small", "mobilenet_v3_large",
+           "get_mobilenet", "get_mobilenet_v2"]
+
+
+class RELU6(HybridBlock):
+    """ReLU6 (reference: RELU6)."""
+
+    def hybrid_forward(self, F, x):
+        return F.clip(x, 0, 6)
+
+
+class HardSigmoid(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.clip(x + 3.0, 0, 6) / 6.0
+
+
+class HardSwish(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._hsig = HardSigmoid()
+
+    def hybrid_forward(self, F, x):
+        return x * self._hsig(x)
+
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm(scale=True))
+    if active:
+        out.add(RELU6() if relu6 else nn.Activation("relu"))
+
+
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels=channels, relu6=relu6)
+
+
+class LinearBottleneck(HybridBlock):
+    """MobileNetV2 inverted-residual bottleneck (reference:
+    LinearBottleneck)."""
+
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        with self.name_scope():
+            self.out = nn.HybridSequential()
+            _add_conv(self.out, in_channels * t, relu6=True)
+            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
+                      pad=1, num_group=in_channels * t, relu6=True)
+            _add_conv(self.out, channels, active=False, relu6=True)
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    """MobileNet V1 (reference: MobileNet)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            with self.features.name_scope():
+                _add_conv(self.features, channels=int(32 * multiplier),
+                          kernel=3, pad=1, stride=2)
+                dw_channels = [int(x * multiplier) for x in
+                               [32, 64] + [128] * 2 + [256] * 2
+                               + [512] * 6 + [1024]]
+                channels = [int(x * multiplier) for x in
+                            [64] + [128] * 2 + [256] * 2 + [512] * 6
+                            + [1024] * 2]
+                strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+                for dwc, c, s in zip(dw_channels, channels, strides):
+                    _add_conv_dw(self.features, dw_channels=dwc, channels=c,
+                                 stride=s)
+                self.features.add(nn.GlobalAvgPool2D())
+                self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class MobileNetV2(HybridBlock):
+    """MobileNet V2 (reference: MobileNetV2)."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="features_")
+            with self.features.name_scope():
+                _add_conv(self.features, int(32 * multiplier), kernel=3,
+                          stride=2, pad=1, relu6=True)
+                in_channels_group = [int(x * multiplier) for x in
+                                     [32] + [16] + [24] * 2 + [32] * 3
+                                     + [64] * 4 + [96] * 3 + [160] * 3]
+                channels_group = [int(x * multiplier) for x in
+                                  [16] + [24] * 2 + [32] * 3 + [64] * 4
+                                  + [96] * 3 + [160] * 3 + [320]]
+                ts = [1] + [6] * 16
+                strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
+                for in_c, c, t, s in zip(in_channels_group, channels_group,
+                                         ts, strides):
+                    self.features.add(LinearBottleneck(
+                        in_channels=in_c, channels=c, t=t, stride=s))
+                last_channels = (int(1280 * multiplier)
+                                 if multiplier > 1.0 else 1280)
+                _add_conv(self.features, last_channels, relu6=True)
+                self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.HybridSequential(prefix="output_")
+            with self.output.name_scope():
+                self.output.add(nn.Conv2D(classes, 1, use_bias=False,
+                                          prefix="pred_"))
+                self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class _SEBlock(HybridBlock):
+    """Squeeze-excite with hard-sigmoid gating (MobileNetV3)."""
+
+    def __init__(self, channels, reduction=4, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.pool = nn.GlobalAvgPool2D()
+            self.fc1 = nn.Conv2D(channels // reduction, 1, activation="relu")
+            self.fc2 = nn.Conv2D(channels, 1)
+            self.hsig = HardSigmoid()
+
+    def hybrid_forward(self, F, x):
+        w = self.pool(x)
+        w = self.fc1(w)
+        w = self.hsig(self.fc2(w))
+        return x * w
+
+
+class _V3Bottleneck(HybridBlock):
+    """MobileNetV3 bottleneck: expand → dw → (SE) → project."""
+
+    def __init__(self, in_channels, exp_channels, out_channels, kernel,
+                 stride, use_se, act, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == out_channels
+        act_block = HardSwish if act == "hswish" else None
+        with self.name_scope():
+            self.out = nn.HybridSequential()
+            if exp_channels != in_channels:
+                self.out.add(nn.Conv2D(exp_channels, 1, use_bias=False))
+                self.out.add(nn.BatchNorm())
+                self.out.add(act_block() if act_block
+                             else nn.Activation("relu"))
+            self.out.add(nn.Conv2D(exp_channels, kernel, stride,
+                                   kernel // 2, groups=exp_channels,
+                                   use_bias=False))
+            self.out.add(nn.BatchNorm())
+            self.out.add(act_block() if act_block else nn.Activation("relu"))
+            if use_se:
+                self.out.add(_SEBlock(exp_channels))
+            self.out.add(nn.Conv2D(out_channels, 1, use_bias=False))
+            self.out.add(nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+# (kernel, exp, out, SE, activation, stride)
+_V3_LARGE_CFG = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2),
+    (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1),
+    (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2),
+    (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_V3_SMALL_CFG = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1),
+    (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2),
+    (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+class MobileNetV3(HybridBlock):
+    """MobileNet V3 small/large (Howard et al. 2019)."""
+
+    def __init__(self, mode="large", classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        cfg = _V3_LARGE_CFG if mode == "large" else _V3_SMALL_CFG
+        last_exp = 960 if mode == "large" else 576
+        last_ch = 1280 if mode == "large" else 1024
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(16, 3, 2, 1, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(HardSwish())
+            in_ch = 16
+            for k, exp, out, se, act, s in cfg:
+                self.features.add(_V3Bottleneck(in_ch, exp, out, k, s, se,
+                                                act))
+                in_ch = out
+            self.features.add(nn.Conv2D(last_exp, 1, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(HardSwish())
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Conv2D(last_ch, 1, use_bias=False))
+            self.features.add(HardSwish())
+            self.output = nn.HybridSequential(prefix="output_")
+            self.output.add(nn.Conv2D(classes, 1))
+            self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def _version_suffix(multiplier):
+    # reference naming: '1.0', '0.75', '0.5', '0.25'
+    suffix = f"{multiplier:.2f}"
+    if suffix.endswith("0"):
+        suffix = suffix[:-1]
+    return suffix
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        load_pretrained(net, f"mobilenet{_version_suffix(multiplier)}",
+                        root, ctx)
+    return net
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    net = MobileNetV2(multiplier, **kwargs)
+    if pretrained:
+        load_pretrained(net, f"mobilenetv2_{_version_suffix(multiplier)}",
+                        root, ctx)
+    return net
+
+
+def mobilenet1_0(**kwargs):
+    return get_mobilenet(1.0, **kwargs)
+
+
+def mobilenet0_75(**kwargs):
+    return get_mobilenet(0.75, **kwargs)
+
+
+def mobilenet0_5(**kwargs):
+    return get_mobilenet(0.5, **kwargs)
+
+
+def mobilenet0_25(**kwargs):
+    return get_mobilenet(0.25, **kwargs)
+
+
+def mobilenet_v2_1_0(**kwargs):
+    return get_mobilenet_v2(1.0, **kwargs)
+
+
+def mobilenet_v2_0_75(**kwargs):
+    return get_mobilenet_v2(0.75, **kwargs)
+
+
+def mobilenet_v2_0_5(**kwargs):
+    return get_mobilenet_v2(0.5, **kwargs)
+
+
+def mobilenet_v2_0_25(**kwargs):
+    return get_mobilenet_v2(0.25, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, ctx=None, root=None, **kwargs):
+    net = MobileNetV3("small", **kwargs)
+    if pretrained:
+        load_pretrained(net, "mobilenetv3_small", root, ctx)
+    return net
+
+
+def mobilenet_v3_large(pretrained=False, ctx=None, root=None, **kwargs):
+    net = MobileNetV3("large", **kwargs)
+    if pretrained:
+        load_pretrained(net, "mobilenetv3_large", root, ctx)
+    return net

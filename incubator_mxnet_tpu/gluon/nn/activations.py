@@ -9,8 +9,8 @@ __all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish",
 
 class Activation(HybridBlock):
     def __init__(self, activation, **kwargs):
+        self._act_type = activation   # before super(): _alias() needs it
         super().__init__(**kwargs)
-        self._act_type = activation
 
     def _alias(self):
         return self._act_type

@@ -1,0 +1,113 @@
+"""Model zoo tests (reference model:
+tests/python/unittest/test_gluon_model_zoo.py — construct every zoo model,
+forward a subset at reduced resolution to keep CPU CI fast)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+from incubator_mxnet_tpu.gluon.model_zoo.vision import get_model
+
+ALL_MODELS = [
+    "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+    "resnet152_v1",
+    "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+    "resnet152_v2",
+    "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+    "alexnet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "squeezenet1_0", "squeezenet1_1", "inception_v3",
+    "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+    "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+    "mobilenet_v2_0_25", "mobilenet_v3_small", "mobilenet_v3_large",
+]
+
+
+def test_all_models_construct():
+    for name in ALL_MODELS:
+        net = get_model(name, classes=10)
+        assert net is not None, name
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError):
+        get_model("resnet1337_v9")
+
+
+def _forward(name, size, **kwargs):
+    net = get_model(name, classes=10, **kwargs)
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(size=(2, 3, size, size))
+                    .astype("float32"))
+    y = net(x)
+    assert y.shape == (2, 10), (name, y.shape)
+    return net, y
+
+
+def test_resnet_v1_thumbnail_forward():
+    # thumbnail=True uses the CIFAR 3x3 stem — small input, fast on CPU
+    net, y = _forward("resnet18_v1", 32, thumbnail=True)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_resnet_v2_thumbnail_forward():
+    net, y = _forward("resnet18_v2", 32, thumbnail=True)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_resnet_bottleneck_thumbnail_forward():
+    net, y = _forward("resnet50_v1", 32, thumbnail=True)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_mobilenet_v2_forward():
+    net, y = _forward("mobilenet_v2_0_25", 64)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_mobilenet_v3_forward():
+    net, y = _forward("mobilenet_v3_small", 64)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_squeezenet_forward():
+    net, y = _forward("squeezenet1_1", 96)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_resnet18_hybridize_and_train_step():
+    """End-to-end: hybridized zoo model trains one step."""
+    from incubator_mxnet_tpu import gluon, autograd
+    net = get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.uniform(size=(4, 3, 32, 32)).astype("float32"))
+    label = mx.nd.array(np.array([0, 1, 2, 3]).astype("float32"))
+    net(x)  # trigger deferred shape inference
+    w0 = net.collect_params()
+    before = {k: v.data().asnumpy().copy() for k, v in list(w0.items())[:2]}
+    with autograd.record():
+        out = net(x)
+        loss = loss_fn(out, label)
+    loss.backward()
+    trainer.step(4)
+    changed = any(
+        not np.allclose(before[k], w0[k].data().asnumpy())
+        for k in before)
+    assert changed
+
+
+def test_model_zoo_params_roundtrip(tmp_path):
+    net = get_model("squeezenet1_1", classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.uniform(size=(1, 3, 96, 96)).astype("float32"))
+    y0 = net(x).asnumpy()
+    f = str(tmp_path / "sq.params")
+    net.save_parameters(f)
+    net2 = get_model("squeezenet1_1", classes=10)
+    net2.load_parameters(f)
+    y1 = net2(x).asnumpy()
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
