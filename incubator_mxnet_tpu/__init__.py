@@ -45,6 +45,8 @@ from . import callback
 from . import model
 from . import module
 from . import module as mod
+from . import numpy as np
+from . import numpy_extension as npx
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
@@ -52,4 +54,4 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "autograd", "random", "base", "context", "initializer", "init",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
            "parallel", "symbol", "sym", "Executor", "io", "metric",
-           "callback", "model", "module", "mod"]
+           "callback", "model", "module", "mod", "np", "npx"]

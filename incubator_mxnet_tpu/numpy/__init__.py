@@ -1,0 +1,26 @@
+"""``mx.np``: NumPy-compatible array API on the framework tensor
+(reference: python/mxnet/numpy/__init__.py).
+
+Attribute access is lazy (PEP 562): the jnp-backed function table and the
+linalg/random submodules materialize on first use so that importing the
+package stays jax.numpy-free.
+"""
+import importlib as _importlib
+
+from . import multiarray as _ma
+from .multiarray import ndarray  # noqa: F401 — the array type, always eager
+
+_SUBMODULES = ("linalg", "random")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    return getattr(_ma, name)
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_ma.__all__)
+                      + list(_SUBMODULES)))

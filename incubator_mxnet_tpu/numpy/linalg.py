@@ -1,0 +1,30 @@
+"""``mx.np.linalg`` (reference: python/mxnet/numpy/linalg.py).
+
+Delegates to jax.numpy.linalg (XLA-native decompositions; on TPU these
+lower to MXU-friendly blocked algorithms where available, else run on
+host via XLA CustomCall exactly like the reference falls back to LAPACK).
+"""
+from __future__ import annotations
+
+from .multiarray import _np_op
+
+
+def _gen():
+    import jax.numpy.linalg as jla
+    names = ["norm", "inv", "pinv", "det", "slogdet", "matrix_rank",
+             "matrix_power", "solve", "lstsq", "cholesky", "qr", "svd",
+             "svdvals", "eig", "eigh", "eigvals", "eigvalsh", "multi_dot",
+             "tensorinv", "tensorsolve", "cond", "matrix_transpose",
+             "vector_norm", "matrix_norm", "cross", "outer", "diagonal",
+             "trace", "vecdot"]
+    out = {}
+    for n in names:
+        f = getattr(jla, n, None)
+        if f is not None:
+            out[n] = _np_op(f, f"linalg.{n}")
+    return out
+
+
+globals().update(_gen())
+
+__all__ = [n for n in list(globals()) if not n.startswith("_") and n != "annotations"]

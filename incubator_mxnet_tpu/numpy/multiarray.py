@@ -1,0 +1,426 @@
+"""NumPy-compatible array + operator namespace (reference:
+python/mxnet/numpy/multiarray.py and python/mxnet/numpy/*.py, ~15k LoC of
+wrappers there).
+
+TPU-native design: ``mx.np.ndarray`` is the SAME eager tensor as
+``mx.nd.NDArray`` (one ``jax.Array`` underneath, one autograd tape), just a
+subclass carrying NumPy conventions — ``array(...)`` repr, NumPy argument
+spellings (``axis=``, ``keepdims=``, ``size=``), and NumPy function names.
+Functions are generated from ``jax.numpy``, which already implements NumPy
+semantics on XLA, so every op here inherits the jit/grad/sharding machinery
+instead of re-implementing ~300 wrappers by hand.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import (NDArray, _invoke, _place,
+                               array as _nd_array)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class ndarray(NDArray):
+    """NumPy-flavoured view of the framework tensor (reference:
+    numpy/multiarray.py ndarray).  Same storage/autograd as NDArray."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        arr = self.asnumpy()
+        prefix = "array("
+        body = _onp.array2string(arr, separator=", ", prefix=prefix)
+        ctx = self.context
+        suffix = f", ctx={ctx})" if ctx.device_type != "cpu" else ")"
+        return f"{prefix}{body}{suffix}"
+
+    def __str__(self):
+        return str(self.asnumpy())
+
+    def as_nd_ndarray(self) -> NDArray:
+        out = NDArray(self._data, ctx=self._ctx)
+        out._ag_node, out._ag_idx = self._ag_node, self._ag_idx
+        out._require_grad = self._require_grad
+        out._grad, out._grad_req = self._grad, self._grad_req
+        return out
+
+    def as_np_ndarray(self) -> "ndarray":
+        return self
+
+    # NumPy spellings over the base methods
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return mean(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return sum(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return min(self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return prod(self, axis=axis, keepdims=keepdims)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return std(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return var(self, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return argmax(self, axis=axis)
+
+    def argmin(self, axis=None):
+        return argmin(self, axis=axis)
+
+    def cumsum(self, axis=None):
+        return cumsum(self, axis=axis)
+
+    def dot(self, b):
+        return dot(self, b)
+
+    def round(self, decimals=0):
+        return around(self, decimals=decimals)
+
+    def clip(self, a_min=None, a_max=None):
+        return clip(self, a_min, a_max)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return reshape(self, shape, **kwargs)
+
+    def ravel(self):
+        return ravel(self)
+
+    def flatten(self):
+        return ravel(self)
+
+    def squeeze(self, axis=None):
+        return squeeze(self, axis=axis)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return transpose(self, axes if axes else None)
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    def astype(self, dtype, copy=True):
+        return _reclass(super().astype(dtype, copy=copy))
+
+    def copy(self):
+        return _reclass(super().copy())
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+
+def _reclass(x):
+    """Re-brand base-NDArray results as np ndarrays (zero-copy: identical
+    slot layout, so only __class__ changes)."""
+    _ensure_funcs()   # methods like .mean() resolve generated module
+    #                   globals directly, bypassing module __getattr__
+    if isinstance(x, (list, tuple)):
+        return [_reclass(i) for i in x]
+    if isinstance(x, NDArray) and not isinstance(x, ndarray):
+        x.__class__ = ndarray
+    return x
+
+
+# re-brand operator results: the base dunders (__add__, __getitem__, ...)
+# return base NDArray; np semantics keep the np class closed under ops
+def _np_dunder(name):
+    base = getattr(NDArray, name)
+
+    def f(self, *a, **kw):
+        return _reclass(base(self, *a, **kw))
+    f.__name__ = name
+    return f
+
+
+for _name in ["__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+              "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+              "__rfloordiv__", "__mod__", "__rmod__", "__pow__", "__rpow__",
+              "__matmul__", "__rmatmul__", "__neg__", "__abs__", "__eq__",
+              "__ne__", "__gt__", "__ge__", "__lt__", "__le__",
+              "__getitem__"]:
+    if hasattr(NDArray, _name):
+        setattr(ndarray, _name, _np_dunder(_name))
+ndarray.__hash__ = None  # rich __eq__ → unhashable, like numpy
+
+
+# ---------------------------------------------------------------------------
+# generic wrapper: jax.numpy function → eager autograd-recorded np function
+# ---------------------------------------------------------------------------
+def _np_op(jfn, name):
+    def fn(*args, **kwargs):
+        # NDArrays may sit anywhere in the argument pytree (e.g.
+        # concatenate([a, b])); flatten, lift them out, and rebuild inside
+        # the recorded fun so autograd sees every array input.
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+        arr_idx = [i for i, l in enumerate(leaves)
+                   if isinstance(l, NDArray)]
+        arrs = [leaves[i] for i in arr_idx]
+
+        def run(*jarrs):
+            ls = list(leaves)
+            for i, j in zip(arr_idx, jarrs):
+                ls[i] = j
+            a, kw = jax.tree_util.tree_unflatten(treedef, ls)
+            return jfn(*a, **kw)
+
+        return _reclass(_invoke(run, arrs, name=name))
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = (f"NumPy-compatible ``{name}`` lowered through jax.numpy "
+                  f"(reference: python/mxnet/numpy {name}).")
+    return fn
+
+
+# The exported function surface.  Every name is a jax.numpy function with
+# NumPy semantics; wrappers record on the autograd tape when inputs do.
+_JNP_FUNCS = [
+    # math / elementwise
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "fmod", "power", "float_power", "negative",
+    "positive", "absolute", "abs", "fabs", "sign", "rint", "floor",
+    "ceil", "trunc", "exp", "expm1", "exp2", "log", "log2", "log10",
+    "log1p", "sqrt", "cbrt", "square", "reciprocal", "gcd", "lcm",
+    "maximum", "minimum", "fmax", "fmin", "hypot", "heaviside",
+    "logaddexp", "logaddexp2", "ldexp", "copysign", "nextafter",
+    # trig / hyperbolic
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "degrees", "radians", "deg2rad", "rad2deg", "unwrap",
+    # rounding / clip
+    "around", "round", "clip", "nan_to_num",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "max", "min", "amax", "amin",
+    "ptp", "median", "average", "percentile", "quantile",
+    "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmax", "nanmin",
+    "cumsum", "cumprod", "nancumsum", "nancumprod",
+    "argmax", "argmin", "nanargmax", "nanargmin", "count_nonzero",
+    "all", "any",
+    # linear algebra (top-level numpy names)
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "trace", "diagonal",
+    # comparison / logic
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "isnan", "isinf", "isfinite", "isposinf", "isneginf", "isclose",
+    "array_equal", "allclose", "signbit",
+    # bit ops
+    "bitwise_and", "bitwise_or", "bitwise_xor", "invert", "bitwise_not",
+    "left_shift", "right_shift",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "atleast_1d", "atleast_2d", "atleast_3d", "flip", "fliplr", "flipud",
+    "rot90", "roll", "tile", "repeat", "concatenate", "stack", "vstack",
+    "hstack", "dstack", "column_stack", "row_stack", "split",
+    "array_split", "hsplit", "vsplit", "dsplit", "append", "insert",
+    "delete", "pad", "resize", "flatnonzero",
+    # indexing / selection
+    "where", "take", "take_along_axis", "choose", "compress", "extract",
+    "searchsorted", "argwhere", "nonzero", "diag", "diagflat", "tril",
+    "triu", "tri", "select", "indices", "unravel_index", "ravel_multi_index",
+    # sorting
+    "sort", "argsort", "lexsort", "partition", "argpartition",
+    "unique", "sort_complex",
+    # sets
+    "intersect1d", "union1d", "setdiff1d", "setxor1d", "in1d", "isin",
+    # statistics / histograms
+    "histogram", "histogram2d", "histogram_bin_edges", "bincount",
+    "digitize", "corrcoef", "cov", "correlate", "convolve",
+    # polynomials / misc
+    "interp", "diff", "ediff1d", "gradient", "trapezoid", "i0", "sinc",
+    "real", "imag", "conj", "conjugate", "angle",
+    # special values
+    "floor_divide",
+]
+
+_THIS = globals()
+_jnp_mod = None
+
+
+def _ensure_funcs():
+    global _jnp_mod
+    if _jnp_mod is not None:
+        return
+    jnp = _jnp()
+    _jnp_mod = jnp
+    for fname in _JNP_FUNCS:
+        jfn = getattr(jnp, fname, None)
+        if jfn is None:   # older jax: skip gracefully
+            continue
+        if fname not in _THIS:
+            _THIS[fname] = _np_op(jfn, fname)
+    # numpy fix == truncate toward zero; jnp.fix is deprecated for trunc
+    _THIS["fix"] = _np_op(jnp.trunc, "fix")
+
+
+def __getattr__(name):
+    """PEP 562: the jnp-generated function table materializes on first
+    access, keeping `import incubator_mxnet_tpu` free of jax.numpy."""
+    if name.startswith("_"):
+        raise AttributeError(name)
+    _ensure_funcs()
+    try:
+        return _THIS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'incubator_mxnet_tpu.numpy' has no attribute {name!r}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# creation functions (need ctx/device handling, hence explicit)
+# ---------------------------------------------------------------------------
+def array(object, dtype=None, ctx=None, device=None):
+    """Create an np ndarray (reference: numpy/multiarray.py array)."""
+    if isinstance(object, NDArray):
+        object = object.asnumpy()
+    return _reclass(_nd_array(object, ctx=device or ctx, dtype=dtype))
+
+
+def asarray(a, dtype=None, ctx=None, device=None):
+    if isinstance(a, ndarray) and dtype is None:
+        return a
+    return array(a, dtype=dtype, ctx=ctx, device=device)
+
+
+def _creation(fname, default_dtype="float32"):
+    def fn(*args, dtype=None, ctx=None, device=None, **kwargs):
+        jnp = _jnp()
+        dtype = dtype if dtype is not None else default_dtype
+        out = getattr(jnp, fname)(*args, dtype=_onp.dtype(dtype), **kwargs)
+        return _reclass(_place(out, device or ctx))
+    fn.__name__ = fname
+    return fn
+
+
+zeros = _creation("zeros")
+ones = _creation("ones")
+empty = _creation("empty")
+eye = _creation("eye")
+identity = _creation("identity")
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None):
+    jnp = _jnp()
+    out = jnp.full(shape, fill_value,
+                   dtype=_onp.dtype(dtype) if dtype else None)
+    return _reclass(_place(out, device or ctx))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    jnp = _jnp()
+    out = jnp.arange(start, stop, step,
+                     dtype=_onp.dtype(dtype) if dtype else None)
+    if out.dtype == _onp.float64:
+        out = out.astype(_onp.float32)
+    return _reclass(_place(out, device or ctx))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    jnp = _jnp()
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=_onp.dtype(dtype) if dtype else _onp.float32,
+                       axis=axis)
+    if retstep:
+        return _reclass(_place(out[0], device or ctx)), float(out[1])
+    return _reclass(_place(out, device or ctx))
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    jnp = _jnp()
+    out = jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                       dtype=_onp.dtype(dtype) if dtype else _onp.float32,
+                       axis=axis)
+    return _reclass(_place(out, device or ctx))
+
+
+def meshgrid(*xi, **kwargs):
+    jnp = _jnp()
+    outs = jnp.meshgrid(*[x._data if isinstance(x, NDArray) else x
+                          for x in xi], **kwargs)
+    ctx = (xi[0]._ctx if xi and isinstance(xi[0], NDArray)
+           else current_context())
+    return [_reclass(_place(o, ctx)) for o in outs]
+
+
+def zeros_like(a, dtype=None):
+    return full_like(a, 0, dtype=dtype)
+
+
+def ones_like(a, dtype=None):
+    return full_like(a, 1, dtype=dtype)
+
+
+def full_like(a, fill_value, dtype=None):
+    jnp = _jnp()
+    data = a._data if isinstance(a, NDArray) else a
+    ctx = a._ctx if isinstance(a, NDArray) else None
+    out = jnp.full_like(data, fill_value,
+                        dtype=_onp.dtype(dtype) if dtype else None)
+    return _reclass(_place(out, ctx))
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def copy(a):
+    return asarray(a).copy()
+
+
+# constants
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+# dtypes re-exported like numpy
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+dtype = _onp.dtype
+
+
+def get_include():
+    raise MXNetError("get_include is a CPython-extension helper of the "
+                     "reference; not applicable to the TPU build")
+
+
+__all__ = (["ndarray", "array", "asarray", "zeros", "ones", "empty", "full",
+            "arange", "linspace", "logspace", "meshgrid", "eye", "identity",
+            "zeros_like", "ones_like", "full_like", "empty_like", "copy",
+            "pi", "e", "euler_gamma", "inf", "nan", "newaxis", "fix",
+            "dtype", "float16", "float32", "float64", "int8", "int16",
+            "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+            "bool_"]
+           + _JNP_FUNCS)

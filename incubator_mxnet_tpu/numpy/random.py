@@ -1,0 +1,160 @@
+"""``mx.np.random`` — NumPy-style samplers (reference:
+python/mxnet/numpy/random.py).
+
+Same per-context key stream as ``mx.nd.random`` (incubator_mxnet_tpu.random),
+so ``mx.random.seed`` governs both namespaces; NumPy spelling: ``size=``
+instead of ``shape=``.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .. import random as _random
+from ..context import current_context
+from ..ndarray import random as _nd_random
+from ..ndarray.ndarray import _place
+from .multiarray import _reclass
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "beta", "gamma",
+           "exponential", "poisson", "multinomial", "binomial",
+           "lognormal", "laplace", "standard_normal"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def _size(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None,
+            device=None):
+    return _reclass(_nd_random.uniform(low, high, _size(size), dtype=dtype,
+                                       ctx=device or ctx))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None,
+           device=None):
+    return _reclass(_nd_random.normal(loc, scale, _size(size), dtype=dtype,
+                                      ctx=device or ctx))
+
+
+def standard_normal(size=None, dtype="float32"):
+    return normal(0.0, 1.0, size=size, dtype=dtype)
+
+
+def randn(*size, dtype="float32"):
+    return normal(0.0, 1.0, size=size or None, dtype=dtype)
+
+
+def rand(*size, dtype="float32"):
+    return uniform(0.0, 1.0, size=size or None, dtype=dtype)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None, device=None):
+    if high is None:
+        low, high = 0, low
+    return _reclass(_nd_random.randint(low, high, _size(size), dtype=dtype,
+                                       ctx=device or ctx))
+
+
+def poisson(lam=1.0, size=None, ctx=None, device=None):
+    return _reclass(_nd_random.poisson(lam, _size(size),
+                                       ctx=device or ctx))
+
+
+def exponential(scale=1.0, size=None, ctx=None, device=None):
+    return _reclass(_nd_random.exponential(scale, _size(size),
+                                           ctx=device or ctx))
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None, device=None):
+    return _reclass(_nd_random.gamma(shape, scale, _size(size),
+                                     ctx=device or ctx))
+
+
+def beta(a, b, size=None, ctx=None, device=None):
+    import jax
+    ctx = device or ctx or current_context()
+    key = _random.new_key(ctx)
+    # size=None keeps jax's parameter-broadcast shape (numpy semantics)
+    out = jax.random.beta(key, a, b,
+                          None if size is None else _size(size))
+    return _reclass(_place(out, ctx))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    import jax
+    ctx = device or ctx or current_context()
+    key = _random.new_key(ctx)
+    out = loc + scale * jax.random.laplace(key, _size(size))
+    return _reclass(_place(out, ctx))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, device=None):
+    from . import multiarray as _mnp
+    return _mnp.exp(normal(mean, sigma, size=size, ctx=device or ctx))
+
+
+def binomial(n, p, size=None, ctx=None, device=None):
+    import jax
+    ctx = device or ctx or current_context()
+    key = _random.new_key(ctx)
+    out = jax.random.binomial(
+        key, n, p, shape=None if size is None else _size(size))
+    return _reclass(_place(out, ctx))
+
+
+def multinomial(n, pvals, size=None):
+    import jax
+    ctx = current_context()
+    key = _random.new_key(ctx)
+    pv = _onp.asarray(pvals, dtype="float32")
+    # jax's shape= is the FULL result shape including the category axis
+    # (p is broadcast to it), so numpy's size + (k,) maps directly
+    counts = jax.random.multinomial(
+        key, n, jax.numpy.asarray(pv), shape=_size(size) + (len(pv),))
+    return _reclass(_place(counts, ctx))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None):
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    ctx = device or ctx or current_context()
+    key = _random.new_key(ctx)
+    if isinstance(a, NDArray):
+        a = a._data
+    elif isinstance(a, int):
+        a = jnp.arange(a)
+    else:
+        a = jnp.asarray(a)
+    out = jax.random.choice(key, a, shape=_size(size), replace=replace,
+                            p=None if p is None else jnp.asarray(p))
+    return _reclass(_place(out, ctx))
+
+
+def permutation(x):
+    import jax
+    from ..ndarray.ndarray import NDArray
+    ctx = x._ctx if isinstance(x, NDArray) else current_context()
+    key = _random.new_key(ctx)
+    if isinstance(x, NDArray):
+        out = jax.random.permutation(key, x._data)
+    else:
+        out = jax.random.permutation(key, x)
+    return _reclass(_place(out, ctx))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference: np.random.shuffle)."""
+    from ..ndarray.ndarray import NDArray
+    if not isinstance(x, NDArray):
+        raise TypeError("shuffle expects an ndarray")
+    x._set_data(permutation(x)._data)

@@ -1,0 +1,206 @@
+"""``mx.npx``: operators that extend NumPy semantics with NN primitives
+(reference: python/mxnet/numpy_extension/__init__.py and
+python/mxnet/_numpy_op_doc.py; npx.set_np semantics from
+python/mxnet/util.py).
+
+The reference gates NumPy semantics behind ``npx.set_np()`` because its
+legacy ndarray forbids zero-dim/zero-size arrays.  Here the tensor is a
+``jax.Array``, which is NumPy-semantic natively, so ``set_np`` only flips
+the compatibility flags that other modules may consult.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from ..ndarray import nn as _nd_nn
+from ..ndarray import ops as _nd_ops
+from ..ndarray.ndarray import NDArray
+from ..numpy.multiarray import _reclass
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "set_np_shape", "use_np", "np_shape", "np_array",
+           # nn ops
+           "activation", "relu", "sigmoid", "softmax", "log_softmax",
+           "batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "convolution", "deconvolution", "fully_connected", "pooling",
+           "dropout", "embedding", "leaky_relu", "gelu", "rnn",
+           "one_hot", "pick", "topk", "batch_dot", "gamma",
+           "sequence_mask", "reshape_like", "smooth_l1", "gather_nd",
+           "scatter_nd", "stop_gradient", "erf", "erfinv",
+           "waitall", "seed", "cpu", "gpu", "num_gpus", "current_device"]
+
+_state = threading.local()
+
+
+def _flags():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = False
+        _state.np_array = False
+    return _state
+
+
+def set_np(shape=True, array=True):
+    """Enable NumPy semantics (reference: npx.set_np).  Always-on here —
+    the flags are tracked for API parity."""
+    if not shape and array:
+        raise MXNetError("NumPy array semantics require NumPy shape "
+                         "semantics (reference behavior)")
+    f = _flags()
+    f.np_shape, f.np_array = shape, array
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+def set_np_shape(active):
+    f = _flags()
+    prev, f.np_shape = f.np_shape, active
+    return prev
+
+
+class np_shape:
+    """Context manager (reference: mxnet.util.np_shape)."""
+
+    def __init__(self, active=True):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+
+class np_array(np_shape):
+    """Context manager (reference: mxnet.util.np_array)."""
+
+    def __enter__(self):
+        f = _flags()
+        self._prev = f.np_array
+        f.np_array = self._active
+        return self
+
+    def __exit__(self, *exc):
+        _flags().np_array = self._prev
+
+
+def use_np(func):
+    """Decorator form (reference: mxnet.util.use_np).  NumPy semantics are
+    native here, so this is an identity decorator kept for parity."""
+    return func
+
+
+def _np_face(fn, name=None):
+    def wrapped(*args, **kwargs):
+        return _reclass(fn(*args, **kwargs))
+    wrapped.__name__ = name or fn.__name__
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
+# NN primitives with npx spellings (lowercase, numpy-flavored), each
+# delegating to the eager op corpus (which records autograd + jits)
+activation = _np_face(_nd_ops.Activation, "activation")
+relu = _np_face(lambda data: _nd_ops.Activation(data, act_type="relu"),
+                "relu")
+sigmoid = _np_face(lambda data: _nd_ops.Activation(data, act_type="sigmoid"),
+                   "sigmoid")
+softmax = _np_face(_nd_ops.softmax, "softmax")
+log_softmax = _np_face(_nd_ops.log_softmax, "log_softmax")
+leaky_relu = _np_face(_nd_ops.leaky_relu, "leaky_relu")
+gelu = _np_face(_nd_ops.gelu, "gelu")
+batch_norm = _np_face(_nd_nn.BatchNorm, "batch_norm")
+layer_norm = _np_face(_nd_nn.LayerNorm, "layer_norm")
+instance_norm = _np_face(_nd_nn.InstanceNorm, "instance_norm")
+group_norm = _np_face(_nd_nn.GroupNorm, "group_norm")
+convolution = _np_face(_nd_nn.Convolution, "convolution")
+deconvolution = _np_face(_nd_nn.Deconvolution, "deconvolution")
+fully_connected = _np_face(_nd_nn.FullyConnected, "fully_connected")
+pooling = _np_face(_nd_nn.Pooling, "pooling")
+dropout = _np_face(_nd_ops.dropout, "dropout")
+embedding = _np_face(_nd_ops.Embedding, "embedding")
+rnn = _np_face(_nd_nn.RNN, "rnn")
+one_hot = _np_face(_nd_ops.one_hot, "one_hot")
+pick = _np_face(_nd_ops.pick, "pick")
+topk = _np_face(_nd_ops.topk, "topk")
+batch_dot = _np_face(_nd_ops.batch_dot, "batch_dot")
+sequence_mask = _np_face(_nd_ops.SequenceMask, "sequence_mask")
+reshape_like = _np_face(_nd_ops.reshape_like, "reshape_like")
+smooth_l1 = _np_face(_nd_ops.smooth_l1, "smooth_l1")
+gather_nd = _np_face(_nd_ops.gather_nd, "gather_nd")
+scatter_nd = _np_face(_nd_ops.scatter_nd, "scatter_nd")
+stop_gradient = _np_face(_nd_ops.stop_gradient, "stop_gradient")
+
+
+def gamma(data):
+    """Elementwise gamma function Γ(x) via exp(gammaln) with the
+    reflection sign for x<0 (sign Γ(x) = sign sin(πx) there)."""
+    from ..ndarray.ndarray import _invoke
+
+    def run(x):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+        mag = jnp.exp(jsp.gammaln(x))
+        sign = jnp.where(x > 0, 1.0, jnp.sign(jnp.sin(jnp.pi * x)))
+        return mag * sign
+    return _reclass(_invoke(run, [data], name="gamma"))
+
+
+def erf(data):
+    from ..ndarray.ndarray import _invoke
+
+    def run(x):
+        import jax.scipy.special as jsp
+        return jsp.erf(x)
+    return _reclass(_invoke(run, [data], name="erf"))
+
+
+def erfinv(data):
+    from ..ndarray.ndarray import _invoke
+
+    def run(x):
+        import jax.scipy.special as jsp
+        return jsp.erfinv(x)
+    return _reclass(_invoke(run, [data], name="erfinv"))
+
+
+# conveniences re-exported under npx like the reference
+def waitall():
+    from ..ndarray.ndarray import waitall as w
+    w()
+
+
+def seed(s):
+    from .. import random as _r
+    _r.seed(s)
+
+
+def cpu(device_id=0):
+    from ..context import cpu as c
+    return c(device_id)
+
+
+def gpu(device_id=0):
+    from ..context import gpu as g
+    return g(device_id)
+
+
+def num_gpus():
+    from ..context import num_gpus as n
+    return n()
+
+
+def current_device():
+    from ..context import current_context as c
+    return c()

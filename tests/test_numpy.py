@@ -1,0 +1,167 @@
+"""mx.np / mx.npx tests (reference model:
+tests/python/unittest/test_numpy_op.py, test_numpy_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import np, npx, autograd
+
+
+def test_array_creation():
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    onp.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+    z = np.zeros((3, 4))
+    assert z.dtype == onp.float32
+    o = np.ones((2,), dtype="int32")
+    assert o.dtype == onp.int32
+    f = np.full((2, 2), 7.0)
+    onp.testing.assert_allclose(f.asnumpy(), 7 * onp.ones((2, 2)))
+    r = np.arange(5)
+    assert r.shape == (5,)
+    ls = np.linspace(0, 1, 5)
+    onp.testing.assert_allclose(ls.asnumpy(), onp.linspace(0, 1, 5),
+                                rtol=1e-6)
+
+
+def test_zero_dim_and_zero_size():
+    # numpy semantics: 0-d and 0-size arrays are first-class
+    s = np.array(3.0)
+    assert s.shape == ()
+    assert float(s.asnumpy()) == 3.0
+    z = np.zeros((0, 4))
+    assert z.shape == (0, 4) and z.size == 0
+
+
+def test_elementwise_and_reductions_match_numpy():
+    x = onp.random.RandomState(0).uniform(-2, 2, (3, 4)).astype("float32")
+    a = np.array(x)
+    for name in ["exp", "log1p", "sqrt", "tanh", "sin", "floor", "sign",
+                 "square", "abs"]:
+        if name == "sqrt":
+            got = getattr(np, name)(np.abs(a)).asnumpy()
+            want = getattr(onp, name)(onp.abs(x))
+        else:
+            got = getattr(np, name)(a).asnumpy()
+            want = getattr(onp, name)(x)
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                    err_msg=name)
+    onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.mean(a).asnumpy(), x.mean(), rtol=1e-5)
+    onp.testing.assert_allclose(np.var(a, axis=0).asnumpy(), x.var(0),
+                                rtol=1e-4)
+    assert np.argmax(a).asnumpy() == x.argmax()
+
+
+def test_operators_return_np_ndarray():
+    a = np.ones((2, 3))
+    b = np.ones((2, 3))
+    for r in [a + b, a - b, a * 2, a / 3, a ** 2, a @ b.T, -a, abs(a),
+              a == b, a[0], a.sum(), a.reshape(3, 2), a.T]:
+        assert isinstance(r, np.ndarray), type(r)
+
+
+def test_manipulation():
+    a = np.arange(12, dtype="float32").reshape(3, 4)
+    assert np.transpose(a).shape == (4, 3)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert np.concatenate([a, a], axis=0).shape == (6, 4)
+    assert np.stack([a, a]).shape == (2, 3, 4)
+    parts = np.split(a, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    w = np.where(a > 5, a, np.zeros_like(a))
+    onp.testing.assert_allclose(
+        w.asnumpy(), onp.where(a.asnumpy() > 5, a.asnumpy(), 0))
+    assert np.flip(a, 0).asnumpy()[0, 0] == 8
+
+
+def test_autograd_through_np_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    a.attach_grad()
+    with autograd.record():
+        y = np.sum(np.exp(a) * 2)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * onp.exp([1, 2, 3]),
+                                rtol=1e-5)
+
+
+def test_linalg():
+    x = onp.random.RandomState(1).uniform(1, 2, (3, 3)).astype("float32")
+    x = x @ x.T + 3 * onp.eye(3, dtype="float32")  # SPD
+    a = np.array(x)
+    onp.testing.assert_allclose(np.linalg.det(a).asnumpy(),
+                                onp.linalg.det(x), rtol=1e-4)
+    onp.testing.assert_allclose(np.linalg.inv(a).asnumpy(),
+                                onp.linalg.inv(x), rtol=1e-3, atol=1e-4)
+    L = np.linalg.cholesky(a).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, x, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm(x), rtol=1e-5)
+
+
+def test_np_random():
+    mx.random.seed(3)
+    u = np.random.uniform(0, 1, size=(100,))
+    assert isinstance(u, np.ndarray) and u.shape == (100,)
+    assert 0 <= float(u.min().asnumpy()) and float(u.max().asnumpy()) <= 1
+    mx.random.seed(3)
+    u2 = np.random.uniform(0, 1, size=(100,))
+    onp.testing.assert_allclose(u.asnumpy(), u2.asnumpy())
+    n = np.random.randn(2, 3)
+    assert n.shape == (2, 3)
+    r = np.random.randint(0, 10, size=(50,))
+    assert int(r.max().asnumpy()) < 10
+    c = np.random.choice(5, size=(20,))
+    assert c.shape == (20,)
+    p = np.random.permutation(np.arange(10))
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+
+
+def test_npx_flags():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+    with npx.np_shape(True):
+        assert npx.is_np_shape()
+    assert not npx.is_np_shape()
+
+
+def test_npx_nn_ops():
+    x = np.array(onp.random.RandomState(0)
+                 .uniform(-1, 1, (2, 8)).astype("float32"))
+    r = npx.relu(x)
+    assert isinstance(r, np.ndarray)
+    assert (r.asnumpy() >= 0).all()
+    s = npx.softmax(x, axis=-1)
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), onp.ones(2), rtol=1e-5)
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 4)
+    assert oh.shape == (2, 4)
+    w = np.array(onp.random.RandomState(1)
+                 .uniform(-1, 1, (3, 8)).astype("float32"))
+    fc = npx.fully_connected(x, w, None, no_bias=True, num_hidden=3)
+    assert fc.shape == (2, 3)
+    onp.testing.assert_allclose(fc.asnumpy(), x.asnumpy() @ w.asnumpy().T,
+                                rtol=1e-4)
+
+
+def test_npx_special():
+    x = np.array([0.5, -0.5])
+    onp.testing.assert_allclose(npx.erf(x).asnumpy(),
+                                [0.5204999, -0.5204999], rtol=1e-4)
+    g = npx.gamma(np.array([4.0, 0.5]))
+    onp.testing.assert_allclose(g.asnumpy(), [6.0, onp.sqrt(onp.pi)],
+                                rtol=1e-4)
+
+
+def test_np_nd_interop():
+    a = np.ones((2, 2))
+    nd = a.as_nd_ndarray()
+    assert type(nd) is mx.nd.NDArray
+    back = nd.as_np_ndarray() if hasattr(nd, "as_np_ndarray") else None
+    b = mx.nd.ones((2, 2))
+    s = np.add(a, np.array(b.asnumpy()))
+    assert isinstance(s, np.ndarray)
