@@ -15,6 +15,7 @@ from .optimizer_ops import __all__ as _opt_all
 from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
 from . import utils  # noqa: F401
+from . import contrib  # noqa: F401
 from .utils import save, load, load_frombuffer  # noqa: F401
 
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",

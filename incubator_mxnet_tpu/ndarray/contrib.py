@@ -1,0 +1,579 @@
+"""``mx.nd.contrib`` — detection ops, control flow, and misc extensions
+(reference: src/operator/contrib/*: multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc, bounding_box.cc (box_nms/box_iou), roi_align.cc,
+bilinear_resize.cc, adaptive_avg_pooling.cc; control flow:
+src/operator/control_flow.cc with python sugar in
+python/mxnet/ndarray/contrib.py).
+
+TPU-first re-design notes:
+  * Data-dependent result sizes (NMS, matching) use the fixed-size +
+    valid-marker pattern the reference also uses (-1-filled rows), so every
+    kernel is static-shape and jit/vmap-able — nothing here blocks XLA.
+  * NMS is the O(n²) IoU-matrix + lax.scan suppression sweep: a (topk,topk)
+    matrix fits VMEM for typical anchor counts and maps to the MXU, instead
+    of the reference's serialized CUDA bitonic+bitmask kernels.
+  * AdaptiveAvgPooling2D is lowered to two small matmuls (precomputed
+    row/col averaging weights), which beats gather-based pooling on TPU.
+  * foreach lowers to lax.scan (compiled loop, grad via scan's VJP);
+    while_loop/cond execute eagerly — their trip counts/predicates are
+    data-dependent by definition, which is exactly what the reference's
+    imperative path does too.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _invoke, _wrap_out
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "MultiBoxPrior",
+           "MultiBoxTarget", "MultiBoxDetection", "ROIAlign",
+           "BilinearResize2D", "AdaptiveAvgPooling2D", "foreach",
+           "while_loop", "cond", "isinf", "isnan", "isfinite",
+           "arange_like", "index_array", "index_copy"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _corner(box):
+    # (..., 4) xmin,ymin,xmax,ymax
+    return box[..., 0], box[..., 1], box[..., 2], box[..., 3]
+
+
+def _iou_corner(a, b):
+    """IoU between (..., Na, 4) and (..., Nb, 4) corner boxes → (..., Na, Nb)."""
+    jnp = _jnp()
+    ax0, ay0, ax1, ay1 = [t[..., :, None] for t in _corner(a)]
+    bx0, by0, bx1, by1 = [t[..., None, :] for t in _corner(b)]
+    iw = jnp.clip(jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0), 0, None)
+    ih = jnp.clip(jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0), 0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax1 - ax0, 0, None) * jnp.clip(ay1 - ay0, 0, None)
+    area_b = jnp.clip(bx1 - bx0, 0, None) * jnp.clip(by1 - by0, 0, None)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(box, fmt):
+    jnp = _jnp()
+    if fmt == "corner":
+        return box
+    cx, cy, w, h = _corner(box)
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: bounding_box.cc _contrib_box_iou)."""
+    fmt = format
+
+    def run(a, b):
+        return _iou_corner(_to_corner(a, fmt), _to_corner(b, fmt))
+    return _invoke(run, [lhs, rhs], name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference: bounding_box.cc
+    _contrib_box_nms).  Input (..., N, K) rows [id?, score, x0,y0,x1,y1,...];
+    suppressed rows are -1-filled, shape is preserved (fixed-size pattern).
+    """
+    def run(x):
+        import jax
+        jnp = _jnp()
+        lax = jax.lax
+        batch_shape = x.shape[:-2]
+        N, K = x.shape[-2], x.shape[-1]
+        flat = x.reshape((-1, N, K))
+
+        def one(sample):
+            score = sample[:, score_index]
+            valid = score > valid_thresh
+            if id_index >= 0 and background_id >= 0:
+                valid &= sample[:, id_index] != background_id
+            order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+            s = sample[order]
+            svalid = valid[order]
+            if topk > 0:
+                svalid &= jnp.arange(N) < topk
+            boxes = _to_corner(s[:, coord_start:coord_start + 4], in_format)
+            iou = _iou_corner(boxes, boxes)
+            if id_index >= 0 and not force_suppress:
+                same = s[:, id_index][:, None] == s[:, id_index][None, :]
+                iou = jnp.where(same, iou, 0.0)
+
+            # sequential sweep in score order: i survives unless some
+            # earlier survivor overlaps it
+            def step(kept, i):
+                over = (iou[i] > overlap_thresh) & kept
+                over = over & (jnp.arange(N) < i)
+                keep_i = svalid[i] & ~over.any()
+                kept = kept.at[i].set(keep_i)
+                return kept, keep_i
+
+            kept, _ = lax.scan(step, jnp.zeros((N,), bool), jnp.arange(N))
+            out = jnp.where(kept[:, None], s, -jnp.ones_like(s))
+            if out_format != in_format:
+                coords = out[:, coord_start:coord_start + 4]
+                conv = (_to_corner(coords, in_format) if out_format == "corner"
+                        else _from_corner(coords))
+                out = out.at[:, coord_start:coord_start + 4].set(
+                    jnp.where(kept[:, None], conv, -1.0))
+            return out
+
+        out = jax.vmap(one)(flat)
+        return out.reshape(batch_shape + (N, K))
+    return _invoke(run, [data], name="box_nms")
+
+
+def _from_corner(box):
+    jnp = _jnp()
+    x0, y0, x1, y1 = _corner(box)
+    return jnp.stack([(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0],
+                     axis=-1)
+
+
+def bipartite_matching(data, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a score matrix (reference:
+    bounding_box.cc _contrib_bipartite_matching).  Returns (row_match,
+    col_match): for each row the matched col (or -1), and inverse."""
+    def run(x):
+        import jax
+        jnp = _jnp()
+        lax = jax.lax
+        batch = x.shape[:-2]
+        R, C = x.shape[-2:]
+        flat = x.reshape((-1, R, C))
+        sign = 1.0 if is_ascend else -1.0
+        n_iter = R if topk <= 0 else min(topk, R)
+
+        def one(score):
+            s = sign * score  # minimize s
+
+            def step(carry, _):
+                s_cur, row_m, col_m = carry
+                idx = jnp.argmin(s_cur)
+                r, c = idx // C, idx % C
+                ok = (s_cur[r, c] <= sign * threshold
+                      if is_ascend else s_cur[r, c] < -threshold)
+                row_m = jnp.where(ok, row_m.at[r].set(c), row_m)
+                col_m = jnp.where(ok, col_m.at[c].set(r), col_m)
+                s_cur = jnp.where(ok, s_cur.at[r, :].set(jnp.inf), s_cur)
+                s_cur = jnp.where(ok, s_cur.at[:, c].set(jnp.inf), s_cur)
+                return (s_cur, row_m, col_m), None
+
+            init = (s, -jnp.ones((R,), jnp.float32),
+                    -jnp.ones((C,), jnp.float32))
+            (_, row_m, col_m), _ = lax.scan(step, init, None, length=n_iter)
+            return row_m, col_m
+
+        rows, cols = jax.vmap(one)(flat)
+        return rows.reshape(batch + (R,)), cols.reshape(batch + (C,))
+
+    out = _invoke(run, [data], name="bipartite_matching",
+                  differentiable=False)
+    return out
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                  offsets=(0.5, 0.5)):
+    """Anchor-box generation (reference: multibox_prior.cc).  data: (B,C,H,W)
+    → (1, H*W*(len(sizes)+len(ratios)-1), 4) corner boxes."""
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+
+    def run(x):
+        jnp = _jnp()
+        H, W = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H) + offsets[0]) * step_y
+        cx = (jnp.arange(W) + offsets[1]) * step_x
+        cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # H,W,2
+        wh = []
+        for s in sizes:
+            wh.append((s * _np.sqrt(ratios[0]), s / _np.sqrt(ratios[0])))
+        for r in ratios[1:]:
+            wh.append((sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r)))
+        wh = jnp.asarray(wh)  # A,2 (w,h)
+        A = wh.shape[0]
+        ctr = jnp.broadcast_to(cyx[:, :, None, :], (H, W, A, 2))
+        half_w = wh[None, None, :, 0] / 2
+        half_h = wh[None, None, :, 1] / 2
+        x0 = ctr[..., 1] - half_w
+        y0 = ctr[..., 0] - half_h
+        x1 = ctr[..., 1] + half_w
+        y1 = ctr[..., 0] + half_h
+        out = jnp.stack([x0, y0, x1, y1], -1).reshape(1, H * W * A, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out.astype(x.dtype)
+    return _invoke(run, [data], name="MultiBoxPrior", differentiable=False)
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign ground-truth to anchors + encode regression targets
+    (reference: multibox_target.cc).  anchor (1,N,4) corner; label
+    (B,M,5) [cls,x0,y0,x1,y1] (-1 rows pad); cls_pred (B,num_cls+1,N).
+    Returns [loc_target (B,N*4), loc_mask (B,N*4), cls_target (B,N)].
+
+    With ``negative_mining_ratio > 0``, hard-negative mining keeps the
+    ``ratio × num_pos`` highest-confidence negatives (max non-background
+    score in ``cls_pred``) below ``negative_mining_thresh`` IoU; unmined
+    negatives get ``ignore_label``."""
+    var = tuple(float(v) for v in variances)
+
+    def run(anc, lab, pred):
+        import jax
+        jnp = _jnp()
+        ancs = anc.reshape(-1, 4)                     # N,4
+        N = ancs.shape[0]
+
+        def one(lb, cf):
+            gt_valid = lb[:, 0] >= 0                  # M
+            gt_boxes = lb[:, 1:5]                     # M,4
+            iou = _iou_corner(ancs, gt_boxes)         # N,M
+            iou = jnp.where(gt_valid[None, :], iou, -1.0)
+            best_gt = jnp.argmax(iou, 1)              # N
+            best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
+            # every gt also claims its best anchor (bipartite step);
+            # invalid gts scatter to index N which mode='drop' discards,
+            # so they can't clobber a valid gt's claim
+            best_anchor = jnp.argmax(iou, 0)          # M
+            safe_idx = jnp.where(gt_valid, best_anchor, N)
+            forced = jnp.zeros((N,), bool).at[safe_idx].set(
+                True, mode="drop")
+            forced_gt = jnp.zeros((N,), jnp.int32).at[safe_idx].set(
+                jnp.arange(lb.shape[0], dtype=jnp.int32), mode="drop")
+            pos = forced | (best_iou >= overlap_threshold)
+            gt_idx = jnp.where(forced, forced_gt, best_gt)
+            matched = gt_boxes[gt_idx]                # N,4
+            # encode center-size offsets scaled by variances
+            acx, acy = (ancs[:, 0] + ancs[:, 2]) / 2, (ancs[:, 1] + ancs[:, 3]) / 2
+            aw = jnp.clip(ancs[:, 2] - ancs[:, 0], 1e-8, None)
+            ah = jnp.clip(ancs[:, 3] - ancs[:, 1], 1e-8, None)
+            gcx, gcy = (matched[:, 0] + matched[:, 2]) / 2, (matched[:, 1] + matched[:, 3]) / 2
+            gw = jnp.clip(matched[:, 2] - matched[:, 0], 1e-8, None)
+            gh = jnp.clip(matched[:, 3] - matched[:, 1], 1e-8, None)
+            tx = (gcx - acx) / aw / var[0]
+            ty = (gcy - acy) / ah / var[1]
+            tw = jnp.log(gw / aw) / var[2]
+            th = jnp.log(gh / ah) / var[3]
+            loc_t = jnp.stack([tx, ty, tw, th], 1)    # N,4
+            loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+            loc_m = jnp.where(pos[:, None],
+                              jnp.ones((N, 4)), 0.0).reshape(-1)
+            if negative_mining_ratio > 0:
+                neg_cand = ~pos & (best_iou < negative_mining_thresh)
+                hard = jnp.max(cf[1:], axis=0)        # max fg confidence
+                hard = jnp.where(neg_cand, hard, -jnp.inf)
+                k = jnp.maximum(
+                    (negative_mining_ratio
+                     * pos.sum()).astype(jnp.int32),
+                    minimum_negative_samples)
+                order = jnp.argsort(-hard)
+                rank = jnp.zeros((N,), jnp.int32).at[order].set(
+                    jnp.arange(N, dtype=jnp.int32))
+                mined = neg_cand & (rank < k)
+                cls_t = jnp.where(
+                    pos, lb[gt_idx, 0] + 1.0,
+                    jnp.where(mined, 0.0, ignore_label))
+            else:
+                cls_t = jnp.where(pos, lb[gt_idx, 0] + 1.0, 0.0)
+            return loc_t, loc_m, cls_t
+
+        loc_t, loc_m, cls_t = jax.vmap(one)(lab, pred)
+        return (loc_t.astype(anc.dtype), loc_m.astype(anc.dtype),
+                cls_t.astype(anc.dtype))
+    return _invoke(run, [anchor, label, cls_pred], name="MultiBoxTarget",
+                   differentiable=False)
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5, force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions to detections + per-class NMS (reference:
+    multibox_detection.cc).  cls_prob (B,C,N), loc_pred (B,N*4), anchor
+    (1,N,4) → (B,N,6) rows [cls_id, score, x0,y0,x1,y1], -1 = invalid."""
+    var = tuple(float(v) for v in variances)
+
+    def run(prob, loc, anc):
+        jnp = _jnp()
+        B, C, N = prob.shape
+        ancs = anc.reshape(-1, 4)
+        acx, acy = (ancs[:, 0] + ancs[:, 2]) / 2, (ancs[:, 1] + ancs[:, 3]) / 2
+        aw = ancs[:, 2] - ancs[:, 0]
+        ah = ancs[:, 3] - ancs[:, 1]
+        l = loc.reshape(B, N, 4)
+        cx = l[..., 0] * var[0] * aw + acx
+        cy = l[..., 1] * var[1] * ah + acy
+        w = jnp.exp(l[..., 2] * var[2]) * aw
+        h = jnp.exp(l[..., 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          -1)                          # B,N,4
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([prob[:, :background_id],
+                              prob[:, background_id + 1:]], 1)  # B,C-1,N
+        cls_id = jnp.argmax(fg, 1).astype(prob.dtype)           # B,N
+        score = jnp.max(fg, 1)
+        keep = score > threshold
+        det = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[..., None],
+             jnp.where(keep, score, -1.0)[..., None],
+             jnp.where(keep[..., None], boxes, -1.0)], -1)      # B,N,6
+        return det
+    det = _invoke(run, [cls_prob, loc_pred, anchor],
+                  name="MultiBoxDetection", differentiable=False)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   background_id=-1, force_suppress=force_suppress)
+
+
+def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
+             position_sensitive=False, aligned=False):
+    """ROI Align with bilinear sampling (reference: roi_align.cc).  data
+    (B,C,H,W); rois (R,5) [batch_idx,x0,y0,x1,y1] in image coords."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    if position_sensitive:
+        raise MXNetError("ROIAlign(position_sensitive=True) (PS-ROIAlign) "
+                         "is not implemented in this build")
+
+    def run(x, r):
+        import jax
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        offset = 0.5 if aligned else 0.0
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x0 = roi[1] * spatial_scale - offset
+            y0 = roi[2] * spatial_scale - offset
+            x1 = roi[3] * spatial_scale - offset
+            y1 = roi[4] * spatial_scale - offset
+            rw = jnp.maximum(x1 - x0, 1.0 if not aligned else 1e-6)
+            rh = jnp.maximum(y1 - y0, 1.0 if not aligned else 1e-6)
+            bw, bh = rw / pw, rh / ph
+            ns = sample_ratio if sample_ratio > 0 else 2
+            # sample grid: (ph*ns, pw*ns)
+            ys = y0 + (jnp.arange(ph * ns) + 0.5) * rh / (ph * ns)
+            xs = x0 + (jnp.arange(pw * ns) + 0.5) * rw / (pw * ns)
+            img = x[bidx]                              # C,H,W
+
+            def bilinear(c_img):
+                yy = jnp.clip(ys, 0, H - 1)
+                xx = jnp.clip(xs, 0, W - 1)
+                y0i = jnp.floor(yy).astype(jnp.int32)
+                x0i = jnp.floor(xx).astype(jnp.int32)
+                y1i = jnp.minimum(y0i + 1, H - 1)
+                x1i = jnp.minimum(x0i + 1, W - 1)
+                wy = (yy - y0i)[:, None]
+                wx = (xx - x0i)[None, :]
+                v00 = c_img[y0i][:, x0i]
+                v01 = c_img[y0i][:, x1i]
+                v10 = c_img[y1i][:, x0i]
+                v11 = c_img[y1i][:, x1i]
+                val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                       + v10 * wy * (1 - wx) + v11 * wy * wx)
+                return val.reshape(ph, ns, pw, ns).mean((1, 3))
+
+            return jax.vmap(bilinear)(img)             # C,ph,pw
+
+        return jax.vmap(one_roi)(r)                    # R,C,ph,pw
+    return _invoke(run, [data, rois], name="ROIAlign")
+
+
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size", align_corners=True):
+    """Bilinear resize (reference: bilinear_resize.cc)."""
+    def run(x):
+        import jax
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        h = int(height) if height else int(round(H * (scale_height or 1)))
+        w = int(width) if width else int(round(W * (scale_width or 1)))
+        if align_corners and h > 1 and w > 1:
+            ys = jnp.linspace(0, H - 1, h)
+            xs = jnp.linspace(0, W - 1, w)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, H - 1)
+            x1 = jnp.minimum(x0 + 1, W - 1)
+            wy = (ys - y0)[:, None]
+            wx = (xs - x0)[None, :]
+            v00 = x[:, :, y0][:, :, :, x0]
+            v01 = x[:, :, y0][:, :, :, x1]
+            v10 = x[:, :, y1][:, :, :, x0]
+            v11 = x[:, :, y1][:, :, :, x1]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+        return jax.image.resize(x, (B, C, h, w), method="bilinear")
+    return _invoke(run, [data], name="BilinearResize2D")
+
+
+def AdaptiveAvgPooling2D(data, output_size=1):
+    """Adaptive average pooling (reference: adaptive_avg_pooling.cc).
+
+    Lowered to two matmuls with precomputed averaging weights
+    (out = Wh · x · Wwᵀ) — MXU-friendly, no gathers."""
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def _weights(in_dim, out_dim):
+        w = _np.zeros((out_dim, in_dim), dtype=_np.float32)
+        for i in range(out_dim):
+            start = int(_np.floor(i * in_dim / out_dim))
+            end = int(_np.ceil((i + 1) * in_dim / out_dim))
+            w[i, start:end] = 1.0 / (end - start)
+        return w
+
+    def run(x):
+        jnp = _jnp()
+        H, W = x.shape[2], x.shape[3]
+        wh = jnp.asarray(_weights(H, oh), dtype=x.dtype)
+        ww = jnp.asarray(_weights(W, ow), dtype=x.dtype)
+        return jnp.einsum("oh,bchw,pw->bcop", wh, x, ww)
+    return _invoke(run, [data], name="AdaptiveAvgPooling2D")
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference: src/operator/control_flow.cc foreach/while_loop/
+# cond subgraph ops; python sugar python/mxnet/ndarray/contrib.py)
+# ---------------------------------------------------------------------------
+def foreach(body, data, init_states):
+    """Scan ``body`` over axis 0 of ``data`` (reference: contrib.foreach).
+
+    body(item, states) -> (output, new_states).  Compiled to a single
+    ``lax.scan`` — one XLA loop, differentiable, no per-step dispatch.
+    """
+    single_data = isinstance(data, NDArray)
+    data_list = [data] if single_data else list(data)
+    single_state = isinstance(init_states, NDArray)
+    states_list = [init_states] if single_state else list(init_states)
+    n_data = len(data_list)
+
+    def run(*jarrs):
+        import jax
+        d = jarrs[:n_data]
+        s = list(jarrs[n_data:])
+
+        def step(carry, xs):
+            xs_nd = [NDArray(x) for x in (xs if n_data > 1 else [xs])]
+            st_nd = [NDArray(c) for c in carry]
+            out, new_states = body(xs_nd[0] if single_data else xs_nd,
+                                   st_nd[0] if single_state else st_nd)
+            out_j = (out._data if isinstance(out, NDArray)
+                     else [o._data for o in out])
+            ns = ([new_states._data] if isinstance(new_states, NDArray)
+                  else [o._data for o in new_states])
+            return ns, out_j
+
+        final, outs = jax.lax.scan(step, list(s),
+                                   d[0] if n_data == 1 else tuple(d))
+        if isinstance(outs, (tuple, list)):
+            return tuple(outs) + tuple(final)
+        return (outs,) + tuple(final)
+
+    res = _invoke(run, data_list + states_list, name="foreach")
+    res = res if isinstance(res, list) else [res]
+    n_states = len(states_list)
+    n_out = len(res) - n_states
+    outs = res[:n_out]
+    states = res[n_out:]
+    return (outs[0] if len(outs) == 1 else outs,
+            states[0] if single_state else states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Imperative while loop (reference: contrib.while_loop).  The trip
+    count is data-dependent, so this runs eagerly — each iteration's body
+    is still jit-compiled op-by-op.  Returns (outputs_stacked, loop_vars)."""
+    single = isinstance(loop_vars, NDArray)
+    lv = [loop_vars] if single else list(loop_vars)
+    outputs = []
+    it = 0
+    while bool(cond(*lv).asnumpy()):
+        out, lv_new = func(*lv)
+        lv = [lv_new] if isinstance(lv_new, NDArray) else list(lv_new)
+        outputs.append([out] if isinstance(out, NDArray) else list(out))
+        it += 1
+        if max_iterations is not None and it >= max_iterations:
+            break
+    if outputs:
+        from . import ops as _ops
+        n_out = len(outputs[0])
+        stacked = [_ops.stack(*[o[i] for o in outputs], axis=0)
+                   for i in range(n_out)]
+    else:
+        stacked = []
+    return (stacked[0] if len(stacked) == 1 else stacked,
+            lv[0] if single else lv)
+
+
+def cond(pred, then_func, else_func):
+    """Conditional execution (reference: contrib.cond).  Predicate is a
+    value → decided eagerly; both branches stay jit-compiled."""
+    p = pred().asnumpy() if callable(pred) else pred.asnumpy()
+    return then_func() if bool(p) else else_func()
+
+
+# ---------------------------------------------------------------------------
+# misc contrib ops
+# ---------------------------------------------------------------------------
+def isinf(data):
+    return _invoke(lambda x: _jnp().isinf(x), [data], name="isinf",
+                   differentiable=False)
+
+
+def isnan(data):
+    return _invoke(lambda x: _jnp().isnan(x), [data], name="isnan",
+                   differentiable=False)
+
+
+def isfinite(data):
+    return _invoke(lambda x: _jnp().isfinite(x), [data], name="isfinite",
+                   differentiable=False)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """reference: contrib.arange_like — output matches the input's extent
+    (full shape, or 1-D of len shape[axis]); each value appears ``repeat``
+    times, so the distinct-value count is ceil(n / repeat)."""
+    def run(x):
+        jnp = _jnp()
+        n = x.shape[axis] if axis is not None else x.size
+        n_vals = -(-n // repeat)   # ceil
+        out = jnp.repeat(start + step * jnp.arange(n_vals, dtype=x.dtype),
+                         repeat)[:n]
+        if axis is None:
+            return out.reshape(x.shape)
+        return out
+    return _invoke(run, [data], name="arange_like", differentiable=False)
+
+
+def index_array(data, axes=None):
+    """reference: contrib/index_array.cc — coordinates of every element."""
+    def run(x):
+        jnp = _jnp()
+        axes_ = axes if axes is not None else range(x.ndim)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in x.shape],
+                             indexing="ij")
+        return jnp.stack([grids[a] for a in axes_], -1).astype(jnp.int32)
+    return _invoke(run, [data], name="index_array", differentiable=False)
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """reference: contrib/index_copy.cc — rows of new copied into old."""
+    def run(old, idx, new):
+        return old.at[idx].set(new)
+    return _invoke(run, [old_tensor, index_vector, new_tensor],
+                   name="index_copy")

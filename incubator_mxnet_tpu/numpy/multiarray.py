@@ -247,8 +247,6 @@ _JNP_FUNCS = [
     # polynomials / misc
     "interp", "diff", "ediff1d", "gradient", "trapezoid", "i0", "sinc",
     "real", "imag", "conj", "conjugate", "angle",
-    # special values
-    "floor_divide",
 ]
 
 _THIS = globals()
@@ -289,14 +287,15 @@ def __getattr__(name):
 # creation functions (need ctx/device handling, hence explicit)
 # ---------------------------------------------------------------------------
 def array(object, dtype=None, ctx=None, device=None):
-    """Create an np ndarray (reference: numpy/multiarray.py array)."""
-    if isinstance(object, NDArray):
-        object = object.asnumpy()
+    """Create an np ndarray (reference: numpy/multiarray.py array).
+    NDArray sources stay on device (_nd_array copies device-to-device)."""
     return _reclass(_nd_array(object, ctx=device or ctx, dtype=dtype))
 
 
 def asarray(a, dtype=None, ctx=None, device=None):
-    if isinstance(a, ndarray) and dtype is None:
+    target = device or ctx
+    if (isinstance(a, ndarray) and dtype is None
+            and (target is None or target == a.context)):
         return a
     return array(a, dtype=dtype, ctx=ctx, device=device)
 

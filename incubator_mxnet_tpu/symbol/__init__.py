@@ -11,9 +11,10 @@ from __future__ import annotations
 from .symbol import (Symbol, var, Variable, Group, load, load_json,
                      apply_op)
 from . import op_registry
+from . import contrib
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
-           "apply_op"]
+           "apply_op", "contrib"]
 
 
 def __getattr__(name: str):

@@ -1,0 +1,51 @@
+"""``mx.sym.contrib`` — symbolic faces of the contrib op corpus
+(reference: python/mxnet/symbol/contrib.py; both frontends generate from
+one registry, mirrored here by resolving through ``ndarray.contrib``).
+
+Control flow (``foreach``/``while_loop``/``cond``) is exposed eagerly only:
+the hybridize/jit path already compiles Python-driven loops through
+``lax.scan`` in the eager implementation, so a symbolic subgraph-op clone
+would be redundant — call the ``nd.contrib`` versions inside a
+HybridBlock instead.
+"""
+from __future__ import annotations
+
+from . import op_registry
+from .symbol import apply_op
+
+_EAGER_ONLY = {"foreach", "while_loop", "cond"}
+
+# multi-output contrib ops (the registry default is 1)
+_NUM_OUTPUTS = {"bipartite_matching": 2, "MultiBoxTarget": 3}
+
+
+def __getattr__(name: str):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    if name in _EAGER_ONLY:
+        raise AttributeError(
+            f"contrib.{name} is eager-only in this build: use "
+            f"mx.nd.contrib.{name} (hybridize compiles it via lax.scan)")
+    from ..ndarray import contrib as _ndc
+    fn = getattr(_ndc, name, None)
+    if fn is None or not callable(fn):
+        raise AttributeError(f"module 'symbol.contrib' has no op '{name}'")
+    opname = f"_contrib_{name}"
+    try:
+        op_registry.get(opname)
+    except Exception:
+        n_out = _NUM_OUTPUTS.get(name)
+        kw = ({"num_outputs_fn": (lambda attrs, n=n_out: n)}
+              if n_out else {})
+        op_registry.register(opname, fn=fn, **kw)
+
+    def op(*args, **kwargs):
+        return apply_op(opname, *args, **kwargs)
+    op.__name__ = name
+    globals()[name] = op
+    return op
+
+
+def __dir__():
+    from ..ndarray import contrib as _ndc
+    return sorted(n for n in _ndc.__all__ if n not in _EAGER_ONLY)

@@ -1,0 +1,224 @@
+"""contrib op tests (reference model:
+tests/python/unittest/test_contrib_operator.py, test_contrib_control_flow.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+
+C = mx.nd.contrib
+
+
+def test_box_iou():
+    a = mx.nd.array([[0, 0, 2, 2], [1, 1, 3, 3]])
+    b = mx.nd.array([[0, 0, 2, 2]])
+    iou = C.box_iou(a, b).asnumpy()
+    onp.testing.assert_allclose(iou[:, 0], [1.0, 1 / 7], rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    dets = mx.nd.array(
+        [[[0, .9, 0, 0, 2, 2], [0, .8, 0.1, 0.1, 2, 2], [1, .7, 5, 5, 6, 6]]])
+    out = C.box_nms(dets, overlap_thresh=0.5, force_suppress=True).asnumpy()
+    scores = out[0, :, 1]
+    assert (scores == -1).sum() == 1
+    assert .9 in scores and .7 in scores
+    # shape is preserved (fixed-size pattern)
+    assert out.shape == dets.shape
+
+
+def test_box_nms_per_class():
+    # same boxes, different class ids: no suppression without force
+    dets = mx.nd.array([[[0, .9, 0, 0, 2, 2], [1, .8, 0, 0, 2, 2]]])
+    out = C.box_nms(dets, overlap_thresh=0.5, id_index=0,
+                    force_suppress=False).asnumpy()
+    assert (out[0, :, 1] > 0).all()
+
+
+def test_multibox_pipeline():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = C.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 48, 4)
+    label = mx.nd.array([[[0, .1, .1, .4, .4], [-1, 0, 0, 0, 0]]])
+    cls_pred = mx.nd.zeros((1, 2, 48))
+    loc_t, loc_m, cls_t = C.MultiBoxTarget(anchors, label, cls_pred)
+    assert loc_t.shape == (1, 192) and cls_t.shape == (1, 48)
+    assert cls_t.asnumpy().max() == 1.0   # gt claims its best anchor
+    assert loc_m.asnumpy().sum() > 0
+    probs = onp.random.RandomState(0).dirichlet(
+        onp.ones(3), size=(1, 48)).transpose(0, 2, 1).astype("float32")
+    det = C.MultiBoxDetection(mx.nd.array(probs), mx.nd.zeros((1, 192)),
+                              anchors)
+    assert det.shape == (1, 48, 6)
+
+
+def test_roi_align_forward_backward():
+    data = mx.nd.array(onp.arange(64, dtype="float32").reshape(1, 1, 8, 8))
+    data.attach_grad()
+    rois = mx.nd.array([[0, 0, 0, 4, 4]])
+    with autograd.record():
+        out = C.ROIAlign(data, rois, (2, 2), 1.0)
+        s = out.sum()
+    s.backward()
+    assert out.shape == (1, 1, 2, 2)
+    assert float(data.grad.asnumpy().sum()) > 0
+
+
+def test_bilinear_resize():
+    data = mx.nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    r = C.BilinearResize2D(data, height=8, width=8)
+    assert r.shape == (1, 1, 8, 8)
+    # corners preserved with align_corners
+    assert float(r.asnumpy()[0, 0, 0, 0]) == 0.0
+    assert float(r.asnumpy()[0, 0, -1, -1]) == 15.0
+
+
+def test_adaptive_avg_pooling():
+    data = mx.nd.array(onp.arange(64, dtype="float32").reshape(1, 1, 8, 8))
+    ap = C.AdaptiveAvgPooling2D(data, output_size=(2, 2)).asnumpy()
+    want = data.asnumpy().reshape(1, 1, 2, 4, 2, 4).mean((3, 5))
+    onp.testing.assert_allclose(ap, want, rtol=1e-5)
+    # non-divisible output size
+    ap3 = C.AdaptiveAvgPooling2D(data, output_size=3)
+    assert ap3.shape == (1, 1, 3, 3)
+
+
+def test_foreach_scan_with_grad():
+    xs = mx.nd.array(onp.arange(6, dtype="float32").reshape(3, 2))
+    s0 = mx.nd.zeros((2,))
+    xs.attach_grad()
+    with autograd.record():
+        outs, final = C.foreach(lambda x, st: (x * 2 + st, x * 2 + st),
+                                xs, s0)
+        loss = outs.sum()
+    loss.backward()
+    assert outs.shape == (3, 2)
+    want_final = (onp.arange(6).reshape(3, 2) * 2).cumsum(0)[-1]
+    onp.testing.assert_allclose(final.asnumpy(), want_final, rtol=1e-5)
+    # d(sum of prefix sums)/dx_i = 2 * (n - i)
+    want_grad = 2 * onp.array([[3, 3], [2, 2], [1, 1]], dtype="float32")
+    onp.testing.assert_allclose(xs.grad.asnumpy(), want_grad, rtol=1e-5)
+
+
+def test_foreach_multiple_data_and_states():
+    xs = mx.nd.array(onp.ones((4, 2), "float32"))
+    ys = mx.nd.array(onp.full((4, 2), 2.0, "float32"))
+    s0 = [mx.nd.zeros((2,)), mx.nd.ones((2,))]
+
+    def body(inputs, states):
+        x, y = inputs
+        a, b = states
+        return [x + a, y + b], [a + x, b * 1.0]
+
+    outs, states = C.foreach(body, [xs, ys], s0)
+    assert len(outs) == 2 and len(states) == 2
+    onp.testing.assert_allclose(states[0].asnumpy(), [4, 4])
+
+
+def test_while_loop():
+    i = mx.nd.array([0.0])
+    acc = mx.nd.array([0.0])
+    outs, (i_f, acc_f) = C.while_loop(
+        lambda i, a: i < 3,
+        lambda i, a: ((i.copy(),), (i + 1, a + i)),
+        (i, acc))
+    assert float(i_f.asnumpy()[0]) == 3.0
+    assert float(acc_f.asnumpy()[0]) == 3.0   # 0+1+2
+    assert outs.shape == (3, 1)
+
+
+def test_while_loop_max_iterations():
+    i = mx.nd.array([0.0])
+    _, (i_f,) = C.while_loop(lambda i: i < 100,
+                             lambda i: ((i.copy(),), (i + 1,)),
+                             (i,), max_iterations=5)
+    assert float(i_f.asnumpy()[0]) == 5.0
+
+
+def test_cond():
+    r = C.cond(mx.nd.array([1.0]) > 0,
+               lambda: mx.nd.ones((2,)),
+               lambda: mx.nd.zeros((2,)))
+    assert r.asnumpy().sum() == 2
+    r2 = C.cond(mx.nd.array([-1.0]) > 0,
+                lambda: mx.nd.ones((2,)),
+                lambda: mx.nd.zeros((2,)))
+    assert r2.asnumpy().sum() == 0
+
+
+def test_misc_ops():
+    assert C.isnan(mx.nd.array([float("nan"), 1.0])).asnumpy().tolist() == \
+        [True, False]
+    assert C.isinf(mx.nd.array([float("inf"), 1.0])).asnumpy().tolist() == \
+        [True, False]
+    assert C.isfinite(mx.nd.array([float("inf"), 1.0])).asnumpy().tolist() \
+        == [False, True]
+    am = C.arange_like(mx.nd.zeros((2, 3)), axis=1)
+    assert am.shape == (3,)
+    ia = C.index_array(mx.nd.zeros((2, 2)))
+    assert ia.shape == (2, 2, 2)
+    ic = C.index_copy(mx.nd.zeros((4, 2)),
+                      mx.nd.array([1, 3]).astype("int32"),
+                      mx.nd.ones((2, 2)))
+    assert ic.asnumpy().sum() == 4
+
+
+def test_bipartite_matching():
+    score = mx.nd.array([[[0.9, 0.1], [0.8, 0.2]]])
+    rm, cm = C.bipartite_matching(score, threshold=0.5)
+    assert rm.shape == (1, 2)
+    # greedy: row0 takes col0 (0.9), row1 gets nothing above threshold
+    assert float(rm.asnumpy()[0, 0]) == 0.0
+    assert float(cm.asnumpy()[0, 0]) == 0.0
+
+
+def test_arange_like_repeat():
+    out = C.arange_like(mx.nd.zeros((2, 3)), repeat=2)
+    assert out.shape == (2, 3)
+    onp.testing.assert_allclose(out.asnumpy().ravel(),
+                                [0, 0, 1, 1, 2, 2])
+    out2 = C.arange_like(mx.nd.zeros((2, 3)), axis=1, repeat=2)
+    assert out2.shape == (3,)
+    onp.testing.assert_allclose(out2.asnumpy(), [0, 0, 1])
+
+
+def test_multibox_target_negative_mining():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = C.MultiBoxPrior(x, sizes=(0.5,), ratios=(1,))
+    label = mx.nd.array([[[0, .1, .1, .4, .4]]])
+    # confident predictions on the fg class → hard negatives exist
+    pred = onp.zeros((1, 3, 16), "float32")
+    pred[0, 1] = onp.linspace(0, 1, 16)
+    _, _, cls_t = C.MultiBoxTarget(anchors, label, mx.nd.array(pred),
+                                   negative_mining_ratio=2.0,
+                                   ignore_label=-1.0)
+    vals = cls_t.asnumpy()[0]
+    assert (vals == -1.0).any()          # unmined negatives ignored
+    assert (vals == 0.0).sum() <= 2 * (vals == 1.0).sum() + 1
+
+
+def test_roialign_position_sensitive_raises():
+    with pytest.raises(mx.MXNetError):
+        C.ROIAlign(mx.nd.zeros((1, 1, 4, 4)), mx.nd.zeros((1, 5)),
+                   (2, 2), 1.0, position_sensitive=True)
+
+
+def test_symbol_contrib_multi_output():
+    import incubator_mxnet_tpu.symbol as sym
+    s = sym.contrib.bipartite_matching(sym.var("a"), threshold=0.5)
+    ex = s.bind(args={"a": mx.nd.array([[[0.9, 0.1], [0.8, 0.2]]])})
+    outs = ex.forward()
+    assert len(outs) == 2
+
+
+def test_symbol_contrib_mirror():
+    import incubator_mxnet_tpu.symbol as sym
+    a = sym.var("a")
+    b = sym.var("b")
+    s = sym.contrib.box_iou(a, b)
+    ex = s.bind(args={"a": mx.nd.array([[0, 0, 2, 2]]),
+                      "b": mx.nd.array([[0, 0, 2, 2]])})
+    out = ex.forward()[0]
+    onp.testing.assert_allclose(out.asnumpy(), [[1.0]], rtol=1e-5)
+    with pytest.raises(AttributeError):
+        sym.contrib.foreach
