@@ -47,6 +47,10 @@ from . import module
 from . import module as mod
 from . import numpy as np
 from . import numpy_extension as npx
+from . import engine
+from . import profiler
+from . import runtime
+from . import contrib
 
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
@@ -54,4 +58,4 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "autograd", "random", "base", "context", "initializer", "init",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
            "parallel", "symbol", "sym", "Executor", "io", "metric",
-           "callback", "model", "module", "mod", "np", "npx"]
+           "callback", "model", "module", "mod", "np", "npx", "engine", "profiler", "runtime", "contrib"]

@@ -1,0 +1,5 @@
+"""``mx.contrib`` — experimental / auxiliary subsystems (reference:
+python/mxnet/contrib/__init__.py)."""
+from . import amp
+
+__all__ = ["amp"]

@@ -1,0 +1,154 @@
+"""``mx.contrib.amp`` — automatic mixed precision (reference:
+python/mxnet/contrib/amp/amp.py).
+
+TPU-first stance: the native low-precision type is **bfloat16** — fp32
+exponent range, so no loss scaling is required and ``amp.init()`` defaults
+to it.  ``float16`` is also supported with the reference's dynamic
+loss-scaling workflow:
+
+    amp.init()                       # bf16 by default
+    net = ...; trainer = gluon.Trainer(...)
+    amp.init_trainer(trainer)
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    trainer.step(batch)              # skips the update on overflow
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ...base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "convert_model", "lists", "LossScaler"]
+
+_state = {"initialized": False, "target_dtype": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (reference: amp.init).  target_dtype: 'bfloat16'
+    (recommended on TPU) or 'float16'."""
+    import numpy as _np
+    if isinstance(target_dtype, type) and target_dtype is _np.float16:
+        target_dtype = "float16"
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("amp.init: target_dtype must be 'bfloat16' or "
+                         "'float16'")
+    _state["initialized"] = True
+    _state["target_dtype"] = target_dtype
+
+
+def _check_initialized():
+    if not _state["initialized"]:
+        raise MXNetError("AMP is not initialized: call amp.init() first")
+
+
+def target_dtype():
+    return _state["target_dtype"]
+
+
+def init_trainer(optimizer_or_trainer):
+    """Attach a dynamic loss scaler to a Trainer (reference:
+    amp.init_trainer).  With bfloat16 the scaler idles at scale 1.0."""
+    _check_initialized()
+    from ...gluon.trainer import Trainer
+    if not isinstance(optimizer_or_trainer, Trainer):
+        raise MXNetError("amp.init_trainer expects a gluon Trainer")
+    trainer = optimizer_or_trainer
+    scaler = LossScaler(
+        init_scale=2.0 ** 16 if _state["target_dtype"] == "float16" else 1.0,
+        scale_window=2000)
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+
+    orig_update = trainer._update
+
+    def _amp_update(ignore_stale_grad=False):
+        overflow = (scaler.has_overflow(trainer._params)
+                    if _state["target_dtype"] == "float16" else False)
+        scaler.update_scale(overflow)
+        if overflow:   # skip the step, like the reference's skip-on-overflow
+            for p in trainer._params:
+                if p.grad_req != "null":
+                    p.zero_grad()
+            return
+        orig_update(ignore_stale_grad)
+
+    trainer._update = _amp_update
+    return trainer
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss up and the gradient rescale down (reference:
+    amp.scale_loss).  Use as ``with amp.scale_loss(loss, t) as l: l.backward()``."""
+    _check_initialized()
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        init_trainer(trainer)
+        scaler = trainer._amp_loss_scaler
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    from ... import autograd as _ag
+
+    def _scaled():
+        if isinstance(loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in loss]
+        return loss * scaler.loss_scale
+
+    if _ag.is_recording():
+        yield _scaled()
+    else:
+        # reference usage keeps scale_loss inside record(); support the
+        # outside-record spelling by extending the tape here
+        with _ag.record():
+            yield _scaled()
+
+
+def unscale(optimizer_or_trainer):
+    """Divide gradients by the current loss scale in place (reference:
+    amp.unscale)."""
+    _check_initialized()
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    for p in optimizer_or_trainer._params:
+        if p.grad_req != "null" and p.grad() is not None:
+            g = p.grad()
+            g._set_data(g._data / scaler.loss_scale)
+
+
+def convert_hybrid_block(block, target_dtype=None):
+    """Cast a (Hybrid)Block's parameters to the AMP dtype, keeping
+    normalization layers in fp32 (reference: amp.convert_hybrid_block,
+    which rewrites the symbol with amp_cast nodes; here the array IS the
+    graph input so casting params is the whole rewrite — XLA handles the
+    mixed-dtype promotion in the fused program)."""
+    _check_initialized()
+    import numpy as _np
+    from ...gluon import nn as gnn
+    dtype = target_dtype or _state["target_dtype"]
+    if dtype == "bfloat16":
+        import ml_dtypes
+        dtype = ml_dtypes.bfloat16   # numpy proper has no 'bfloat16' name
+    fp32_types = tuple(getattr(gnn, name) for name in
+                       lists.FP32_PARAM_LAYERS if hasattr(gnn, name))
+
+    def _cast(b):
+        if isinstance(b, fp32_types):
+            return
+        for child in b._children.values():
+            _cast(child)
+        for p in b.params.values():
+            if p._data is not None and _np.dtype(p.dtype).kind == "f":
+                p.cast(dtype)
+
+    _cast(block)
+    return block
+
+
+convert_model = convert_hybrid_block

@@ -1,0 +1,31 @@
+"""AMP op classification lists (reference:
+python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+On TPU the low-precision type is bfloat16: same exponent range as fp32, so
+the reference's fp16 overflow machinery (loss scaling) is unnecessary for
+bf16 — but the op classification still decides where low precision is
+numerically safe vs where fp32 accumulate/compute must be kept.
+"""
+
+# Ops whose math is dominated by MXU matmul/conv — run in low precision
+LOW_PRECISION_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "matmul", "RNN", "linalg_gemm2",
+]
+
+# Numerically sensitive — keep fp32 compute (reference FP32_FUNCS)
+FP32_OPS = [
+    "softmax", "log_softmax", "softmax_cross_entropy", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "l2_normalization",
+    "norm", "mean", "sum", "exp", "log", "log2", "log10", "log1p", "expm1",
+    "power", "cumsum", "erf", "erfinv", "gamma", "smooth_l1",
+]
+
+# Run in the widest input dtype (reference WIDEST_TYPE_CASTS)
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "where", "concat", "stack", "add_n",
+]
+
+# Layer classes whose *parameters* stay fp32 under convert_hybrid_block
+FP32_PARAM_LAYERS = ["BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm"]

@@ -1,0 +1,41 @@
+"""Dynamic loss scaler (reference:
+python/mxnet/contrib/amp/loss_scaler.py).
+
+Needed for float16 training; bfloat16 shares fp32's exponent range so it
+trains unscaled — the scaler then stays at 1.0 and never skips.
+"""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference:
+        LossScaler.has_overflow via multi_all_finite)."""
+        import jax.numpy as jnp
+        for p in params:
+            if p.grad_req == "null" or p.grad() is None:
+                continue
+            if not bool(jnp.isfinite(p.grad()._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        """Halve on overflow; double every scale_window clean steps
+        (reference: LossScaler.update_scale)."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

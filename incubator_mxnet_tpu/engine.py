@@ -1,0 +1,74 @@
+"""``mx.engine`` — execution-engine control shims (reference:
+python/mxnet/engine.py; src/engine/*).
+
+The reference's dependency engine does not exist here: jax's async dispatch
+plus XLA scheduling subsume it (SURVEY.md §7.0).  What remains meaningful:
+
+* ``MXNET_ENGINE_TYPE=NaiveEngine`` — the reference's synchronous debugging
+  oracle (reference: src/engine/naive_engine.cc).  Here it forces every
+  eager op to block until computed, which serializes execution exactly the
+  same way; async-vs-sync bug bisection works identically.
+* ``bulk`` — the reference batches engine pushes
+  (MXNET_EXEC_BULK_EXEC_*); XLA fuses compiled programs already, so the
+  scope is kept for API compatibility and tracks its size setting only.
+"""
+from __future__ import annotations
+
+from .base import getenv
+
+__all__ = ["bulk", "set_bulk_size", "get_bulk_size", "set_engine_type",
+           "get_engine_type"]
+
+_bulk_size = 15
+_engine_type = "ThreadedEnginePerDevice"
+
+
+def _nd_mod():
+    from .ndarray import ndarray as nd_mod
+    return nd_mod
+
+
+def set_engine_type(name: str) -> str:
+    """'NaiveEngine' → synchronous dispatch; anything else → async
+    (the default).  Returns the previous engine name."""
+    global _engine_type
+    prev = _engine_type
+    _engine_type = name
+    _nd_mod()._sync_dispatch = (name == "NaiveEngine")
+    return prev
+
+
+def get_engine_type() -> str:
+    return _engine_type
+
+
+def set_bulk_size(size: int) -> int:
+    """reference: mx.engine.set_bulk_size — returns previous value."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+def get_bulk_size() -> int:
+    return _bulk_size
+
+
+class bulk:
+    """Scope marking a bulked segment (reference: mx.engine.bulk).  XLA
+    fuses compiled regions regardless; the scope only tracks the size."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)
+
+
+_env_engine = getenv("MXNET_ENGINE_TYPE")
+if _env_engine:
+    set_engine_type(_env_engine)

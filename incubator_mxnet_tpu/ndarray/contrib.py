@@ -24,7 +24,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ..base import MXNetError
-from .ndarray import NDArray, _invoke, _wrap_out
+from .ndarray import NDArray, _invoke
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "MultiBoxPrior",
            "MultiBoxTarget", "MultiBoxDetection", "ROIAlign",
@@ -362,7 +362,6 @@ def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
             y1 = roi[4] * spatial_scale - offset
             rw = jnp.maximum(x1 - x0, 1.0 if not aligned else 1e-6)
             rh = jnp.maximum(y1 - y0, 1.0 if not aligned else 1e-6)
-            bw, bh = rw / pw, rh / ph
             ns = sample_ratio if sample_ratio > 0 else 2
             # sample grid: (ph*ns, pw*ns)
             ys = y0 + (jnp.arange(ph * ns) + 0.5) * rh / (ph * ns)
@@ -394,7 +393,14 @@ def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
 
 def BilinearResize2D(data, height=None, width=None, scale_height=None,
                      scale_width=None, mode="size", align_corners=True):
-    """Bilinear resize (reference: bilinear_resize.cc)."""
+    """Bilinear resize (reference: bilinear_resize.cc).  Only
+    ``mode='size'`` (explicit height/width or scale factors) is
+    implemented; the parity modes ('odd_scale', 'like', 'to_even_*',
+    'to_odd_*') raise rather than silently mis-resize."""
+    if mode != "size":
+        raise MXNetError(f"BilinearResize2D: mode={mode!r} is not "
+                         "implemented in this build (only 'size')")
+
     def run(x):
         import jax
         jnp = _jnp()

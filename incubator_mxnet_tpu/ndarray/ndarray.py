@@ -539,8 +539,40 @@ def _expand_reshape(old: Sequence[int], new: Sequence[int]):
 # _invoke: the op funnel (analog of Imperative::Invoke,
 # reference: src/imperative/imperative.cc + imperative_utils.h PushFCompute)
 # ---------------------------------------------------------------------------
+# Dispatch instrumentation (reference analogs: profiler hooks bracket
+# ThreadedEngine::ExecuteOprBlock, src/profiler/profiler.h; and
+# MXNET_ENGINE_TYPE=NaiveEngine forces synchronous execution as the
+# debugging oracle, src/engine/naive_engine.cc).  When either is active
+# every op blocks until computed so measured time = true op time.
+_op_observer = None       # set by mx.profiler: callback(op_name, seconds)
+_sync_dispatch = False    # set by mx.engine for NaiveEngine parity
+
+
 def _invoke(fun: Callable, inputs: Sequence[NDArray], *,
             name: str = "op", differentiable: bool = True):
+    if _op_observer is None and not _sync_dispatch:
+        return _invoke_async(fun, inputs, name=name,
+                             differentiable=differentiable)
+    import time as _time
+    t0 = _time.perf_counter()
+    out = _invoke_async(fun, inputs, name=name,
+                        differentiable=differentiable)
+    outs = out if isinstance(out, list) else [out]
+    # inside a jit trace the outputs are Tracers: blocking is impossible
+    # and per-op timing meaningless — the compiled program is profiled as
+    # one unit (XLA trace), so skip instrumentation there
+    import jax
+    if any(isinstance(o._data, jax.core.Tracer) for o in outs):
+        return out
+    for o in outs:
+        o.wait_to_read()
+    if _op_observer is not None:
+        _op_observer(name, _time.perf_counter() - t0)
+    return out
+
+
+def _invoke_async(fun: Callable, inputs: Sequence[NDArray], *,
+                  name: str = "op", differentiable: bool = True):
     """Run ``fun(*jax_arrays) -> jax_array | tuple`` eagerly, recording on the
     autograd tape when needed.  Returns NDArray or list of NDArrays (list iff
     ``fun`` returns a tuple/list)."""

@@ -1,0 +1,222 @@
+"""``mx.profiler`` — profiling API (reference: python/mxnet/profiler.py;
+native side src/profiler/profiler.{h,cc}, aggregate_stats.cc).
+
+Two complementary planes, mirroring the reference's design:
+
+* **Op-level table + chrome://tracing JSON** — while running, every eager op
+  dispatch is bracketed (the analog of ``ProfileOperator`` wrapping
+  ``ThreadedEngine::ExecuteOprBlock``); ops run synchronously during
+  profiling so durations are true compute times.  ``dump()`` writes
+  chrome-trace JSON (the reference's output format); ``dumps()`` returns
+  the min/max/avg aggregate table (reference: aggregate_stats.cc).
+* **XLA trace** — ``set_config(xla_trace_dir=...)`` additionally records a
+  jax.profiler trace (TensorBoard/Perfetto), the TPU-native superset of
+  the reference's NVTX/VTune emitters.
+
+Env autostart: ``MXNET_PROFILER_AUTOSTART=1`` (reference parity).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .base import MXNetError, getenv_bool
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Task", "Frame", "Counter", "Marker", "scope"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+    "xla_trace_dir": None,
+}
+_state = "stop"
+_paused = False
+_events = []          # (name, t_start_us, dur_us)
+_t0 = None
+_xla_tracing = False
+
+
+def set_config(**kwargs):
+    """reference: mx.profiler.set_config."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError(f"profiler.set_config: unknown options {unknown}")
+    _config.update(kwargs)
+
+
+def _observer(name, seconds):
+    if _paused:
+        return
+    now = time.perf_counter()
+    with _lock:
+        _events.append((name, (now - seconds - _t0) * 1e6, seconds * 1e6))
+
+
+def set_state(state="stop"):
+    """'run' starts op bracketing (+XLA trace if configured); 'stop' ends
+    it (reference: mx.profiler.set_state)."""
+    global _state, _t0, _xla_tracing
+    from .ndarray import ndarray as nd_mod
+    if state == "run":
+        _state = "run"
+        _t0 = time.perf_counter()
+        nd_mod._op_observer = _observer
+        if _config["xla_trace_dir"] and not _xla_tracing:
+            import jax
+            jax.profiler.start_trace(_config["xla_trace_dir"])
+            _xla_tracing = True
+    elif state == "stop":
+        _state = "stop"
+        nd_mod._op_observer = None
+        if _xla_tracing:
+            import jax
+            jax.profiler.stop_trace()
+            _xla_tracing = False
+    else:
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+
+
+def state():
+    return _state
+
+
+def pause(profile_process="worker"):
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to the configured filename
+    (reference: MXDumpProfile → chrome trace)."""
+    with _lock:
+        events = list(_events)
+    trace = {
+        "traceEvents": [
+            {"name": n, "ph": "X", "ts": ts, "dur": dur,
+             "pid": 0, "tid": 0, "cat": "operator"}
+            for n, ts, dur in events
+        ],
+        "displayTimeUnit": "ms",
+    }
+    with open(_config["filename"], "w") as f:
+        json.dump(trace, f)
+
+
+def dumps(reset=False):
+    """Aggregate per-op stats table (reference: aggregate_stats.cc
+    DumpTable): name, calls, total/min/max/avg ms."""
+    global _events
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events = []
+    agg = {}
+    for name, _ts, dur in events:
+        rec = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        rec[0] += 1
+        rec[1] += dur
+        rec[2] = min(rec[2], dur)
+        rec[3] = max(rec[3], dur)
+    lines = [f"{'Name':<28}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+             f"{'Max(ms)':>10}{'Avg(ms)':>10}"]
+    for name, (calls, total, mn, mx) in sorted(
+            agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<28}{calls:>8}{total/1e3:>12.3f}"
+                     f"{mn/1e3:>10.3f}{mx/1e3:>10.3f}"
+                     f"{total/calls/1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+class _Named:
+    def __init__(self, name):
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            return
+        dur = time.perf_counter() - self._start
+        if _state == "run":
+            _observer(f"{type(self).__name__}:{self.name}", dur)
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Named):
+    """reference: MXProfileCreateTask."""
+
+
+class Frame(_Named):
+    """reference: MXProfileCreateFrame."""
+
+
+class Marker:
+    """Instant event (reference: MXProfileSetMarker)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _state == "run":
+            _observer(f"Marker:{self.name}", 0.0)
+
+
+class Counter:
+    """reference: MXProfileCreateCounter."""
+
+    def __init__(self, name, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class scope:
+    """Name scope for profiling (reference: profiler_scope attr →
+    jax.named_scope, so compiled-graph ops carry the name in XLA traces)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        import jax
+        self._cm = jax.named_scope(self.name)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._cm.__exit__(*exc)
+
+
+if getenv_bool("MXNET_PROFILER_AUTOSTART", False):
+    set_state("run")

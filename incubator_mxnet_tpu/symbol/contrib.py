@@ -27,9 +27,9 @@ def __getattr__(name: str):
             f"contrib.{name} is eager-only in this build: use "
             f"mx.nd.contrib.{name} (hybridize compiles it via lax.scan)")
     from ..ndarray import contrib as _ndc
-    fn = getattr(_ndc, name, None)
-    if fn is None or not callable(fn):
+    if name not in _ndc.__all__:
         raise AttributeError(f"module 'symbol.contrib' has no op '{name}'")
+    fn = getattr(_ndc, name)
     opname = f"_contrib_{name}"
     try:
         op_registry.get(opname)

@@ -1,0 +1,154 @@
+"""Profiler / engine / runtime / AMP tests (reference models:
+tests/python/unittest/test_profiler.py, test_engine.py, test_amp.py,
+test_runtime.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.contrib import amp
+
+
+# ---------------------------------------------------------------- profiler
+def test_profiler_op_table_and_trace(tmp_path):
+    f = str(tmp_path / "profile.json")
+    mx.profiler.set_config(filename=f)
+    mx.profiler.set_state("run")
+    a = mx.nd.ones((32, 32))
+    for _ in range(3):
+        a = mx.nd.dot(a, a) * 0.5
+    a.wait_to_read()
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps(reset=False)
+    assert "dot" in table and "Calls" in table
+    mx.profiler.dump()
+    import json
+    trace = json.load(open(f))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "dot" in names
+    mx.profiler.dumps(reset=True)
+
+
+def test_profiler_pause_resume():
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    mx.nd.ones((4,)).wait_to_read()
+    mx.profiler.resume()
+    mx.profiler.set_state("stop")
+    mx.profiler.dumps(reset=True)
+
+
+def test_profiler_task_counter():
+    mx.profiler.set_state("run")
+    with mx.profiler.Task("mytask"):
+        mx.nd.ones((4,)).wait_to_read()
+    c = mx.profiler.Counter("n", 1)
+    c.increment(2)
+    assert c.value == 3
+    mx.profiler.set_state("stop")
+    assert "Task:mytask" in mx.profiler.dumps(reset=True)
+
+
+def test_profiler_bad_state():
+    with pytest.raises(mx.MXNetError):
+        mx.profiler.set_state("bogus")
+
+
+# ------------------------------------------------------------------ engine
+def test_engine_naive_sync_mode():
+    prev = mx.engine.set_engine_type("NaiveEngine")
+    try:
+        x = mx.nd.ones((8, 8))
+        y = (x * 2 + 1).sum()
+        assert float(y.asnumpy()) == 8 * 8 * 3
+    finally:
+        mx.engine.set_engine_type(prev)
+    assert mx.engine.get_engine_type() == prev
+
+
+def test_engine_bulk_scope():
+    assert mx.engine.set_bulk_size(10) >= 0
+    with mx.engine.bulk(32):
+        assert mx.engine.get_bulk_size() == 32
+        x = mx.nd.ones((4,)) + 1
+    assert mx.engine.get_bulk_size() == 10
+
+
+# ----------------------------------------------------------------- runtime
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert "DIST_KVSTORE" in feats
+    assert isinstance(mx.runtime.feature_list(), list)
+
+
+# --------------------------------------------------------------------- amp
+def test_amp_requires_init():
+    amp._state["initialized"] = False
+    with pytest.raises(mx.MXNetError):
+        amp.scale_loss(mx.nd.ones((1,)), None).__enter__()
+
+
+def test_amp_bf16_workflow():
+    amp.init()   # bfloat16 default
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = mx.nd.ones((2, 8))
+    with autograd.record():
+        loss = net(x).sum()
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    before = net.weight.data().asnumpy().copy()
+    trainer.step(2)
+    after = net.weight.data().asnumpy()
+    assert not onp.allclose(before, after)
+
+
+def test_amp_fp16_overflow_skips_step():
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    x = mx.nd.ones((1, 4))
+    with autograd.record():
+        loss = (net(x) * float("inf")).sum()   # force non-finite grads
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    before = net.weight.data().asnumpy().copy()
+    s0 = scaler.loss_scale
+    trainer.step(1)
+    after = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(before, after)      # step skipped
+    assert scaler.loss_scale < s0                   # scale halved
+
+
+def test_amp_convert_hybrid_block():
+    amp.init()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4))
+    net.add(gluon.nn.BatchNorm(in_channels=8))
+    net.add(gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    net(mx.nd.ones((2, 4)))
+    amp.convert_hybrid_block(net)
+    import ml_dtypes
+    dts = {p.name.split("_")[-1]: onp.dtype(p.dtype)
+           for p in net.collect_params().values()}
+    dense_p = [p for p in net.collect_params().values()
+               if "dense" in p.name]
+    bn_p = [p for p in net.collect_params().values()
+            if "batchnorm" in p.name]
+    assert all(onp.dtype(p.dtype) == onp.dtype(ml_dtypes.bfloat16)
+               for p in dense_p)
+    assert all(onp.dtype(p.dtype) == onp.float32 for p in bn_p)
+    out = net(mx.nd.ones((2, 4)))
+    assert out.shape == (2, 2)
