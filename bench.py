@@ -1,17 +1,19 @@
 #!/usr/bin/env python
-"""Benchmark: BERT pretraining samples/sec on the attached chip.
+"""Benchmark: BERT-large pretraining samples/sec/chip + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} and
+ALWAYS exits 0 — backend failures degrade to a CPU-smoke record instead of
+an empty artifact.
 
-The judged metric (BASELINE.md) is BERT pretraining samples/sec/chip.  The
-baseline anchor: published GluonNLP BERT-large phase-1 throughput ~O(100)
-seq/sec on 8x V100 => ~12.5 samples/sec per device; vs_baseline is our
-per-chip rate over that anchor.  Config scales down on small/virtual
-devices so the bench completes quickly; the model/step structure (full
-fwd+bwd+Adam in one compiled program) is the real path.
+Judged metric (BASELINE.md): BERT pretraining samples/sec/chip, north star
+>= 35% MFU.  Anchor: published GluonNLP BERT-large phase-1 throughput
+~O(100) seq/sec on 8x V100 => 12.5 samples/sec/chip; vs_baseline is our
+per-chip rate over that anchor.  On the accelerator we measure the REAL
+anchor config (BERT-large, seq 128, bf16 compute); the CPU fallback runs a
+tiny config purely to prove the path and is labeled as such.
 """
 import json
-import os
+import subprocess
 import sys
 import time
 
@@ -19,25 +21,88 @@ import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 12.5
 
+# bf16 peak FLOP/s per chip by device kind (public TPU specs).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(kind):
+    """Match a JAX device_kind string (e.g. 'TPU v5 lite', 'TPU v5p') to a
+    peak-FLOPs entry; longest key wins so 'v5 lite' beats 'v5'."""
+    k = (kind or "").lower().replace("tpu", "").strip()
+    best = None
+    for key, val in PEAK_FLOPS.items():
+        if key in k and (best is None or len(key) > len(best[0])):
+            best = (key, val)
+    return best[1] if best else 197e12  # unknown TPU kind: v5e-class
+
+
+def _probe_backend(timeout=90):
+    """Probe the default (axon TPU tunnel) backend in a SUBPROCESS so a
+    hung PJRT init cannot take the bench down with it (round-1 failure
+    mode: rc=1/rc=124 and no JSON emitted)."""
+    code = ("import jax; d=jax.devices()[0]; "
+            "print(d.platform, '|', getattr(d,'device_kind',''))")
+    for _ in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout)
+            if out.returncode == 0 and out.stdout.strip():
+                platform, _, kind = out.stdout.strip().partition("|")
+                return platform.strip(), kind.strip()
+        except subprocess.TimeoutExpired:
+            pass
+    return None, None
+
+
+def _model_flops_per_step(cfg, batch, seqlen):
+    """Training FLOPs per step: 6*N*tokens for the param matmuls
+    (fwd 2N + bwd 4N per token) + 12*L*T^2*d per sequence for attention
+    scores/context (fwd 4*T^2*d, x3 for bwd), + the vocab projection."""
+    d, L, ffn, V = (cfg["units"], cfg["num_layers"], cfg["hidden_size"],
+                    cfg["vocab_size"])
+    n_block = L * (4 * d * d + 2 * d * ffn)   # qkv+out proj + 2 ffn mats
+    tokens = batch * seqlen
+    matmul = 6.0 * n_block * tokens
+    attn = 12.0 * L * seqlen * seqlen * d * batch
+    head = 6.0 * d * V * tokens               # tied-embedding MLM decoder
+    return matmul + attn + head
+
 
 def main():
+    platform, kind = _probe_backend()
+    on_accel = platform not in (None, "cpu")
+
     import jax
+    if not on_accel:
+        # never touch the broken/hung backend again in-process
+        jax.config.update("jax_platforms", "cpu")
+
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
     from incubator_mxnet_tpu.models import bert as bert_mod
 
     dev = jax.devices()[0]
-    on_accel = dev.platform != "cpu"
-    # sized for one v5e chip; tiny on CPU so CI stays fast
     if on_accel:
-        cfg = dict(vocab_size=30522, units=768, hidden_size=3072,
-                   num_layers=12, num_heads=12, max_length=512)
-        B, T = 8, 128
+        # the anchor config itself: BERT-large, phase-1 seq length
+        cfg = dict(vocab_size=30522, units=1024, hidden_size=4096,
+                   num_layers=24, num_heads=16, max_length=512)
+        T = 128
+        batch_ladder = [32, 16, 8]
         steps, warmup = 20, 3
     else:
         cfg = dict(vocab_size=1024, units=128, hidden_size=256,
                    num_layers=2, num_heads=2, max_length=128)
-        B, T = 4, 64
+        T = 64
+        batch_ladder = [4]
         steps, warmup = 5, 2
 
     mx.random.seed(0)
@@ -45,46 +110,74 @@ def main():
         bert_mod.BERTModel(dropout=0.0, **cfg),
         vocab_size=cfg["vocab_size"])
     net.initialize(init=mx.init.Normal(0.02))
+    if on_accel:
+        net.cast("bfloat16")  # bf16 compute — the MXU-native dtype
 
     V = cfg["vocab_size"]
     rng = np.random.default_rng(0)
-    ids = mx.nd.array(rng.integers(0, V, (B, T)), dtype=np.int32)
-    types = mx.nd.array(np.zeros((B, T)), dtype=np.int32)
-    with mx.autograd.pause():
-        net(ids, types)  # settle deferred shapes
-
     mesh = parallel.make_mesh({"data": 1}, devices=[dev])
 
-    trainer = parallel.SPMDTrainer(
-        bert_mod.BERTMLMOnly(net), bert_mod.MLMPretrainLoss(V), "adam",
-        {"learning_rate": 1e-4}, mesh=mesh, data_axis="data")
+    def _attempt(B):
+        """One measured run at batch size B.  Lives in its own frame so
+        an OOM unwinds and releases the trainer/opt-state/arrays before
+        the ladder retries at a smaller B."""
+        ids = mx.nd.array(rng.integers(0, V, (B, T)), dtype=np.int32)
+        types = mx.nd.array(np.zeros((B, T)), dtype=np.int32)
+        with mx.autograd.pause():
+            net(ids, types)  # settle deferred shapes
+        trainer = parallel.SPMDTrainer(
+            bert_mod.BERTMLMOnly(net), bert_mod.MLMPretrainLoss(V),
+            "adam", {"learning_rate": 1e-4}, mesh=mesh, data_axis="data")
+        x_ids = rng.integers(0, V, (B, T)).astype(np.int32)
+        x_types = np.zeros((B, T), np.int32)
+        labels = rng.integers(0, V, (B, T)).astype(np.float32)
+        for _ in range(warmup):
+            loss = trainer.step(x_ids, x_types, labels)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step(x_ids, x_types, labels)
+        jax.block_until_ready(loss)
+        return steps * B / (time.perf_counter() - t0)
 
-    x_ids = rng.integers(0, V, (B, T)).astype(np.int32)
-    x_types = np.zeros((B, T), np.int32)
-    labels = rng.integers(0, V, (B, T)).astype(np.float32)
+    samples_per_sec, B_used = None, None
+    for B in batch_ladder:
+        try:
+            samples_per_sec, B_used = _attempt(B), B
+            break
+        except Exception as e:  # OOM on this batch size -> step down
+            if "RESOURCE_EXHAUSTED" not in str(e) or B == batch_ladder[-1]:
+                raise
+            import gc
+            gc.collect()
+    assert samples_per_sec is not None  # loop breaks or re-raises
 
-    for _ in range(warmup):
-        loss = trainer.step(x_ids, x_types, labels)
-    jax.block_until_ready(loss)
+    flops = _model_flops_per_step(cfg, B_used, T)
+    peak = _peak_flops(kind) if on_accel else None
+    mfu = (samples_per_sec / B_used) * flops / peak if peak else None
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(x_ids, x_types, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = steps * B / dt
     out = {
-        "metric": ("bert_base_pretrain_samples_per_sec_per_chip"
+        "metric": ("bert_large_pretrain_samples_per_sec_per_chip"
                    if on_accel else
                    "bert_tiny_cpu_smoke_samples_per_sec"),
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
         "vs_baseline": round(
             samples_per_sec / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch_size": B_used,
+        "seq_len": T,
+        "device": f"{platform or 'cpu'}:{kind or ''}",
+        "dtype": "bfloat16" if on_accel else "float32",
     }
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # degrade, never lose the artifact
+        print(json.dumps({
+            "metric": "bench_degraded", "value": 0.0, "unit": "samples/s",
+            "vs_baseline": 0.0, "error": str(e)[:300]}))
+        sys.exit(0)

@@ -63,12 +63,19 @@ def init_trainer(optimizer_or_trainer):
     scaler = LossScaler(
         init_scale=2.0 ** 16 if _state["target_dtype"] == "float16" else 1.0,
         scale_window=2000)
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        # idempotent: swap the scaler; the existing _update wrapper reads
+        # it through the attribute, so re-wrapping would double-advance
+        # the scale window
+        trainer._amp_loss_scaler = scaler
+        return trainer
     trainer._amp_loss_scaler = scaler
     trainer._amp_original_scale = trainer._scale
 
     orig_update = trainer._update
 
     def _amp_update(ignore_stale_grad=False):
+        scaler = trainer._amp_loss_scaler
         overflow = (scaler.has_overflow(trainer._params)
                     if _state["target_dtype"] == "float16" else False)
         scaler.update_scale(overflow)
@@ -111,15 +118,20 @@ def scale_loss(loss, trainer):
 
 def unscale(optimizer_or_trainer):
     """Divide gradients by the current loss scale in place (reference:
-    amp.unscale)."""
+    amp.unscale).  Also restores the trainer's rescale factor so the
+    subsequent ``step()``/``update()`` does not divide by the loss scale a
+    second time (the reference resets the trainer scale the same way)."""
     _check_initialized()
-    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    trainer = optimizer_or_trainer
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None or scaler.loss_scale == 1.0:
         return
-    for p in optimizer_or_trainer._params:
+    for p in trainer._params:
         if p.grad_req != "null" and p.grad() is not None:
             g = p.grad()
             g._set_data(g._data / scaler.loss_scale)
+    if getattr(trainer, "_amp_original_scale", None) is not None:
+        trainer._scale = trainer._amp_original_scale
 
 
 def convert_hybrid_block(block, target_dtype=None):

@@ -243,15 +243,32 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
             iou = jnp.where(gt_valid[None, :], iou, -1.0)
             best_gt = jnp.argmax(iou, 1)              # N
             best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
-            # every gt also claims its best anchor (bipartite step);
-            # invalid gts scatter to index N which mode='drop' discards,
-            # so they can't clobber a valid gt's claim
-            best_anchor = jnp.argmax(iou, 0)          # M
-            safe_idx = jnp.where(gt_valid, best_anchor, N)
-            forced = jnp.zeros((N,), bool).at[safe_idx].set(
-                True, mode="drop")
-            forced_gt = jnp.zeros((N,), jnp.int32).at[safe_idx].set(
-                jnp.arange(lb.shape[0], dtype=jnp.int32), mode="drop")
+            # forced gt->anchor assignment via iterative greedy bipartite
+            # matching: each round claims the globally-best (anchor, gt)
+            # pair among still-unmatched rows/cols, so two gts sharing a
+            # best anchor get distinct anchors (the loser takes its
+            # next-best) instead of overwriting each other
+            M = lb.shape[0]
+
+            def bip_round(carry, _):
+                forced_gt, forced, gt_done = carry
+                masked = jnp.where(
+                    forced[:, None] | gt_done[None, :]
+                    | ~gt_valid[None, :], -1.0, iou)
+                flat = jnp.argmax(masked)
+                a_i, g_i = flat // M, flat % M
+                ok = masked.reshape(-1)[flat] > 0
+                forced = forced.at[a_i].set(ok | forced[a_i])
+                gt_done = gt_done.at[g_i].set(ok | gt_done[g_i])
+                forced_gt = forced_gt.at[a_i].set(
+                    jnp.where(ok, g_i.astype(jnp.int32), forced_gt[a_i]))
+                return (forced_gt, forced, gt_done), None
+
+            (forced_gt, forced, _), _ = jax.lax.scan(
+                bip_round,
+                (jnp.zeros((N,), jnp.int32), jnp.zeros((N,), bool),
+                 jnp.zeros((M,), bool)),
+                None, length=M)
             pos = forced | (best_iou >= overlap_threshold)
             gt_idx = jnp.where(forced, forced_gt, best_gt)
             matched = gt_boxes[gt_idx]                # N,4
@@ -502,23 +519,45 @@ def foreach(body, data, init_states):
 def while_loop(cond, func, loop_vars, max_iterations=None):
     """Imperative while loop (reference: contrib.while_loop).  The trip
     count is data-dependent, so this runs eagerly — each iteration's body
-    is still jit-compiled op-by-op.  Returns (outputs_stacked, loop_vars)."""
+    is still jit-compiled op-by-op.  Returns (outputs_stacked, loop_vars).
+
+    Reference contract kept: ``max_iterations`` is required (ValueError
+    otherwise) and stacked outputs have leading dimension
+    ``max_iterations`` — steps beyond the actual trip count are
+    zero-padded — so code ported from the reference sees identical
+    shapes.  One documented deviation: if the condition is false on
+    entry, the body never ran, eager mode cannot know the output shapes,
+    and ``outputs`` is an empty list (the reference's symbolic op reads
+    shapes from the graph; running the body speculatively to discover
+    them would execute user side effects a zero-trip loop must not
+    have)."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations "
+                         "(reference: contrib.while_loop)")
+    max_iterations = int(max_iterations)
+    if max_iterations < 0:
+        raise ValueError("max_iterations must be non-negative")
     single = isinstance(loop_vars, NDArray)
     lv = [loop_vars] if single else list(loop_vars)
     outputs = []
     it = 0
-    while bool(cond(*lv).asnumpy()):
+    while it < max_iterations and bool(cond(*lv).asnumpy()):
         out, lv_new = func(*lv)
         lv = [lv_new] if isinstance(lv_new, NDArray) else list(lv_new)
         outputs.append([out] if isinstance(out, NDArray) else list(out))
         it += 1
-        if max_iterations is not None and it >= max_iterations:
-            break
+    from . import ops as _ops
+    from .ndarray import zeros as _zeros
     if outputs:
-        from . import ops as _ops
         n_out = len(outputs[0])
-        stacked = [_ops.stack(*[o[i] for o in outputs], axis=0)
-                   for i in range(n_out)]
+        stacked = []
+        for i in range(n_out):
+            s = _ops.stack(*[o[i] for o in outputs], axis=0)
+            if it < max_iterations:  # pad to max_iterations like reference
+                pad = _zeros((max_iterations - it,) + s.shape[1:],
+                             dtype=s.dtype)
+                s = _ops.concat(s, pad, dim=0)
+            stacked.append(s)
     else:
         stacked = []
     return (stacked[0] if len(stacked) == 1 else stacked,

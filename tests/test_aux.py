@@ -131,6 +131,53 @@ def test_amp_fp16_overflow_skips_step():
     assert scaler.loss_scale < s0                   # scale halved
 
 
+def test_amp_unscale_then_step_applies_grads_once():
+    # advisor round-1 medium: unscale() must reset trainer._scale, else
+    # step() divides by loss_scale a second time -> ~zero updates at 2^16
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    amp.init_trainer(trainer)
+    x = mx.nd.ones((1, 4))
+    with autograd.record():
+        loss = net(x).sum()
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    amp.unscale(trainer)
+    g = net.weight.grad().asnumpy().copy()
+    before = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    after = net.weight.data().asnumpy()
+    # sgd, lr=1, batch=1: delta == -grad (unscaled, applied exactly once)
+    onp.testing.assert_allclose(after - before, -g, rtol=1e-5, atol=1e-6)
+
+
+def test_amp_init_trainer_idempotent():
+    # advisor round-1 low: double init_trainer must not nest the _update
+    # wrapper (double-advancing the scale window per step)
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    upd1 = trainer._update
+    amp.init_trainer(trainer)
+    assert trainer._update is upd1     # not re-wrapped
+    scaler = trainer._amp_loss_scaler
+    x = mx.nd.ones((1, 4))
+    with autograd.record():
+        loss = net(x).sum()
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    steps0 = scaler._unskipped if hasattr(scaler, "_unskipped") else None
+    trainer.step(1)
+    if steps0 is not None:   # scale window advanced exactly once
+        assert scaler._unskipped == steps0 + 1
+
+
 def test_amp_convert_hybrid_block():
     amp.init()
     net = gluon.nn.HybridSequential()

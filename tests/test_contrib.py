@@ -116,15 +116,23 @@ def test_foreach_multiple_data_and_states():
 
 
 def test_while_loop():
+    import pytest
     i = mx.nd.array([0.0])
     acc = mx.nd.array([0.0])
     outs, (i_f, acc_f) = C.while_loop(
         lambda i, a: i < 3,
         lambda i, a: ((i.copy(),), (i + 1, a + i)),
-        (i, acc))
+        (i, acc), max_iterations=10)
     assert float(i_f.asnumpy()[0]) == 3.0
     assert float(acc_f.asnumpy()[0]) == 3.0   # 0+1+2
-    assert outs.shape == (3, 1)
+    # reference contract: stacked outputs padded to max_iterations
+    assert outs.shape == (10, 1)
+    onp.testing.assert_allclose(outs.asnumpy()[:3, 0], [0, 1, 2])
+    onp.testing.assert_allclose(outs.asnumpy()[3:, 0], 0.0)
+    # reference contract: max_iterations is required
+    with pytest.raises(ValueError):
+        C.while_loop(lambda i: i < 3,
+                     lambda i: ((i.copy(),), (i + 1,)), (i,))
 
 
 def test_while_loop_max_iterations():
