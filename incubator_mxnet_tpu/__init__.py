@@ -40,6 +40,8 @@ from . import symbol
 from . import symbol as sym
 from .executor import Executor
 from . import io
+from . import recordio
+from . import image
 from . import metric
 from . import callback
 from . import model
@@ -49,6 +51,7 @@ from . import numpy as np
 from . import numpy_extension as npx
 from . import engine
 from . import profiler
+from . import test_utils
 from . import runtime
 from . import contrib
 
@@ -57,5 +60,6 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",
            "autograd", "random", "base", "context", "initializer", "init",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
-           "parallel", "symbol", "sym", "Executor", "io", "metric",
-           "callback", "model", "module", "mod", "np", "npx", "engine", "profiler", "runtime", "contrib"]
+           "parallel", "symbol", "sym", "Executor", "io", "recordio",
+           "image", "metric", "callback", "model", "module", "mod", "np",
+           "npx", "engine", "profiler", "runtime", "contrib"]

@@ -1,0 +1,8 @@
+"""``mx.image`` — image decode / resize / crop ops, augmenters, and
+ImageIter (reference: python/mxnet/image/image.py, detection.py)."""
+from .image import *  # noqa: F401,F403
+from . import image
+from . import detection
+from .detection import ImageDetIter, CreateDetAugmenter  # noqa: F401
+
+__all__ = list(image.__all__) + ["ImageDetIter", "CreateDetAugmenter"]

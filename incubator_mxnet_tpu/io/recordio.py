@@ -1,0 +1,278 @@
+"""RecordIO: the dmlc packed-record container format.
+
+Byte-compatible with the reference's dmlc-core RecordIO (reference:
+3rdparty/dmlc-core/include/dmlc/recordio.h, python/mxnet/recordio.py) so
+``.rec``/``.idx`` datasets packed by the reference's ``im2rec`` tools load
+here unchanged and vice versa:
+
+* stream = sequence of records, each ``[kMagic u32le][lrec u32le][payload]
+  [pad to 4B]`` where ``lrec`` packs ``cflag`` in the top 3 bits and the
+  payload length in the low 29 bits;
+* payloads longer than 2^29-1 are split into continuation records with
+  cflag 1 (start) / 2 (middle) / 3 (end); cflag 0 = whole record;
+* ``IndexedRecordIO`` adds a text ``.idx`` sidecar of ``key\\tposition``
+  lines for random access;
+* ``pack``/``unpack`` add the MXNet image-record header ``IRHeader``
+  (struct ``IfQQ``: flag, label, id, id2) with multi-label payloads
+  inlined after the header (flag = label count).
+
+TPU-first note: this is deliberately plain Python file IO — the decode /
+augment compute happens in DataLoader workers (gluon.data) or the
+ImageRecordIter thread pool; the arrays XLA sees are already batched.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
+
+_kMagic = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _kMagic)
+_LEN_MASK = (1 << 29) - 1
+_CFLAG_WHOLE, _CFLAG_START, _CFLAG_MIDDLE, _CFLAG_END = 0, 1, 2, 3
+
+
+def _pad4(n: int) -> int:
+    return (4 - n % 4) % 4
+
+
+class MXRecordIO:
+    """Sequential reader/writer over a RecordIO file (reference:
+    python/mxnet/recordio.py MXRecordIO; dmlc RecordIOWriter/Reader)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.fp = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+        else:
+            raise MXNetError(f"Invalid flag {self.flag!r} (use 'r'/'w')")
+        self.writable = self.flag == "w"
+
+    def close(self):
+        if self.fp is not None:
+            self.fp.close()
+            self.fp = None
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # pickling support so DataLoader worker processes can reopen the file
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fp"] = None
+        if self.writable:
+            raise MXNetError("cannot pickle a writable MXRecordIO")
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        """Rewind the read cursor."""
+        self.close()
+        self.open()
+
+    def tell(self) -> int:
+        return self.fp.tell()
+
+    def write(self, buf: bytes):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        data = memoryview(buf)
+        n = len(data)
+        if n <= _LEN_MASK:
+            chunks = [(_CFLAG_WHOLE, data)]
+        else:
+            chunks = []
+            off = 0
+            while off < n:
+                size = min(_LEN_MASK, n - off)
+                last = off + size >= n
+                cflag = (_CFLAG_START if off == 0 else
+                         (_CFLAG_END if last else _CFLAG_MIDDLE))
+                chunks.append((cflag, data[off:off + size]))
+                off += size
+        for cflag, piece in chunks:
+            lrec = (cflag << 29) | len(piece)
+            self.fp.write(_MAGIC_BYTES)
+            self.fp.write(struct.pack("<I", lrec))
+            self.fp.write(piece)
+            self.fp.write(b"\x00" * _pad4(len(piece)))
+
+    def _read_one(self):
+        head = self.fp.read(8)
+        if len(head) < 8:
+            return None, None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise MXNetError(
+                f"corrupt RecordIO: bad magic {magic:#x} at "
+                f"{self.fp.tell() - 8} in {self.uri}")
+        cflag, length = lrec >> 29, lrec & _LEN_MASK
+        payload = self.fp.read(length)
+        if len(payload) != length:
+            raise MXNetError(f"corrupt RecordIO: truncated record in "
+                             f"{self.uri}")
+        self.fp.read(_pad4(length))
+        return cflag, payload
+
+    def read(self):
+        """Read the next logical record; None at EOF."""
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        cflag, payload = self._read_one()
+        if cflag is None:
+            return None
+        if cflag == _CFLAG_WHOLE:
+            return payload
+        if cflag != _CFLAG_START:
+            raise MXNetError("corrupt RecordIO: continuation without start")
+        parts = [payload]
+        while True:
+            cflag, payload = self._read_one()
+            if cflag is None:
+                raise MXNetError("corrupt RecordIO: unterminated record")
+            parts.append(payload)
+            if cflag == _CFLAG_END:
+                return b"".join(parts)
+            if cflag != _CFLAG_MIDDLE:
+                raise MXNetError("corrupt RecordIO: bad continuation flag")
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO via a ``key\\tposition`` text index
+    (reference: python/mxnet/recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    k, pos = line.split("\t")
+                    k = key_type(k)
+                    self.idx[k] = int(pos)
+                    self.keys.append(k)
+
+    def close(self):
+        if self.fp is not None and self.writable:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        return d
+
+    def seek(self, idx):
+        """Position the read cursor at record ``idx`` (a key)."""
+        if self.writable:
+            raise MXNetError("seek on a writable IndexedRecordIO")
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# reference also exposes the shorter alias
+IndexedRecordIO = MXIndexedRecordIO
+
+
+# ---------------------------------------------------------------------------
+# image-record packing (reference: python/mxnet/recordio.py pack/unpack)
+# ---------------------------------------------------------------------------
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Serialize header+payload.  Scalar label lives in the header; a
+    label vector is inlined (float32) after it with flag = its length."""
+    header = IRHeader(*header)
+    label = header.label
+    if isinstance(label, (int, float)):
+        return struct.pack(_IR_FORMAT, header.flag, float(label),
+                           header.id, header.id2) + s
+    arr = _np.asarray(label, dtype=_np.float32).ravel()
+    packed = struct.pack(_IR_FORMAT, len(arr), 0.0, header.id, header.id2)
+    return packed + arr.tobytes() + s
+
+
+def unpack(s: bytes):
+    """Inverse of :func:`pack` → (IRHeader, payload bytes)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        n = flag * 4
+        labels = _np.frombuffer(payload[:n], dtype=_np.float32)
+        return IRHeader(flag, labels, id_, id2), payload[n:]
+    return IRHeader(flag, label, id_, id2), payload
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an HWC uint8 image (RGB) and pack it (reference: pack_img;
+    codec is PIL here instead of cv2 — byte output is standard JPEG/PNG
+    either way)."""
+    import io as _io
+    from PIL import Image
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        pil = Image.fromarray(arr, mode="L")
+    else:
+        pil = Image.fromarray(arr[..., :3].astype(_np.uint8))
+    buf = _io.BytesIO()
+    fmt = img_fmt.lower().lstrip(".")
+    if fmt in ("jpg", "jpeg"):
+        pil.save(buf, format="JPEG", quality=quality)
+    elif fmt == "png":
+        pil.save(buf, format="PNG")
+    else:
+        raise MXNetError(f"unsupported img_fmt {img_fmt!r}")
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor=1):
+    """Inverse of :func:`pack_img` → (IRHeader, HWC uint8 ndarray)."""
+    import io as _io
+    from PIL import Image
+    header, payload = unpack(s)
+    pil = Image.open(_io.BytesIO(payload))
+    pil = pil.convert("RGB" if iscolor else "L")
+    return header, _np.asarray(pil)

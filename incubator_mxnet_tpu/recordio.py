@@ -1,0 +1,8 @@
+"""``mx.recordio`` — alias of :mod:`incubator_mxnet_tpu.io.recordio`
+(the reference exposes the same module at both ``mx.recordio`` and via
+``mx.io``; reference: python/mxnet/recordio.py)."""
+from .io.recordio import (MXRecordIO, MXIndexedRecordIO, IndexedRecordIO,
+                          IRHeader, pack, unpack, pack_img, unpack_img)
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]

@@ -1,0 +1,380 @@
+"""Finite-difference gradient sweep over the op corpus (reference:
+python/mxnet/test_utils.py check_numeric_gradient applied the way
+tests/python/unittest/test_operator.py does — the universal grad test).
+
+Every differentiable op family gets its Jacobian action checked against
+central differences on small shapes.  Non-differentiable ops (comparisons,
+argmax, rounding) get a forward-only sanity pass instead.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils as tu
+
+nd = mx.nd
+
+
+def _rng(seed=0):
+    return onp.random.default_rng(seed)
+
+
+def _u(lo, hi, shape=(3, 4), seed=0):
+    return (_rng(seed).random(shape) * (hi - lo) + lo).astype(onp.float64)
+
+
+# --------------------------------------------------------------- unary ops
+# (name, input-domain) — domains avoid kinks/poles so central differences
+# are valid
+UNARY = [
+    ("abs", (0.2, 2.0)), ("negative", (-2, 2)), ("reciprocal", (0.5, 2.0)),
+    ("square", (-2, 2)), ("sqrt", (0.2, 3.0)), ("rsqrt", (0.3, 3.0)),
+    ("cbrt", (0.2, 3.0)), ("rcbrt", (0.3, 3.0)), ("exp", (-1, 1)),
+    ("expm1", (-1, 1)), ("log", (0.2, 3.0)), ("log10", (0.2, 3.0)),
+    ("log2", (0.2, 3.0)), ("log1p", (-0.5, 2.0)), ("sin", (-2, 2)),
+    ("cos", (-2, 2)), ("tan", (-1.0, 1.0)), ("arcsin", (-0.8, 0.8)),
+    ("arccos", (-0.8, 0.8)), ("arctan", (-2, 2)), ("sinh", (-1.5, 1.5)),
+    ("cosh", (-1.5, 1.5)), ("tanh", (-1.5, 1.5)),
+    ("arcsinh", (-2, 2)), ("arccosh", (1.3, 3.0)),
+    ("arctanh", (-0.7, 0.7)), ("degrees", (-2, 2)), ("radians", (-90, 90)),
+    ("gammaln", (0.5, 3.0)), ("digamma", (0.8, 3.0)), ("erf", (-1.5, 1.5)),
+    ("erfinv", (-0.7, 0.7)), ("relu", (0.1, 2.0)), ("sigmoid", (-2, 2)),
+    ("softsign", (0.2, 2.0)), ("softrelu", (-2, 2)), ("gelu", (-2, 2)),
+    ("erf_gelu", (-2, 2)), ("identity", (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,domain", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_grad(name, domain):
+    fn = getattr(nd, name)
+    tu.check_numeric_gradient(lambda x: fn(x), [_u(*domain, seed=1)])
+
+
+# non-differentiable unaries: forward matches numpy
+UNARY_FWD = [
+    ("sign", onp.sign, (-2, 2)), ("floor", onp.floor, (-2, 2)),
+    ("ceil", onp.ceil, (-2, 2)), ("trunc", onp.trunc, (-2, 2)),
+    ("rint", onp.rint, (-2, 2)), ("round", onp.round, (-2, 2)),
+    ("fix", onp.trunc, (-2, 2)),
+    ("isnan", onp.isnan, (-2, 2)), ("isinf", onp.isinf, (-2, 2)),
+    ("isfinite", onp.isfinite, (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,ref,domain", UNARY_FWD,
+                         ids=[u[0] for u in UNARY_FWD])
+def test_unary_forward(name, ref, domain):
+    x = _u(*domain, seed=2).astype(onp.float32)
+    fn = getattr(nd, name)
+    tu.assert_almost_equal(fn(nd.array(x)).asnumpy().astype(onp.float64),
+                           ref(x).astype(onp.float64))
+
+
+# -------------------------------------------------------------- binary ops
+BINARY = [
+    ("add", (-2, 2), (-2, 2)), ("subtract", (-2, 2), (-2, 2)),
+    ("multiply", (-2, 2), (-2, 2)), ("divide", (-2, 2), (0.5, 2.0)),
+    ("power", (0.5, 2.0), (0.5, 2.0)), ("maximum", (-2, 2), (-2, 2)),
+    ("minimum", (-2, 2), (-2, 2)), ("hypot", (0.5, 2), (0.5, 2)),
+    ("arctan2", (0.5, 2), (0.5, 2)), ("mod", (0.6, 3.0), (3.5, 5.0)),
+]
+
+
+@pytest.mark.parametrize("name,da,db", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_grad(name, da, db):
+    fn = getattr(nd, name)
+    tu.check_numeric_gradient(
+        lambda a, b: fn(a, b), [_u(*da, seed=3), _u(*db, seed=4)])
+
+
+def test_binary_broadcast_grad():
+    # broadcasting across mismatched shapes (reference:
+    # elemwise_binary_broadcast_op)
+    tu.check_numeric_gradient(
+        lambda a, b: nd.broadcast_add(a, b),
+        [_u(-2, 2, (3, 4)), _u(-2, 2, (1, 4))])
+    tu.check_numeric_gradient(
+        lambda a, b: nd.broadcast_mul(a, b),
+        [_u(-2, 2, (3, 1)), _u(-2, 2, (3, 4))])
+
+
+BINARY_FWD = [("equal", onp.equal), ("not_equal", onp.not_equal),
+              ("greater", onp.greater), ("greater_equal", onp.greater_equal),
+              ("lesser", onp.less), ("lesser_equal", onp.less_equal)]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_FWD,
+                         ids=[b[0] for b in BINARY_FWD])
+def test_binary_compare_forward(name, ref):
+    if not hasattr(nd, name):
+        pytest.skip(f"no {name}")
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+    b = onp.array([[2.0, 2.0], [1.0, 4.0]], onp.float32)
+    out = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+    tu.assert_almost_equal(out, ref(a, b).astype(onp.float32))
+
+
+# -------------------------------------------------------------- reductions
+REDUCE = [("sum", {}), ("mean", {}), ("prod", {}),
+          ("sum", {"axis": 0}), ("mean", {"axis": 1}),
+          ("sum", {"axis": 1, "keepdims": True}),
+          ("nansum", {}), ("nanprod", {}),
+          ("max", {"axis": 1}), ("min", {"axis": 0}),
+          ("norm", {}), ("norm", {"ord": 1})]
+
+
+@pytest.mark.parametrize("name,kw", REDUCE,
+                         ids=[f"{r[0]}-{r[1]}" for r in REDUCE])
+def test_reduce_grad(name, kw):
+    fn = getattr(nd, name)
+    dom = (0.5, 2.0) if name in ("prod", "nanprod", "norm") else (-2, 2)
+    tu.check_numeric_gradient(lambda x: fn(x, **kw),
+                              [_u(*dom, (3, 4), seed=5)])
+
+
+def test_cumsum_grad():
+    tu.check_numeric_gradient(lambda x: nd.cumsum(x, axis=1),
+                              [_u(-2, 2, (3, 4))])
+
+
+# ---------------------------------------------------------- linalg/matmul
+def test_dot_grad():
+    tu.check_numeric_gradient(lambda a, b: nd.dot(a, b),
+                              [_u(-1, 1, (3, 4)), _u(-1, 1, (4, 2))])
+
+
+def test_batch_dot_grad():
+    tu.check_numeric_gradient(
+        lambda a, b: nd.batch_dot(a, b),
+        [_u(-1, 1, (2, 3, 4)), _u(-1, 1, (2, 4, 2))])
+
+
+def test_linalg_gemm2_grad():
+    tu.check_numeric_gradient(
+        lambda a, b: nd.linalg_gemm2(a, b),
+        [_u(-1, 1, (3, 4)), _u(-1, 1, (4, 2))])
+
+
+def test_matmul_grad():
+    tu.check_numeric_gradient(lambda a, b: nd.matmul(a, b),
+                              [_u(-1, 1, (3, 4)), _u(-1, 1, (4, 2))])
+
+
+# -------------------------------------------------------- shape/index ops
+SHAPE_OPS = [
+    ("reshape", lambda x: nd.reshape(x, (4, 3)), (3, 4)),
+    ("flatten", lambda x: nd.flatten(x), (2, 3, 2)),
+    ("transpose", lambda x: nd.transpose(x), (3, 4)),
+    ("swapaxes", lambda x: nd.swapaxes(x, 0, 1), (3, 4)),
+    ("expand_dims", lambda x: nd.expand_dims(x, 1), (3, 4)),
+    ("squeeze", lambda x: nd.squeeze(x), (3, 1, 4)),
+    ("broadcast_to", lambda x: nd.broadcast_to(x, (3, 4)), (1, 4)),
+    ("tile", lambda x: nd.tile(x, (2, 2)), (2, 3)),
+    ("repeat", lambda x: nd.repeat(x, 2, axis=0), (2, 3)),
+    ("flip", lambda x: nd.flip(x, axis=1), (3, 4)),
+    ("pad2", lambda x: nd.slice(x, (0, 0), (2, 3)), (3, 4)),
+    ("slice_axis", lambda x: nd.slice_axis(x, 1, 1, 3), (3, 4)),
+    ("diag", lambda x: nd.diag(x), (4, 4)),
+    ("clip", lambda x: nd.clip(x, -0.8, 0.8), (3, 4)),
+]
+
+
+@pytest.mark.parametrize("name,fn,shape", SHAPE_OPS,
+                         ids=[s[0] for s in SHAPE_OPS])
+def test_shape_op_grad(name, fn, shape):
+    dom = (-2, 2) if name != "clip" else (-0.5, 0.5)
+    tu.check_numeric_gradient(fn, [_u(*dom, shape, seed=6)])
+
+
+def test_concat_stack_split_grad():
+    tu.check_numeric_gradient(
+        lambda a, b: nd.concat(a, b, dim=1),
+        [_u(-1, 1, (2, 3)), _u(-1, 1, (2, 2))])
+    tu.check_numeric_gradient(
+        lambda a, b: nd.stack(a, b, axis=0),
+        [_u(-1, 1, (2, 3)), _u(-1, 1, (2, 3))])
+    tu.check_numeric_gradient(
+        lambda x: nd.split(x, num_outputs=2, axis=1)[0],
+        [_u(-1, 1, (2, 4))])
+
+
+def test_take_pick_gather_grad():
+    idx = onp.array([0, 2], onp.int32)
+    tu.check_numeric_gradient(
+        lambda x: nd.take(x, nd.array(idx, dtype=onp.int32)),
+        [_u(-1, 1, (4, 3))])
+    pick_idx = onp.array([0, 1, 2], onp.float32)
+    tu.check_numeric_gradient(
+        lambda x: nd.pick(x, nd.array(pick_idx), axis=1),
+        [_u(-1, 1, (3, 4))])
+    gnd_idx = onp.array([[0, 2]], onp.int32)
+    tu.check_numeric_gradient(
+        lambda x: nd.gather_nd(x, nd.array(gnd_idx, dtype=onp.int32)),
+        [_u(-1, 1, (4, 3))])
+
+
+def test_where_embedding_grad():
+    cond = onp.array([[1, 0, 1, 0]] * 3, onp.float32)
+    tu.check_numeric_gradient(
+        lambda a, b: nd.where(nd.array(cond), a, b),
+        [_u(-1, 1, (3, 4)), _u(-1, 1, (3, 4))])
+    eidx = onp.array([[0, 2], [1, 1]], onp.float32)
+    tu.check_numeric_gradient(
+        lambda w: nd.Embedding(nd.array(eidx), w, input_dim=4,
+                               output_dim=3),
+        [_u(-1, 1, (4, 3))])
+
+
+def test_sequence_ops_grad():
+    x = _u(-1, 1, (4, 2, 3))                       # (seq, batch, feat)
+    length = onp.array([2, 4], onp.float32)
+    tu.check_numeric_gradient(
+        lambda d: nd.SequenceMask(d, nd.array(length),
+                                  use_sequence_length=True), [x])
+    tu.check_numeric_gradient(
+        lambda d: nd.SequenceLast(d, nd.array(length),
+                                  use_sequence_length=True), [x])
+    tu.check_numeric_gradient(
+        lambda d: nd.SequenceReverse(d, nd.array(length),
+                                     use_sequence_length=True), [x])
+
+
+def test_misc_grad():
+    tu.check_numeric_gradient(
+        lambda a, b, c: nd.add_n(a, b, c),
+        [_u(-1, 1, (2, 3), seed=i) for i in range(3)])
+    tu.check_numeric_gradient(lambda x: nd.smooth_l1(x, scalar=1.0),
+                              [_u(0.3, 2.0, (3, 4))])
+    tu.check_numeric_gradient(lambda x: nd.l2_normalization(x),
+                              [_u(0.5, 2.0, (3, 4))])
+    tu.check_numeric_gradient(lambda x: nd.batch_take(
+        x, nd.array(onp.array([0, 2, 1], onp.int32), dtype=onp.int32)),
+        [_u(-1, 1, (3, 4))])
+
+
+# ----------------------------------------------------------------- nn ops
+def test_softmax_family_grad():
+    tu.check_numeric_gradient(lambda x: nd.softmax(x), [_u(-2, 2, (3, 4))])
+    tu.check_numeric_gradient(lambda x: nd.log_softmax(x),
+                              [_u(-2, 2, (3, 4))])
+    tu.check_numeric_gradient(lambda x: nd.softmax(x, axis=0),
+                              [_u(-2, 2, (3, 4))])
+
+
+def test_activation_grad():
+    for act in ("relu", "sigmoid", "tanh", "softrelu", "softsign"):
+        dom = (0.1, 2.0) if act in ("relu",) else (-2, 2)
+        tu.check_numeric_gradient(
+            lambda x, a=act: nd.Activation(x, act_type=a),
+            [_u(*dom, (3, 4), seed=7)])
+    tu.check_numeric_gradient(lambda x: nd.leaky_relu(x, slope=0.1),
+                              [_u(0.1, 2.0, (3, 4))])
+
+
+def test_fully_connected_grad():
+    tu.check_numeric_gradient(
+        lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3),
+        [_u(-1, 1, (2, 4)), _u(-1, 1, (3, 4)), _u(-1, 1, (3,))])
+
+
+def test_convolution_grad():
+    tu.check_numeric_gradient(
+        lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3),
+                                       num_filter=2, pad=(1, 1)),
+        [_u(-1, 1, (1, 2, 5, 5)), _u(-1, 1, (2, 2, 3, 3)),
+         _u(-1, 1, (2,))], rtol=2e-2)
+
+
+def test_deconvolution_grad():
+    tu.check_numeric_gradient(
+        lambda x, w: nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2,
+                                      no_bias=True),
+        [_u(-1, 1, (1, 2, 4, 4)), _u(-1, 1, (2, 2, 2, 2))], rtol=2e-2)
+
+
+def test_pooling_grad():
+    tu.check_numeric_gradient(
+        lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg",
+                             stride=(2, 2)),
+        [_u(-1, 1, (1, 2, 4, 4))])
+    # max pool: keep values distinct so the argmax is stable under eps
+    base = onp.arange(32, dtype=onp.float64).reshape(1, 2, 4, 4) * 0.37
+    tu.check_numeric_gradient(
+        lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="max",
+                             stride=(2, 2)), [base])
+
+
+def test_norm_layers_grad():
+    x = _u(-1, 1, (2, 3, 4))
+    g, b = _u(0.5, 1.5, (3,)), _u(-0.5, 0.5, (3,))
+    tu.check_numeric_gradient(
+        lambda d, gg, bb: nd.LayerNorm(d, gg, bb, axis=-1),
+        [_u(-1, 1, (3, 4)), _u(0.5, 1.5, (4,)), _u(-0.5, 0.5, (4,))])
+    tu.check_numeric_gradient(
+        lambda d, gg, bb: nd.InstanceNorm(d, gg, bb),
+        [x, g, b], rtol=2e-2)
+    tu.check_numeric_gradient(
+        lambda d, gg, bb: nd.GroupNorm(d, gg, bb, num_groups=1),
+        [_u(-1, 1, (2, 2, 4)), _u(0.5, 1.5, (1,)), _u(-0.5, 0.5, (1,))],
+        rtol=2e-2)
+
+
+def test_batchnorm_grad():
+    x = _u(-1, 1, (2, 3, 4))
+    tu.check_numeric_gradient(
+        lambda d, gg, bb: nd.BatchNorm(
+            d, gg, bb, nd.zeros((3,)), nd.ones((3,)), fix_gamma=False),
+        [x, _u(0.5, 1.5, (3,)), _u(-0.5, 0.5, (3,))], rtol=2e-2)
+
+
+def test_softmax_cross_entropy_grad():
+    lab = onp.array([0, 2], onp.float32)
+    tu.check_numeric_gradient(
+        lambda x: nd.softmax_cross_entropy(x, nd.array(lab)),
+        [_u(-1, 1, (2, 4))])
+
+
+def test_upsampling_grad():
+    tu.check_numeric_gradient(
+        lambda x: nd.UpSampling(x, scale=2, sample_type="nearest"),
+        [_u(-1, 1, (1, 2, 3, 3))])
+
+
+# --------------------------------------------------- contrib/detection ops
+def test_contrib_grads():
+    from incubator_mxnet_tpu.ndarray import contrib as C
+    tu.check_numeric_gradient(
+        lambda x: C.AdaptiveAvgPooling2D(x, output_size=2),
+        [_u(-1, 1, (1, 2, 4, 4))])
+    tu.check_numeric_gradient(
+        lambda x: C.BilinearResize2D(x, height=6, width=6),
+        [_u(-1, 1, (1, 2, 3, 3))], rtol=2e-2)
+    rois = onp.array([[0, 0, 0, 3, 3]], onp.float32)
+    tu.check_numeric_gradient(
+        lambda x: C.ROIAlign(x, nd.array(rois), pooled_size=(2, 2),
+                             spatial_scale=1.0),
+        [_u(0.2, 1.0, (1, 1, 5, 5))], rtol=2e-2)
+
+
+# ------------------------------------------------------- consistency tier
+def test_check_consistency_smoke():
+    tu.check_consistency(lambda a, b: nd.dot(a, b),
+                         [_u(-1, 1, (3, 4)), _u(-1, 1, (4, 2))],
+                         ctx_list=[mx.cpu(0), mx.cpu(0)])
+
+
+def test_stop_gradient_blocks_grad():
+    # FD can't check this (perturbation leaks through the stopped branch);
+    # analytic contract: d/dx sum(x * sg(x)) == sg(x), not 2x
+    x = _u(-1, 1, (3, 4))
+    tu.check_symbolic_backward(
+        lambda a: a * nd.stop_gradient(a), [x],
+        [onp.ones((3, 4))], [x])
+
+
+def test_check_symbolic_forward_backward():
+    x = onp.array([[1.0, 2.0], [3.0, 4.0]])
+    tu.check_symbolic_forward(lambda a: a * 2, [x], [x * 2])
+    tu.check_symbolic_backward(lambda a: a * a, [x],
+                               [onp.ones_like(x)], [2 * x])

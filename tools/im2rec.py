@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / .lst file into RecordIO (.rec + .idx)
+(reference: tools/im2rec.py).
+
+Usage:
+    python tools/im2rec.py --list  PREFIX IMG_ROOT   # make PREFIX.lst
+    python tools/im2rec.py PREFIX IMG_ROOT           # pack PREFIX.lst
+                                                      -> PREFIX.rec/.idx
+
+The .lst format is the reference's: ``index\\tlabel...\\trelpath`` lines.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def list_images(root, recursive=True):
+    """Yield (relpath, label) with labels assigned per subdirectory in
+    sorted order (reference: im2rec list_image)."""
+    cat = {}
+    entries = []
+    if recursive:
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if fname.lower().endswith(_EXTS):
+                    label_dir = os.path.relpath(path, root).split(
+                        os.sep)[0]
+                    if label_dir not in cat:
+                        cat[label_dir] = len(cat)
+                    entries.append((os.path.relpath(
+                        os.path.join(path, fname), root), cat[label_dir]))
+    else:
+        for i, fname in enumerate(sorted(os.listdir(root))):
+            if fname.lower().endswith(_EXTS):
+                entries.append((fname, 0))
+    return entries
+
+
+def write_list(prefix, entries, shuffle=False, seed=0):
+    if shuffle:
+        rng = random.Random(seed)
+        rng.shuffle(entries)
+    path = prefix + ".lst"
+    with open(path, "w") as f:
+        for i, (rel, label) in enumerate(entries):
+            f.write(f"{i}\t{float(label)}\t{rel}\n")
+    return path
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, color=1):
+    """Pack ``prefix.lst`` images under ``root`` into
+    ``prefix.rec``/``prefix.idx``."""
+    from incubator_mxnet_tpu.io.recordio import (MXIndexedRecordIO,
+                                                 IRHeader, pack_img)
+    from incubator_mxnet_tpu.image import imread, resize_short
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        img = imread(os.path.join(root, rel), flag=color)
+        if resize:
+            img = resize_short(img, resize)
+        label = labels[0] if len(labels) == 1 else labels
+        header = IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, pack_img(header, img.asnumpy().astype("uint8"),
+                                    quality=quality))
+        n += 1
+    rec.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="create the .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true", default=True)
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge before packing")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1, choices=[0, 1])
+    args = ap.parse_args()
+    if args.list:
+        entries = list_images(args.root, args.recursive)
+        path = write_list(args.prefix, entries, args.shuffle)
+        print(f"wrote {len(entries)} entries to {path}")
+    else:
+        n = pack(args.prefix, args.root, args.resize, args.quality,
+                 args.color)
+        print(f"packed {n} images into {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
