@@ -215,10 +215,15 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
                 for i in range(len(node.out_avals))]
         if all(o is None for o in outs):
             continue
+        from .ndarray.sparse import BaseSparseNDArray as _SparseND
         cots = []
         for (shape, dtype), o in zip(node.out_avals, outs):
             if o is None:
                 cots.append(NDArray(jnp.zeros(shape, dtype)))
+            elif isinstance(o, _SparseND):
+                # a sparse grad flowing through a non-sparse-aware op
+                # densifies (reference: FComputeEx dense fallback)
+                cots.append(o.tostype("default"))
             else:
                 cots.append(o)
 
@@ -245,7 +250,10 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         else:
             cot_data = tuple(c._data for c in cots)
             gs = node.vjp_fn(cot_data if node.out_is_tuple else cot_data[0])
-            in_grads = [NDArray(g, ctx=inp.ctx)
+            # a vjp may return NDArray directly (sparse grads from the
+            # Embedding sparse_grad path) — pass those through unchanged
+            in_grads = [g if isinstance(g, NDArray)
+                        else NDArray(g, ctx=inp.ctx)
                         for g, inp in zip(gs, node.inputs)]
 
         for inp, g in zip(node.inputs, in_grads):
@@ -274,16 +282,28 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         for h in heads:
             if id(h) not in seen:
                 seen.add(id(h)); stack_arrays.append(h)
+        from .ndarray import sparse as _sparse
         for arr in stack_arrays:
             if arr._require_grad and id(arr) in leaf_acc:
                 acc = leaf_acc[id(arr)]
-                if arr._grad_req == "add" and arr._grad is not None:
-                    arr._grad._set_data(arr._grad._data + acc._data)
+                buf = arr._grad
+                if isinstance(buf, _sparse.BaseSparseNDArray):
+                    # sparse grad buffer (attach_grad(stype='row_sparse'))
+                    if not isinstance(acc, _sparse.BaseSparseNDArray):
+                        acc = acc.tostype(buf.stype)
+                    if arr._grad_req == "add":
+                        acc = _sparse.add(buf, acc)
+                    buf._replace_with(acc)
+                    continue
+                if isinstance(acc, _sparse.BaseSparseNDArray):
+                    acc = acc.tostype("default")
+                if arr._grad_req == "add" and buf is not None:
+                    buf._set_data(buf._data + acc._data)
                 else:
-                    if arr._grad is None:
+                    if buf is None:
                         arr._grad = NDArray(acc._data, ctx=arr.ctx)
                     else:
-                        arr._grad._set_data(acc._data.astype(arr._grad.dtype))
+                        buf._set_data(acc._data.astype(buf.dtype))
         return None
 
     out = []

@@ -54,7 +54,10 @@ class Parameter:
         self._deferred_init = None   # (initializer, ctx, default_init)
         if stype not in ("default", "row_sparse", "csr"):
             raise MXNetError(f"invalid stype {stype!r}")
+        if grad_stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid grad_stype {grad_stype!r}")
         self._stype = stype
+        self._grad_stype = grad_stype
 
     # ------------------------------------------------------------------
     @property
@@ -91,7 +94,7 @@ class Parameter:
                 self._data._grad = None
                 self._data._grad_req = "null"
             else:
-                self._data.attach_grad(req)
+                self._data.attach_grad(req, stype=self._grad_stype)
 
     @property
     def stype(self):
@@ -135,7 +138,7 @@ class Parameter:
         self._data = arr
         self._deferred_init = None
         if self._grad_req != "null":
-            self._data.attach_grad(self._grad_req)
+            self._data.attach_grad(self._grad_req, stype=self._grad_stype)
 
     def _finish_deferred_init(self):
         if self._deferred_init is None:
@@ -199,7 +202,7 @@ class Parameter:
                                    dtype=self.dtype)
                 self._data = arr
                 if self._grad_req != "null":
-                    self._data.attach_grad(self._grad_req)
+                    self._data.attach_grad(self._grad_req, stype=self._grad_stype)
                 self._deferred_init = None
                 return
             self._check_initialized()
@@ -217,7 +220,7 @@ class Parameter:
         if self._data is not None:
             self._data = self._data.as_in_context(ctx[0])
             if self._grad_req != "null":
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req, stype=self._grad_stype)
 
     def cast(self, dtype):
         self.dtype = _np.dtype(dtype)
@@ -225,7 +228,7 @@ class Parameter:
             had_grad = self._data.grad is not None
             self._data = self._data.astype(dtype)
             if had_grad:
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req, stype=self._grad_stype)
 
     def var(self):
         from ..symbol import var as _svar

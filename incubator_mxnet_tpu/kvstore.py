@@ -124,8 +124,15 @@ class KVStore:
                 raise MXNetError(f"key {k!r} was not init()-ed")
             src = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
+            from .ndarray import sparse as _sp
             for t in targets:
-                src.copyto(t)
+                if isinstance(t, _sp.BaseSparseNDArray):
+                    t._replace_with(src if src.stype == t.stype
+                                    else src.tostype(t.stype))
+                elif isinstance(src, _sp.BaseSparseNDArray):
+                    src.tostype("default").copyto(t)
+                else:
+                    src.copyto(t)
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused push+pull (reference: KVStorePushPullEx)."""
@@ -139,11 +146,36 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Dense fallback: full pull then row gather (sparse storage comes
-        with the sparse package)."""
+        """Pull only the requested rows as row_sparse (reference:
+        kvstore_local.h PullRowSparse — per-key row gather, no full-weight
+        transfer)."""
+        import numpy as _np
+        from .ndarray import sparse as _sp
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
-        self.pull(key, out, priority)
+        single, keys, outs = self._norm_keys(key, out)
+        ids_list = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        for k, o, ids in zip(keys, outs, ids_list):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} was not init()-ed")
+            src = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            rows = _np.unique(_np.asarray(
+                ids.asnumpy() if isinstance(ids, NDArray) else ids
+            ).astype(_np.int64).reshape(-1))
+            if isinstance(src, _sp.RowSparseNDArray):
+                gathered = _sp.retain(src, rows)
+            else:
+                import jax.numpy as jnp
+                ridx = jnp.asarray(rows.astype(_np.int32))
+                gathered = _sp.RowSparseNDArray(
+                    src._data[ridx], ridx, src.shape, ctx=src.ctx)
+            for t in targets:
+                if isinstance(t, _sp.RowSparseNDArray):
+                    t._replace_with(gathered)
+                else:
+                    gathered.tostype("default").copyto(t)
 
     # ------------------------------------------------------------------
     def set_optimizer(self, optimizer):

@@ -16,9 +16,10 @@ from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
 from . import utils  # noqa: F401
 from . import contrib  # noqa: F401
+from . import sparse  # noqa: F401
 from .utils import save, load, load_frombuffer  # noqa: F401
 
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
             "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
-            "op", "utils", "save", "load", "load_frombuffer"]
+            "op", "utils", "save", "load", "load_frombuffer", "sparse"]
            + list(_ops_all) + list(_nn_all) + list(_opt_all))

@@ -109,6 +109,13 @@ class NDArray:
     def stype(self) -> str:
         return "default"
 
+    def tostype(self, stype: str):
+        """Convert storage type (reference: NDArray.tostype)."""
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp._from_dense_jax(self._data, stype, ctx=self._ctx)
+
     @property
     def grad(self) -> Optional["NDArray"]:
         return self._grad
@@ -207,13 +214,20 @@ class NDArray:
     def attach_grad(self, grad_req: str = "write", stype=None):
         """Allocate a gradient buffer and mark this array as a variable
         (reference: python/mxnet/ndarray/ndarray.py attach_grad →
-        MXAutogradMarkVariables)."""
+        MXAutogradMarkVariables).  ``stype='row_sparse'`` allocates a sparse
+        grad buffer — the Embedding sparse_grad path."""
         if grad_req not in ("write", "add", "null"):
             raise MXNetError(f"invalid grad_req {grad_req!r}")
         jnp = _jnp()
         self._require_grad = grad_req != "null"
         self._grad_req = grad_req
-        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        if stype is not None and stype != "default":
+            from . import sparse as _sp
+            self._grad = _sp.zeros(stype, self.shape, ctx=self._ctx,
+                                   dtype=self.dtype)
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
+                                 ctx=self._ctx)
         # a variable is a fresh tape leaf: cut any history
         self._ag_node = None
         self._ag_idx = 0
@@ -230,8 +244,14 @@ class NDArray:
                        retain_graph=retain_graph, train_mode=train_mode)
 
     def zero_grad(self):
-        if self._grad is not None:
-            jnp = _jnp()
+        if self._grad is None:
+            return
+        from . import sparse as _sp
+        if isinstance(self._grad, _sp.BaseSparseNDArray):
+            self._grad._replace_with(
+                _sp.zeros(self._grad.stype, self.shape, ctx=self._ctx,
+                          dtype=self.dtype))
+        else:
             self._grad._set_data(_jnp().zeros(self.shape, self.dtype))
 
     # internal: replace wrapped buffer (in-place semantics)

@@ -781,11 +781,43 @@ def boolean_mask(data, index, axis=0):
 
 def Embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
               sparse_grad=False):
-    """reference: Embedding op (src/operator/tensor/indexing_op.cc)."""
+    """reference: Embedding op (src/operator/tensor/indexing_op.cc).
+
+    ``sparse_grad=True`` records a custom tape node whose backward emits a
+    ``RowSparseNDArray`` gradient holding only the touched rows (reference:
+    EmbeddingOpBackward row_sparse output) — eager-only, since nnz is
+    data-dependent; under a jit trace it falls back to the dense VJP."""
     idx, w = _nd(data), _nd(weight)
-    return _invoke(
-        lambda i, ww: _jnp().take(ww, i.astype(_jnp().int32), axis=0),
-        [idx, w], name="Embedding")
+    dense = lambda i, ww: _jnp().take(ww, i.astype(_jnp().int32), axis=0)
+    if sparse_grad:
+        import jax
+        if not (isinstance(idx._data, jax.core.Tracer)
+                or isinstance(w._data, jax.core.Tracer)):
+            return _embedding_sparse_grad(idx, w)
+    return _invoke(dense, [idx, w], name="Embedding")
+
+
+def _embedding_sparse_grad(idx: NDArray, w: NDArray) -> NDArray:
+    from .. import autograd as _ag_mod
+    jnp = _jnp()
+    out = NDArray(jnp.take(w._data, idx._data.astype(jnp.int32), axis=0),
+                  ctx=w.ctx)
+    if _ag_mod.is_recording() and w._tape_entry_active():
+        idx_dev = idx._data  # host sync deferred to backward time
+        wshape, wctx = w.shape, w.ctx
+
+        def sparse_vjp(cot):
+            from . import sparse as _sp
+            return (_sp.embedding_row_sparse_grad(_np.asarray(idx_dev), cot,
+                                                  wshape, ctx=wctx),)
+
+        node = _ag_mod._TapeNode(fun=None, inputs=[w], vjp_fn=sparse_vjp,
+                                 out_is_tuple=False,
+                                 name="Embedding(sparse_grad)", custom=True)
+        node.out_avals = [(out.shape, out.dtype)]
+        out._ag_node = node
+        out._ag_idx = 0
+    return out
 
 
 embedding = Embedding

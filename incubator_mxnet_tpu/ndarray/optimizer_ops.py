@@ -33,11 +33,41 @@ def _prep_grad(g, rescale_grad, clip_gradient, wd=0.0, w=None):
     return g
 
 
+def _row_sparse_grad(grad, lazy_update=True):
+    """(rows, data) for a row_sparse grad under lazy_update, else None →
+    caller uses the dense path (reference: sgd/adam FComputeEx dispatch on
+    grad stype, src/operator/optimizer_op.cc)."""
+    from .sparse import RowSparseNDArray
+    if isinstance(grad, RowSparseNDArray):
+        if lazy_update:
+            return grad._rs_indices, grad._rs_data
+        return None  # densified by _as_dense_grad
+    return None
+
+
+def _as_dense_grad(grad):
+    from .sparse import BaseSparseNDArray
+    if isinstance(grad, BaseSparseNDArray):
+        return grad.tostype("default")
+    return grad
+
+
 def sgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True, out=None):
-    w, g = weight._data, grad._data
-    g = _prep_grad(g, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    clip = clip_gradient if clip_gradient > 0 else None
+    rs = _row_sparse_grad(grad, lazy_update)
+    if rs is not None:
+        # lazy row-sparse update: only touched rows move (reference:
+        # SGDUpdateRspImpl — wd applies to the touched rows only)
+        rows, gd = rs
+        w = weight._data
+        wr = w[rows]
+        g = _prep_grad(gd, rescale_grad, clip, wd, wr)
+        tgt = out if out is not None else weight
+        tgt._set_data(w.at[rows].set((wr - lr * g).astype(w.dtype)))
+        return tgt
+    w, g = weight._data, _as_dense_grad(grad)._data
+    g = _prep_grad(g, rescale_grad, clip, wd, w)
     new_w = w - lr * g
     tgt = out if out is not None else weight
     tgt._set_data(new_w.astype(w.dtype))
@@ -47,9 +77,20 @@ def sgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
 def sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
                    momentum=0.0, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True, out=None):
-    w, g, m = weight._data, grad._data, mom._data
-    g = _prep_grad(g, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    clip = clip_gradient if clip_gradient > 0 else None
+    rs = _row_sparse_grad(grad, lazy_update)
+    if rs is not None:
+        rows, gd = rs
+        w, m = weight._data, mom._data
+        wr, mr = w[rows], m[rows]
+        g = _prep_grad(gd, rescale_grad, clip, wd, wr)
+        new_mr = momentum * mr - lr * g
+        mom._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
+        tgt = out if out is not None else weight
+        tgt._set_data(w.at[rows].set((wr + new_mr).astype(w.dtype)))
+        return tgt
+    w, g, m = weight._data, _as_dense_grad(grad)._data, mom._data
+    g = _prep_grad(g, rescale_grad, clip, wd, w)
     new_m = momentum * m - lr * g
     new_w = w + new_m
     mom._set_data(new_m.astype(m.dtype))
@@ -62,7 +103,7 @@ def nag_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
                    momentum=0.0, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, out=None):
     """Nesterov (reference: nag_mom_update kernel)."""
-    w, g, m = weight._data, grad._data, mom._data
+    w, g, m = weight._data, _as_dense_grad(grad)._data, mom._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
     new_m = momentum * m + g
@@ -80,10 +121,26 @@ def adam_update(weight: NDArray, grad: NDArray, mean: NDArray, var: NDArray,
     """reference: adam_update — lr is expected pre-scaled by
     sqrt(1-beta2^t)/(1-beta1^t) as the python Adam class does."""
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    clip = clip_gradient if clip_gradient > 0 else None
+    rs = _row_sparse_grad(grad, lazy_update)
+    if rs is not None:
+        # lazy adam: moments and weight move only on touched rows
+        # (reference: AdamUpdateRspImpl)
+        rows, gd = rs
+        w, m, v = weight._data, mean._data, var._data
+        wr, mr, vr = w[rows], m[rows], v[rows]
+        g = _prep_grad(gd, rescale_grad, clip, wd, wr)
+        new_mr = beta1 * mr + (1 - beta1) * g
+        new_vr = beta2 * vr + (1 - beta2) * g * g
+        new_wr = wr - lr * new_mr / (jnp.sqrt(new_vr) + epsilon)
+        mean._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
+        var._set_data(v.at[rows].set(new_vr.astype(v.dtype)))
+        tgt = out if out is not None else weight
+        tgt._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
+        return tgt
+    w, g = weight._data, _as_dense_grad(grad)._data
     m, v = mean._data, var._data
-    g = _prep_grad(g, rescale_grad,
-                   clip_gradient if clip_gradient > 0 else None, wd, w)
+    g = _prep_grad(g, rescale_grad, clip, wd, w)
     new_m = beta1 * m + (1 - beta1) * g
     new_v = beta2 * v + (1 - beta2) * g * g
     new_w = w - lr * new_m / (jnp.sqrt(new_v) + epsilon)
@@ -98,7 +155,7 @@ def rmsprop_update(weight: NDArray, grad: NDArray, n: NDArray, lr,
                    gamma1=0.95, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, clip_weights=-1.0, out=None):
     jnp = _jnp()
-    w, g, nn = weight._data, grad._data, n._data
+    w, g, nn = weight._data, _as_dense_grad(grad)._data, n._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
     new_n = (1 - gamma1) * g * g + gamma1 * nn
@@ -117,7 +174,7 @@ def rmspropalex_update(weight: NDArray, grad: NDArray, n: NDArray,
                        clip_gradient=-1.0, clip_weights=-1.0, out=None):
     """Centered RMSProp (Graves 2013; reference: rmspropalex_update)."""
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     nn, gm, d = n._data, g_mean._data, delta._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
@@ -139,7 +196,7 @@ def ftrl_update(weight: NDArray, grad: NDArray, z: NDArray, n: NDArray, lr,
                 lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, out=None):
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     zz, nn = z._data, n._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
@@ -157,7 +214,7 @@ def ftrl_update(weight: NDArray, grad: NDArray, z: NDArray, n: NDArray, lr,
 def signsgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, out=None):
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
     new_w = w - lr * (jnp.sign(g) + wd * w)
@@ -170,7 +227,7 @@ def signum_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
                   momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                   wd_lh=0.0, out=None):
     jnp = _jnp()
-    w, g, m = weight._data, grad._data, mom._data
+    w, g, m = weight._data, _as_dense_grad(grad)._data, mom._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
     new_m = momentum * m - (1 - momentum) * g
@@ -187,7 +244,7 @@ def mp_sgd_update(weight: NDArray, grad: NDArray, weight32: NDArray, lr,
     """Multi-precision: fp32 master weights, low-precision model weights
     (reference: mp_sgd_update)."""
     jnp = _jnp()
-    w32, g = weight32._data, grad._data.astype(jnp.float32)
+    w32, g = weight32._data, _as_dense_grad(grad)._data.astype(jnp.float32)
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w32)
     new_w32 = w32 - lr * g
@@ -202,7 +259,7 @@ def mp_sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray,
                       rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True, out=None):
     jnp = _jnp()
-    w32, g, m = weight32._data, grad._data.astype(jnp.float32), mom._data
+    w32, g, m = weight32._data, _as_dense_grad(grad)._data.astype(jnp.float32), mom._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w32)
     new_m = momentum * m - lr * g
@@ -220,7 +277,7 @@ def lamb_update_phase1(weight: NDArray, grad: NDArray, mean: NDArray,
                        clip_gradient=-1.0):
     """reference: lamb_update_phase1 — returns the raw update direction."""
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     m, v = mean._data, var._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
@@ -259,7 +316,7 @@ def adagrad_update(weight: NDArray, grad: NDArray, history: NDArray, lr,
                    epsilon=1e-7, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, out=None):
     jnp = _jnp()
-    w, g, h = weight._data, grad._data, history._data
+    w, g, h = weight._data, _as_dense_grad(grad)._data, history._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
     new_h = h + g * g
@@ -274,7 +331,7 @@ def adadelta_update(weight: NDArray, grad: NDArray, acc_g: NDArray,
                     acc_delta: NDArray, rho=0.9, epsilon=1e-5, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, out=None):
     jnp = _jnp()
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     ag, ad = acc_g._data, acc_delta._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
@@ -295,7 +352,7 @@ def sgld_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
     import jax
     jnp = _jnp()
     from .. import random as _random
-    w, g = weight._data, grad._data
+    w, g = weight._data, _as_dense_grad(grad)._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
     key = _random.new_key(weight.ctx)

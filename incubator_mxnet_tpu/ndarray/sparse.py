@@ -1,0 +1,499 @@
+"""Sparse NDArray storage: ``RowSparseNDArray`` and ``CSRNDArray``.
+
+TPU-native re-design of the reference sparse frontend (reference:
+python/mxnet/ndarray/sparse.py; kernels in src/operator/tensor/ and
+row_sparse handling in src/kvstore/kvstore_local.h).  Design mapping:
+
+* The reference stores sparse arrays as typed Chunks with auxiliary arrays
+  (indices / indptr) managed by the storage manager.  Here each sparse array
+  holds its component arrays (``data``, ``indices`` [, ``indptr``]) as
+  device-resident ``jax.Array`` buffers — XLA/PJRT owns allocation.
+* Sparse×dense matmul lowers through ``jax.experimental.sparse.BCOO``
+  (gather/scatter programs the TPU backend compiles natively) rather than
+  hand-written CSR kernels.
+* Data-dependent sizes (nnz) make sparse construction eager-only — the
+  same restriction XLA imposes; dense fallbacks are documented per op.
+
+Scope matches what GluonNLP-era workloads use (SURVEY §7.2 hard-part 6):
+row-sparse embedding gradients, ``sparse.retain``/``row_sparse_pull`` row
+gather, csr dot, elementwise add of same-stype arrays, dense conversion.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, _wrap_out
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
+           "retain", "dot", "add"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_IDX_DTYPE = _np.int32  # reference uses int64; jax x64 is off → int32
+                        # (documented divergence; >2^31 rows is out of scope)
+
+
+class BaseSparseNDArray(NDArray):
+    """Common machinery for sparse storage types.
+
+    Subclasses carry their component buffers; the dense ``_data`` slot of
+    the base class stays ``None`` — any op without a sparse implementation
+    must go through ``tostype('default')`` explicitly (the reference raises
+    on unsupported stype dispatch the same way).
+    """
+
+    __slots__ = ("_sp_shape", "_sp_dtype")
+
+    def __init__(self, shape, dtype, ctx: Optional[Context] = None):
+        self._data = None
+        self._ctx = ctx if ctx is not None else current_context()
+        self._ag_node = None
+        self._ag_idx = 0
+        self._require_grad = False
+        self._grad = None
+        self._grad_req = "null"
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._sp_dtype = _np.dtype(dtype)
+
+    # -- shape/dtype come from metadata, not a dense buffer ------------
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return self._sp_dtype
+
+    def _dense_jax(self):
+        raise NotImplementedError
+
+    def _components(self):
+        raise NotImplementedError
+
+    def wait_to_read(self):
+        for c in self._components():
+            c.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> _np.ndarray:
+        """Dense host copy (reference: BaseSparseNDArray.asnumpy returns the
+        dense materialization)."""
+        return _np.asarray(self._dense_jax())
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self._dense_jax(), ctx=self._ctx)
+        return _from_dense_jax(self._dense_jax(), stype, ctx=self._ctx)
+
+    todense = lambda self: self.tostype("default")  # noqa: E731
+
+    def astype(self, dtype, copy=True):
+        if not copy and _np.dtype(dtype) == self.dtype:
+            return self
+        return self._astype_impl(dtype)
+
+    # arithmetic: same-stype add/sub stay sparse; scalar mul scales data;
+    # everything else densifies (reference FComputeEx fallback behavior)
+    def __add__(self, other):
+        return add(self, other)
+
+    def __radd__(self, other):
+        return add(other, self)
+
+    def __sub__(self, other):
+        return add(self, other * -1 if isinstance(other, BaseSparseNDArray)
+                   else -other)
+
+    def __rsub__(self, other):
+        return add(other, self * -1)
+
+    def __neg__(self):
+        return self * -1
+
+    def __getitem__(self, key):
+        return self.tostype("default")[key]
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {'x'.join(map(str, self.shape))}"
+                f" @{self._ctx}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """A 2D+ array where only a subset of rows (leading-dim slices) are
+    stored (reference: python/mxnet/ndarray/sparse.py RowSparseNDArray).
+
+    ``indices``: sorted unique row ids, shape (nnz_rows,).
+    ``data``: the stored rows, shape (nnz_rows, *shape[1:]).
+    """
+
+    __slots__ = ("_rs_data", "_rs_indices")
+
+    def __init__(self, data, indices, shape, ctx=None, dtype=None):
+        jnp = _jnp()
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        i = (indices._data if isinstance(indices, NDArray)
+             else jnp.asarray(indices, _IDX_DTYPE))
+        if dtype is not None:
+            d = d.astype(dtype)
+        super().__init__(shape, d.dtype, ctx=ctx)
+        if d.ndim != len(self._sp_shape) or i.ndim != 1:
+            raise MXNetError(
+                f"row_sparse components malformed: data {d.shape}, "
+                f"indices {i.shape} for shape {shape}")
+        self._rs_data = d
+        self._rs_indices = i.astype(_IDX_DTYPE)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._rs_data, ctx=self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._rs_indices, ctx=self._ctx)
+
+    def _components(self):
+        return (self._rs_data, self._rs_indices)
+
+    def _dense_jax(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._sp_shape, self._sp_dtype)
+        if self._rs_indices.shape[0] == 0:
+            return out
+        return out.at[self._rs_indices].set(self._rs_data)
+
+    def _astype_impl(self, dtype):
+        return RowSparseNDArray(self._rs_data.astype(dtype),
+                                self._rs_indices, self._sp_shape,
+                                ctx=self._ctx)
+
+    def _replace_with(self, other: "RowSparseNDArray"):
+        """In-place component overwrite (grad-buffer deposit path)."""
+        self._rs_data = other._rs_data.astype(self._sp_dtype)
+        self._rs_indices = other._rs_indices
+        return self
+
+    def copy(self):
+        return RowSparseNDArray(self._rs_data, self._rs_indices,
+                                self._sp_shape, ctx=self._ctx)
+
+    def __mul__(self, other):
+        if _np.isscalar(other):
+            return RowSparseNDArray(self._rs_data * other, self._rs_indices,
+                                    self._sp_shape, ctx=self._ctx)
+        return self.tostype("default") * other
+
+    __rmul__ = __mul__
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        return retain(self, row_ids)
+
+    @classmethod
+    def from_dense(cls, dense) -> "RowSparseNDArray":
+        arr = dense.asnumpy() if isinstance(dense, NDArray) \
+            else _np.asarray(dense)
+        flat = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 \
+            else arr.reshape(-1, 1)
+        rows = _np.nonzero(_np.any(flat != 0, axis=1))[0].astype(_IDX_DTYPE)
+        return cls(arr[rows], rows, arr.shape,
+                   ctx=dense.ctx if isinstance(dense, NDArray) else None)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2D compressed-sparse-row array (reference:
+    python/mxnet/ndarray/sparse.py CSRNDArray).
+
+    ``data``: nnz values; ``indices``: nnz column ids; ``indptr``: row
+    extents, shape (nrows+1,).
+    """
+
+    __slots__ = ("_cs_data", "_cs_indices", "_cs_indptr")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None, dtype=None):
+        jnp = _jnp()
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        i = (indices._data if isinstance(indices, NDArray)
+             else jnp.asarray(indices, _IDX_DTYPE))
+        p = (indptr._data if isinstance(indptr, NDArray)
+             else jnp.asarray(indptr, _IDX_DTYPE))
+        if dtype is not None:
+            d = d.astype(dtype)
+        super().__init__(shape, d.dtype, ctx=ctx)
+        if len(self._sp_shape) != 2 or d.ndim != 1 or i.ndim != 1 \
+                or p.shape[0] != self._sp_shape[0] + 1:
+            raise MXNetError(
+                f"csr components malformed: data {d.shape}, indices "
+                f"{i.shape}, indptr {p.shape} for shape {shape}")
+        self._cs_data = d
+        self._cs_indices = i.astype(_IDX_DTYPE)
+        self._cs_indptr = p.astype(_IDX_DTYPE)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._cs_data, ctx=self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._cs_indices, ctx=self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._cs_indptr, ctx=self._ctx)
+
+    def _components(self):
+        return (self._cs_data, self._cs_indices, self._cs_indptr)
+
+    def _row_ids_np(self):
+        ptr = _np.asarray(self._cs_indptr)
+        return _np.repeat(_np.arange(len(ptr) - 1, dtype=_IDX_DTYPE),
+                          _np.diff(ptr))
+
+    def _dense_jax(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._sp_shape, self._sp_dtype)
+        if self._cs_data.shape[0] == 0:
+            return out
+        rows = jnp.asarray(self._row_ids_np())
+        return out.at[rows, self._cs_indices].add(self._cs_data)
+
+    def _astype_impl(self, dtype):
+        return CSRNDArray(self._cs_data.astype(dtype), self._cs_indices,
+                          self._cs_indptr, self._sp_shape, ctx=self._ctx)
+
+    def _replace_with(self, other: "CSRNDArray"):
+        self._cs_data = other._cs_data.astype(self._sp_dtype)
+        self._cs_indices = other._cs_indices
+        self._cs_indptr = other._cs_indptr
+        return self
+
+    def copy(self):
+        return CSRNDArray(self._cs_data, self._cs_indices, self._cs_indptr,
+                          self._sp_shape, ctx=self._ctx)
+
+    def __mul__(self, other):
+        if _np.isscalar(other):
+            return CSRNDArray(self._cs_data * other, self._cs_indices,
+                              self._cs_indptr, self._sp_shape, ctx=self._ctx)
+        return self.tostype("default") * other
+
+    __rmul__ = __mul__
+
+    def _bcoo(self):
+        """Lower to jax BCOO for compiled sparse matmul."""
+        from jax.experimental import sparse as jsp
+        jnp = _jnp()
+        rows = jnp.asarray(self._row_ids_np())
+        idx = jnp.stack([rows, self._cs_indices], axis=1)
+        return jsp.BCOO((self._cs_data, idx), shape=self._sp_shape)
+
+    @classmethod
+    def from_dense(cls, dense) -> "CSRNDArray":
+        arr = dense.asnumpy() if isinstance(dense, NDArray) \
+            else _np.asarray(dense)
+        if arr.ndim != 2:
+            raise MXNetError("csr requires a 2D array")
+        rows, cols = _np.nonzero(arr)
+        order = _np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        indptr = _np.zeros(arr.shape[0] + 1, dtype=_IDX_DTYPE)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr).astype(_IDX_DTYPE)
+        return cls(arr[rows, cols], cols.astype(_IDX_DTYPE), indptr,
+                   arr.shape,
+                   ctx=dense.ctx if isinstance(dense, NDArray) else None)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: sparse.py csr_matrix / row_sparse_array / zeros)
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source
+    (reference: sparse.row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape is required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape, ctx=ctx, dtype=dtype)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy()
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return RowSparseNDArray.from_dense(src)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) / (data, (row, col))
+    / dense (reference: sparse.csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape is required with (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx, dtype=dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, rowcol = arg1
+        if not (isinstance(rowcol, (tuple, list)) and len(rowcol) == 2):
+            raise MXNetError(
+                "csr_matrix with a 2-tuple expects (data, (row, col)); "
+                "use (data, indices, indptr) for CSR components")
+        row, col = rowcol
+        if shape is None:
+            raise MXNetError("shape is required with (data, (row, col))")
+        dense = _np.zeros(shape, _np.asarray(data).dtype)
+        _np.add.at(dense, (_np.asarray(row), _np.asarray(col)),
+                   _np.asarray(data))
+        return CSRNDArray.from_dense(dense.astype(dtype) if dtype else dense)
+    if isinstance(arg1, CSRNDArray):
+        return arg1.copy()
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    if dtype is not None:
+        src = src.astype(dtype)
+    return CSRNDArray.from_dense(src)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """All-zero sparse array: empty component buffers (reference:
+    sparse.zeros)."""
+    jnp = _jnp()
+    dtype = dtype or _np.float32
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype),
+            jnp.zeros((0,), _IDX_DTYPE), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), _IDX_DTYPE),
+                          jnp.zeros((shape[0] + 1,), _IDX_DTYPE), shape,
+                          ctx=ctx)
+    if stype == "default":
+        from . import ndarray as _ndmod
+        return _ndmod.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-preserving array() (reference: sparse.array)."""
+    if isinstance(source_array, BaseSparseNDArray):
+        out = source_array.copy()
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+    raise MXNetError("sparse.array expects a sparse source; use "
+                     "csr_matrix/row_sparse_array for dense sources")
+
+
+# ---------------------------------------------------------------------------
+# ops (reference: src/operator/tensor sparse FComputeEx kernels)
+# ---------------------------------------------------------------------------
+def retain(data: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the requested rows (reference: sparse_retain op) — the
+    primitive under row_sparse_pull."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices).astype(_np.int64).reshape(-1)
+    have = _np.asarray(data._rs_indices)
+    keep = _np.nonzero(_np.isin(have, want))[0]
+    jnp = _jnp()
+    return RowSparseNDArray(data._rs_data[jnp.asarray(keep)],
+                            have[keep].astype(_IDX_DTYPE), data.shape,
+                            ctx=data._ctx)
+
+
+def _merge_row_sparse(a: RowSparseNDArray,
+                      b: RowSparseNDArray) -> RowSparseNDArray:
+    """Row-union sum of two row_sparse arrays (gradient accumulation)."""
+    jnp = _jnp()
+    ia, ib = _np.asarray(a._rs_indices), _np.asarray(b._rs_indices)
+    rows, inv = _np.unique(_np.concatenate([ia, ib]), return_inverse=True)
+    import jax
+    data = jax.ops.segment_sum(
+        jnp.concatenate([a._rs_data, b._rs_data], axis=0),
+        jnp.asarray(inv.astype(_IDX_DTYPE)), num_segments=len(rows))
+    return RowSparseNDArray(data, rows.astype(_IDX_DTYPE), a.shape,
+                            ctx=a._ctx)
+
+
+def add(lhs, rhs):
+    """Elementwise add with stype dispatch: same-stype stays sparse
+    (reference: elemwise_add FComputeEx); mixed densifies."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError(f"shape mismatch {lhs.shape} vs {rhs.shape}")
+        return _merge_row_sparse(lhs, rhs)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError(f"shape mismatch {lhs.shape} vs {rhs.shape}")
+        return CSRNDArray.from_dense(lhs._dense_jax() + rhs._dense_jax())
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return a + b
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: dot FComputeEx for csr):
+    dot(csr, dense) and dot(csr.T, dense) lower through BCOO so XLA compiles
+    the gather/scatter; other combinations densify."""
+    from . import ops as _ops
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
+                                                      BaseSparseNDArray):
+        mat = lhs._bcoo()
+        if transpose_a:
+            mat = mat.T
+        r = rhs._data if isinstance(rhs, NDArray) else _jnp().asarray(rhs)
+        if transpose_b:
+            r = r.T
+        return NDArray((mat @ r), ctx=lhs._ctx)
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _ops.dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def _from_dense_jax(jarr, stype, ctx=None):
+    if stype == "row_sparse":
+        return RowSparseNDArray.from_dense(NDArray(jarr, ctx=ctx))
+    if stype == "csr":
+        return CSRNDArray.from_dense(NDArray(jarr, ctx=ctx))
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def embedding_row_sparse_grad(idx_np: _np.ndarray, cotangent,
+                              weight_shape, ctx=None) -> RowSparseNDArray:
+    """Build the row_sparse gradient of an Embedding lookup: unique touched
+    rows + segment-summed cotangent slices (reference: indexing_op.cc
+    EmbeddingOpBackward with row_sparse output; SURVEY §7.2 hard-part 6)."""
+    import jax
+    jnp = _jnp()
+    flat_idx = _np.asarray(idx_np).astype(_np.int64).reshape(-1)
+    rows, inv = _np.unique(flat_idx, return_inverse=True)
+    cot = cotangent.reshape((-1,) + tuple(weight_shape[1:]))
+    data = jax.ops.segment_sum(cot, jnp.asarray(inv.astype(_IDX_DTYPE)),
+                               num_segments=len(rows))
+    return RowSparseNDArray(data, rows.astype(_IDX_DTYPE), weight_shape,
+                            ctx=ctx)

@@ -172,9 +172,9 @@ class SGD(Optimizer):
                   clip_gradient=self.clip_gradient or -1.0)
         if state is not None:
             _oo.sgd_mom_update(weight, grad, state, momentum=self.momentum,
-                               **kw)
+                               lazy_update=self.lazy_update, **kw)
         else:
-            _oo.sgd_update(weight, grad, **kw)
+            _oo.sgd_update(weight, grad, lazy_update=self.lazy_update, **kw)
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == _np.float16:
@@ -218,6 +218,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_zeros_like(weight), _zeros_like(weight))
@@ -233,7 +234,8 @@ class Adam(Optimizer):
         _oo.adam_update(weight, grad, mean, var, lr=lr, beta1=self.beta1,
                         beta2=self.beta2, epsilon=self.epsilon, wd=wd,
                         rescale_grad=self.rescale_grad,
-                        clip_gradient=self.clip_gradient or -1.0)
+                        clip_gradient=self.clip_gradient or -1.0,
+                        lazy_update=self.lazy_update)
 
 
 @register
@@ -256,7 +258,7 @@ class Adamax(Optimizer):
         t = self._index_update_count[index]
         lr /= (1. - self.beta1 ** t)
         m, u = state
-        g = grad._data * self.rescale_grad + wd * weight._data
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad + wd * weight._data
         if self.clip_gradient:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         new_m = self.beta1 * m._data + (1 - self.beta1) * g
@@ -287,7 +289,7 @@ class Nadam(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
-        g = grad._data * self.rescale_grad + wd * weight._data
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad + wd * weight._data
         if self.clip_gradient:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         momentum_t = self.beta1 * (1. - 0.5 * 0.96 **
@@ -499,7 +501,7 @@ class DCASGD(Optimizer):
         import jax.numpy as jnp
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = grad._data * self.rescale_grad
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad
         if self.clip_gradient:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         mom, prev = state
@@ -522,7 +524,7 @@ class Test(Optimizer):
 
     def update(self, index, weight, grad, state):
         weight._set_data(
-            weight._data - self.lr * grad._data * self.rescale_grad)
+            weight._data - self.lr * _oo._as_dense_grad(grad)._data * self.rescale_grad)
 
 
 class Updater:
