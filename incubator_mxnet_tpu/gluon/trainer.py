@@ -69,10 +69,20 @@ class Trainer:
             self._kvstore = kv_mod.create(self._kvstore_type)
         else:
             self._kvstore = self._kvstore_type
-        # single logical arrays: updates run locally (the compiled-step
-        # path); update_on_kvstore retained only when explicitly requested
-        if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.set_optimizer(self._optimizer)
+        # dist/tpu stores aggregate gradients (across mesh devices and
+        # processes) even when the optimizer runs locally — the reference's
+        # update_on_kvstore=False flow (push grad, pull aggregated grad,
+        # update locally; trainer.py _allreduce_grads)
+        self._distributed = (self._kvstore is not None
+                             and self._kvstore._is_dist())
+        if self._kvstore is not None and self._compression_params:
+            # validate eagerly so a non-dist store raises instead of
+            # silently dropping the compression config
+            self._kvstore.set_gradient_compression(self._compression_params)
+        if self._kvstore is not None and (self._update_on_kvstore
+                                          or self._distributed):
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
@@ -109,14 +119,24 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
-        # one logical grad per param — cross-device reduction is inside the
-        # compiled step (psum); kvstore push/pull only for the
-        # update_on_kvstore contract
-        if self._kvstore is not None and self._update_on_kvstore:
+        # one logical grad per param — single-process cross-device
+        # reduction is inside the compiled step (psum).  For dist/tpu
+        # stores the gradient is pushed (summed across processes over DCN)
+        # and the aggregate pulled back before the local update
+        if self._kvstore is None:
+            return
+        if self._update_on_kvstore:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.push(i, p.grad())
                     self._kvstore.pull(i, p.data())
+        elif self._distributed and (self._kvstore.num_workers > 1
+                                    or self._compression_params):
+            # single process without compression: the DCN sum is the
+            # identity — skip the two full-parameter copies per step
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

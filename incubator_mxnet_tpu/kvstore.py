@@ -3,12 +3,24 @@ python/mxnet/kvstore.py; src/kvstore/kvstore_local.h, kvstore_dist.h).
 
 TPU-native re-design (SURVEY §5.8): the reference's 'local'/'device'/'nccl'
 stores aggregate per-device gradient copies; here a Parameter is ONE logical
-(possibly mesh-sharded) array, so single-process aggregation is summing the
-pushed values.  Multi-host data parallelism rides XLA collectives compiled
-into the train step (see incubator_mxnet_tpu.parallel) — 'dist_sync' maps to
-a psum-over-mesh step, with KVStore retained as the API shell.  'dist_async'
-is refused by design: an asynchronous parameter server contradicts SPMD
-execution (documented divergence from reference kvstore_dist_server.h).
+(possibly mesh-sharded) array.  Aggregation semantics by type:
+
+* 'local'/'device'/'nccl': values pushed for one key are summed.  Under an
+  ambient ``parallel.mesh_scope`` a multi-value push lowers to ONE compiled
+  XLA all-reduce over the mesh devices (the ICI path — replaces the
+  reference's comm.h reduce / kvstore_nccl.h allreduce) instead of a chain
+  of device-to-device adds.
+* 'dist_sync'/'dist'/'tpu': additionally, every push is summed ACROSS
+  PROCESSES over DCN (jax.distributed must be initialized; reference analog:
+  ps-lite worker→server push + aggregate, kvstore_dist_server.h).  With one
+  process this is the identity, so single-host code runs unchanged.
+* 'dist_async' is refused by design: an asynchronous parameter server
+  contradicts SPMD compiled execution.
+
+2-bit gradient compression (reference: src/kvstore/gradient_compression.cc)
+is implemented for dist-type stores: sign-threshold quantization with a
+per-key error-feedback residual, applied to the local value before the
+cross-process sum.
 """
 from __future__ import annotations
 
@@ -21,9 +33,12 @@ from .ndarray import ndarray as _ndmod
 
 __all__ = ["KVStore", "create"]
 
+_mesh_sum_cache: Dict = {}   # device-id tuple -> jitted replicated sum
+
 _SINGLE_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
-                 "device", "nccl", "tpu")
-_DIST_TYPES = ("dist_sync", "dist_device_sync", "dist_sync_device", "dist")
+                 "device", "nccl")
+_DIST_TYPES = ("dist_sync", "dist_device_sync", "dist_sync_device", "dist",
+               "tpu")
 
 
 def create(name="local") -> "KVStore":
@@ -31,9 +46,7 @@ def create(name="local") -> "KVStore":
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     name = name.lower()
-    if name in _SINGLE_TYPES:
-        return KVStore(name)
-    if name in _DIST_TYPES:
+    if name in _SINGLE_TYPES or name in _DIST_TYPES:
         return KVStore(name)
     if "async" in name:
         raise MXNetError(
@@ -46,7 +59,7 @@ def create(name="local") -> "KVStore":
 class KVStore:
     """Key→NDArray store with push/pull aggregation semantics matching the
     reference (values pushed from multiple devices are summed; pull fans the
-    aggregate back out)."""
+    aggregate back out; dist types also sum across processes)."""
 
     def __init__(self, kv_type="local"):
         self._type = kv_type
@@ -54,9 +67,10 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._residuals: Dict = {}   # per-key error feedback (2bit)
         if kv_type in _DIST_TYPES:
             # multi-host sync via jax.distributed (one process per host);
-            # aggregation itself is compiled into the step by parallel.*
+            # push aggregates across processes (see _cross_process_sum)
             import jax
             self._rank = jax.process_index()
             self._num_workers = jax.process_count()
@@ -77,6 +91,9 @@ class KVStore:
     def num_workers(self):
         return self._num_workers
 
+    def _is_dist(self) -> bool:
+        return self._type in _DIST_TYPES
+
     # ------------------------------------------------------------------
     def _norm_keys(self, key, value):
         single = not isinstance(key, (list, tuple))
@@ -85,33 +102,125 @@ class KVStore:
         return single, list(key), list(value)
 
     def init(self, key, value):
-        """reference: KVStore.init — one-time value registration."""
+        """reference: KVStore.init — one-time value registration.  For dist
+        types every process adopts rank 0's value, matching the reference's
+        worker-0-init-push / everyone-pulls flow (kvstore_dist.h InitImpl)."""
         _, keys, values = self._norm_keys(key, value)
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
                 v = v[0]
-            self._store[k] = v.copy() if isinstance(v, NDArray) else \
-                _ndmod.array(v)
+            v = v.copy() if isinstance(v, NDArray) else _ndmod.array(v)
+            if self._is_dist() and self._num_workers > 1:
+                v = self._bcast_from_rank0(v)
+            self._store[k] = v
 
+    @staticmethod
+    def _bcast_from_rank0(value: NDArray) -> NDArray:
+        """All processes adopt rank 0's value (DCN broadcast)."""
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(value, BaseSparseNDArray):
+            value = value.tostype("default")
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(value._data)
+        return NDArray(gathered[0], ctx=value.ctx)
+
+    # ------------------------------------------------------------------
+    # aggregation machinery
+    # ------------------------------------------------------------------
     def _aggregate(self, vlist) -> NDArray:
+        """Sum values pushed for one key (reference: comm.h Reduce).  Under
+        an ambient mesh, a multi-value push compiles to one XLA all-reduce
+        over the mesh devices instead of a serial add chain."""
         if isinstance(vlist, NDArray):
             return vlist
         if len(vlist) == 1:
             return vlist[0]
+        from .parallel import mesh as mesh_mod
+        from .ndarray.sparse import BaseSparseNDArray
+        mesh = mesh_mod.current_mesh()
+        if (mesh is not None and mesh.devices.size >= len(vlist)
+                and not any(isinstance(v, BaseSparseNDArray)
+                            for v in vlist)):
+            return self._mesh_reduce(vlist, mesh)
         out = vlist[0]
         for v in vlist[1:]:
             out = out + v
         return out
 
+    @staticmethod
+    def _mesh_reduce(vlist, mesh) -> NDArray:
+        """One compiled all-reduce: shard the stacked values over the mesh
+        devices, jit a leading-axis sum with a replicated output sharding —
+        XLA lowers this to a psum over ICI (reference analogs:
+        kvstore_nccl.h allreduce, comm_tree.h 2-level reduce).  The jitted
+        reducer is cached per device set so the program compiles once."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        n = len(vlist)
+        devs = tuple(mesh.devices.flat)[:n]
+        flat_mesh = Mesh(list(devs), ("kv",))
+        shape = (n,) + tuple(vlist[0].shape)
+        shards = [
+            jax.device_put(v._data.reshape((1,) + tuple(v.shape)), d)
+            for v, d in zip(vlist, devs)
+        ]
+        stacked = jax.make_array_from_single_device_arrays(
+            shape, NamedSharding(flat_mesh, PartitionSpec("kv")), shards)
+        key = tuple(d.id for d in devs)
+        fn = _mesh_sum_cache.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+            fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                         out_shardings=NamedSharding(flat_mesh,
+                                                     PartitionSpec()))
+            _mesh_sum_cache[key] = fn
+        return NDArray(fn(stacked), ctx=vlist[0].ctx)
+
+    def _cross_process_sum(self, value: NDArray) -> NDArray:
+        """Sum a per-process value over all processes (the DCN path;
+        reference analog: ps-lite push → server aggregate → pull,
+        kvstore_dist_server.h DataHandleEx).  Identity for one process."""
+        if self._num_workers == 1:
+            return value
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(value, BaseSparseNDArray):
+            value = value.tostype("default")
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(value._data).sum(axis=0)
+        return NDArray(summed, ctx=value.ctx)
+
+    def _compress(self, k, value: NDArray) -> NDArray:
+        """2-bit sign-threshold quantization with error feedback
+        (reference: gradient_compression.cc GradientCompression::Quantize).
+        Values become {-t, 0, +t}; the quantization error is carried to the
+        next push.  Sparse values pass through uncompressed (the reference
+        compresses dense keys only)."""
+        import jax.numpy as jnp
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(value, BaseSparseNDArray):
+            return value
+        t = float(self._compression_params.get("threshold", 0.5))
+        res = self._residuals.get(k)
+        g = value._data if res is None else value._data + res
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0)).astype(g.dtype)
+        self._residuals[k] = g - q
+        return NDArray(q, ctx=value.ctx)
+
+    # ------------------------------------------------------------------
     def push(self, key, value, priority=0):
-        """Push value(s); multiple values per key are summed (reference:
-        comm.h Reduce).  With an updater set, the update is applied here —
-        the 'update_on_kvstore' path."""
+        """Push value(s); multiple values per key are summed; dist types
+        also sum across processes.  With an updater set, the update is
+        applied here — the 'update_on_kvstore' path."""
         _, keys, values = self._norm_keys(key, value)
         for k, v in zip(keys, values):
             agg = self._aggregate(v)
             if k not in self._store:
                 raise MXNetError(f"key {k!r} was not init()-ed")
+            if self._is_dist():
+                if self._compression_params and \
+                        self._compression_params.get("type") == "2bit":
+                    agg = self._compress(k, agg)
+                agg = self._cross_process_sum(agg)
             if self._updater is not None:
                 self._updater(_key_int(k), agg, self._store[k])
             else:
@@ -191,9 +300,17 @@ class KVStore:
         return self._updater
 
     def set_gradient_compression(self, compression_params):
-        self._compression_params = dict(compression_params)
-        if compression_params.get("type") not in (None, "none", "2bit"):
+        """Enable 2-bit compression on dist pushes (reference:
+        KVStore.set_gradient_compression)."""
+        params = dict(compression_params)
+        if params.get("type") not in (None, "none", "2bit"):
             raise MXNetError("unknown gradient compression type")
+        if params.get("type") == "2bit" and not self._is_dist():
+            raise MXNetError(
+                "gradient compression applies to dist KVStore types only "
+                "(reference restriction)")
+        self._compression_params = params
+        self._residuals = {}
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
