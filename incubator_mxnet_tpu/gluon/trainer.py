@@ -35,6 +35,10 @@ class Trainer:
             self._params.append(p)
             self._param2idx[p.name] = i
         self._compression_params = compression_params
+        # 'none' is accepted-but-inert; only 2bit changes push semantics
+        self._compress_active = bool(
+            compression_params
+            and compression_params.get("type") == "2bit")
         self._contains_sparse = any(p.stype != "default"
                                     for p in self._params)
         optimizer_params = optimizer_params or {}
@@ -73,8 +77,8 @@ class Trainer:
         # processes) even when the optimizer runs locally — the reference's
         # update_on_kvstore=False flow (push grad, pull aggregated grad,
         # update locally; trainer.py _allreduce_grads)
-        self._distributed = (self._kvstore is not None
-                             and self._kvstore._is_dist())
+        self._distributed = (self._kvstore is not None and getattr(
+            self._kvstore, "_is_dist", lambda: False)())
         if self._kvstore is not None and self._compression_params:
             # validate eagerly so a non-dist store raises instead of
             # silently dropping the compression config
@@ -131,7 +135,7 @@ class Trainer:
                     self._kvstore.push(i, p.grad())
                     self._kvstore.pull(i, p.data())
         elif self._distributed and (self._kvstore.num_workers > 1
-                                    or self._compression_params):
+                                    or self._compress_active):
             # single process without compression: the DCN sum is the
             # identity — skip the two full-parameter copies per step
             for i, p in enumerate(self._params):

@@ -113,6 +113,7 @@ class KVStore:
             if self._is_dist() and self._num_workers > 1:
                 v = self._bcast_from_rank0(v)
             self._store[k] = v
+            self._residuals.pop(k, None)  # fresh key: no stale feedback
 
     @staticmethod
     def _bcast_from_rank0(value: NDArray) -> NDArray:

@@ -14,10 +14,13 @@ TPU-first re-design notes:
     of the reference's serialized CUDA bitonic+bitmask kernels.
   * AdaptiveAvgPooling2D is lowered to two small matmuls (precomputed
     row/col averaging weights), which beats gather-based pooling on TPU.
-  * foreach lowers to lax.scan (compiled loop, grad via scan's VJP);
-    while_loop/cond execute eagerly — their trip counts/predicates are
-    data-dependent by definition, which is exactly what the reference's
-    imperative path does too.
+  * foreach lowers to lax.scan (compiled loop, grad via scan's VJP).
+    while_loop/cond execute eagerly when values are concrete (the
+    reference's imperative path), and lower to lax.while_loop/lax.cond
+    when tracing (hybridize/jit) — one compiled program, matching the
+    reference's control_flow.cc subgraph ops inside the graph executor.
+    Traced while_loop is forward-only for autodiff (lax.while_loop has no
+    reverse-mode rule); use foreach for differentiable loops.
 """
 from __future__ import annotations
 
@@ -539,6 +542,9 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         raise ValueError("max_iterations must be non-negative")
     single = isinstance(loop_vars, NDArray)
     lv = [loop_vars] if single else list(loop_vars)
+    import jax
+    if any(isinstance(v._data, jax.core.Tracer) for v in lv):
+        return _while_loop_traced(cond, func, lv, single, max_iterations)
     outputs = []
     it = 0
     while it < max_iterations and bool(cond(*lv).asnumpy()):
@@ -564,11 +570,80 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
             lv[0] if single else lv)
 
 
+def _while_loop_traced(cond, func, lv, single, max_iterations):
+    """Trace-time lowering of while_loop to ``lax.while_loop`` (reference:
+    control_flow.cc _while_loop subgraph op inside the graph executor).
+    Output buffers are preallocated (max_iterations, *shape) and written
+    per iteration, so the stacked-output/zero-pad contract of the eager
+    path holds with fully static shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    lv_data = tuple(v._data for v in lv)
+
+    def fn_body(*jargs):
+        outs, new_lv = func(*[NDArray(a) for a in jargs])
+        outs = [outs] if isinstance(outs, NDArray) else list(outs)
+        new_lv = [new_lv] if isinstance(new_lv, NDArray) else list(new_lv)
+        return ([o._data for o in outs], [l._data for l in new_lv])
+
+    out_shapes, lv_shapes = jax.eval_shape(fn_body, *lv_data)
+    for s, v in zip(lv_shapes, lv_data):
+        if tuple(s.shape) != tuple(v.shape) or s.dtype != v.dtype:
+            raise MXNetError(
+                "while_loop body must keep loop_vars' shapes/dtypes "
+                f"(got {s.shape}/{s.dtype} for {v.shape}/{v.dtype})")
+    bufs = tuple(jnp.zeros((max_iterations,) + tuple(s.shape), s.dtype)
+                 for s in out_shapes)
+
+    def cond_fn(carry):
+        i, lvs, _ = carry
+        p = cond(*[NDArray(a) for a in lvs])._data
+        return jnp.logical_and(i < max_iterations,
+                               p.reshape(()).astype(bool))
+
+    def body_fn(carry):
+        i, lvs, bufs = carry
+        outs, new_lvs = fn_body(*lvs)
+        bufs = tuple(b.at[i].set(o) for b, o in zip(bufs, outs))
+        return (i + 1, tuple(new_lvs), bufs)
+
+    _, final_lv, bufs = jax.lax.while_loop(
+        cond_fn, body_fn, (jnp.asarray(0, jnp.int32), lv_data, bufs))
+    ctx = lv[0].ctx
+    stacked = [NDArray(b, ctx=ctx) for b in bufs]
+    out_lv = [NDArray(a, ctx=ctx) for a in final_lv]
+    return (stacked[0] if len(stacked) == 1 else stacked,
+            out_lv[0] if single else out_lv)
+
+
 def cond(pred, then_func, else_func):
-    """Conditional execution (reference: contrib.cond).  Predicate is a
-    value → decided eagerly; both branches stay jit-compiled."""
-    p = pred().asnumpy() if callable(pred) else pred.asnumpy()
-    return then_func() if bool(p) else else_func()
+    """Conditional execution (reference: contrib.cond).  With a concrete
+    predicate the branch is decided eagerly; a traced predicate
+    (hybridize/jit) lowers to ``lax.cond`` — both branches compiled into
+    one program, matching the reference's _cond subgraph op.  Under
+    lax.cond both branches must produce matching shapes/dtypes (the
+    reference's symbolic cond has the same contract)."""
+    import jax
+    p = pred() if callable(pred) else pred
+    if not isinstance(p._data, jax.core.Tracer):
+        return then_func() if bool(p.asnumpy()) else else_func()
+
+    def _wrap(branch):
+        def fn(_):
+            out = branch()
+            if isinstance(out, NDArray):
+                return out._data
+            return tuple(o._data for o in out)
+        return fn
+
+    outs = jax.lax.cond(p._data.reshape(()).astype(bool),
+                        _wrap(then_func), _wrap(else_func), None)
+    ctx = p.ctx
+    # single-vs-list structure is preserved by lax.cond's pytree result
+    if not isinstance(outs, tuple):
+        return NDArray(outs, ctx=ctx)
+    return [NDArray(o, ctx=ctx) for o in outs]
 
 
 # ---------------------------------------------------------------------------
