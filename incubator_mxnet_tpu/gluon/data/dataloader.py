@@ -1,16 +1,29 @@
 """DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
 
-TPU-native re-design of the worker model: the reference forks
+TPU-native re-design of the worker model.  The reference forks
 multiprocessing workers that build batches in POSIX shared memory
-(cpu_shared context, reference: src/storage/cpu_shared_storage_manager.h)
-and passes fds over sockets.  Here host batches are numpy until the single
-``device_put`` at the end, so worker parallelism is a prefetching thread
-pool (decode/augment is numpy/PIL releasing the GIL) — no fd plumbing, and
-the jax transfer guard keeps device placement on the main thread.
-``num_workers>0`` controls the prefetch pool size with the same API.
+(cpu_shared context, reference: src/storage/cpu_shared_storage_manager.h
++ _MultiWorkerIter) and passes fds over sockets.  Here:
+
+* ``num_workers>0`` forks worker PROCESSES (default, reference parity) —
+  each worker runs ``dataset[idx]`` + batchify to NUMPY (workers never
+  touch jax: the single-client TPU tunnel and XLA state stay owned by the
+  parent), batches come back over pipes, and the parent does the one
+  ``device_put``.  Fork inheritance replaces fd-passing — the dataset is
+  inherited, not pickled per task.
+* ``thread_pool=True`` keeps the round-2 prefetching thread pool
+  (decode/augment in numpy/PIL releases the GIL) for workloads where fork
+  is undesirable.
+
+Start method is FORK deliberately: spawn would re-run sitecustomize's jax
+import in every worker and contend for the single-client TPU tunnel.
+Workers never call jax (numpy-only contract above), which is what jax's
+fork-deadlock warning is about; ``thread_pool=True`` is the escape hatch
+if a platform makes fork unsafe.
 """
 from __future__ import annotations
 
+import multiprocessing
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
@@ -39,7 +52,48 @@ def default_batchify_fn(data):
     return _ndmod.array(arr, dtype=arr.dtype)
 
 
-default_mp_batchify_fn = default_batchify_fn  # shm path not needed
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: stacks to NUMPY only (reference:
+    default_mp_batchify_fn builds cpu_shared NDArrays; here the no-jax-in-
+    workers rule means numpy over the pipe, one device_put in the parent)."""
+    if isinstance(data[0], NDArray):
+        # the dataset produced device arrays INSIDE a forked worker —
+        # that breaks the no-jax-in-workers contract fork depends on
+        # (deadlock risk); fail loudly with the two safe spellings
+        raise MXNetError(
+            "Dataset returned NDArray under num_workers>0: worker "
+            "processes must stay jax-free. Return numpy from "
+            "__getitem__/transform, or use thread_pool=True")
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_mp_batchify_fn(list(x)) for x in zip(*data))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    if arr.dtype == _np.int64:
+        arr = arr.astype(_np.int32)
+    return arr
+
+
+def _to_device(batch):
+    """Parent-side: numpy → NDArray (the single host→device hop)."""
+    if isinstance(batch, (tuple, list)):
+        return tuple(_to_device(b) for b in batch)
+    if isinstance(batch, _np.ndarray):
+        return _ndmod.array(batch, dtype=batch.dtype)
+    return batch
+
+
+# worker globals, inherited through fork (reference: _worker_initializer)
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_initializer():
+    pass  # dataset/batchify arrive via fork-inherited module globals
+
+
+def _worker_fn(indices):
+    return _worker_batchify([_worker_dataset[i] for i in indices])
 
 
 class DataLoader:
@@ -70,7 +124,14 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        if thread_pool:
+            self._batchify_fn = batchify_fn or default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn or (
+                default_mp_batchify_fn if self._num_workers > 0
+                else default_batchify_fn)
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
@@ -80,6 +141,12 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
+        if self._thread_pool:
+            yield from self._iter_threaded()
+        else:
+            yield from self._iter_multiprocess()
+
+    def _iter_threaded(self):
         # prefetching pool: keep `prefetch` batch futures in flight
         with ThreadPoolExecutor(self._num_workers) as pool:
             batches = iter(self._batch_sampler)
@@ -98,6 +165,35 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield fut.result()
+
+    def _iter_multiprocess(self):
+        """Reference _MultiWorkerIter flow: dispatch index batches to forked
+        workers, keep `prefetch` in flight, reorder-free FIFO collection."""
+        global _worker_dataset, _worker_batchify
+        ctx = multiprocessing.get_context("fork")
+        _worker_dataset = self._dataset
+        _worker_batchify = self._batchify_fn
+        pool = ctx.Pool(self._num_workers, initializer=_worker_initializer)
+        try:
+            batches = iter(self._batch_sampler)
+            inflight = []
+            try:
+                for _ in range(max(1, self._prefetch)):
+                    inflight.append(pool.apply_async(_worker_fn,
+                                                     (next(batches),)))
+            except StopIteration:
+                pass
+            while inflight:
+                res = inflight.pop(0)
+                try:
+                    inflight.append(pool.apply_async(_worker_fn,
+                                                     (next(batches),)))
+                except StopIteration:
+                    pass
+                yield _to_device(res.get(self._timeout))
+        finally:
+            pool.terminate()
+            pool.join()
 
     def __len__(self):
         return len(self._batch_sampler)

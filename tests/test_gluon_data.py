@@ -95,3 +95,69 @@ def test_compose_pipeline():
                       dtype=np.uint8)
     out = aug(img)
     assert out.shape == (3, 8, 8)
+
+
+def test_loader_multiprocess_workers_are_processes():
+    """num_workers>0 must run dataset access in forked worker processes
+    (reference: _MultiWorkerIter), not threads."""
+    import os
+    parent = os.getpid()
+
+    class PidDataset(gdata.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, idx):
+            return np.array([float(os.getpid())], np.float64)
+
+    loader = gdata.DataLoader(PidDataset(), batch_size=4, num_workers=2)
+    pids = {int(v) for b in loader for v in b.asnumpy().ravel()}
+    assert parent not in pids and len(pids) >= 1
+
+
+def test_loader_multiprocess_tuple_batches():
+    X = np.random.randn(20, 3).astype(np.float32)
+    Y = np.arange(20, dtype=np.float32)
+    ds = gdata.ArrayDataset(X, Y)
+    loader = gdata.DataLoader(ds, batch_size=5, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    xs = np.concatenate([b[0].asnumpy() for b in batches])
+    ys = np.concatenate([b[1].asnumpy() for b in batches])
+    np.testing.assert_allclose(xs, X)
+    np.testing.assert_allclose(ys, Y)
+
+
+def test_loader_thread_pool_flag():
+    ds = gdata.ArrayDataset(np.arange(16, dtype=np.float32))
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                              thread_pool=True)
+    vals = sorted(np.concatenate([b.asnumpy() for b in loader]))
+    np.testing.assert_allclose(vals, np.arange(16))
+
+
+@pytest.mark.skipif((__import__("os").cpu_count() or 1) < 2,
+                    reason="needs >1 core to demonstrate parallel decode")
+def test_loader_multiprocess_beats_gil():
+    """CPU-bound (GIL-holding) per-item work must scale with worker
+    processes — the reference's motivation for process workers over
+    threads (SURVEY Missing#6)."""
+    import time
+
+    class BusyDataset(gdata.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, idx):
+            acc = 0
+            for i in range(200_000):   # pure-python: holds the GIL
+                acc += i * i
+            return np.array([float(acc % 7)], np.float32)
+
+    t0 = time.perf_counter()
+    list(gdata.DataLoader(BusyDataset(), batch_size=4, num_workers=0))
+    serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    list(gdata.DataLoader(BusyDataset(), batch_size=4, num_workers=4))
+    par = time.perf_counter() - t0
+    assert par < serial * 0.8, (serial, par)
