@@ -32,7 +32,15 @@ _state = {"initialized": False, "target_dtype": None}
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Enable AMP (reference: amp.init).  target_dtype: 'bfloat16'
-    (recommended on TPU) or 'float16'."""
+    (recommended on TPU) or 'float16'.
+
+    Initialization patches the op namespaces with input-cast wrappers per
+    the curated lists (the imperative analog of the reference's amp_cast
+    graph rewrite, reference: amp.init → _initialize wrapping generated op
+    functions): TARGET_DTYPE_OPS cast float inputs down to the AMP dtype,
+    FP32_OPS cast low-precision inputs up to fp32, WIDEST_TYPE_CASTS align
+    all float inputs to the widest present dtype.  ``target_precision_ops``
+    / ``fp32_ops`` extend the respective lists (reference kwargs)."""
     import numpy as _np
     if isinstance(target_dtype, type) and target_dtype is _np.float16:
         target_dtype = "float16"
@@ -41,11 +49,151 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
                          "'float16'")
     _state["initialized"] = True
     _state["target_dtype"] = target_dtype
+    # reference conditional_fp32_ops entries are (op, arg, values) tuples
+    # — the op runs fp32 when arg takes one of the values; here the whole
+    # op is pinned fp32 (conservative superset, documented divergence)
+    cond_names = [t[0] if isinstance(t, (tuple, list)) else t
+                  for t in (conditional_fp32_ops or [])]
+    _patch_namespaces(extra_low=target_precision_ops,
+                      extra_fp32=list(fp32_ops or []) + cond_names)
+
+
+# ---------------------------------------------------------------------------
+# cast-insertion machinery (reference: amp.py _initialize / amp_cast nodes)
+# ---------------------------------------------------------------------------
+_patched = {}   # (module id, name) -> original fn
+
+
+def _np_target_dtype():
+    import numpy as _np
+    if _state["target_dtype"] == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(_np.float16)
+
+
+def _is_float_dtype(dt):
+    import numpy as _np
+    dt = _np.dtype(dt)
+    if dt.kind == "f":
+        return True
+    # ml_dtypes types (bfloat16, fp8...) register as numpy kind 'V'
+    import ml_dtypes
+    return dt == _np.dtype(ml_dtypes.bfloat16)
+
+
+def _is_float_nd(x):
+    from ...ndarray.ndarray import NDArray
+    from ...ndarray.sparse import BaseSparseNDArray
+    return (isinstance(x, NDArray)
+            and not isinstance(x, BaseSparseNDArray)
+            and _is_float_dtype(x.dtype))
+
+
+def _cast_tree(x, dtype):
+    from ...ndarray.ndarray import NDArray
+    if isinstance(x, (list, tuple)):
+        return type(x)(_cast_tree(e, dtype) for e in x)
+    if _is_float_nd(x) and x.dtype != dtype:
+        return x.astype(dtype)
+    return x
+
+
+def _widest_float(args):
+    import numpy as _np
+
+    def rank(dt):
+        dt = _np.dtype(dt)
+        if dt.itemsize >= 4:
+            return dt.itemsize
+        return 2
+
+    found = []
+
+    def walk(x):
+        if isinstance(x, (list, tuple)):
+            for e in x:
+                walk(e)
+        elif _is_float_nd(x):
+            found.append(_np.dtype(x.dtype))
+    walk(list(args))
+    if not found:
+        return None
+    widest = max(found, key=rank)
+    if any(rank(d) == rank(widest) and d != widest for d in found):
+        return _np.dtype(_np.float32)  # e.g. bf16 mixed with fp16
+    return widest
+
+
+def _wrap_op(fn, rule):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if not _state["initialized"]:
+            return fn(*args, **kwargs)
+        import numpy as _np
+        if rule == "low":
+            dtype = _np_target_dtype()
+        elif rule == "fp32":
+            dtype = _np.dtype(_np.float32)
+        else:  # widest — consider keyword tensors too
+            dtype = _widest_float(list(args) + list(kwargs.values()))
+        if dtype is not None:
+            if rule == "fp32":
+                # only widen low-precision floats; leave fp32/fp64 alone
+                def up(x):
+                    if isinstance(x, (list, tuple)):
+                        return type(x)(up(e) for e in x)
+                    if _is_float_nd(x) and _np.dtype(x.dtype).itemsize < 4:
+                        return x.astype(dtype)
+                    return x
+                args = [up(a) for a in args]
+                kwargs = {k: up(v) for k, v in kwargs.items()}
+            else:
+                args = [_cast_tree(a, dtype) for a in args]
+                kwargs = {k: _cast_tree(v, dtype)
+                          for k, v in kwargs.items()}
+        return fn(*args, **kwargs)
+
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def _patch_namespaces(extra_low=None, extra_fp32=None):
+    """Install cast wrappers into ndarray.ops / ndarray.nn and the mx.nd
+    package namespace (gluon layers dispatch F=the package).  Idempotent."""
+    from ... import ndarray as nd_pkg
+    from ...ndarray import ops as ops_mod, nn as nn_mod
+    plan = ([(n, "low") for n in list(lists.TARGET_DTYPE_OPS)
+             + list(extra_low or [])]
+            + [(n, "fp32") for n in list(lists.FP32_OPS)
+               + list(extra_fp32 or [])]
+            + [(n, "widest") for n in lists.WIDEST_TYPE_CASTS])
+    for name, rule in plan:
+        for mod in (ops_mod, nn_mod, nd_pkg):
+            fn = getattr(mod, name, None)
+            if fn is None or getattr(fn, "_amp_original", None) is not None:
+                continue
+            key = (mod, name)
+            if key not in _patched:
+                _patched[key] = fn
+            setattr(mod, name, _wrap_op(fn, rule))
 
 
 def _check_initialized():
     if not _state["initialized"]:
         raise MXNetError("AMP is not initialized: call amp.init() first")
+
+
+def _reset():
+    """Undo init(): restore original op functions (test isolation aid —
+    the reference has no off-switch, so this stays private)."""
+    for (mod, name), fn in _patched.items():
+        setattr(mod, name, fn)
+    _patched.clear()
+    _state["initialized"] = False
+    _state["target_dtype"] = None
 
 
 def target_dtype():

@@ -1,30 +1,110 @@
-"""AMP op classification lists (reference:
-python/mxnet/contrib/amp/lists/symbol_fp16.py).
+"""AMP op classification lists, curated over the full op corpus
+(reference: python/mxnet/contrib/amp/lists/symbol_fp16.py — FP16_FUNCS /
+FP16_FP32_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS).
 
 On TPU the low-precision type is bfloat16: same exponent range as fp32, so
 the reference's fp16 overflow machinery (loss scaling) is unnecessary for
 bf16 — but the op classification still decides where low precision is
-numerically safe vs where fp32 accumulate/compute must be kept.
+numerically safe vs where fp32 compute must be kept.  These lists are
+load-bearing: ``amp.init()`` wraps every listed op with the corresponding
+input-cast rule (the imperative analog of the reference's amp_cast graph
+rewrite).  tests/test_amp.py asserts the four lists exactly cover the
+``mx.nd`` + nn op corpus with no overlaps.
 """
 
-# Ops whose math is dominated by MXU matmul/conv — run in low precision
-LOW_PRECISION_OPS = [
-    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
-    "matmul", "RNN", "linalg_gemm2",
+# ---------------------------------------------------------------------------
+# Ops whose math is dominated by MXU matmul/conv — cast inputs DOWN to the
+# AMP target dtype (reference: FP16_FUNCS)
+# ---------------------------------------------------------------------------
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "fully_connected",
+    "Convolution", "convolution",
+    "Deconvolution", "deconvolution",
+    "RNN", "rnn",
+    "dot", "batch_dot", "matmul", "linalg_gemm2", "khatri_rao",
 ]
+LOW_PRECISION_OPS = TARGET_DTYPE_OPS  # back-compat alias
 
-# Numerically sensitive — keep fp32 compute (reference FP32_FUNCS)
+# ---------------------------------------------------------------------------
+# Numerically sensitive — cast low-precision inputs UP to fp32
+# (reference: FP32_FUNCS)
+# ---------------------------------------------------------------------------
 FP32_OPS = [
-    "softmax", "log_softmax", "softmax_cross_entropy", "SoftmaxOutput",
-    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "l2_normalization",
-    "norm", "mean", "sum", "exp", "log", "log2", "log10", "log1p", "expm1",
-    "power", "cumsum", "erf", "erfinv", "gamma", "smooth_l1",
+    # softmax / loss heads
+    "softmax", "log_softmax", "softmax_cross_entropy",
+    "SoftmaxOutput", "softmax_output",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "make_loss", "smooth_l1",
+    # normalization (fp32 statistics)
+    "BatchNorm", "batch_norm", "LayerNorm", "layer_norm",
+    "InstanceNorm", "instance_norm", "GroupNorm", "group_norm",
+    "L2Normalization", "l2_normalization", "norm", "linalg_norm",
+    # reductions (fp32 accumulate)
+    "sum", "sum_axis", "nansum", "mean", "prod", "nanprod", "cumsum",
+    # exp/log/power family
+    "exp", "expm1", "log", "log1p", "log2", "log10",
+    "power", "broadcast_power", "reciprocal", "rsqrt", "rcbrt",
+    "softplus", "softrelu",
+    # special functions
+    "erf", "erfinv", "gamma", "gammaln", "digamma",
 ]
 
-# Run in the widest input dtype (reference WIDEST_TYPE_CASTS)
+# ---------------------------------------------------------------------------
+# Multi-input elementwise — cast every float input to the WIDEST input
+# dtype (reference: WIDEST_TYPE_CASTS)
+# ---------------------------------------------------------------------------
 WIDEST_TYPE_CASTS = [
-    "add", "subtract", "multiply", "divide", "maximum", "minimum",
-    "where", "concat", "stack", "add_n",
+    "add", "subtract", "multiply", "divide", "mod", "floor_divide",
+    "maximum", "minimum", "hypot", "arctan2",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "broadcast_add", "broadcast_plus", "broadcast_sub", "broadcast_minus",
+    "broadcast_mul", "broadcast_div", "broadcast_mod",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_hypot",
+    "equal", "not_equal", "greater", "greater_equal", "lesser",
+    "lesser_equal",
+    "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+    "broadcast_greater_equal", "broadcast_lesser", "broadcast_lesser_equal",
+    "broadcast_logical_and", "broadcast_logical_or",
+    "broadcast_logical_xor",
+    "add_n", "ElementWiseSum", "where", "concat", "Concat", "stack",
+]
+
+# ---------------------------------------------------------------------------
+# Safe in either dtype — run in the input's dtype, no cast inserted
+# (reference: FP16_FP32_FUNCS)
+# ---------------------------------------------------------------------------
+TARGET_SAFE_OPS = [
+    # activations
+    "Activation", "relu", "sigmoid", "tanh", "gelu", "erf_gelu",
+    "LeakyReLU", "leaky_relu", "softsign",
+    # trig / rounding / unary arithmetic
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "sinh", "cosh", "arcsinh", "arccosh", "arctanh",
+    "abs", "sign", "negative", "floor", "ceil", "round", "rint", "trunc",
+    "fix", "sqrt", "cbrt", "square", "clip", "degrees", "radians",
+    # shape / layout / views
+    "reshape", "reshape_like", "Flatten", "flatten", "transpose",
+    "SwapAxis", "swapaxes", "expand_dims", "squeeze", "broadcast_to",
+    "broadcast_like", "broadcast_axes", "broadcast_axis",
+    "Pad", "pad", "tile", "repeat", "flip", "reverse",
+    "slice", "slice_axis", "slice_like", "SliceChannel", "split",
+    "split_v2", "diag", "shape_array", "size_array",
+    # indexing / gather / scatter
+    "take", "batch_take", "pick", "gather_nd", "scatter_nd",
+    "boolean_mask", "one_hot", "Embedding", "embedding",
+    # ordering
+    "sort", "argsort", "topk", "argmax", "argmin", "argmax_channel",
+    "max", "max_axis", "min", "min_axis",
+    # sequence
+    "SequenceLast", "sequence_last", "SequenceMask", "sequence_mask",
+    "SequenceReverse", "sequence_reverse",
+    # logical / predicates (dtype-preserving or bool-valued)
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "isfinite", "isinf", "isnan",
+    # misc / identity / dtype plumbing
+    "identity", "copy", "Cast", "cast", "BlockGrad", "stop_gradient",
+    "zeros_like", "ones_like", "full_like", "Dropout", "dropout",
+    "Pooling", "pooling", "UpSampling", "rnn_param_size",
 ]
 
 # Layer classes whose *parameters* stay fp32 under convert_hybrid_block

@@ -28,3 +28,13 @@ def _seeded():
     mx.random.seed(42)
     _np.random.seed(42)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _amp_isolation():
+    """amp.init() patches op namespaces; never let that leak across
+    tests."""
+    yield
+    from incubator_mxnet_tpu.contrib import amp
+    if amp._state["initialized"] or amp._patched:
+        amp._reset()

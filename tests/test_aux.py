@@ -140,6 +140,9 @@ def test_amp_unscale_then_step_applies_grads_once():
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 1.0})
     amp.init_trainer(trainer)
+    # real fp16 compute now: 2^16 would overflow this toy's grads before
+    # the assertion; a modest scale keeps them finite (idempotent swap)
+    trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0)
     x = mx.nd.ones((1, 4))
     with autograd.record():
         loss = net(x).sum()
@@ -166,6 +169,7 @@ def test_amp_init_trainer_idempotent():
     upd1 = trainer._update
     amp.init_trainer(trainer)
     assert trainer._update is upd1     # not re-wrapped
+    trainer._amp_loss_scaler = amp.LossScaler(init_scale=8.0)
     scaler = trainer._amp_loss_scaler
     x = mx.nd.ones((1, 4))
     with autograd.record():
