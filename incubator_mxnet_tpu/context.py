@@ -82,10 +82,10 @@ class Context:
 
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
                 # CPU backend unavailable (rare); fall back to default.
-                devs = jax.devices()
+                devs = jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         devs = _accelerators()
         if not devs:
@@ -119,12 +119,15 @@ Device = Context
 
 
 def _accelerators():
-    """All non-CPU jax devices (the axon PJRT TPU plugin reports platform
-    'axon'/'tpu' depending on version, so filter by != 'cpu')."""
+    """This process's non-CPU jax devices (the axon PJRT TPU plugin
+    reports platform 'axon'/'tpu' depending on version, so filter by
+    != 'cpu').  LOCAL devices only: in a multi-process job another host's
+    chips are non-addressable, and ``tpu(i)`` always means "my i-th
+    chip" (reference Context semantics)."""
     import jax
 
     try:
-        return [d for d in jax.devices() if d.platform != "cpu"]
+        return [d for d in jax.local_devices() if d.platform != "cpu"]
     except RuntimeError:
         return []
 

@@ -100,12 +100,21 @@ class SPMDTrainer:
                                    for p in self._trainable)
         self._aux_shardings = tuple(shardings[p.name] for p in self._aux)
 
-        # place parameter values on the mesh per their shardings
+        # place parameter values on the mesh per their shardings.
+        # device_put may ALIAS the input buffer (even via a distinct Array
+        # object) when placement already matches — a later donated step
+        # would then delete the Block's own parameter array; always copy
+        # so the Block stays usable (the copy is reclaimed by donation on
+        # the first step)
+        def placed_copy(x, s):
+            import jax.numpy as jnp
+            return jnp.copy(jax.device_put(x, s))
+
         self._tr_vals = tuple(
-            jax.device_put(p.data()._data, s)
+            placed_copy(p.data()._data, s)
             for p, s in zip(self._trainable, self._tr_shardings))
         self._aux_vals = tuple(
-            jax.device_put(p.data()._data, s)
+            placed_copy(p.data()._data, s)
             for p, s in zip(self._aux, self._aux_shardings))
         # zeros_like inside opt.init makes each state leaf inherit its
         # param's sharding (XLA propagates NamedSharding through zeros_like)
@@ -136,11 +145,13 @@ class SPMDTrainer:
                 nds = [NDArray(b) for b in xs]
                 out_vals, new_aux = functional_call(
                     net, trainable, tr, aux, aux_vals, nds, True, rng)
-                out_nd = NDArray(out_vals[0])
+                # multi-output nets (e.g. MLM+NSP heads) pass every output
+                # to the loss block: loss(out0, out1, ..., label)
+                out_nds = [NDArray(v) for v in out_vals]
                 with_label = NDArray(label)
                 from .. import autograd as _ag
                 with _ag.pause(train_mode=True):
-                    loss_nd = loss_blk(out_nd, with_label)
+                    loss_nd = loss_blk(*out_nds, with_label)
                 loss = jnp.mean(loss_nd._data)
                 return loss, tuple(new_aux)
 
@@ -160,8 +171,16 @@ class SPMDTrainer:
         import jax
         if isinstance(arr, NDArray):
             arr = arr._data
-        return jax.device_put(
-            arr, mesh_mod.named_sharding(self._mesh, self._data_axis))
+        sharding = mesh_mod.named_sharding(self._mesh, self._data_axis)
+        if jax.process_count() > 1:
+            # multi-host: each process feeds its LOCAL batch shard; the
+            # global array is assembled across processes (DCN path —
+            # reference analog: each dist worker computes on its own
+            # partition, kvstore_dist.h)
+            import numpy as _np
+            return jax.make_array_from_process_local_data(
+                sharding, _np.asarray(arr))
+        return jax.device_put(arr, sharding)
 
     def step(self, *batch) -> float:
         """Run one train step; returns the (replicated) scalar loss as a

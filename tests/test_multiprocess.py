@@ -1,0 +1,28 @@
+"""Multi-process (DCN-path) proof: 2 localhost processes under
+jax.distributed, launched through tools/launch.py (reference:
+tests/nightly/dist_sync_kvstore.py via the dmlc 'local' tracker —
+the multi-node-without-a-cluster trick, SURVEY §4)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout(600)
+def test_two_process_dist_sync():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"   # belt; worker script also pins cpu
+    env.pop("XLA_FLAGS", None)     # no virtual-device forcing in workers
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--",
+         sys.executable, os.path.join(_REPO, "tests",
+                                      "distributed_worker.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=_REPO)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "WORKER-0-OK" in out.stdout
+    assert "WORKER-1-OK" in out.stdout

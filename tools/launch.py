@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Multi-process job launcher (reference: tools/launch.py + the dmlc
+'local' tracker, 3rdparty/dmlc-core/tracker/dmlc_tracker/local.py).
+
+TPU-native re-design: the reference starts 1 scheduler + S servers + N
+workers talking ps-lite over ZMQ.  Here there are no servers — SPMD
+collectives replace the parameter server — so the launcher starts N worker
+processes wired to one jax.distributed coordinator via the SAME DMLC_*
+environment variables the reference uses, so reference launch scripts keep
+working:
+
+    python tools/launch.py -n 2 python train.py --kv-store dist_sync
+
+Env handed to each worker (consumed by parallel.distributed.initialize):
+    DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT  -> coordinator address
+    DMLC_NUM_WORKER                       -> process count
+    DMLC_WORKER_ID                        -> process rank
+
+Only ``--launcher local`` (single machine, the reference's no-cluster
+test mode) is implemented; ssh/mpi/yarn would only add remote process
+spawning around the same env contract.
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference CLI parity; SPMD has no "
+                         "parameter servers, so this is ignored")
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"],
+                    help="only 'local' (single machine) is supported")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="coordinator port (0 = pick a free one)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command, e.g. python train.py")
+    args = ap.parse_args()
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        ap.error("missing worker command")
+    if args.num_servers:
+        print(f"[launch] note: -s {args.num_servers} ignored — SPMD "
+              "collectives replace parameter servers", file=sys.stderr)
+
+    port = args.port or _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env["DMLC_PS_ROOT_URI"] = args.host
+        env["DMLC_PS_ROOT_PORT"] = str(port)
+        env["DMLC_NUM_WORKER"] = str(args.num_workers)
+        env["DMLC_WORKER_ID"] = str(rank)
+        env["DMLC_ROLE"] = "worker"
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for rank, p in enumerate(procs):
+        r = p.wait()
+        if r != 0:
+            print(f"[launch] worker {rank} exited rc={r}", file=sys.stderr)
+            rc = rc or r
+    if rc:  # one failed: don't leave the rest hanging on collectives
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
