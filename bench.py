@@ -1,18 +1,23 @@
 #!/usr/bin/env python
-"""Benchmark: BERT-large pretraining samples/sec/chip + MFU.
+"""Benchmark: BERT-large pretraining samples/sec/chip + MFU, plus the
+second judged metric's artifacts: ResNet-50 throughput and a DP-scaling
+dryrun (BASELINE.md metric 2 — scaling efficiency — as far as a single
+chip + virtual CPU mesh allow).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} and
-ALWAYS exits 0 — backend failures degrade to a CPU-smoke record instead of
-an empty artifact.
+Prints ONE JSON line.  The primary record is the BERT anchor; "resnet50"
+and "dp_scaling" sub-records carry the conv-net throughput and the 1→8
+virtual-device weak-scaling efficiency.  ALWAYS exits 0 — backend failures
+degrade to a CPU-smoke record instead of an empty artifact.
 
 Judged metric (BASELINE.md): BERT pretraining samples/sec/chip, north star
 >= 35% MFU.  Anchor: published GluonNLP BERT-large phase-1 throughput
-~O(100) seq/sec on 8x V100 => 12.5 samples/sec/chip; vs_baseline is our
-per-chip rate over that anchor.  On the accelerator we measure the REAL
-anchor config (BERT-large, seq 128, bf16 compute); the CPU fallback runs a
-tiny config purely to prove the path and is labeled as such.
+~O(100) seq/sec on 8x V100 => 12.5 samples/sec/chip.  NOTE the anchor is a
+2019-era fp32 V100 number; vs_baseline is a cross-era reference point —
+MFU is the honest efficiency metric.  The BERT step trains the FULL
+pretrain objective (MLM + NSP heads), matching the anchor workload.
 """
 import json
+import os
 import subprocess
 import sys
 import time
@@ -20,6 +25,7 @@ import time
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 12.5
+BASELINE_ANCHOR = "GluonNLP BERT-large phase-1, 8xV100 fp32 (2019 era)"
 
 # bf16 peak FLOP/s per chip by device kind (public TPU specs).
 PEAK_FLOPS = {
@@ -31,6 +37,10 @@ PEAK_FLOPS = {
     "v6 lite": 918e12,
     "v6e": 918e12,
 }
+
+# ResNet-50 v1 224x224 forward FLOPs per image (mul+add), the standard
+# 4.1 GFLOPs accounting; training ~= fwd + 2x bwd = 3x forward.
+RESNET50_FWD_FLOPS = 4.1e9
 
 
 def _peak_flops(kind):
@@ -66,7 +76,8 @@ def _probe_backend(timeout=90):
 def _model_flops_per_step(cfg, batch, seqlen):
     """Training FLOPs per step: 6*N*tokens for the param matmuls
     (fwd 2N + bwd 4N per token) + 12*L*T^2*d per sequence for attention
-    scores/context (fwd 4*T^2*d, x3 for bwd), + the vocab projection."""
+    scores/context (fwd 4*T^2*d, x3 for bwd), + the vocab projection.
+    (The NSP head adds only 6*2*d per sequence — negligible, excluded.)"""
     d, L, ffn, V = (cfg["units"], cfg["num_layers"], cfg["hidden_size"],
                     cfg["vocab_size"])
     n_block = L * (4 * d * d + 2 * d * ffn)   # qkv+out proj + 2 ffn mats
@@ -77,20 +88,12 @@ def _model_flops_per_step(cfg, batch, seqlen):
     return matmul + attn + head
 
 
-def main():
-    platform, kind = _probe_backend()
-    on_accel = platform not in (None, "cpu")
-
+def _bench_bert(on_accel, kind, dev):
     import jax
-    if not on_accel:
-        # never touch the broken/hung backend again in-process
-        jax.config.update("jax_platforms", "cpu")
-
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
     from incubator_mxnet_tpu.models import bert as bert_mod
 
-    dev = jax.devices()[0]
     if on_accel:
         # the anchor config itself: BERT-large, phase-1 seq length
         cfg = dict(vocab_size=30522, units=1024, hidden_size=4096,
@@ -126,11 +129,14 @@ def main():
         with mx.autograd.pause():
             net(ids, types)  # settle deferred shapes
         trainer = parallel.SPMDTrainer(
-            bert_mod.BERTMLMOnly(net), bert_mod.MLMPretrainLoss(V),
+            net, bert_mod.BERTPretrainLoss(V),
             "adam", {"learning_rate": 1e-4}, mesh=mesh, data_axis="data")
         x_ids = rng.integers(0, V, (B, T)).astype(np.int32)
         x_types = np.zeros((B, T), np.int32)
-        labels = rng.integers(0, V, (B, T)).astype(np.float32)
+        # packed labels: T MLM targets + 1 NSP class per sequence
+        labels = np.concatenate(
+            [rng.integers(0, V, (B, T)), rng.integers(0, 2, (B, 1))],
+            axis=1).astype(np.float32)
         for _ in range(warmup):
             loss = trainer.step(x_ids, x_types, labels)
         jax.block_until_ready(loss)
@@ -155,6 +161,187 @@ def main():
     flops = _model_flops_per_step(cfg, B_used, T)
     peak = _peak_flops(kind) if on_accel else None
     mfu = (samples_per_sec / B_used) * flops / peak if peak else None
+    return samples_per_sec, B_used, T, mfu
+
+
+def _bench_resnet50(on_accel, kind, dev):
+    """ResNet-50 v1 ImageNet-shape training throughput (reference:
+    example/image-classification/benchmark_score.py).  CPU fallback runs a
+    tiny conv net purely to prove the path."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
+
+    if on_accel:
+        net = zoo.resnet50_v1(classes=1000)
+        H = 224
+        batch_ladder = [64, 32, 16]
+        steps, warmup = 10, 2
+        flops_per_img = 3.0 * RESNET50_FWD_FLOPS
+    else:
+        net = zoo.resnet18_v1(classes=10)
+        H = 32
+        batch_ladder = [4]
+        steps, warmup = 3, 1
+        flops_per_img = None
+
+    mx.random.seed(0)
+    net.initialize(init=mx.init.Xavier())
+    if on_accel:
+        net.cast("bfloat16")
+    rng = np.random.default_rng(0)
+    mesh = parallel.make_mesh({"data": 1}, devices=[dev])
+
+    class _CE(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, scores, labels):
+            return self.ce(scores, labels).mean()
+
+    def _attempt(B):
+        with mx.autograd.pause():
+            net(mx.nd.array(np.zeros((2, 3, H, H), np.float32)))
+        trainer = parallel.SPMDTrainer(
+            net, _CE(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+            data_axis="data")
+        x = rng.standard_normal((B, 3, H, H)).astype(np.float32)
+        y = rng.integers(0, 10, (B,)).astype(np.float32)
+        for _ in range(warmup):
+            loss = trainer.step(x, y)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step(x, y)
+        jax.block_until_ready(loss)
+        return steps * B / (time.perf_counter() - t0)
+
+    imgs_per_sec, B_used = None, None
+    for B in batch_ladder:
+        try:
+            imgs_per_sec, B_used = _attempt(B), B
+            break
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) or B == batch_ladder[-1]:
+                raise
+            import gc
+            gc.collect()
+
+    peak = _peak_flops(kind) if on_accel else None
+    mfu = (imgs_per_sec * flops_per_img / peak
+           if (peak and flops_per_img) else None)
+    return {
+        "metric": ("resnet50_v1_train_imgs_per_sec_per_chip" if on_accel
+                   else "resnet18_cpu_smoke_imgs_per_sec"),
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/s",
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch_size": B_used,
+        "image_size": H,
+        "dtype": "bfloat16" if on_accel else "float32",
+    }
+
+
+_SCALING_SCRIPT = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
+
+PER_DEV_B, H, STEPS, WARM = 8, 32, 8, 2
+
+class CE(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    def hybrid_forward(self, F, scores, labels):
+        return self.ce(scores, labels).mean()
+
+def step_time(n_dev):
+    mx.random.seed(0)
+    net = zoo.resnet18_v1(classes=10)
+    net.initialize(init=mx.init.Xavier())
+    with mx.autograd.pause():
+        net(mx.nd.array(np.zeros((2, 3, H, H), np.float32)))
+    mesh = parallel.make_mesh({"data": n_dev},
+                              devices=jax.devices()[:n_dev])
+    tr = parallel.SPMDTrainer(net, CE(), "sgd", {"learning_rate": 0.1},
+                              mesh=mesh, data_axis="data")
+    rng = np.random.default_rng(0)
+    B = PER_DEV_B * n_dev
+    x = rng.standard_normal((B, 3, H, H)).astype(np.float32)
+    y = rng.integers(0, 10, (B,)).astype(np.float32)
+    for _ in range(WARM):
+        loss = tr.step(x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = tr.step(x, y)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / STEPS
+
+t1, t8 = step_time(1), step_time(8)
+# All 8 virtual devices share this host's cores, so wall-clock speedup is
+# impossible; the honest number is the sharding-overhead ratio: the
+# 8-device program doing 8x the work vs 8x the 1-device time.  <= 1.0
+# means the sharded program adds no overhead (no hidden serialization,
+# no collective blowup).
+print(json.dumps({"t_step_1dev_s": round(t1, 4),
+                  "t_step_8dev_s": round(t8, 4),
+                  "sharding_overhead_ratio": round(t8 / (8 * t1), 3)}))
+"""
+
+
+def _scaling_dryrun(timeout=900):
+    """Weak-scaling DP dryrun on the virtual 8-device CPU mesh: fixed
+    per-device batch, 1 vs 8 devices; efficiency = t(1)/t(8).  NOTE: the 8
+    virtual devices share one host's cores, so this validates that the
+    sharded program scales structurally (no hidden serialization), not ICI
+    bandwidth — the honest limit of a single-chip environment."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _SCALING_SCRIPT], capture_output=True,
+            text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() \
+            else ""
+        rec = json.loads(line)
+        rec["devices"] = ("8 virtual CPU sharing one host's cores (weak "
+                          "scaling, per-dev batch 8; ratio <= 1.0 means "
+                          "the sharded program adds no overhead)")
+        return rec
+    except Exception as e:
+        return {"error": str(e)[:200]}
+
+
+def main():
+    platform, kind = _probe_backend()
+    on_accel = platform not in (None, "cpu")
+
+    import jax
+    if not on_accel:
+        # never touch the broken/hung backend again in-process
+        jax.config.update("jax_platforms", "cpu")
+
+    dev = jax.devices()[0]
+    samples_per_sec, B_used, T, mfu = _bench_bert(on_accel, kind, dev)
+
+    try:
+        resnet = _bench_resnet50(on_accel, kind, dev)
+    except Exception as e:
+        resnet = {"error": str(e)[:200]}
+    scaling = _scaling_dryrun()
 
     out = {
         "metric": ("bert_large_pretrain_samples_per_sec_per_chip"
@@ -164,11 +351,15 @@ def main():
         "unit": "samples/s",
         "vs_baseline": round(
             samples_per_sec / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+        "baseline_anchor": BASELINE_ANCHOR,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "batch_size": B_used,
         "seq_len": T,
+        "objective": "MLM+NSP",
         "device": f"{platform or 'cpu'}:{kind or ''}",
         "dtype": "bfloat16" if on_accel else "float32",
+        "resnet50": resnet,
+        "dp_scaling": scaling,
     }
     print(json.dumps(out))
 

@@ -242,6 +242,28 @@ class MLMPretrainLoss(HybridBlock):
                        labels.reshape(-1))
 
 
+class BERTPretrainLoss(HybridBlock):
+    """Full pretraining loss: masked-LM CE + next-sentence CE (the anchor
+    workload's objective — reference: GluonNLP scripts/bert pretraining
+    loss = MLM + NSP).  Labels pack both targets in one (B, T+1) array:
+    ``labels[:, :T]`` are per-token MLM targets, ``labels[:, T]`` the NSP
+    class."""
+
+    def __init__(self, vocab_size, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.ce = loss_mod.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, mlm_scores, nsp_scores, labels):
+        mlm_labels = labels[:, :-1]
+        nsp_labels = labels[:, -1]
+        mlm = self.ce(mlm_scores.reshape(-1, self._vocab_size),
+                      mlm_labels.reshape(-1))
+        nsp = self.ce(nsp_scores, nsp_labels)
+        return mlm.mean() + nsp.mean()
+
+
 class BERTMLMOnly(HybridBlock):
     """Wrap BERTForPretrain to expose only the MLM scores (single-output
     step function for SPMDTrainer)."""

@@ -103,6 +103,11 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
     padding = [(p, p) for p in pad_]
 
     def conv(x, w):
+        # lax.conv requires matching dtypes; after net.cast('bfloat16') the
+        # activations may still arrive fp32 — follow the weight dtype (the
+        # reference's cudnn path casts the same way under AMP)
+        if x.dtype != w.dtype:
+            x = x.astype(w.dtype)
         return lax.conv_general_dilated(
             x, w, window_strides=stride_, padding=padding,
             lhs_dilation=None, rhs_dilation=dilate_,
