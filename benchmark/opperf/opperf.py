@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Per-op performance harness (reference: benchmark/opperf/opperf.py —
+sweeps op x shape x ctx and emits JSON/markdown).
+
+TPU-native notes: each timed sample blocks on the result
+(``wait_to_read``), so measured time includes dispatch + device compute —
+the analog of the reference's profiler-driven per-op timing.  The first
+call per (op, shape) pays XLA compile and is excluded via warmup.
+
+Usage:
+    python benchmark/opperf/opperf.py                    # default sweep
+    python benchmark/opperf/opperf.py --ops add,dot      # subset
+    python benchmark/opperf/opperf.py --output md        # markdown table
+    python benchmark/opperf/opperf.py --ctx cpu          # force backend
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+# runnable from anywhere: the repo root is two levels up
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def _default_suite():
+    """op name -> (argument builder, flop estimate or None).  Shapes
+    follow the reference's large/small split."""
+    import numpy as np
+
+    shapes = [(1024, 1024), (10000, 1)]
+
+    def arrs(n, shape, seed=0):
+        import incubator_mxnet_tpu as mx
+        rng = np.random.default_rng(seed)
+        return [mx.nd.array(rng.standard_normal(shape).astype(np.float32))
+                for _ in range(n)]
+
+    suite = []
+    for shape in shapes:
+        n = shape[0] * shape[1]
+        for name in ("add", "subtract", "multiply", "divide", "maximum",
+                     "minimum"):
+            suite.append((name, shape, lambda nm=name, s=shape: (
+                getattr(_nd(), nm), arrs(2, s)), 2 * n))
+        for name in ("exp", "log", "sqrt", "tanh", "sigmoid", "relu",
+                     "gelu", "erf", "square", "abs"):
+            suite.append((name, shape, lambda nm=name, s=shape: (
+                getattr(_nd(), nm), arrs(1, s, 1)), n))
+        for name in ("sum", "mean", "max", "argmax", "softmax",
+                     "log_softmax"):
+            suite.append((name, shape, lambda nm=name, s=shape: (
+                getattr(_nd(), nm), arrs(1, s, 2)), n))
+    # MXU ops
+    for m, k, nn_ in ((1024, 1024, 1024), (4096, 512, 512)):
+        suite.append(("dot", (m, k, nn_), lambda m_=m, k_=k, n_=nn_: (
+            _nd().dot, [_mk((m_, k_)), _mk((k_, n_))]), 2 * m * k * nn_))
+    suite.append(("FullyConnected", (256, 1024, 1024),
+                  lambda: (lambda x, w: _nd().FullyConnected(
+                      x, w, num_hidden=1024, no_bias=True),
+                      [_mk((256, 1024)), _mk((1024, 1024))]),
+                  2 * 256 * 1024 * 1024))
+    suite.append(("Convolution", (32, 64, 56, 56),
+                  lambda: (lambda x, w: _nd().Convolution(
+                      x, w, kernel=(3, 3), pad=(1, 1), num_filter=64,
+                      no_bias=True),
+                      [_mk((32, 64, 56, 56)), _mk((64, 64, 3, 3))]),
+                  2 * 32 * 64 * 64 * 9 * 56 * 56))
+    return suite
+
+
+def _nd():
+    import incubator_mxnet_tpu as mx
+    return mx.nd
+
+
+def _mk(shape, seed=3):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    return mx.nd.array(rng.standard_normal(shape).astype(np.float32))
+
+
+def time_op(fn, args, warmup=3, runs=20):
+    for _ in range(warmup):
+        out = fn(*args)
+        _wait(out)
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _wait(out)
+        samples.append(time.perf_counter() - t0)
+    return {
+        "avg_us": statistics.mean(samples) * 1e6,
+        "p50_us": statistics.median(samples) * 1e6,
+        "min_us": min(samples) * 1e6,
+        "max_us": max(samples) * 1e6,
+    }
+
+
+def _wait(out):
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        o.wait_to_read()
+
+
+def run_sweep(op_filter=None, warmup=3, runs=20):
+    results = []
+    for name, shape, build, flops in _default_suite():
+        if op_filter and name not in op_filter:
+            continue
+        fn, args = build()
+        rec = {"op": name, "shape": list(shape)}
+        try:
+            rec.update(time_op(fn, args, warmup=warmup, runs=runs))
+            if flops:
+                rec["gflops"] = flops / rec["p50_us"] / 1e3
+        except Exception as e:
+            rec["error"] = str(e)[:120]
+        results.append(rec)
+    return results
+
+
+def to_markdown(results):
+    lines = ["| op | shape | p50 (us) | avg (us) | GFLOP/s |",
+             "|---|---|---|---|---|"]
+    for r in results:
+        lines.append(
+            f"| {r['op']} | {tuple(r['shape'])} "
+            f"| {r.get('p50_us', float('nan')):.1f} "
+            f"| {r.get('avg_us', float('nan')):.1f} "
+            f"| {r.get('gflops', 0) or 0:.1f} |")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset of op names")
+    ap.add_argument("--output", choices=["json", "md"], default="json")
+    ap.add_argument("--ctx", choices=["default", "cpu"], default="default")
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    if args.ctx == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    ops = set(args.ops.split(",")) if args.ops else None
+    results = run_sweep(op_filter=ops, warmup=args.warmup, runs=args.runs)
+    import jax
+    dev = jax.devices()[0]
+    header = {"device": f"{dev.platform}:"
+                        f"{getattr(dev, 'device_kind', '')}"}
+    if args.output == "md":
+        print(f"opperf on {header['device']}\n")
+        print(to_markdown(results))
+    else:
+        print(json.dumps({"meta": header, "results": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
 """``mx.contrib`` — experimental / auxiliary subsystems (reference:
 python/mxnet/contrib/__init__.py)."""
 from . import amp
+from . import quantization
 
-__all__ = ["amp"]
+__all__ = ["amp", "quantization"]

@@ -1,0 +1,375 @@
+"""``mx.contrib.quantization`` — post-training INT8 quantization
+(reference: python/mxnet/contrib/quantization.py quantize_model /
+quantize_net; graph rewrite src/operator/quantization/
+quantize_graph_pass.cc; calibration calibrate.py — the fork owner's
+signature subsystem, built there on oneDNN INT8 kernels).
+
+TPU-native re-design:
+
+* Quantized compute lowers to int8 x int8 -> int32 ``lax.dot_general`` /
+  ``lax.conv_general_dilated`` with ``preferred_element_type=int32`` —
+  XLA maps these onto the MXU's native int8 path — followed by one fused
+  rescale (the reference's requantize/dequantize pair collapses into a
+  single fp multiplier since the output returns to fp32).
+* Weights are quantized per-output-channel, activations per-tensor from
+  calibration (reference: quantized_conv per-channel min/max).
+* Calibration modes: 'naive' (min/max over the calibration set) and
+  'entropy' (KL-optimal threshold over a 2048-bin histogram, reference:
+  calibrate.py _LayerHistogramCollector + _get_optimal_threshold).
+* The rewrite operates on Gluon blocks (``quantize_net``): Dense/Conv2D
+  children are swapped for Quantized* equivalents in place.  The
+  symbol-era ``quantize_model`` wraps the same machinery for
+  (sym, arg_params, aux_params) inputs via SymbolBlock import/export.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _invoke
+from ..gluon import nn as _gnn
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "quantize_model", "CalibrationCollector",
+           "QuantizedDense", "QuantizedConv2D"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# quantization math
+# ---------------------------------------------------------------------------
+def _quantize_weight_per_channel(w: _np.ndarray):
+    """int8 weight + per-output-channel fp32 scale (reference:
+    quantized ops' channel-wise min/max).  w: (out_ch, ...)."""
+    flat = _np.abs(w.reshape(w.shape[0], -1))
+    absmax = _np.maximum(flat.max(axis=1), 1e-12)
+    scale = (absmax / 127.0).astype(_np.float32)
+    q = _np.clip(_np.round(w / scale.reshape((-1,) + (1,) * (w.ndim - 1))),
+                 -127, 127).astype(_np.int8)
+    return q, scale
+
+
+def _entropy_threshold(hist: _np.ndarray, edges: _np.ndarray,
+                       num_quantized_bins: int = 255) -> float:
+    """KL-optimal |x| clipping threshold (reference: calibrate.py
+    _get_optimal_threshold, the TensorRT-style entropy calibration)."""
+    total = hist.sum()
+    if total == 0:
+        return float(edges[-1])
+    best_kl, best_t = _np.inf, float(edges[-1])
+    nbins = len(hist)
+    # candidate thresholds: every bin edge beyond the quantized bin count
+    for i in range(num_quantized_bins, nbins + 1, 8):
+        base = hist[:i].astype(_np.float64)
+        p = base.copy()
+        p[i - 1] += hist[i:].sum()   # reference P: outliers clip to edge
+        # candidate Q: the UNCLIPPED in-range mass quantized to
+        # num_quantized_bins levels and expanded back — clipping error
+        # then shows up as P-mass Q cannot express (TensorRT-style KL,
+        # reference: calibrate.py _get_optimal_threshold)
+        factor = i / num_quantized_bins
+        idx = _np.minimum((_np.arange(i) / factor).astype(_np.int64),
+                          num_quantized_bins - 1)
+        q_small = _np.zeros(num_quantized_bins)
+        _np.add.at(q_small, idx, base)
+        counts = _np.zeros(num_quantized_bins)
+        _np.add.at(counts, idx, (base > 0))
+        ratio = _np.divide(q_small, _np.maximum(counts, 1))
+        q = _np.where(base > 0, ratio[idx], 0.0)
+        p_sum, q_sum = p.sum(), q.sum()
+        if p_sum <= 0 or q_sum <= 0:
+            continue
+        eps = 1e-10   # smoothing so P-mass with zero Q is penalized
+        pn = p / p_sum
+        qn = _np.maximum(q / q_sum, eps)
+        mask = pn > 0
+        kl = float(_np.sum(pn[mask] * _np.log(pn[mask] / qn[mask])))
+        if kl < best_kl:
+            best_kl, best_t = kl, float(edges[i])
+    return best_t
+
+
+class CalibrationCollector:
+    """Collects per-layer activation statistics during calibration
+    forwards (reference: _LayerOutputMinMaxCollector /
+    _LayerHistogramCollector)."""
+
+    NBINS = 2048
+
+    def __init__(self, mode="naive"):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError(f"unknown calib_mode {mode!r}")
+        self.mode = mode
+        self.absmax: Dict[str, float] = {}
+        self.hists: Dict[str, _np.ndarray] = {}
+
+    def collect(self, name: str, arr: _np.ndarray):
+        amax = float(_np.abs(arr).max()) if arr.size else 0.0
+        self.absmax[name] = max(self.absmax.get(name, 0.0), amax)
+        if self.mode == "entropy":
+            h, _ = _np.histogram(_np.abs(arr), bins=self.NBINS,
+                                 range=(0, max(self.absmax[name], 1e-12)))
+            prev = self.hists.get(name)
+            # histograms over growing ranges are merged approximately by
+            # accumulating counts (range drift is second-order for calib)
+            self.hists[name] = h if prev is None else prev + h
+
+    def threshold(self, name: str) -> float:
+        amax = max(self.absmax.get(name, 0.0), 1e-12)
+        if self.mode == "naive" or name not in self.hists:
+            return amax
+        edges = _np.linspace(0, amax, self.NBINS + 1)
+        return _entropy_threshold(self.hists[name], edges)
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+_SUPPORTED_ACTS = (None, "relu", "sigmoid", "tanh", "softrelu",
+                   "softsign")
+
+
+def _apply_act(out, act_type):
+    import jax
+    import jax.numpy as jnp
+    if act_type is None:
+        return out
+    return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+            "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+            "softsign": jax.nn.soft_sign}[act_type](out)
+
+
+class _QuantizedBase(HybridBlock):
+    """Shared int8 machinery: frozen int8 weights + scales as constants."""
+
+    def __init__(self, w_q: _np.ndarray, w_scale: _np.ndarray,
+                 bias: Optional[_np.ndarray], act_scale: float, **kwargs):
+        super().__init__(**kwargs)
+        jnp = _jnp()
+        # frozen inference constants (not Parameters: no grads, no init)
+        self._wq = jnp.asarray(w_q)
+        self._wscale = jnp.asarray(w_scale, jnp.float32)
+        self._bias = None if bias is None else jnp.asarray(
+            bias, jnp.float32)
+        self._xscale = float(max(act_scale, 1e-12)) / 127.0
+
+    def _quantize_input(self, x):
+        jnp = _jnp()
+        q = jnp.clip(jnp.round(x / self._xscale), -127, 127)
+        return q.astype(jnp.int8)
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 FullyConnected (reference: quantized_fully_connected op).
+    y = (x_q @ w_q^T) * (s_x * s_w[c]) + b, accumulated in int32."""
+
+    def __init__(self, dense: "_gnn.Dense", act_scale: float, **kwargs):
+        w = dense.weight.data().asnumpy()
+        b = None if dense.bias is None else dense.bias.data().asnumpy()
+        w_q, w_scale = _quantize_weight_per_channel(w)
+        super().__init__(w_q, w_scale, b, act_scale, **kwargs)
+        self._units = dense._units
+        self._flatten = dense._flatten
+        if dense._act_type not in _SUPPORTED_ACTS:
+            raise MXNetError(
+                f"cannot quantize Dense with activation "
+                f"{dense._act_type!r}; exclude the layer instead")
+        self._act_type = dense._act_type
+
+    def hybrid_forward(self, F, x):
+        def run(xv):
+            import jax
+            jnp = _jnp()
+            orig_dtype = xv.dtype
+            xf = xv.astype(jnp.float32)
+            if self._flatten and xf.ndim > 2:
+                xf = xf.reshape(xf.shape[0], -1)
+            xq = jnp.clip(jnp.round(xf / self._xscale), -127,
+                          127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, self._wq, (((xf.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (self._xscale * self._wscale)
+            if self._bias is not None:
+                out = out + self._bias
+            out = _apply_act(out, self._act_type)
+            return out.astype(orig_dtype)
+        return _invoke(run, [x], name="quantized_dense",
+                       differentiable=False)
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 Convolution (reference: quantized_conv op — the oneDNN INT8
+    conv is the fork's flagship kernel; here XLA's int8 conv path)."""
+
+    def __init__(self, conv: "_gnn.Conv2D", act_scale: float, **kwargs):
+        w = conv.weight.data().asnumpy()
+        b = None if conv.bias is None else conv.bias.data().asnumpy()
+        w_q, w_scale = _quantize_weight_per_channel(w)
+        super().__init__(w_q, w_scale, b, act_scale, **kwargs)
+        if conv._act_type not in _SUPPORTED_ACTS:
+            raise MXNetError(
+                f"cannot quantize Conv2D with activation "
+                f"{conv._act_type!r}; exclude the layer instead")
+        self._strides = conv._strides
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._act_type = conv._act_type
+
+    def hybrid_forward(self, F, x):
+        def run(xv):
+            import jax
+            jnp = _jnp()
+            orig_dtype = xv.dtype
+            xf = xv.astype(jnp.float32)
+            xq = jnp.clip(jnp.round(xf / self._xscale), -127,
+                          127).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, self._wq,
+                window_strides=self._strides,
+                padding=[(p, p) for p in self._padding],
+                rhs_dilation=self._dilation,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=self._groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                self._xscale * self._wscale.reshape(1, -1, 1, 1))
+            if self._bias is not None:
+                out = out + self._bias.reshape(1, -1, 1, 1)
+            out = _apply_act(out, self._act_type)
+            return out.astype(orig_dtype)
+        return _invoke(run, [x], name="quantized_conv2d",
+                       differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# calibration + rewrite
+# ---------------------------------------------------------------------------
+def _quantizable_children(block, prefix=""):
+    for name, child in block._children.items():
+        full = f"{prefix}{name}"
+        if isinstance(child, (_gnn.Dense, _gnn.Conv2D)):
+            yield block, name, full, child
+        else:
+            yield from _quantizable_children(child, prefix=full + ".")
+
+
+def _calibrate(net, calib_data, collector, num_calib_batches=None,
+               names=None):
+    """Run fp32 forwards capturing each quantizable layer's INPUT
+    statistics via forward hooks."""
+    from .. import autograd as _ag
+    handles = []
+    try:
+        for _, _, full, child in _quantizable_children(net):
+            if names is not None and full not in names:
+                continue
+
+            def hook(blk, inputs, _out, _full=full):
+                x = inputs[0]
+                collector.collect(_full, x.asnumpy())
+            child.register_forward_hook(hook)
+            handles.append((child, hook))
+        with _ag.pause():
+            for i, batch in enumerate(calib_data):
+                if num_calib_batches is not None \
+                        and i >= num_calib_batches:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                if not isinstance(x, NDArray):
+                    from ..ndarray import ndarray as _ndmod
+                    x = _ndmod.array(_np.asarray(x))
+                net(x)
+    finally:
+        # remove only the calibration hooks; user hooks stay registered
+        for child, hook in handles:
+            child._forward_hooks.remove(hook)
+
+
+def quantize_net(network, quantized_dtype="int8", calib_data=None,
+                 calib_mode="naive", num_calib_batches=None,
+                 exclude_layers=None, exclude_layers_match=None,
+                 logger=None):
+    """Post-training INT8 quantization of a Gluon network IN PLACE
+    (reference: quantization.quantize_net).  Dense/Conv2D children are
+    replaced with int8 equivalents using activation scales calibrated
+    over ``calib_data`` (iterable of batches or (x, y) tuples).  Returns
+    the network."""
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("only int8 quantization is supported (uint8 "
+                         "offers no advantage on TPU's signed MXU path)")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    if calib_data is None:
+        raise MXNetError("calib_data is required: post-training "
+                         "quantization needs activation ranges")
+    exclude = set(exclude_layers or [])
+
+    targets = [(parent, name, full, child)
+               for parent, name, full, child in
+               _quantizable_children(network)
+               if full not in exclude
+               and not any(m in full for m in (exclude_layers_match or []))]
+    if not targets:
+        raise MXNetError("no quantizable (Dense/Conv2D) layers found")
+
+    collector = CalibrationCollector(calib_mode)
+    _calibrate(network, calib_data, collector,
+               num_calib_batches=num_calib_batches,
+               names={t[2] for t in targets})
+
+    for parent, name, full, child in targets:
+        thresh = collector.threshold(full)
+        if isinstance(child, _gnn.Conv2D):
+            q = QuantizedConv2D(child, thresh, prefix=child.prefix)
+        else:
+            q = QuantizedDense(child, thresh, prefix=child.prefix)
+        parent._children[name] = q
+        # keep the attribute view in sync when the child was set by name
+        if getattr(parent, "__dict__", {}).get(name) is child:
+            object.__setattr__(parent, name, q)
+    return network
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Symbol-era API (reference: quantization.quantize_model).  Wraps the
+    gluon rewrite: the symbol+params are imported into a SymbolBlock-style
+    net, quantized, and returned as a callable block (our symbolic
+    executor runs gluon blocks natively, so the (sym, args, aux) triple
+    round-trip is unnecessary)."""
+    from ..gluon.block import SymbolBlock
+    from ..symbol import var as _svar
+    inputs = [_svar(n) for n in data_names]
+    net = SymbolBlock(sym, inputs)
+    params = net.collect_params()
+    for k, v in {**(arg_params or {}), **(aux_params or {})}.items():
+        for name, p in params.items():
+            if name == k or name.endswith(k):
+                p.set_data(v)
+    if num_calib_examples is not None and calib_data is not None:
+        calib_data = _limit_examples(calib_data, num_calib_examples)
+    return quantize_net(net, quantized_dtype=quantized_dtype,
+                        calib_data=calib_data, calib_mode=calib_mode,
+                        exclude_layers=excluded_sym_names)
+
+
+def _limit_examples(data, n):
+    """Yield batches until ~n EXAMPLES were seen (reference:
+    num_calib_examples counts examples, not batches)."""
+    seen = 0
+    for b in data:
+        yield b
+        x = b[0] if isinstance(b, (tuple, list)) else b
+        seen += int(x.shape[0])
+        if seen >= n:
+            break

@@ -189,11 +189,13 @@ def _np_op(jfn, name):
     return fn
 
 
-# Names numpy kept but modern jax.numpy dropped → equivalent jnp function
-_JNP_ALIASES = {
-    "row_stack": "vstack",   # numpy: row_stack is an alias of vstack
-    "in1d": "isin",          # numpy renamed in1d -> isin
-}
+# Names numpy kept but modern jax.numpy dropped → equivalent jnp callable
+# (in1d flattens to 1-D per numpy semantics; isin preserves shape)
+def _jnp_aliases(jnp):
+    return {
+        "row_stack": jnp.vstack,  # numpy: row_stack aliases vstack
+        "in1d": lambda ar1, ar2, **kw: jnp.isin(ar1, ar2, **kw).ravel(),
+    }
 
 # The exported function surface.  Every name is a jax.numpy function with
 # NumPy semantics; wrappers record on the autograd tape when inputs do.
@@ -271,8 +273,7 @@ def _ensure_funcs():
             # removed from modern jax.numpy: resolve through the alias
             # table so every advertised name works (no phantom __all__
             # entries — from mx.np import * must succeed)
-            alias = _JNP_ALIASES.get(fname)
-            jfn = getattr(jnp, alias) if alias else None
+            jfn = _jnp_aliases(jnp).get(fname)
             if jfn is None:
                 continue
         if fname not in _THIS:

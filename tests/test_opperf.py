@@ -1,0 +1,23 @@
+"""opperf harness smoke (reference: benchmark/opperf/opperf.py)."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_opperf_runs_and_reports():
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "benchmark", "opperf", "opperf.py"),
+         "--ctx", "cpu", "--ops", "add,relu", "--runs", "3",
+         "--warmup", "1"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-1000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["results"], rec
+    for r in rec["results"]:
+        assert "error" not in r, r
+        assert r["p50_us"] > 0

@@ -1,0 +1,173 @@
+"""CPU-vs-TPU numerical consistency over the op corpus (reference:
+test_utils.check_consistency as used by tests/python/gpu/
+test_operator_gpu.py — the cross-device tier).  50+ ops, forward AND
+backward compared between the jax CPU backend and the live chip."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils as tu
+
+nd = mx.nd
+
+
+def _u(lo, hi, shape=(3, 4), seed=0):
+    rng = onp.random.default_rng(seed)
+    return (rng.random(shape) * (hi - lo) + lo).astype(onp.float32)
+
+
+_CTXS = None
+
+
+def _ctx_list():
+    global _CTXS
+    if _CTXS is None:
+        _CTXS = [mx.cpu(0), mx.tpu(0)]
+    return _CTXS
+
+
+# elementwise / unary — tight tolerance (VPU exact-ish)
+UNARY = [
+    ("abs", (-2, 2)), ("negative", (-2, 2)), ("reciprocal", (0.5, 2.0)),
+    ("square", (-2, 2)), ("sqrt", (0.2, 3.0)), ("rsqrt", (0.3, 3.0)),
+    ("cbrt", (0.2, 3.0)), ("exp", (-1, 1)), ("expm1", (-1, 1)),
+    ("log", (0.2, 3.0)), ("log1p", (-0.5, 2.0)), ("log2", (0.2, 3.0)),
+    ("log10", (0.2, 3.0)), ("sin", (-2, 2)), ("cos", (-2, 2)),
+    ("tan", (-1, 1)), ("arcsin", (-0.8, 0.8)), ("arccos", (-0.8, 0.8)),
+    ("arctan", (-2, 2)), ("sinh", (-1.5, 1.5)), ("cosh", (-1.5, 1.5)),
+    ("tanh", (-1.5, 1.5)), ("arcsinh", (-2, 2)), ("arctanh", (-0.7, 0.7)),
+    ("sigmoid", (-2, 2)), ("relu", (-2, 2)), ("gelu", (-2, 2)),
+    ("softsign", (-2, 2)), ("erf", (-1.5, 1.5)), ("gammaln", (0.5, 3.0)),
+    ("floor", (-2, 2)), ("ceil", (-2, 2)), ("round", (-2, 2)),
+    ("sign", (-2, 2)), ("square", (-3, 3)),
+]
+
+
+@pytest.mark.parametrize("name,domain", UNARY,
+                         ids=[f"{u[0]}_{i}" for i, u in enumerate(UNARY)])
+def test_unary_consistency(name, domain):
+    fn = getattr(nd, name)
+    grad = name not in ("floor", "ceil", "round", "sign")
+    tu.check_consistency(lambda x: fn(x), [_u(*domain, seed=2)],
+                         ctx_list=_ctx_list(), grad=grad,
+                         rtol=1e-4, atol=1e-5)
+
+
+BINARY = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+          "broadcast_add", "broadcast_mul", "broadcast_div", "hypot",
+          "power"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_consistency(name):
+    fn = getattr(nd, name)
+    tu.check_consistency(lambda a, b: fn(a, b),
+                         [_u(0.5, 2.0, seed=3), _u(0.5, 2.0, seed=4)],
+                         ctx_list=_ctx_list(), rtol=1e-4, atol=1e-5)
+
+
+REDUCTIONS = ["sum", "mean", "max", "min", "prod", "norm",
+              "nansum", "argmax", "argmin"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+def test_reduction_consistency(name):
+    fn = getattr(nd, name)
+    grad = name not in ("argmax", "argmin")
+    tu.check_consistency(lambda x: fn(x), [_u(0.2, 2.0, (4, 5), seed=5)],
+                         ctx_list=_ctx_list(), grad=grad,
+                         rtol=1e-4, atol=1e-4)
+
+
+# MXU-path ops: the TPU may accumulate differently — looser tolerance
+def test_dot_consistency():
+    tu.check_consistency(
+        lambda a, b: nd.dot(a, b),
+        [_u(-1, 1, (8, 16), seed=6), _u(-1, 1, (16, 4), seed=7)],
+        ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+
+
+def test_fully_connected_consistency():
+    tu.check_consistency(
+        lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=8),
+        [_u(-1, 1, (4, 16), seed=8), _u(-0.2, 0.2, (8, 16), seed=9),
+         _u(-0.1, 0.1, (8,), seed=10)],
+        ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+
+
+def test_convolution_consistency():
+    tu.check_consistency(
+        lambda x, w: mx.nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                       num_filter=4, no_bias=True),
+        [_u(-1, 1, (2, 3, 8, 8), seed=11),
+         _u(-0.3, 0.3, (4, 3, 3, 3), seed=12)],
+        ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+
+
+def test_softmax_family_consistency():
+    for fn in (nd.softmax, nd.log_softmax):
+        tu.check_consistency(lambda x, f=fn: f(x),
+                             [_u(-3, 3, (4, 7), seed=13)],
+                             ctx_list=_ctx_list(), rtol=1e-3, atol=1e-4)
+
+
+def test_batchnorm_consistency():
+    tu.check_consistency(
+        lambda x, g, b: mx.nd.BatchNorm(
+            x, g, b, mx.nd.zeros((3,)), mx.nd.ones((3,)),
+            fix_gamma=False),
+        [_u(-1, 1, (4, 3, 5, 5), seed=14), _u(0.5, 1.5, (3,), seed=15),
+         _u(-0.2, 0.2, (3,), seed=16)],
+        ctx_list=_ctx_list(), rtol=1e-3, atol=1e-3)
+
+
+def test_layernorm_consistency():
+    tu.check_consistency(
+        lambda x, g, b: mx.nd.LayerNorm(x, g, b),
+        [_u(-1, 1, (4, 8), seed=17), _u(0.5, 1.5, (8,), seed=18),
+         _u(-0.2, 0.2, (8,), seed=19)],
+        ctx_list=_ctx_list(), rtol=1e-3, atol=1e-3)
+
+
+def test_take_embedding_consistency():
+    x = _u(-1, 1, (10, 4), seed=20)
+    idx = onp.array([1, 3, 7], onp.float32)
+
+    def emb(w):
+        return mx.nd.Embedding(mx.nd.array(idx, dtype=onp.int32), w,
+                               input_dim=10, output_dim=4)
+    tu.check_consistency(emb, [x], ctx_list=_ctx_list(),
+                         rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_consistency():
+    """A whole LeNet-ish training step must match CPU within tolerance —
+    the end-to-end version of the per-op checks."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+    X = _u(-1, 1, (8, 1, 12, 12), seed=21)
+    Y = onp.arange(8, dtype=onp.float32) % 4
+    weights = {}
+    for ctx in _ctx_list():
+        with ctx:
+            mx.random.seed(7)
+            net = nn.HybridSequential()
+            net.add(nn.Conv2D(4, kernel_size=3, activation="relu"),
+                    nn.Flatten(), nn.Dense(4))
+            net.initialize(init=mx.init.Xavier())
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            for _ in range(3):
+                with mx.autograd.record():
+                    loss = loss_fn(net(mx.nd.array(X)),
+                                   mx.nd.array(Y)).mean()
+                loss.backward()
+                tr.step(8)
+            weights[str(ctx)] = {
+                k: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+    (k0, w0), (k1, w1) = weights.items()
+    for name in w0:
+        tu.assert_almost_equal(w0[name], w1[name], rtol=2e-2, atol=1e-3,
+                               names=(f"{name}@{k0}", f"{name}@{k1}"))
