@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot ops (SURVEY §7 stance: XLA fuses most
+of the graph; hand-written kernels only where the compiler's schedule
+leaves HBM bandwidth on the table — attention being the canonical case)."""
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

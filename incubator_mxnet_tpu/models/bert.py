@@ -72,14 +72,23 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
                     body, mesh=mesh, in_specs=(spec, spec, spec),
                     out_specs=spec, check_vma=False)(qh, kh, vh)
         else:
-            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-            if rest:
-                s = jnp.where(rest[0][:, None, None, :] > 0, s, -1e30)
-            m = jnp.max(s, axis=-1, keepdims=True)
-            p = jnp.exp(s - m)
-            l = jnp.sum(p, axis=-1, keepdims=True)
-            out = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(vh.dtype),
-                             vh)
+            import os
+            if not rest and os.environ.get("MXNET_USE_FUSION", "0") == "1":
+                # Pallas flash-attention kernel (reference env-var parity:
+                # MXNET_USE_FUSION gates the fused-kernel tier,
+                # src/operator/fusion/fused_op.cc); opt-in until the
+                # kernel is profiled on the real chip
+                from ..kernels import flash_attention
+                out = flash_attention(qh, kh, vh, scale=scale)
+            else:
+                s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+                if rest:
+                    s = jnp.where(rest[0][:, None, None, :] > 0, s, -1e30)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.exp(s - m)
+                l = jnp.sum(p, axis=-1, keepdims=True)
+                out = jnp.einsum("bhqk,bhkd->bhqd",
+                                 (p / l).astype(vh.dtype), vh)
         return out.transpose(0, 2, 1, 3).reshape(B, -1, C)
     return _invoke(fn, inputs, name="sdpa")
 
