@@ -1,0 +1,145 @@
+"""Asynchronous, preemption-safe checkpointing (SURVEY §5.3: the
+reference's recovery story is restart-from-epoch-checkpoint via
+Module.fit callbacks — python/mxnet/callback.py do_checkpoint,
+model.py save_checkpoint; this module EXCEEDS that with the
+goodput-relevant pieces a pod run needs):
+
+* **async**: the device→host copy happens on the caller's thread (cheap,
+  and required — arrays must be snapshotted before the next step mutates
+  them), the file write happens on a background thread so the train loop
+  never blocks on storage;
+* **atomic**: writes go to a temp file + os.replace, so a preemption
+  mid-write never corrupts the newest checkpoint;
+* **retention**: keep the last k checkpoints (default 3);
+* **resume**: ``latest_checkpoint`` finds the newest complete step.
+
+Format: the same reference-compatible ``.params`` container
+(ndarray/utils.save) everything else uses, named ``<prefix>-NNNNNNN.params``
+— readable by load_checkpoint/load_parameters tooling.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["AsyncCheckpointer", "latest_checkpoint"]
+
+
+def _step_path(prefix: str, step: int) -> str:
+    return f"{prefix}-{step:07d}.params"
+
+
+_STEP_RE = re.compile(r"-(\d{7})\.params$")
+
+
+def latest_checkpoint(prefix: str) -> Optional[int]:
+    """Newest complete checkpoint step for ``prefix``, or None."""
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    best = None
+    if not os.path.isdir(d):
+        return None
+    for name in os.listdir(d):
+        if not name.startswith(base):
+            continue
+        m = _STEP_RE.search(name)
+        if m:
+            step = int(m.group(1))
+            best = step if best is None else max(best, step)
+    return best
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer.
+
+    Usage::
+
+        ckpt = AsyncCheckpointer("ckpt/model", keep=3)
+        for step, batch in enumerate(loader):
+            ...train...
+            if step % 500 == 0:
+                ckpt.save(step, {name: p.data() for name, p in params})
+        ckpt.wait_until_finished()    # before exit
+    """
+
+    def __init__(self, prefix: str, keep: int = 3):
+        d = os.path.dirname(prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._prefix = prefix
+        self._keep = max(1, int(keep))
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._saved_steps: List[int] = []
+        lt = latest_checkpoint(prefix)
+        if lt is not None:
+            self._saved_steps.append(lt)
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, params: Dict[str, NDArray]):
+        """Snapshot ``params`` and write asynchronously.  Raises any error
+        from the PREVIOUS save (errors never vanish silently)."""
+        self.wait_until_finished()
+        # snapshot on the caller's thread: after return the trainer may
+        # mutate the arrays freely
+        snap = {}
+        for k, v in params.items():
+            if isinstance(v, NDArray):
+                snap[k] = v.asnumpy().copy()
+            else:
+                snap[k] = _np.asarray(v).copy()
+        self._thread = threading.Thread(
+            target=self._write, args=(step, snap), daemon=True)
+        self._thread.start()
+
+    def _write(self, step: int, snap: Dict[str, _np.ndarray]):
+        try:
+            from .ndarray import ndarray as _ndmod
+            from .ndarray import utils as nd_utils
+            final = _step_path(self._prefix, step)
+            tmp = f"{final}.tmp-{os.getpid()}"
+            arrs = {k: _ndmod.array(v, dtype=v.dtype)
+                    for k, v in snap.items()}
+            nd_utils.save(tmp, arrs)
+            os.replace(tmp, final)    # atomic publish
+            self._saved_steps.append(step)
+            self._gc()
+        except BaseException as e:   # surfaced on the next save()/wait
+            self._error = e
+
+    def _gc(self):
+        self._saved_steps.sort()
+        while len(self._saved_steps) > self._keep:
+            step = self._saved_steps.pop(0)
+            try:
+                os.unlink(_step_path(self._prefix, step))
+            except OSError:
+                pass
+
+    def wait_until_finished(self):
+        """Block until the in-flight write completes; re-raise its error."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise MXNetError(f"async checkpoint write failed: {err}") \
+                from err
+
+    # ------------------------------------------------------------------
+    def restore(self, step: Optional[int] = None) -> Dict[str, NDArray]:
+        """Load the checkpoint at ``step`` (default: newest)."""
+        from .ndarray import utils as nd_utils
+        if step is None:
+            step = latest_checkpoint(self._prefix)
+            if step is None:
+                raise MXNetError(
+                    f"no checkpoint found for prefix {self._prefix!r}")
+        return nd_utils.load(_step_path(self._prefix, step))

@@ -2,5 +2,8 @@
 python/mxnet/contrib/__init__.py)."""
 from . import amp
 from . import quantization
+from . import text
+from . import svrg_optimization
+from . import hvd
 
-__all__ = ["amp", "quantization"]
+__all__ = ["amp", "quantization", "text", "svrg_optimization", "hvd"]

@@ -1,0 +1,106 @@
+"""Horovod-shaped shim (SURVEY §2.4: the reference integrates Horovod at
+the Trainer level — hvd.init/rank/size + hvd.DistributedTrainer +
+broadcast_parameters, example/distributed_training-horovod/).  Code
+written against that surface runs here unchanged: the MPI/NCCL allreduce
+becomes the same XLA-collective path the dist KVStore uses.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
+           "allreduce", "allgather", "broadcast_parameters",
+           "DistributedTrainer"]
+
+
+def init():
+    """hvd.init() — bootstrap the multi-process runtime (DMLC/OMPI env
+    vars both work; single-process is a no-op)."""
+    import os
+    from ..parallel import distributed as dist
+    if "OMPI_COMM_WORLD_RANK" in os.environ and \
+            "DMLC_WORKER_ID" not in os.environ:
+        # accept Open MPI's env the way horovod's launcher sets it
+        os.environ.setdefault("DMLC_WORKER_ID",
+                              os.environ["OMPI_COMM_WORLD_RANK"])
+        os.environ.setdefault("DMLC_NUM_WORKER",
+                              os.environ.get("OMPI_COMM_WORLD_SIZE", "1"))
+    dist.initialize()
+
+
+def shutdown():
+    from ..parallel import distributed as dist
+    dist.shutdown()
+
+
+def rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def size() -> int:
+    import jax
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    return 0     # one process per host in the SPMD model
+
+
+def local_size() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def allreduce(tensor, average=True, name=None):
+    """Sum (or mean) a tensor across processes (hvd.allreduce)."""
+    if not isinstance(tensor, NDArray):
+        raise MXNetError("hvd.allreduce expects an NDArray")
+    n = size()
+    if n == 1:
+        return tensor.copy()
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(tensor._data).sum(axis=0)
+    if average:
+        out = out / n
+    return NDArray(out, ctx=tensor.ctx)
+
+
+def allgather(tensor, name=None):
+    if not isinstance(tensor, NDArray):
+        raise MXNetError("hvd.allgather expects an NDArray")
+    if size() == 1:
+        return tensor.copy()
+    from jax.experimental import multihost_utils
+    return NDArray(
+        multihost_utils.process_allgather(tensor._data, tiled=True),
+        ctx=tensor.ctx)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Everyone adopts root's parameter values (hvd.broadcast_parameters;
+    same DCN path as KVStore init)."""
+    if size() == 1:
+        return
+    from jax.experimental import multihost_utils
+    items = params.items() if hasattr(params, "items") else params
+    for _, p in items:
+        data = p.data() if hasattr(p, "data") and callable(p.data) else p
+        gathered = multihost_utils.process_allgather(data._data)
+        data._set_data(gathered[root_rank])
+
+
+class DistributedTrainer:
+    """hvd.DistributedTrainer workalike: gluon Trainer + pre-update
+    gradient allreduce (the reference subclass lives in the horovod repo;
+    here dist aggregation is the 'dist_sync' KVStore path)."""
+
+    def __new__(cls, params, optimizer, optimizer_params=None, **kwargs):
+        from ..gluon.trainer import Trainer
+        optimizer_params = dict(optimizer_params or {})
+        # horovod semantics: grads are AVERAGED over workers
+        scale = optimizer_params.get("rescale_grad", 1.0)
+        optimizer_params["rescale_grad"] = scale / max(size(), 1)
+        return Trainer(params, optimizer, optimizer_params,
+                       kvstore="dist_sync", **kwargs)

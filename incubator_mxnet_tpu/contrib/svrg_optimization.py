@@ -1,0 +1,151 @@
+"""SVRG optimization (reference:
+python/mxnet/contrib/svrg_optimization/svrg_module.py + svrg_optimizer.py
+— Stochastic Variance-Reduced Gradient, Johnson & Zhang 2013).
+
+The update uses a control variate built from a periodic full-dataset
+gradient snapshot: ``g_svrg = g(w) - g_snap(w_snap) + mu`` where ``mu`` is
+the full gradient at the snapshot weights.  The reference composes two
+Modules (live + snapshot) bound to the same symbol; the same composition
+works here — the snapshot module re-runs each batch at the frozen weights
+to get ``g_snap(w_snap)`` per batch.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..module.module import Module
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG updates (reference: SVRGModule).
+
+    update_freq: take a new full-gradient snapshot every this many
+    epochs (call :meth:`update_full_grads` accordingly — ``fit`` does it
+    automatically).
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None,
+                 update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, context=context,
+                         **kwargs)
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = int(update_freq)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, context=context)
+        self._mu: Dict[str, _np.ndarray] = {}
+        self._has_snapshot = False
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, **kwargs):
+        super().bind(data_shapes, label_shapes=label_shapes,
+                     for_training=for_training,
+                     inputs_need_grad=inputs_need_grad, **kwargs)
+        self._mod_aux.bind(data_shapes, label_shapes=label_shapes,
+                           for_training=True)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        self._sync_aux_params()
+
+    def _sync_aux_params(self):
+        # deep-copy: the snapshot must FREEZE the weights — sharing the
+        # live buffers would make g_snap track the live gradient and the
+        # control variate collapse to the stale full gradient
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(
+            arg_params={k: v.copy() for k, v in arg.items()},
+            aux_params={k: v.copy() for k, v in aux.items()},
+            force_init=True, allow_missing=False)
+
+    # ------------------------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Snapshot the current weights into the aux module and compute
+        the full-dataset gradient ``mu`` at them (reference:
+        SVRGModule.update_full_grads)."""
+        self._sync_aux_params()
+        acc: Dict[str, _np.ndarray] = {}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name, grad in self._mod_aux._exec.grad_dict.items():
+                if grad is None:
+                    continue
+                g = grad.asnumpy()
+                acc[name] = g if name not in acc else acc[name] + g
+            nbatch += 1
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        self._mu = {k: v / nbatch for k, v in acc.items()}
+        self._has_snapshot = True
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """Batch grads on BOTH modules: live weights and snapshot weights
+        (the latter feeds the control variate in :meth:`update`)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._has_snapshot:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+
+    def update(self):
+        """Apply the SVRG-corrected gradient through the optimizer
+        (reference: _SVRGOptimizer rewrites the grad before the base
+        update)."""
+        if self._has_snapshot:
+            import jax.numpy as jnp
+            for name, grad in self._exec.grad_dict.items():
+                if grad is None or name not in self._mu:
+                    continue
+                g_snap = self._mod_aux._exec.grad_dict.get(name)
+                if g_snap is None:
+                    continue
+                corrected = (grad._data - g_snap._data
+                             + jnp.asarray(self._mu[name]))
+                grad._set_data(corrected)
+        super().update()
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, batch_end_callback=None,
+            epoch_end_callback=None, **kwargs):
+        """Training loop with automatic periodic snapshots (reference:
+        SVRGModule.fit)."""
+        from .. import metric as metric_mod
+        if not self.binded:
+            first = next(iter(train_data))
+            raise MXNetError("fit: bind() the module first")
+        if not self.params_initialized:
+            self.init_params(initializer=initializer)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        eval_metric = metric_mod.create(eval_metric) \
+            if isinstance(eval_metric, str) else eval_metric
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(epoch=epoch, nbatch=nbatch)
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch=epoch)
+        return eval_metric

@@ -1,0 +1,10 @@
+"""``mx.contrib.text`` — text vocabulary + token-embedding utilities
+(reference: python/mxnet/contrib/text/{vocab,embedding,utils}.py)."""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary
+from .embedding import TokenEmbedding, CustomEmbedding, CompositeEmbedding
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding"]

@@ -1,0 +1,193 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py —
+TokenEmbedding base + GloVe/FastText loaders + CustomEmbedding +
+CompositeEmbedding).
+
+Zero-egress environment note: the reference's pretrained downloads cannot
+run here; loaders read the same text format (``token v1 v2 ... vD`` per
+line) from LOCAL files via ``CustomEmbedding`` / ``from_file``.  The
+vector store is a numpy matrix on host — lookup results are NDArrays, so
+they enter the device path only when used.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import List, Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import ndarray as _ndmod
+from .vocab import Vocabulary
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "get_pretrained_file_names"]
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """reference: embedding.get_pretrained_file_names.  Downloads are
+    unavailable in this environment — documented, not silently empty."""
+    raise MXNetError(
+        "pretrained embedding downloads are unavailable (zero-egress "
+        "environment); load a local file with "
+        "CustomEmbedding(pretrained_file_path=...)")
+
+
+class TokenEmbedding:
+    """Indexed token→vector store (reference: embedding.TokenEmbedding).
+
+    idx 0 is the unknown token, initialized by ``init_unknown_vec``
+    (zeros by default, matching the reference)."""
+
+    def __init__(self, unknown_token="<unk>", init_unknown_vec=None):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec or _np.zeros
+        self._idx_to_token: List[str] = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec: Optional[_np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _load_embedding_txt(self, path, elem_delim=" ", encoding="utf8"):
+        if not os.path.isfile(path):
+            raise MXNetError(f"embedding file not found: {path}")
+        vecs = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue   # blank/malformed line
+                if lineno == 0 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
+                    continue   # fastText-style "N D" header
+                token, elems = parts[0], parts[1:]
+                try:
+                    vec = _np.asarray([float(e) for e in elems],
+                                      _np.float32)
+                except ValueError:
+                    continue
+                if dim is None:
+                    dim = len(vec)
+                elif len(vec) != dim:
+                    raise MXNetError(
+                        f"inconsistent embedding dim at line {lineno} "
+                        f"of {path}: {len(vec)} vs {dim}")
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(vec)
+        if dim is None:
+            raise MXNetError(f"no vectors parsed from {path}")
+        unk = self._init_unknown_vec((dim,)).astype(_np.float32)
+        self._idx_to_vec = _np.vstack([unk[None, :]] + [v[None, :]
+                                                        for v in vecs])
+
+    # ------------------------------------------------------------------
+    @property
+    def vec_len(self) -> int:
+        self._check_loaded()
+        return self._idx_to_vec.shape[1]
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        self._check_loaded()
+        return _ndmod.array(self._idx_to_vec)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def _check_loaded(self):
+        if self._idx_to_vec is None:
+            raise MXNetError("embedding vectors not loaded")
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Token(s) → vector(s); unknown tokens get the unk vector
+        (reference: TokenEmbedding.get_vecs_by_tokens)."""
+        self._check_loaded()
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idxs.append(0 if i is None else i)
+        mat = self._idx_to_vec[_np.asarray(idxs, _np.int64)]
+        return _ndmod.array(mat[0] if single else mat)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors for known tokens (reference:
+        update_token_vectors)."""
+        self._check_loaded()
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        new = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors, _np.float32)
+        if single:
+            new = new.reshape(1, -1)
+        if new.shape != (len(toks), self.vec_len):
+            raise MXNetError(
+                f"new_vectors shape {new.shape} != "
+                f"({len(toks)}, {self.vec_len})")
+        for t, v in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not in the embedding")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Load embeddings from a local ``token v1 ... vD`` text file
+    (reference: embedding.CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary: Optional[Vocabulary] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding)
+        if vocabulary is not None:
+            self._restrict_to_vocab(vocabulary)
+
+    def _restrict_to_vocab(self, vocabulary: Vocabulary):
+        """Re-index to a vocabulary's tokens (reference behavior when a
+        vocabulary is supplied: indices follow the vocabulary)."""
+        dim = self.vec_len
+        vecs = _np.zeros((len(vocabulary), dim), _np.float32)
+        for tok, i in vocabulary.token_to_idx.items():
+            j = self._token_to_idx.get(tok)
+            if j is not None:
+                vecs[i] = self._idx_to_vec[j]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_vec = vecs
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference:
+    embedding.CompositeEmbedding)."""
+
+    def __init__(self, vocabulary: Vocabulary, token_embeddings):
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            emb._check_loaded()
+            mat = _np.zeros((len(vocabulary), emb.vec_len), _np.float32)
+            for tok, i in vocabulary.token_to_idx.items():
+                j = emb._token_to_idx.get(tok)
+                if j is not None:
+                    mat[i] = emb._idx_to_vec[j]
+            parts.append(mat)
+        self._idx_to_vec = _np.concatenate(parts, axis=1)

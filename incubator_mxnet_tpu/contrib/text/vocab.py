@@ -1,0 +1,80 @@
+"""Vocabulary (reference: python/mxnet/contrib/text/vocab.py
+Vocabulary — frequency-ordered indexing with reserved tokens and an
+unknown-token slot at index 0)."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by frequency (reference semantics: index 0 is the
+    unknown token; then reserved tokens; then corpus tokens sorted by
+    descending frequency, ties broken alphabetically)."""
+
+    def __init__(self, counter: Optional[Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[List[str]] = None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token must not be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t
+                              in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index(es); unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"index {i} out of vocabulary range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

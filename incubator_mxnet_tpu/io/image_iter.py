@@ -58,6 +58,7 @@ class ImageRecordIter(DataIter):
         self._pool = ThreadPoolExecutor(max_workers=max(
             1, int(preprocess_threads)))
 
+        from .. import native as _native
         if path_imgidx is None:
             guess = os.path.splitext(path_imgrec)[0] + ".idx"
             path_imgidx = guess if os.path.isfile(guess) else None
@@ -68,7 +69,6 @@ class ImageRecordIter(DataIter):
         else:
             # no sidecar index: build in-memory offsets — the native C
             # scanner when available, else one Python pass
-            from .. import native as _native
             self._record = None
             self._positions = _native.scan_index(path_imgrec)
             if self._positions is None:
@@ -82,7 +82,6 @@ class ImageRecordIter(DataIter):
         self._path_imgrec = path_imgrec
         # one shared native reader (pread: thread-safe, no cursor) when
         # the C core builds; per-thread Python handles otherwise
-        from .. import native as _native
         try:
             self._native_reader = _native.NativeRecordReader(path_imgrec)
         except OSError:

@@ -13,7 +13,13 @@ Scope/contract:
   usual first deployment step for custom kernels);
 * dense (non-causal or causal) attention, no additive mask — callers with
   masks use the XLA path;
-* seq_len must divide by the block size; callers fall back otherwise;
+* K/V for one (batch, head) stay VMEM-resident and are block-streamed
+  from there, so the (T, T) score matrix never exists but T is bounded
+  by the VMEM budget (~8MB for K+V).  Longer sequences fall back to XLA
+  here; the genuinely long-context path is ring attention over the mesh
+  (parallel/ring.py), which shards T before kernels even run;
+* the Pallas path engages only for TPU-tile-aligned shapes (T a multiple
+  of 128); everything else falls back to XLA;
 * on CPU backends the kernel runs in interpret mode, which keeps the
   numerics testable everywhere (tests/test_flash_attention.py).
 """
@@ -143,8 +149,9 @@ def flash_attention(q, k, v, scale=None, causal=False):
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
-    block = min(_BLOCK_Q, T)
-    if T % block or block < 8:
+    kv_bytes = 2 * T * D * q.dtype.itemsize
+    if T % _BLOCK_Q or kv_bytes > 8 * 2 ** 20:
+        # not tile-aligned, or K+V would blow the VMEM budget: XLA path
         return _xla_attention(
             q.reshape(B * H, T, D), k.reshape(B * H, T, D),
             v.reshape(B * H, T, D), scale, causal).reshape(B, H, T, D)

@@ -38,16 +38,17 @@ def _build() -> bool:
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=180)
-        if out.returncode != 0 or not os.path.isfile(tmp):
-            return False
-        os.replace(tmp, _SO)
-        return True
+        if out.returncode == 0 and os.path.isfile(tmp):
+            os.replace(tmp, _SO)
+            return True
+        return False
     except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
         try:
             os.unlink(tmp)
         except OSError:
             pass
-        return False
 
 
 def load():

@@ -110,6 +110,10 @@ int rio_read_at(void* h, int64_t offset, uint8_t** out, int64_t* out_len) {
     return rc;
   }
   *out = static_cast<uint8_t*>(std::malloc(buf.size() ? buf.size() : 1));
+  if (*out == nullptr) {
+    *out_len = 0;
+    return -6;  // allocation failure -> catchable IOError, not a segfault
+  }
   std::memcpy(*out, buf.data(), buf.size());
   *out_len = static_cast<int64_t>(buf.size());
   return 0;
@@ -142,6 +146,7 @@ int64_t rio_scan_index(const char* path, int64_t** out) {
   rio_close(h);
   *out = static_cast<int64_t*>(
       std::malloc(sizeof(int64_t) * (offsets.empty() ? 1 : offsets.size())));
+  if (*out == nullptr) return -6;
   std::memcpy(*out, offsets.data(), sizeof(int64_t) * offsets.size());
   return static_cast<int64_t>(offsets.size());
 }
@@ -168,6 +173,11 @@ int rio_read_many(void* h, const int64_t* offsets, int64_t n,
       }
       bufs[i] = static_cast<uint8_t*>(
           std::malloc(buf.size() ? buf.size() : 1));
+      if (bufs[i] == nullptr) {
+        rcs[t] = -6;
+        lens[i] = 0;
+        continue;
+      }
       std::memcpy(bufs[i], buf.data(), buf.size());
       lens[i] = static_cast<int64_t>(buf.size());
     }

@@ -1,0 +1,220 @@
+"""Tests for async checkpointing, the hvd shim, SVRG, and contrib.text
+(reference: tests/python/unittest/test_contrib_svrg_module.py,
+test_contrib_text.py; the checkpoint subsystem exceeds the reference's
+restart-from-epoch story per SURVEY §5.3)."""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.checkpoint import AsyncCheckpointer, \
+    latest_checkpoint
+from incubator_mxnet_tpu.contrib import hvd, text
+from incubator_mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+# ---------------------------------------------------------------------------
+def test_async_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "run" / "model")
+    ckpt = AsyncCheckpointer(prefix, keep=2)
+    params = {"w": mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)),
+              "b": mx.nd.array(np.ones(3, np.float32))}
+    ckpt.save(100, params)
+    ckpt.wait_until_finished()
+    assert latest_checkpoint(prefix) == 100
+    loaded = ckpt.restore()
+    np.testing.assert_allclose(loaded["w"].asnumpy(),
+                               params["w"].asnumpy())
+
+
+def test_async_checkpoint_snapshot_isolation(tmp_path):
+    """Mutating a param after save() must not corrupt the checkpoint —
+    the snapshot happens before save returns."""
+    prefix = str(tmp_path / "m")
+    ckpt = AsyncCheckpointer(prefix)
+    w = mx.nd.array(np.ones(4, np.float32))
+    ckpt.save(1, {"w": w})
+    w[:] = 999.0           # trainer keeps going
+    ckpt.wait_until_finished()
+    np.testing.assert_allclose(ckpt.restore(1)["w"].asnumpy(),
+                               np.ones(4))
+
+
+def test_async_checkpoint_retention(tmp_path):
+    prefix = str(tmp_path / "m")
+    ckpt = AsyncCheckpointer(prefix, keep=2)
+    for step in (1, 2, 3, 4):
+        ckpt.save(step, {"w": mx.nd.array([float(step)])})
+    ckpt.wait_until_finished()
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["m-0000003.params", "m-0000004.params"]
+
+
+def test_async_checkpoint_atomic_no_tmp_left(tmp_path):
+    prefix = str(tmp_path / "m")
+    ckpt = AsyncCheckpointer(prefix)
+    ckpt.save(7, {"w": mx.nd.ones((2,))})
+    ckpt.wait_until_finished()
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_async_checkpoint_resume_after_restart(tmp_path):
+    prefix = str(tmp_path / "m")
+    c1 = AsyncCheckpointer(prefix)
+    c1.save(5, {"w": mx.nd.array([5.0])})
+    c1.wait_until_finished()
+    c2 = AsyncCheckpointer(prefix)    # "restarted process"
+    assert latest_checkpoint(prefix) == 5
+    np.testing.assert_allclose(c2.restore()["w"].asnumpy(), [5.0])
+
+
+# ---------------------------------------------------------------------------
+# hvd shim (single process: collectives are identities)
+# ---------------------------------------------------------------------------
+def test_hvd_single_process_semantics():
+    hvd.init()
+    assert hvd.rank() == 0 and hvd.size() == 1
+    x = mx.nd.array([2.0, 4.0])
+    np.testing.assert_allclose(hvd.allreduce(x).asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(hvd.allgather(x).asnumpy(), [2.0, 4.0])
+
+
+def test_hvd_distributed_trainer_is_trainer():
+    from incubator_mxnet_tpu.gluon.trainer import Trainer
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    tr = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+    assert isinstance(tr, Trainer)
+    with mx.autograd.record():
+        loss = (net(mx.nd.ones((2, 4))) ** 2).sum()
+    loss.backward()
+    tr.step(2)     # runs through the dist_sync path
+
+
+# ---------------------------------------------------------------------------
+# SVRG
+# ---------------------------------------------------------------------------
+def _linreg_iter(n=64, batch=16, seed=0):
+    from incubator_mxnet_tpu.io.io import NDArrayIter
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = X @ w
+    return NDArrayIter(X, y, batch_size=batch), X, y
+
+
+def test_svrg_module_converges():
+    import incubator_mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    label = sym.var("lin_label")
+    pred = sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = sym.LinearRegressionOutput(pred, label, name="lin")
+    it, X, y = _linreg_iter()
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=2)
+    mod.bind(data_shapes=[("data", (16, 4))],
+             label_shapes=[("lin_label", (16,))])
+    mod.init_params(initializer=mx.init.Zero())
+    # 0.02: SVRG's variance-reduced steps need a smaller lr than plain
+    # SGD tolerates on this problem (full-gradient term has no noise to
+    # average out)
+    mod.fit(it, eval_metric="mse", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.02),), num_epoch=12)
+    w_learned = mod.get_params()[0]["fc_weight"].asnumpy().ravel()
+    np.testing.assert_allclose(w_learned, [1.0, -2.0, 0.5, 3.0],
+                               rtol=0.1, atol=0.1)
+
+
+def test_svrg_control_variate_zero_at_snapshot():
+    """Right after a snapshot with identical weights, the corrected grad
+    for a FULL-dataset batch equals the full gradient mu."""
+    import incubator_mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    label = sym.var("lin_label")
+    out = sym.LinearRegressionOutput(
+        sym.FullyConnected(data, num_hidden=1, name="fc"), label,
+        name="lin")
+    it, X, y = _linreg_iter(n=16, batch=16)
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=1)
+    mod.bind(data_shapes=[("data", (16, 4))],
+             label_shapes=[("lin_label", (16,))])
+    mod.init_params(initializer=mx.init.One())
+    mod.update_full_grads(it)
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    g_live = mod._exec.grad_dict["fc_weight"].asnumpy()
+    g_snap = mod._mod_aux._exec.grad_dict["fc_weight"].asnumpy()
+    np.testing.assert_allclose(g_live, g_snap, rtol=1e-5)
+    np.testing.assert_allclose(g_live, mod._mu["fc_weight"], rtol=1e-5,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# contrib.text
+# ---------------------------------------------------------------------------
+def test_vocabulary_ordering():
+    c = Counter({"b": 3, "a": 3, "c": 1, "d": 5})
+    v = text.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "a", "b"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["zzz", "a"]) == [0, 3]
+    assert v.to_tokens([0, 2]) == ["<unk>", "d"]
+
+
+def test_count_tokens():
+    c = text.utils.count_tokens_from_str("Life is life\nis good",
+                                         to_lower=True)
+    assert c["life"] == 2 and c["is"] == 2 and c["good"] == 1
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    # unknown → zeros
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("nope").asnumpy(), [0, 0, 0])
+    emb.update_token_vectors("hello", mx.nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens(["hello", "world"]).asnumpy(),
+        [[9, 9, 9], [4, 5, 6]])
+
+
+def test_embedding_with_vocabulary_indexing(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("x 1.0 1.0\ny 2.0 2.0\nz 3.0 3.0\n")
+    v = text.Vocabulary(Counter({"y": 2, "x": 1}))
+    emb = text.CustomEmbedding(str(p), vocabulary=v)
+    assert len(emb) == len(v)
+    # index order follows the vocabulary: <unk>, y, x
+    np.testing.assert_allclose(emb.idx_to_vec.asnumpy(),
+                               [[0, 0], [2, 2], [1, 1]])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("tok 1.0 2.0\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("tok 3.0\n")
+    v = text.Vocabulary(Counter({"tok": 1}))
+    comp = text.CompositeEmbedding(v, [text.CustomEmbedding(str(p1)),
+                                       text.CustomEmbedding(str(p2))])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("tok").asnumpy(), [1, 2, 3])
+
+
+def test_pretrained_download_refused():
+    with pytest.raises(mx.base.MXNetError):
+        text.embedding.get_pretrained_file_names("glove")
