@@ -36,20 +36,18 @@ def _step_path(prefix: str, step: int) -> str:
     return f"{prefix}-{step:07d}.params"
 
 
-_STEP_RE = re.compile(r"-(\d{7})\.params$")
-
-
 def latest_checkpoint(prefix: str) -> Optional[int]:
     """Newest complete checkpoint step for ``prefix``, or None."""
     d = os.path.dirname(prefix) or "."
     base = os.path.basename(prefix)
+    # exact-prefix anchor: 'm' must not match 'model-*'; 7+ digits so
+    # steps >= 10^7 (which format wider than the zero-padding) still parse
+    pat = re.compile(rf"^{re.escape(base)}-(\d{{7,}})\.params$")
     best = None
     if not os.path.isdir(d):
         return None
     for name in os.listdir(d):
-        if not name.startswith(base):
-            continue
-        m = _STEP_RE.search(name)
+        m = pat.match(name)
         if m:
             step = int(m.group(1))
             best = step if best is None else max(best, step)
@@ -101,13 +99,12 @@ class AsyncCheckpointer:
 
     def _write(self, step: int, snap: Dict[str, _np.ndarray]):
         try:
-            from .ndarray import ndarray as _ndmod
             from .ndarray import utils as nd_utils
             final = _step_path(self._prefix, step)
             tmp = f"{final}.tmp-{os.getpid()}"
-            arrs = {k: _ndmod.array(v, dtype=v.dtype)
-                    for k, v in snap.items()}
-            nd_utils.save(tmp, arrs)
+            # host numpy straight into the container format — no
+            # host->device->host round trip on the background thread
+            nd_utils.save(tmp, snap)
             os.replace(tmp, final)    # atomic publish
             self._saved_steps.append(step)
             self._gc()

@@ -5,5 +5,7 @@ from . import quantization
 from . import text
 from . import svrg_optimization
 from . import hvd
+from . import onnx
 
-__all__ = ["amp", "quantization", "text", "svrg_optimization", "hvd"]
+__all__ = ["amp", "quantization", "text", "svrg_optimization", "hvd",
+           "onnx"]

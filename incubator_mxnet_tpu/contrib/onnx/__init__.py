@@ -1,0 +1,9 @@
+"""``mx.contrib.onnx`` — ONNX export/import (reference:
+python/mxnet/contrib/onnx: mx2onnx export_model + onnx2mx
+import_model/import_to_gluon).  Self-contained: the IR schema lives
+in-tree and compiles with protoc on demand (the image has no onnx
+package)."""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, import_to_gluon
+
+__all__ = ["export_model", "import_model", "import_to_gluon"]

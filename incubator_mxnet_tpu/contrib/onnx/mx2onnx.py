@@ -1,0 +1,316 @@
+"""Symbol+params → ONNX export (reference:
+python/mxnet/contrib/onnx/mx2onnx/export_model.py + _op_translations.py).
+
+Node-by-node translation of the Symbol DAG into an ONNX GraphProto
+(opset 13): variables with param values become initializers, the rest
+become graph inputs.  Unsupported ops raise with the op name — the same
+fail-loudly contract the reference's converter has.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import serde
+
+__all__ = ["export_model"]
+
+_OPSET = 13
+
+
+def _tuplize(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _Ctx:
+    def __init__(self, pb, graph):
+        self.pb = pb
+        self.graph = graph
+        self._uid = 0
+
+    def tmp(self, base):
+        self._uid += 1
+        return f"{base}__tmp{self._uid}"
+
+    def node(self, op_type, inputs, outputs, name, **attrs):
+        n = self.graph.node.add()
+        n.op_type = op_type
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        n.name = name
+        for k, v in attrs.items():
+            a = n.attribute.add()
+            a.name = k
+            AT = self.pb.AttributeProto
+            if isinstance(v, float):
+                a.type = AT.FLOAT
+                a.f = v
+            elif isinstance(v, bool) or isinstance(v, int):
+                a.type = AT.INT
+                a.i = int(v)
+            elif isinstance(v, str):
+                a.type = AT.STRING
+                a.s = v.encode()
+            elif isinstance(v, (tuple, list)):
+                a.type = AT.INTS
+                a.ints.extend(int(x) for x in v)
+            else:
+                raise MXNetError(f"unsupported attribute value {v!r}")
+        return n
+
+    def const_i64(self, name, values):
+        t = self.graph.initializer.add()
+        t.name = name
+        t.data_type = self.pb.TensorProto.INT64
+        t.dims.extend([len(values)])
+        t.raw_data = _np.asarray(values, _np.int64).tobytes()
+        return name
+
+
+# ---------------------------------------------------------------------------
+# per-op translators: (ctx, node, in_names, out_name) -> None
+# ---------------------------------------------------------------------------
+def _conv(ctx, n, ins, out):
+    a = n.attrs
+    kernel = _tuplize(a["kernel"])
+    pad = _tuplize(a.get("pad", 0), len(kernel))
+    ctx.node("Conv", ins, [out], n.name,
+             kernel_shape=kernel,
+             strides=_tuplize(a.get("stride", 1), len(kernel)),
+             pads=pad + pad,
+             dilations=_tuplize(a.get("dilate", 1), len(kernel)),
+             group=int(a.get("num_group", 1)))
+
+
+def _fc(ctx, n, ins, out):
+    a = n.attrs
+    x = ins[0]
+    if a.get("flatten", True):
+        flat = ctx.tmp(n.name)
+        ctx.node("Flatten", [x], [flat], f"{n.name}_flatten", axis=1)
+        x = flat
+    if len(ins) == 3:
+        ctx.node("Gemm", [x, ins[1], ins[2]], [out], n.name,
+                 alpha=1.0, beta=1.0, transA=0, transB=1)
+    else:
+        ctx.node("Gemm", [x, ins[1]], [out], n.name,
+                 alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+def _batchnorm(ctx, n, ins, out):
+    a = n.attrs
+    # mx order: data, gamma, beta, moving_mean, moving_var — same as ONNX
+    ctx.node("BatchNormalization", ins[:5], [out], n.name,
+             epsilon=float(a.get("eps", 1e-5)),
+             momentum=float(a.get("momentum", 0.9)))
+
+
+def _activation(ctx, n, ins, out):
+    act = n.attrs.get("act_type", "relu")
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softsign": "Softsign", "softrelu": "Softplus"}
+    if act not in table:
+        raise MXNetError(f"ONNX export: unsupported act_type {act!r}")
+    ctx.node(table[act], [ins[0]], [out], n.name)
+
+
+def _pooling(ctx, n, ins, out):
+    a = n.attrs
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.node(op, [ins[0]], [out], n.name)
+        return
+    kernel = _tuplize(a["kernel"])
+    pad = _tuplize(a.get("pad", 0), len(kernel))
+    kwargs = dict(kernel_shape=kernel,
+                  strides=_tuplize(a.get("stride", 1), len(kernel)),
+                  pads=pad + pad)
+    if ptype == "max":
+        ctx.node("MaxPool", [ins[0]], [out], n.name, **kwargs)
+    elif ptype == "avg":
+        ctx.node("AveragePool", [ins[0]], [out], n.name,
+                 count_include_pad=int(bool(
+                     a.get("count_include_pad", True))), **kwargs)
+    else:
+        raise MXNetError(f"ONNX export: pool_type {ptype!r} unsupported")
+
+
+def _reshape(ctx, n, ins, out):
+    shape = n.attrs.get("shape")
+    if shape is None:
+        raise MXNetError("ONNX export: reshape needs a static shape attr")
+    cname = ctx.const_i64(ctx.tmp(n.name), list(shape))
+    ctx.node("Reshape", [ins[0], cname], [out], n.name)
+
+
+def _simple(op_type, **fixed):
+    def f(ctx, n, ins, out):
+        ctx.node(op_type, ins, [out], n.name, **fixed)
+    return f
+
+
+def _softmax(ctx, n, ins, out):
+    ctx.node("Softmax", [ins[0]], [out], n.name,
+             axis=int(n.attrs.get("axis", -1)))
+
+
+def _log_softmax(ctx, n, ins, out):
+    ctx.node("LogSoftmax", [ins[0]], [out], n.name,
+             axis=int(n.attrs.get("axis", -1)))
+
+
+def _transpose(ctx, n, ins, out):
+    axes = n.attrs.get("axes")
+    if axes:
+        ctx.node("Transpose", [ins[0]], [out], n.name,
+                 perm=tuple(axes))
+    else:
+        ctx.node("Transpose", [ins[0]], [out], n.name)
+
+
+def _concat(ctx, n, ins, out):
+    ctx.node("Concat", ins, [out], n.name,
+             axis=int(n.attrs.get("dim", n.attrs.get("axis", 1))))
+
+
+def _dropout(ctx, n, ins, out):
+    ctx.node("Dropout", [ins[0]], [out], n.name)
+
+
+def _embedding(ctx, n, ins, out):
+    # mx: (data, weight) ; ONNX Gather: (weight, indices)
+    ctx.node("Gather", [ins[1], ins[0]], [out], n.name, axis=0)
+
+
+_TRANSLATORS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "BatchNorm": _batchnorm,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "Flatten": _simple("Flatten", axis=1),
+    "flatten": _simple("Flatten", axis=1),
+    "reshape": _reshape,
+    "Reshape": _reshape,
+    "transpose": _transpose,
+    "softmax": _softmax,
+    "log_softmax": _log_softmax,
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "elemwise_add": _simple("Add"),
+    "broadcast_add": _simple("Add"),
+    "add": _simple("Add"),
+    "elemwise_sub": _simple("Sub"),
+    "broadcast_sub": _simple("Sub"),
+    "subtract": _simple("Sub"),
+    "elemwise_mul": _simple("Mul"),
+    "broadcast_mul": _simple("Mul"),
+    "multiply": _simple("Mul"),
+    "elemwise_div": _simple("Div"),
+    "broadcast_div": _simple("Div"),
+    "divide": _simple("Div"),
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "dropout": _dropout,
+    "Embedding": _embedding,
+    "exp": _simple("Exp"),
+    "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"),
+    "abs": _simple("Abs"),
+    "negative": _simple("Neg"),
+    "identity": _simple("Identity"),
+}
+
+
+def _np_to_tensor(pb, t, name, arr: _np.ndarray):
+    t.name = name
+    t.dims.extend(arr.shape)
+    dt = {_np.dtype(_np.float32): pb.TensorProto.FLOAT,
+          _np.dtype(_np.float64): pb.TensorProto.DOUBLE,
+          _np.dtype(_np.int32): pb.TensorProto.INT32,
+          _np.dtype(_np.int64): pb.TensorProto.INT64,
+          _np.dtype(_np.int8): pb.TensorProto.INT8,
+          _np.dtype(_np.uint8): pb.TensorProto.UINT8,
+          _np.dtype(_np.bool_): pb.TensorProto.BOOL}.get(arr.dtype)
+    if dt is None:
+        raise MXNetError(f"ONNX export: unsupported dtype {arr.dtype}")
+    t.data_type = dt
+    t.raw_data = _np.ascontiguousarray(arr).tobytes()
+
+
+def export_model(sym, params: Dict, input_shapes,
+                 input_types=_np.float32, onnx_file_path="model.onnx",
+                 verbose=False):
+    """Export a Symbol + params dict to an ONNX file (reference:
+    onnx_mxnet.export_model).  ``params`` maps arg/aux names (optionally
+    'arg:'/'aux:'-prefixed) to NDArray/numpy values; variables without a
+    param value become graph inputs, in ``list_arguments`` order matched
+    against ``input_shapes``."""
+    pb = serde.pb()
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "incubator_mxnet_tpu"
+    model.producer_version = "0.1"
+    opset = model.opset_import.add()
+    opset.version = _OPSET
+    graph = model.graph
+    graph.name = getattr(sym, "name", "graph") or "graph"
+
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    ctx = _Ctx(pb, graph)
+
+    # name each node output; multi-output nodes get :k suffixes
+    def out_name(node, k=0):
+        return node.name if k == 0 else f"{node.name}_out{k}"
+
+    in_shapes = list(input_shapes) if isinstance(
+        input_shapes[0], (tuple, list)) else [tuple(input_shapes)]
+    next_input = iter(in_shapes)
+
+    for node in sym._topo():
+        if node.is_variable:
+            if node.name in params:
+                arr = params[node.name]
+                arr = arr.asnumpy() if hasattr(arr, "asnumpy") \
+                    else _np.asarray(arr)
+                _np_to_tensor(pb, graph.initializer.add(), node.name, arr)
+            else:
+                vi = graph.input.add()
+                vi.name = node.name
+                tt = vi.type.tensor_type
+                tt.elem_type = pb.TensorProto.FLOAT
+                try:
+                    shape = next(next_input)
+                except StopIteration:
+                    raise MXNetError(
+                        f"no input_shape given for graph input "
+                        f"{node.name!r}")
+                for d in shape:
+                    tt.shape.dim.add().dim_value = int(d)
+            continue
+        fn = _TRANSLATORS.get(node.op)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX export: operator {node.op!r} has no translator "
+                f"(node {node.name!r})")
+        ins = [out_name(src, k) for src, k in node.inputs]
+        fn(ctx, node, ins, out_name(node))
+
+    for out_node, k in sym._outputs:
+        vo = graph.output.add()
+        vo.name = out_name(out_node, k)
+        vo.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+
+    data = model.SerializeToString()
+    with open(onnx_file_path, "wb") as f:
+        f.write(data)
+    if verbose:
+        print(f"exported {len(graph.node)} nodes -> {onnx_file_path}")
+    return onnx_file_path

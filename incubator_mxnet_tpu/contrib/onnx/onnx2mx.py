@@ -1,0 +1,208 @@
+"""ONNX → Symbol+params import (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py + _op_translations.py).
+
+Builds our Symbol DAG from a GraphProto; initializers become arg_params.
+Supports the same opset-13 subset mx2onnx emits, so exported models
+round-trip — the validation strategy this environment allows (no onnx
+package to checker-validate against, but the protobuf schema guarantees
+wire compatibility).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import serde
+
+__all__ = ["import_model", "import_to_gluon"]
+
+
+def _attr_map(pb, node):
+    out = {}
+    AT = pb.AttributeProto
+    for a in node.attribute:
+        if a.type == AT.FLOAT:
+            out[a.name] = a.f
+        elif a.type == AT.INT:
+            out[a.name] = int(a.i)
+        elif a.type == AT.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == AT.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+        elif a.type == AT.FLOATS:
+            out[a.name] = tuple(float(x) for x in a.floats)
+        elif a.type == AT.TENSOR:
+            out[a.name] = _tensor_to_np(pb, a.t)
+        else:
+            raise MXNetError(
+                f"ONNX import: attribute type {a.type} unsupported "
+                f"({node.op_type}.{a.name})")
+    return out
+
+
+def _tensor_to_np(pb, t) -> _np.ndarray:
+    TP = pb.TensorProto
+    dt = {TP.FLOAT: _np.float32, TP.DOUBLE: _np.float64,
+          TP.INT32: _np.int32, TP.INT64: _np.int64, TP.INT8: _np.int8,
+          TP.UINT8: _np.uint8, TP.BOOL: _np.bool_}.get(t.data_type)
+    if dt is None:
+        raise MXNetError(f"ONNX import: tensor dtype {t.data_type} "
+                         "unsupported")
+    if t.raw_data:
+        arr = _np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = _np.asarray(list(t.float_data), dt)
+    elif t.int64_data:
+        arr = _np.asarray(list(t.int64_data), dt)
+    elif t.int32_data:
+        arr = _np.asarray(list(t.int32_data), dt)
+    else:
+        arr = _np.zeros(0, dt)
+    return arr.reshape(tuple(t.dims))
+
+
+def _halve_pads(attrs):
+    pads = attrs.get("pads")
+    if not pads:
+        return (0, 0)
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("ONNX import: asymmetric pads unsupported")
+    return tuple(begin)
+
+
+def import_model(model_file):
+    """ONNX file → (sym, arg_params, aux_params) (reference:
+    onnx_mxnet.import_model)."""
+    from ... import symbol as S
+    from ...ndarray import ndarray as _ndmod
+
+    pb = serde.pb()
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    arg_params: Dict = {}
+    env: Dict[str, object] = {}     # onnx value name -> Symbol
+
+    for t in g.initializer:
+        arr = _tensor_to_np(pb, t)
+        arg_params[t.name] = _ndmod.array(
+            arr, dtype=_np.float32 if arr.dtype == _np.float64
+            else arr.dtype)
+        env[t.name] = S.var(t.name)
+    for vi in g.input:
+        if vi.name not in env:
+            env[vi.name] = S.var(vi.name)
+
+    def ins(node):
+        return [env[i] for i in node.input if i]
+
+    for node in g.node:
+        op = node.op_type
+        attrs = _attr_map(pb, node)
+        i = ins(node)
+        name = node.name or node.output[0]
+        if op == "Conv":
+            kwargs = dict(kernel=attrs["kernel_shape"],
+                          stride=attrs.get("strides", 1),
+                          pad=_halve_pads(attrs),
+                          dilate=attrs.get("dilations", 1),
+                          num_group=attrs.get("group", 1),
+                          num_filter=0, name=name)
+            out = S.Convolution(*i, **kwargs) if len(i) == 3 else \
+                S.Convolution(i[0], i[1], no_bias=True, **kwargs)
+        elif op == "Gemm":
+            if attrs.get("transA", 0) or not attrs.get("transB", 0):
+                raise MXNetError("ONNX import: only Gemm(transB=1) maps "
+                                 "to FullyConnected")
+            out = S.FullyConnected(*i, num_hidden=0, flatten=False,
+                                   no_bias=len(i) == 2, name=name)
+        elif op == "MatMul":
+            out = S.dot(i[0], i[1], name=name)
+        elif op == "BatchNormalization":
+            out = S.BatchNorm(*i, eps=attrs.get("epsilon", 1e-5),
+                              momentum=attrs.get("momentum", 0.9),
+                              fix_gamma=False, name=name)
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softsign", "Softplus"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softsign": "softsign", "Softplus": "softrelu"}[op]
+            out = S.Activation(i[0], act_type=act, name=name)
+        elif op in ("MaxPool", "AveragePool"):
+            out = S.Pooling(
+                i[0], kernel=attrs["kernel_shape"],
+                stride=attrs.get("strides", 1), pad=_halve_pads(attrs),
+                pool_type="max" if op == "MaxPool" else "avg", name=name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = S.Pooling(
+                i[0], kernel=(1, 1), global_pool=True,
+                pool_type="max" if op == "GlobalMaxPool" else "avg",
+                name=name)
+        elif op == "Flatten":
+            if attrs.get("axis", 1) != 1:
+                raise MXNetError("ONNX import: Flatten axis != 1")
+            out = S.Flatten(i[0], name=name)
+        elif op == "Reshape":
+            shape_name = node.input[1]
+            shape_arr = arg_params.pop(shape_name, None)
+            if shape_arr is None:
+                raise MXNetError(
+                    "ONNX import: Reshape needs a constant shape")
+            env.pop(shape_name, None)
+            out = S.reshape(i[0],
+                            shape=tuple(int(x) for x in
+                                        shape_arr.asnumpy()), name=name)
+        elif op == "Transpose":
+            out = S.transpose(i[0], axes=attrs.get("perm"), name=name)
+        elif op == "Softmax":
+            out = S.softmax(i[0], axis=attrs.get("axis", -1), name=name)
+        elif op == "LogSoftmax":
+            out = S.log_softmax(i[0], axis=attrs.get("axis", -1),
+                                name=name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": S.broadcast_add, "Sub": S.broadcast_sub,
+                  "Mul": S.broadcast_mul, "Div": S.broadcast_div}[op]
+            out = fn(i[0], i[1], name=name)
+        elif op == "Concat":
+            out = S.concat(*i, dim=attrs.get("axis", 1), name=name)
+        elif op in ("Dropout", "Identity"):
+            out = S.identity(i[0], name=name)
+        elif op == "Gather":
+            if attrs.get("axis", 0) != 0:
+                raise MXNetError("ONNX import: Gather axis != 0")
+            out = S.take(i[0], i[1], name=name)
+        elif op in ("Exp", "Log", "Sqrt", "Abs", "Neg"):
+            fn = {"Exp": S.exp, "Log": S.log, "Sqrt": S.sqrt,
+                  "Abs": S.abs, "Neg": S.negative}[op]
+            out = fn(i[0], name=name)
+        else:
+            raise MXNetError(
+                f"ONNX import: operator {op!r} has no translator")
+        outs = out if isinstance(out, list) else [out]
+        for k, oname in enumerate(node.output):
+            env[oname] = outs[k] if k < len(outs) else outs[0]
+
+    out_syms = [env[o.name] for o in g.output]
+    sym = out_syms[0] if len(out_syms) == 1 else \
+        __import__("incubator_mxnet_tpu.symbol",
+                   fromlist=["Group"]).Group(out_syms)
+    return sym, arg_params, {}
+
+
+def import_to_gluon(model_file, ctx=None):
+    """ONNX file → runnable SymbolBlock (reference:
+    onnx_mxnet.import_to_gluon)."""
+    from ...gluon.block import SymbolBlock
+    from ... import symbol as S
+
+    sym, arg_params, aux_params = import_model(model_file)
+    input_names = [n for n in sym.list_arguments()
+                   if n not in arg_params and n not in aux_params]
+    inputs = [S.var(n) for n in input_names]
+    net = SymbolBlock(sym, inputs)
+    net._attach_params({**arg_params, **aux_params})
+    return net

@@ -1,0 +1,60 @@
+"""ONNX protobuf serde: compiles the in-tree IR schema with protoc on
+first use (same on-demand pattern as the native recordio core; the image
+has protoc + the protobuf runtime but no onnx package)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import threading
+
+from ...base import MXNetError
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_PROTO = os.path.join(_DIR, "onnx_ir.proto")
+_PB2 = os.path.join(_DIR, "onnx_ir_pb2.py")
+
+_lock = threading.Lock()
+_mod = None
+
+
+def _compile() -> bool:
+    # generate into a per-pid temp dir, then atomic-replace: concurrent
+    # processes never exec a half-written module (same pattern as the
+    # native recordio build)
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix="onnx_pb2_", dir=_DIR)
+    try:
+        out = subprocess.run(
+            ["protoc", f"--proto_path={_DIR}", f"--python_out={tmpdir}",
+             _PROTO],
+            capture_output=True, text=True, timeout=120)
+        gen = os.path.join(tmpdir, os.path.basename(_PB2))
+        if out.returncode != 0 or not os.path.isfile(gen):
+            return False
+        os.replace(gen, _PB2)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def pb():
+    """The generated protobuf module (onnx_ir_pb2)."""
+    global _mod
+    with _lock:
+        if _mod is not None:
+            return _mod
+        need = (not os.path.isfile(_PB2)
+                or os.path.getmtime(_PB2) < os.path.getmtime(_PROTO))
+        if need and not _compile():
+            raise MXNetError(
+                "ONNX support needs protoc (and the protobuf runtime) to "
+                "compile the IR schema; protoc compilation failed")
+        spec = importlib.util.spec_from_file_location("onnx_ir_pb2", _PB2)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _mod = mod
+        return _mod

@@ -127,7 +127,6 @@ class SVRGModule(Module):
         SVRGModule.fit)."""
         from .. import metric as metric_mod
         if not self.binded:
-            first = next(iter(train_data))
             raise MXNetError("fit: bind() the module first")
         if not self.params_initialized:
             self.init_params(initializer=initializer)

@@ -472,20 +472,28 @@ class SymbolBlock(HybridBlock):
         ret = SymbolBlock(sym, inputs)
         if param_file is not None:
             loaded = nd_utils.load(param_file)
-            loaded = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
-            for name, v in loaded.items():
-                p = Parameter(name, shape=v.shape, dtype=v.dtype)
-                p.set_data(v)
-                ret._params._params[name] = p
-                ret._reg_params[name] = p
+            ret._attach_params({k.split(":", 1)[-1]: v
+                                for k, v in loaded.items()})
         return ret
 
+    def _attach_params(self, values):
+        """Register name→NDArray values as this block's Parameters (used
+        by imports and the ONNX importer)."""
+        for name, v in values.items():
+            p = Parameter(name, shape=v.shape, dtype=v.dtype)
+            p.set_data(v)
+            self._params._params[name] = p
+            self._reg_params[name] = p
+
     def _forward_eager(self, *args):
+        from ..symbol.symbol import eval_graph
+        from .. import autograd as _ag
         bindings = {n: a for n, a in zip(
             [i.name for i in self._inputs], args)}
         for name, p in self._params.items():
             bindings[name] = p.data()
-        return self._outputs.eval_dict(bindings)
+        outs = eval_graph(self._outputs, bindings, _ag.is_training())
+        return outs[0] if len(outs) == 1 else outs
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError

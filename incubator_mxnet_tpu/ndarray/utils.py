@@ -56,8 +56,8 @@ def _flag_dtype(flag: int):
         raise MXNetError(f"unknown mshadow type flag {flag}")
 
 
-def _save_ndarray(buf: bytearray, arr: NDArray):
-    np_data = arr.asnumpy()
+def _save_ndarray(buf: bytearray, arr):
+    np_data = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
     buf += struct.pack("<I", _V2_MAGIC)
     buf += struct.pack("<i", 0)                      # stype: dense
     buf += struct.pack("<i", np_data.ndim)           # TShape ndim
@@ -99,8 +99,8 @@ def save(fname: str, data):
     if isinstance(data, NDArray):
         data, names = [data], []
     elif isinstance(data, (list, tuple)):
-        if not all(isinstance(a, NDArray) for a in data):
-            raise MXNetError("save expects NDArray elements")
+        if not all(isinstance(a, (NDArray, _np.ndarray)) for a in data):
+            raise MXNetError("save expects NDArray/numpy elements")
         data, names = list(data), []
     elif isinstance(data, dict):
         names = list(data.keys())

@@ -1,0 +1,130 @@
+"""ONNX export/import tests (reference: tests/python/unittest/onnx/ —
+mxnet_export_test.py + backend tests).  Validation here is exact
+roundtrip through the real protobuf wire format (the image has no onnx
+package to run checker/ORT against)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+from incubator_mxnet_tpu.contrib import onnx as mxonnx
+from incubator_mxnet_tpu.symbol.symbol import eval_graph
+
+
+def _roundtrip(sym, params, inputs, tmp_path, rtol=1e-5):
+    path = str(tmp_path / "m.onnx")
+    feed = {k: mx.nd.array(v) for k, v in inputs.items()}
+    nd_params = {k: mx.nd.array(v) for k, v in params.items()}
+    ref = eval_graph(sym, {**feed, **nd_params}, False)[0].asnumpy()
+    mxonnx.export_model(sym, nd_params,
+                        [tuple(v.shape) for v in inputs.values()],
+                        onnx_file_path=path)
+    net = mxonnx.import_to_gluon(path)
+    got = net(*feed.values()).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=1e-6)
+    return path
+
+
+def test_lenet_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    data = S.var("data")
+    x = S.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                      name="c1")
+    x = S.Activation(x, act_type="relu", name="a1")
+    x = S.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                  name="p1")
+    x = S.Flatten(x, name="f1")
+    x = S.FullyConnected(x, num_hidden=10, name="fc1")
+    out = S.softmax(x, name="sm")
+    params = {
+        "c1_weight": rng.standard_normal((4, 1, 3, 3)).astype(np.float32),
+        "c1_bias": np.zeros(4, np.float32),
+        "fc1_weight": rng.standard_normal((10, 64)).astype(np.float32),
+        "fc1_bias": np.zeros(10, np.float32)}
+    _roundtrip(out, params,
+               {"data": rng.standard_normal((2, 1, 8, 8)).astype(
+                   np.float32)}, tmp_path)
+
+
+def test_batchnorm_global_pool_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    data = S.var("data")
+    x = S.BatchNorm(data, name="bn", fix_gamma=False)
+    out = S.Pooling(x, kernel=(1, 1), global_pool=True, pool_type="avg",
+                    name="gap")
+    params = {
+        "bn_gamma": rng.random(3).astype(np.float32) + 0.5,
+        "bn_beta": rng.standard_normal(3).astype(np.float32),
+        "bn_moving_mean": rng.standard_normal(3).astype(np.float32),
+        "bn_moving_var": rng.random(3).astype(np.float32) + 0.5}
+    _roundtrip(out, params,
+               {"data": rng.standard_normal((2, 3, 4, 4)).astype(
+                   np.float32)}, tmp_path, rtol=1e-4)
+
+
+def test_elementwise_and_shape_ops_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    a, b = S.var("a"), S.var("b")
+    x = S.broadcast_add(a, b, name="add")
+    x = S.transpose(x, axes=(1, 0), name="tr")
+    x = S.reshape(x, shape=(2, 6), name="rs")
+    out = S.concat(x, x, dim=1, name="cc")
+    _roundtrip(out, {},
+               {"a": rng.standard_normal((3, 4)).astype(np.float32),
+                "b": rng.standard_normal((3, 4)).astype(np.float32)},
+               tmp_path)
+
+
+def test_embedding_gather_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    idx = S.var("idx")
+    w = S.var("emb_weight")
+    out = S.Embedding(idx, w, input_dim=10, output_dim=4, name="emb")
+    path = str(tmp_path / "m.onnx")
+    params = {"emb_weight": mx.nd.array(
+        rng.standard_normal((10, 4)).astype(np.float32))}
+    ids = np.array([1, 3, 7], np.int32)
+    ref = eval_graph(out, {"idx": mx.nd.array(ids, dtype=np.int32),
+                           **params}, False)[0].asnumpy()
+    mxonnx.export_model(out, params, [(3,)], onnx_file_path=path)
+    net = mxonnx.import_to_gluon(path)
+    got = net(mx.nd.array(ids, dtype=np.int32)).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_unsupported_op_raises(tmp_path):
+    data = S.var("data")
+    out = S.topk(data, k=2, name="tk")
+    with pytest.raises(mx.base.MXNetError, match="no translator"):
+        mxonnx.export_model(out, {}, [(2, 5)],
+                            onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_wire_format_is_onnx_shaped(tmp_path):
+    """The serialized file must carry the ONNX ModelProto framing: field 7
+    (graph) present, opset import, ir_version — checked by re-parsing with
+    an independently-built message."""
+    from incubator_mxnet_tpu.contrib.onnx import serde
+    data = S.var("data")
+    out = S.relu(data, name="r")
+    path = str(tmp_path / "m.onnx")
+    mxonnx.export_model(out, {}, [(2, 2)], onnx_file_path=path)
+    pb = serde.pb()
+    m = pb.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    assert m.ir_version == 8
+    assert m.opset_import[0].version == 13
+    assert m.graph.node[0].op_type == "Relu"
+    assert m.graph.input[0].type.tensor_type.shape.dim[0].dim_value == 2
+
+
+def test_symbolblock_forward_works():
+    """Regression: SymbolBlock forward previously called a nonexistent
+    Symbol.eval_dict (shipped-untested path)."""
+    from incubator_mxnet_tpu.gluon.block import SymbolBlock
+    data = S.var("data")
+    out = S.relu(data, name="r")
+    net = SymbolBlock(out, [data])
+    x = mx.nd.array(np.array([[-1.0, 2.0]], np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), [[0.0, 2.0]])
