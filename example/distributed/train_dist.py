@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Multi-process data-parallel training via dist_sync KVStore (reference:
+example/distributed_training/ + tools/launch.py usage;
+tests/nightly/dist_sync_kvstore.py is the no-cluster version).
+
+Launch N processes on one machine (or adapt the env for multi-host):
+
+    python tools/launch.py -n 2 --launcher local -- \
+        python example/distributed/train_dist.py --cpu
+
+Each worker trains on its own data shard; gradients are summed across
+processes by the dist_sync KVStore on every step.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=80)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon import nn
+
+    parallel.distributed.initialize()    # DMLC_* env from launch.py
+    rank, world = jax.process_index(), jax.process_count()
+    print(f"worker {rank}/{world} up")
+
+    # same global problem on every worker; each trains its own shard
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 1)).astype(np.float32)
+    y = X @ W
+    shard = slice(rank * len(X) // world, (rank + 1) * len(X) // world)
+    Xs, ys = X[shard], y[shard]
+
+    mx.random.seed(0)                    # identical init on all workers
+    net = nn.Dense(1, in_units=8, use_bias=False)
+    net.initialize(init=mx.init.Zero())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr},
+                            kvstore="dist_sync")
+    loss_fn = gluon.loss.L2Loss()
+    for epoch in range(args.epochs):
+        with mx.autograd.record():
+            loss = loss_fn(net(mx.nd.array(Xs)), mx.nd.array(ys)).mean()
+        loss.backward()
+        trainer.step(world)   # grads summed over workers -> mean
+        if rank == 0 and epoch % 20 == 0:
+            print(f"epoch {epoch}: local loss {float(loss.asscalar()):.5f}")
+    full = float(loss_fn(net(mx.nd.array(X)),
+                         mx.nd.array(y)).mean().asscalar())
+    print(f"worker {rank}: full-data loss {full:.6f}")
+    assert full < 0.05, "did not converge"
+    print(f"WORKER-{rank}-DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -272,7 +272,30 @@ def export_model(sym, params: Dict, input_shapes,
 
     in_shapes = list(input_shapes) if isinstance(
         input_shapes[0], (tuple, list)) else [tuple(input_shapes)]
-    next_input = iter(in_shapes)
+    in_types = (list(input_types) if isinstance(input_types, (list, tuple))
+                else [input_types] * len(in_shapes))
+    if len(in_types) != len(in_shapes):
+        raise MXNetError("input_types must match input_shapes")
+    elem_types = []
+    for t in in_types:
+        dt = {_np.dtype(_np.float32): pb.TensorProto.FLOAT,
+              _np.dtype(_np.float64): pb.TensorProto.DOUBLE,
+              _np.dtype(_np.int32): pb.TensorProto.INT32,
+              _np.dtype(_np.int64): pb.TensorProto.INT64}.get(_np.dtype(t))
+        if dt is None:
+            raise MXNetError(f"ONNX export: unsupported input type {t}")
+        elem_types.append(dt)
+    next_input = iter(zip(in_shapes, elem_types))
+
+    # fail loudly on edges from secondary outputs: no translator emits
+    # output k>0, so such an edge would serialize as a dangling name
+    for node in sym._topo():
+        for src, k in node.inputs:
+            if k > 0 and not src.is_variable:
+                raise MXNetError(
+                    f"ONNX export: node {node.name!r} consumes output "
+                    f"{k} of {src.name!r}; multi-output ops are "
+                    "unsupported")
 
     for node in sym._topo():
         if node.is_variable:
@@ -285,13 +308,13 @@ def export_model(sym, params: Dict, input_shapes,
                 vi = graph.input.add()
                 vi.name = node.name
                 tt = vi.type.tensor_type
-                tt.elem_type = pb.TensorProto.FLOAT
                 try:
-                    shape = next(next_input)
+                    shape, et = next(next_input)
                 except StopIteration:
                     raise MXNetError(
                         f"no input_shape given for graph input "
                         f"{node.name!r}")
+                tt.elem_type = et
                 for d in shape:
                     tt.shape.dim.add().dim_value = int(d)
             continue

@@ -77,6 +77,11 @@ def _halve_pads(attrs):
 def import_model(model_file):
     """ONNX file → (sym, arg_params, aux_params) (reference:
     onnx_mxnet.import_model)."""
+    sym, arg_params, aux_params, _ = _import(model_file)
+    return sym, arg_params, aux_params
+
+
+def _import(model_file):
     from ... import symbol as S
     from ...ndarray import ndarray as _ndmod
 
@@ -95,12 +100,20 @@ def import_model(model_file):
             arr, dtype=_np.float32 if arr.dtype == _np.float64
             else arr.dtype)
         env[t.name] = S.var(t.name)
+    graph_inputs = []          # declared order, initializers excluded
     for vi in g.input:
         if vi.name not in env:
             env[vi.name] = S.var(vi.name)
+            graph_inputs.append(vi.name)
+    consumed = set()
+    for node in g.node:
+        consumed.update(i for i in node.input if i)
+    consumed.update(o.name for o in g.output)
 
     def ins(node):
         return [env[i] for i in node.input if i]
+
+    shape_consts = set()       # Reshape shape initializers to drop later
 
     for node in g.node:
         op = node.op_type
@@ -120,6 +133,10 @@ def import_model(model_file):
             if attrs.get("transA", 0) or not attrs.get("transB", 0):
                 raise MXNetError("ONNX import: only Gemm(transB=1) maps "
                                  "to FullyConnected")
+            if attrs.get("alpha", 1.0) != 1.0 or \
+                    attrs.get("beta", 1.0) != 1.0:
+                raise MXNetError("ONNX import: Gemm alpha/beta != 1 "
+                                 "unsupported")
             out = S.FullyConnected(*i, num_hidden=0, flatten=False,
                                    no_bias=len(i) == 2, name=name)
         elif op == "MatMul":
@@ -148,11 +165,11 @@ def import_model(model_file):
             out = S.Flatten(i[0], name=name)
         elif op == "Reshape":
             shape_name = node.input[1]
-            shape_arr = arg_params.pop(shape_name, None)
+            shape_arr = arg_params.get(shape_name)
             if shape_arr is None:
                 raise MXNetError(
                     "ONNX import: Reshape needs a constant shape")
-            env.pop(shape_name, None)
+            shape_consts.add(shape_name)
             out = S.reshape(i[0],
                             shape=tuple(int(x) for x in
                                         shape_arr.asnumpy()), name=name)
@@ -184,13 +201,29 @@ def import_model(model_file):
                 f"ONNX import: operator {op!r} has no translator")
         outs = out if isinstance(out, list) else [out]
         for k, oname in enumerate(node.output):
-            env[oname] = outs[k] if k < len(outs) else outs[0]
+            if k >= len(outs):
+                # secondary ONNX output this op doesn't produce (e.g.
+                # Dropout mask): fine if nothing reads it, wrong otherwise
+                if oname in consumed:
+                    raise MXNetError(
+                        f"ONNX import: secondary output {oname!r} of "
+                        f"{op} is consumed but unsupported")
+                continue
+            env[oname] = outs[k]
+
+    for sc in shape_consts:
+        uses = sum(1 for node in g.node for i in node.input if i == sc)
+        reshape_uses = sum(1 for node in g.node
+                           if node.op_type == "Reshape"
+                           and len(node.input) > 1 and node.input[1] == sc)
+        if uses == reshape_uses and sc not in (o.name for o in g.output):
+            arg_params.pop(sc, None)
 
     out_syms = [env[o.name] for o in g.output]
     sym = out_syms[0] if len(out_syms) == 1 else \
         __import__("incubator_mxnet_tpu.symbol",
                    fromlist=["Group"]).Group(out_syms)
-    return sym, arg_params, {}
+    return sym, arg_params, {}, graph_inputs
 
 
 def import_to_gluon(model_file, ctx=None):
@@ -199,9 +232,9 @@ def import_to_gluon(model_file, ctx=None):
     from ...gluon.block import SymbolBlock
     from ... import symbol as S
 
-    sym, arg_params, aux_params = import_model(model_file)
-    input_names = [n for n in sym.list_arguments()
-                   if n not in arg_params and n not in aux_params]
+    # input order follows the ONNX graph's DECLARED input order, not
+    # topo order — callers bind positionally per the ONNX contract
+    sym, arg_params, aux_params, input_names = _import(model_file)
     inputs = [S.var(n) for n in input_names]
     net = SymbolBlock(sym, inputs)
     net._attach_params({**arg_params, **aux_params})

@@ -128,3 +128,79 @@ def test_symbolblock_forward_works():
     net = SymbolBlock(out, [data])
     x = mx.nd.array(np.array([[-1.0, 2.0]], np.float32))
     np.testing.assert_allclose(net(x).asnumpy(), [[0.0, 2.0]])
+
+
+def test_integer_input_type_declared(tmp_path):
+    """input_types must drive the declared elem_type (int32 Gather
+    indices must not be declared FLOAT)."""
+    from incubator_mxnet_tpu.contrib.onnx import serde
+    idx = S.var("idx")
+    w = S.var("emb_weight")
+    out = S.Embedding(idx, w, input_dim=4, output_dim=2, name="emb")
+    path = str(tmp_path / "m.onnx")
+    params = {"emb_weight": mx.nd.ones((4, 2))}
+    mxonnx.export_model(out, params, [(3,)], input_types=np.int32,
+                        onnx_file_path=path)
+    pb = serde.pb()
+    m = pb.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    assert m.graph.input[0].type.tensor_type.elem_type == \
+        pb.TensorProto.INT32
+
+
+def test_import_respects_declared_input_order(tmp_path):
+    """Positional binding follows the ONNX graph's declared input order,
+    not symbol topo order (Sub(b, a) with inputs [a, b])."""
+    from incubator_mxnet_tpu.contrib.onnx import serde
+    pb = serde.pb()
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    for nm in ("a", "b"):
+        vi = g.input.add()
+        vi.name = nm
+        tt = vi.type.tensor_type
+        tt.elem_type = pb.TensorProto.FLOAT
+        tt.shape.dim.add().dim_value = 2
+    n = g.node.add()
+    n.op_type = "Sub"
+    n.input.extend(["b", "a"])       # computes b - a
+    n.output.append("out")
+    g.output.add().name = "out"
+    path = str(tmp_path / "sub.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    net = mxonnx.import_to_gluon(path)
+    a = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    b = mx.nd.array(np.array([10.0, 20.0], np.float32))
+    np.testing.assert_allclose(net(a, b).asnumpy(), [9.0, 18.0])
+
+
+def test_import_gemm_alpha_rejected(tmp_path):
+    from incubator_mxnet_tpu.contrib.onnx import serde
+    pb = serde.pb()
+    m = pb.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    vi = g.input.add(); vi.name = "x"
+    vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+    vi.type.tensor_type.shape.dim.add().dim_value = 1
+    t = g.initializer.add()
+    t.name = "w"; t.data_type = pb.TensorProto.FLOAT
+    t.dims.extend([1, 1]); t.raw_data = np.ones((1, 1), np.float32).tobytes()
+    n = g.node.add()
+    n.op_type = "Gemm"; n.input.extend(["x", "w"]); n.output.append("y")
+    for nm, val in (("alpha", 2.0),):
+        a = n.attribute.add(); a.name = nm
+        a.type = pb.AttributeProto.FLOAT; a.f = val
+    a = n.attribute.add(); a.name = "transB"
+    a.type = pb.AttributeProto.INT; a.i = 1
+    g.output.add().name = "y"
+    path = str(tmp_path / "gemm.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    with pytest.raises(mx.base.MXNetError, match="alpha"):
+        mxonnx.import_model(path)
