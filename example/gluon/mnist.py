@@ -2,11 +2,13 @@
 """LeNet on MNIST-shaped data, Gluon style (reference:
 example/gluon/mnist/mnist.py — the canonical minimum end-to-end slice).
 
-Zero-egress environment: with no dataset download available, --synthetic
-generates a separable MNIST-shaped problem so the script runs anywhere;
-point --data-dir at an MNIST idx directory when you have one.
+Zero-egress environment: with no dataset download available, the default
+is a synthetic separable MNIST-shaped problem so the script runs
+anywhere; pass --data-dir with the four MNIST idx files
+(train-images-idx3-ubyte etc., optionally .gz) to train on the real set.
 
-    python example/gluon/mnist.py --epochs 3 --synthetic
+    python example/gluon/mnist.py --epochs 3
+    python example/gluon/mnist.py --data-dir ~/mnist
 """
 import argparse
 import os
@@ -16,6 +18,32 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
 import numpy as np
+
+
+def load_idx_dir(data_dir):
+    """Read the standard MNIST idx files (gz or raw)."""
+    import gzip
+    import struct
+
+    def read(name):
+        for cand in (os.path.join(data_dir, name),
+                     os.path.join(data_dir, name + ".gz")):
+            if os.path.isfile(cand):
+                op = gzip.open if cand.endswith(".gz") else open
+                with op(cand, "rb") as f:
+                    magic, = struct.unpack(">I", f.read(4))
+                    ndim = magic & 0xFF
+                    dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+                    return np.frombuffer(f.read(), np.uint8).reshape(dims)
+        raise FileNotFoundError(f"{name}[.gz] not in {data_dir}")
+
+    Xtr = read("train-images-idx3-ubyte")[:, None].astype(
+        np.float32) / 255.0
+    ytr = read("train-labels-idx1-ubyte").astype(np.float32)
+    Xte = read("t10k-images-idx3-ubyte")[:, None].astype(
+        np.float32) / 255.0
+    yte = read("t10k-labels-idx1-ubyte").astype(np.float32)
+    return (Xtr, ytr), (Xte, yte)
 
 
 def synthetic_mnist(n, seed=0):
@@ -34,7 +62,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=2e-3)
-    ap.add_argument("--synthetic", action="store_true", default=True)
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with the MNIST idx files; synthetic "
+                         "data is used when omitted")
     ap.add_argument("--hybridize", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="pin jax to the CPU backend")
@@ -48,8 +78,11 @@ def main():
     from incubator_mxnet_tpu.gluon import nn
     from incubator_mxnet_tpu.gluon import data as gdata
 
-    Xtr, ytr = synthetic_mnist(4096, seed=0)
-    Xte, yte = synthetic_mnist(512, seed=1)
+    if args.data_dir:
+        (Xtr, ytr), (Xte, yte) = load_idx_dir(args.data_dir)
+    else:
+        Xtr, ytr = synthetic_mnist(4096, seed=0)
+        Xte, yte = synthetic_mnist(512, seed=1)
     train = gdata.DataLoader(gdata.ArrayDataset(Xtr, ytr),
                              batch_size=args.batch_size, shuffle=True,
                              num_workers=2)

@@ -426,7 +426,18 @@ class HybridBlock(Block):
             kw = self._params_kwargs()
         return self.hybrid_forward(F, *args, **kw)
 
+    def _forward_symbolic(self, *args):
+        """Trace this block into a Symbol graph: parameters become
+        variables named by their global names (reference: HybridBlock's
+        dual ndarray/symbol dispatch of hybrid_forward(F, ...))."""
+        from .. import symbol as F
+        kw = {name: F.var(p.name) for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **kw)
+
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+        if args and isinstance(args[0], Symbol):
+            return self._forward_symbolic(*args)
         if self._active and self._cached_graph is not None \
                 and getattr(_trace, "collector", None) is None:
             # ensure deferred shapes are settled before tracing
@@ -439,13 +450,27 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
-    def export(self, path, epoch=0):
+    def to_symbol(self, *input_names):
+        """Trace to a Symbol over named variable inputs (the graph the
+        reference gets from hybrid_forward's symbol dispatch)."""
+        from .. import symbol as sym_mod
+        names = input_names or ("data",)
+        return self(*[sym_mod.var(n) for n in names])
+
+    def export(self, path, epoch=0, input_names=("data",)):
         """Serialize for deployment (reference: HybridBlock.export →
-        json+params pair).  Graph json comes from the symbol layer."""
+        json+params pair: ``path-symbol.json`` + ``path-NNNN.params``).
+        Multi-input blocks pass their input names via ``input_names``."""
         from ..ndarray import utils as nd_utils
-        params = self._collect_params_with_prefix()
-        nd_utils.save(f"{path}-{epoch:04d}.params",
-                      {"arg:" + k: v.data() for k, v in params.items()})
+        sym = self.to_symbol(*input_names)
+        sym.save(f"{path}-symbol.json")
+        # keys are the SYMBOL arg/aux names split by prefix exactly like
+        # model.save_checkpoint, so Module.load restores aux states too
+        aux_names = set(sym.list_auxiliary_states())
+        nd_utils.save(
+            f"{path}-{epoch:04d}.params",
+            {("aux:" if name in aux_names else "arg:") + name: p.data()
+             for name, p in self.collect_params().items()})
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
         self.hybridize(True)

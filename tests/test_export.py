@@ -1,0 +1,89 @@
+"""HybridBlock symbolic tracing + deployment export tests (reference:
+HybridBlock.export / SymbolBlock.imports round trip —
+tests/python/unittest/test_gluon.py test_export/test_import)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.block import SymbolBlock
+from incubator_mxnet_tpu.symbol.symbol import Symbol
+
+
+def _convnet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net(mx.nd.ones((1, 3, 8, 8)))
+    return net
+
+
+def test_to_symbol_traces_graph():
+    net = _convnet()
+    sym = net.to_symbol("data")
+    assert isinstance(sym, Symbol)
+    args = sym.list_arguments()
+    assert args[0] == "data"
+    assert any("weight" in a for a in args)
+
+
+def test_export_writes_json_and_params(tmp_path):
+    net = _convnet()
+    net.export(str(tmp_path / "m"), epoch=7)
+    assert (tmp_path / "m-symbol.json").is_file()
+    assert (tmp_path / "m-0007.params").is_file()
+
+
+def test_export_import_roundtrip_exact(tmp_path):
+    net = _convnet()
+    X = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (2, 3, 8, 8)).astype(np.float32))
+    ref = net(X).asnumpy()
+    net.export(str(tmp_path / "m"))
+    loaded = SymbolBlock.imports(str(tmp_path / "m-symbol.json"), "data",
+                                 str(tmp_path / "m-0000.params"))
+    np.testing.assert_array_equal(loaded(X).asnumpy(), ref)
+
+
+def test_export_with_batchnorm_aux(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.BatchNorm(in_channels=8),
+            nn.Dense(2))
+    net.initialize(init=mx.init.Xavier())
+    X = mx.nd.array(np.random.default_rng(1).standard_normal(
+        (4, 4)).astype(np.float32))
+    # a few training steps move the running stats off their init
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = (net(X) ** 2).sum()
+        loss.backward()
+    ref = net(X).asnumpy()                    # inference-mode output
+    net.export(str(tmp_path / "bn"))
+    loaded = SymbolBlock.imports(str(tmp_path / "bn-symbol.json"), "data",
+                                 str(tmp_path / "bn-0000.params"))
+    np.testing.assert_allclose(loaded(X).asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gluon_to_onnx_pipeline(tmp_path):
+    from incubator_mxnet_tpu.contrib import onnx as mxonnx
+    net = _convnet()
+    X = mx.nd.array(np.random.default_rng(2).standard_normal(
+        (2, 3, 8, 8)).astype(np.float32))
+    ref = net(X).asnumpy()
+    sym = net.to_symbol("data")
+    path = mxonnx.export_model(
+        sym, {n: p.data() for n, p in net.collect_params().items()},
+        [(2, 3, 8, 8)], onnx_file_path=str(tmp_path / "m.onnx"))
+    served = mxonnx.import_to_gluon(path)
+    np.testing.assert_array_equal(served(X).asnumpy(), ref)
+
+
+def test_symbolic_dispatch_on_symbol_input():
+    net = _convnet()
+    import incubator_mxnet_tpu.symbol as S
+    out = net(S.var("data"))
+    assert isinstance(out, Symbol)
