@@ -45,6 +45,9 @@ from . import image
 from . import metric
 from . import callback
 from . import model
+from . import visualization
+from . import visualization as viz
+from . import checkpoint
 from . import module
 from . import module as mod
 from . import numpy as np

@@ -8,6 +8,7 @@ from . import data
 from . import loss
 from . import utils
 from . import model_zoo
+from . import contrib
 
 __all__ = ["Parameter", "Constant", "ParameterDict", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "rnn", "data", "loss", "utils", "model_zoo"]
+           "SymbolBlock", "Trainer", "nn", "rnn", "data", "loss", "utils", "model_zoo", "contrib"]

@@ -1,0 +1,5 @@
+"""``gluon.contrib`` (reference: python/mxnet/gluon/contrib)."""
+from . import nn
+from . import estimator
+
+__all__ = ["nn", "estimator"]

@@ -1,0 +1,282 @@
+"""``gluon.contrib.estimator`` — high-level fit/evaluate driver with event
+handlers (reference: python/mxnet/gluon/contrib/estimator/estimator.py +
+event_handler.py: Estimator, LoggingHandler, CheckpointHandler,
+EarlyStoppingHandler, ValidationHandler)."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ...base import MXNetError
+from ... import metric as metric_mod
+from .. import loss as loss_mod
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "ValidationHandler"]
+
+
+# ---------------------------------------------------------------------------
+# event mixin interfaces (reference: event_handler.py)
+# ---------------------------------------------------------------------------
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator):
+        pass
+
+
+class StopTraining(Exception):
+    pass
+
+
+class Estimator:
+    """Train/evaluate driver (reference: estimator.Estimator).
+
+    net: a (Hybrid)Block; loss: a gluon loss Block; train_metrics: metric
+    or list; trainer: a gluon Trainer (default: adam 1e-3).
+    """
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        self.net = net
+        if not isinstance(loss, loss_mod.Loss):
+            raise MXNetError("loss must be a gluon loss")
+        self.loss = loss
+        if train_metrics is None:
+            train_metrics = [metric_mod.create("acc")]
+        if not isinstance(train_metrics, (list, tuple)):
+            train_metrics = [train_metrics]
+        self.train_metrics = [metric_mod.create(m) if isinstance(m, str)
+                              else m for m in train_metrics]
+        # separate instances: evaluate() must never clobber the train
+        # metrics' running state
+        import copy
+        self.val_metrics_objs = [copy.deepcopy(m)
+                                 for m in self.train_metrics]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.context = context
+        # state the handlers read
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.train_loss = 0.0
+        self.val_metrics = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def _batches(self, data):
+        for batch in data:
+            if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                yield batch[0], batch[1]
+            else:  # DataBatch from a DataIter
+                yield batch.data[0], batch.label[0]
+
+    def evaluate(self, val_data, metrics=None):
+        """Run metrics over a dataset (reference: Estimator.evaluate)."""
+        from ... import autograd as _ag
+        metrics = metrics or self.val_metrics_objs
+        for m in metrics:
+            m.reset()
+        if hasattr(val_data, "reset"):
+            val_data.reset()
+        for x, y in self._batches(val_data):
+            with _ag.predict_mode():
+                out = self.net(x)
+            for m in metrics:
+                m.update([y], [out])
+        return [(m.get()) for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1,
+            event_handlers: Optional[List] = None, batches=None):
+        """Reference: Estimator.fit — epochs of forward/backward/step with
+        handler callbacks at train/epoch/batch boundaries."""
+        from ... import autograd as _ag
+        handlers = list(event_handlers or [])
+        handlers.append(_MetricUpdater())
+
+        def fire(kind):
+            for h in handlers:
+                fn = getattr(h, kind, None)
+                if fn is not None:
+                    fn(self)
+
+        # re-entrant fit: clear terminal state from a previous run
+        self.stop_training = False
+        self.val_metrics = []
+        self.val_metrics_epoch = -1
+        fire("train_begin")
+        try:
+            for epoch in range(epochs):
+                self.current_epoch = epoch
+                for m in self.train_metrics:
+                    m.reset()
+                self.train_loss = 0.0
+                nbatch = 0
+                if hasattr(train_data, "reset"):
+                    train_data.reset()
+                fire("epoch_begin")
+                for x, y in self._batches(train_data):
+                    fire("batch_begin")
+                    with _ag.record():
+                        out = self.net(x)
+                        # per-sample loss vector + step(batch_size) is the
+                        # reference convention: backward sums, step divides
+                        loss = self.loss(out, y)
+                    loss.backward()
+                    self.trainer.step(x.shape[0])
+                    self.train_loss += float(loss.mean().asscalar())
+                    self.processed_samples += x.shape[0]
+                    self._last_batch = (y, out)
+                    nbatch += 1
+                    fire("batch_end")
+                    if batches is not None and nbatch >= batches:
+                        break
+                self.train_loss /= max(nbatch, 1)
+                if val_data is not None:
+                    self.val_metrics = self.evaluate(val_data)
+                    self.val_metrics_epoch = epoch
+                fire("epoch_end")
+                if self.stop_training:
+                    break
+        except StopTraining:
+            pass
+        fire("train_end")
+        return self
+
+
+class _MetricUpdater(BatchEnd):
+    def batch_end(self, estimator):
+        y, out = estimator._last_batch
+        for m in estimator.train_metrics:
+            m.update([y], [out])
+
+
+# ---------------------------------------------------------------------------
+# handlers (reference: event_handler.py)
+# ---------------------------------------------------------------------------
+class LoggingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Per-epoch logging (reference: LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch"):
+        self.log_interval = log_interval
+        self._t0 = None
+
+    def train_begin(self, estimator):
+        self._t0 = time.time()
+        print(f"Training begin: {len(estimator.train_metrics)} metrics")
+
+    def epoch_end(self, estimator):
+        parts = [f"epoch {estimator.current_epoch}:",
+                 f"loss {estimator.train_loss:.4f}"]
+        for m in estimator.train_metrics:
+            name, val = m.get()
+            parts.append(f"train-{name} {val:.4f}")
+        for name, val in estimator.val_metrics:
+            parts.append(f"val-{name} {val:.4f}")
+        print("  ".join(parts))
+
+    def train_end(self, estimator):
+        print(f"Training done in {time.time() - self._t0:.1f}s "
+              f"({estimator.processed_samples} samples)")
+
+
+class CheckpointHandler(EpochEnd):
+    """Save params every epoch (reference: CheckpointHandler; rides the
+    async checkpointer)."""
+
+    def __init__(self, model_dir, model_prefix="model", keep=3):
+        from ...checkpoint import AsyncCheckpointer
+        import os
+        self._ckpt = AsyncCheckpointer(
+            os.path.join(model_dir, model_prefix), keep=keep)
+
+    def epoch_end(self, estimator):
+        self._ckpt.save(estimator.current_epoch,
+                        {k: p.data() for k, p in
+                         estimator.net.collect_params().items()})
+
+    def train_end(self, estimator):
+        self._ckpt.wait_until_finished()
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when a monitored metric stops improving (reference:
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor_idx=0, mode="max", patience=3,
+                 min_delta=0.0):
+        self.monitor_idx = monitor_idx
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self._best = None
+        self._bad = 0
+
+    def epoch_end(self, estimator):
+        if estimator.val_metrics:
+            # only judge epochs with FRESH validation results — with a
+            # coarser ValidationHandler cadence, stale metrics must not
+            # count toward patience
+            if getattr(estimator, "val_metrics_epoch",
+                       estimator.current_epoch) != estimator.current_epoch:
+                return
+            source = estimator.val_metrics
+        else:
+            source = [m.get() for m in estimator.train_metrics]
+        _, val = source[self.monitor_idx]
+        improved = (self._best is None
+                    or (self.mode == "max"
+                        and val > self._best + self.min_delta)
+                    or (self.mode == "min"
+                        and val < self._best - self.min_delta))
+        if improved:
+            self._best = val
+            self._bad = 0
+        else:
+            self._bad += 1
+            if self._bad >= self.patience:
+                estimator.stop_training = True
+
+
+class ValidationHandler(EpochEnd):
+    """Extra validation on a custom cadence (reference:
+    ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn=None, epoch_period=1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+
+    def epoch_end(self, estimator):
+        if estimator.current_epoch % self.epoch_period:
+            return
+        if self.eval_fn is not None:
+            self.eval_fn(estimator, self.val_data)
+        else:
+            estimator.val_metrics = estimator.evaluate(self.val_data)
+        estimator.val_metrics_epoch = estimator.current_epoch

@@ -1,0 +1,80 @@
+"""Contrib layers (reference: python/mxnet/gluon/contrib/nn/basic_layers.py
+— Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle2D).
+
+Concurrent/HybridConcurrent/Identity are the contrib-era names of what
+later became nn.Concatenate/HybridConcatenate/Identity — aliased to the
+single implementation in gluon.nn (the reference keeps both spellings
+too)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn.basic_layers import (Embedding, Identity, Concatenate,
+                               HybridConcatenate)
+from ..nn import basic_layers as _bl
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class Concurrent(Concatenate):
+    """Run children on the same input, concat outputs (reference:
+    contrib.nn.Concurrent)."""
+
+
+class HybridConcurrent(HybridConcatenate):
+    """Hybridizable Concurrent (reference: contrib.nn.HybridConcurrent)."""
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row_sparse weight gradients (reference:
+    contrib.nn.SparseEmbedding — for very large vocabularies only the
+    touched rows carry gradient; here the sparse_grad=True Embedding
+    provides exactly that, so this is the configured alias)."""
+
+    def __init__(self, input_dim, output_dim, dtype=_np.float32,
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+class SyncBatchNorm(_bl.BatchNorm):
+    """Cross-device synchronized BatchNorm (reference:
+    contrib.nn.SyncBatchNorm, key=..., num_devices=...).
+
+    SPMD note: under the compiled train step the batch statistics are
+    computed over the GLOBAL (mesh-sharded) batch by construction — XLA's
+    reduction over a sharded axis is already the cross-device sync the
+    reference implements with an explicit allreduce — so this subclass
+    only needs to accept the reference's extra arguments."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        kwargs.pop("key", None)
+        super().__init__(in_channels=in_channels, momentum=momentum,
+                         epsilon=epsilon, **kwargs)
+        self._num_devices = num_devices
+
+
+class PixelShuffle2D(HybridBlock):
+    """Rearrange (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference:
+    contrib.nn.PixelShuffle2D — the sub-pixel upsampling layer, expressed
+    with the reference's reshape special codes so it traces symbolically
+    too)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor, factor)
+        self._factor = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factor
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))  # N c f1f2 H W
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))    # N c f1 f2 H W
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))         # N c H f1 W f2
+        return F.reshape(x, shape=(0, 0, -3, -3))           # N c Hf1 Wf2

@@ -218,3 +218,99 @@ def test_composite_embedding(tmp_path):
 def test_pretrained_download_refused():
     with pytest.raises(mx.base.MXNetError):
         text.embedding.get_pretrained_file_names("glove")
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.nn
+# ---------------------------------------------------------------------------
+def test_gluon_contrib_concurrent():
+    from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+    from incubator_mxnet_tpu.gluon import nn as gnn
+    blk = cnn.HybridConcurrent(axis=-1)
+    blk.add(gnn.Dense(3, in_units=4), gnn.Dense(5, in_units=4),
+            cnn.Identity())
+    blk.initialize()
+    out = blk(mx.nd.ones((2, 4)))
+    assert out.shape == (2, 3 + 5 + 4)
+
+
+def test_gluon_contrib_sparse_embedding():
+    from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+    emb = cnn.SparseEmbedding(20, 4)
+    emb.initialize()
+    x = mx.nd.array([1, 5], dtype=np.int32)
+    with mx.autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    g = emb.weight.data().grad
+    assert g.stype == "row_sparse"
+    np.testing.assert_array_equal(g.indices.asnumpy(), [1, 5])
+
+
+def test_gluon_contrib_pixelshuffle():
+    from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+    ps = cnn.PixelShuffle2D(2)
+    x = np.arange(2 * 8 * 3 * 3, dtype=np.float32).reshape(2, 8, 3, 3)
+    out = ps(mx.nd.array(x)).asnumpy()
+    assert out.shape == (2, 2, 6, 6)
+    # against the canonical depth-to-space reference
+    ref = x.reshape(2, 2, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3).reshape(
+        2, 2, 6, 6)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_gluon_contrib_syncbatchnorm_api():
+    from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+    bn = cnn.SyncBatchNorm(in_channels=4, num_devices=8, key="bn0")
+    bn.initialize()
+    out = bn(mx.nd.ones((2, 4, 3, 3)))
+    assert out.shape == (2, 4, 3, 3)
+
+
+def test_estimator_fit_with_handlers(tmp_path):
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est
+    from incubator_mxnet_tpu.gluon import nn as gnn
+    from incubator_mxnet_tpu.gluon import data as gdata
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((256, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 3)).astype(np.float32)
+    y = (X @ W).argmax(1).astype(np.float32)
+    train = gdata.DataLoader(gdata.ArrayDataset(X[:192], y[:192]),
+                             batch_size=32, shuffle=True)
+    val = gdata.DataLoader(gdata.ArrayDataset(X[192:], y[192:]),
+                           batch_size=32)
+
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(32, activation="relu"), gnn.Dense(3))
+    net.initialize(init=mx.init.Xavier())
+    e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      train_metrics="acc",
+                      trainer=gluon.Trainer(net.collect_params(), "adam",
+                                            {"learning_rate": 5e-3}))
+    e.fit(train, val_data=val, epochs=25,
+          event_handlers=[est.LoggingHandler(),
+                          est.CheckpointHandler(str(tmp_path)),
+                          est.EarlyStoppingHandler(patience=10)])
+    name, acc = e.val_metrics[0]
+    assert acc > 0.9, acc
+    assert os.listdir(tmp_path)          # checkpoints landed
+
+
+def test_estimator_early_stopping_stops():
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est
+    from incubator_mxnet_tpu.gluon import nn as gnn
+    from incubator_mxnet_tpu.gluon import data as gdata
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    y = rng.integers(0, 2, 64).astype(np.float32)   # pure noise
+    train = gdata.DataLoader(gdata.ArrayDataset(X, y), batch_size=16)
+    net = gnn.Dense(2, in_units=4)
+    net.initialize()
+    e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      train_metrics="acc",
+                      trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                            {"learning_rate": 0.0}))
+    e.fit(train, epochs=50,
+          event_handlers=[est.EarlyStoppingHandler(patience=2)])
+    assert e.current_epoch < 49          # stopped early (frozen metric)

@@ -1,0 +1,42 @@
+"""mx.viz tests (reference: python/mxnet/visualization.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+
+
+def _lenet_sym():
+    data = S.var("data")
+    x = S.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                      name="c1")
+    x = S.Activation(x, act_type="relu", name="a1")
+    x = S.Flatten(x, name="f1")
+    return S.FullyConnected(x, num_hidden=10, name="fc1")
+
+
+def test_print_summary_counts_params(capsys):
+    total = mx.viz.print_summary(_lenet_sym(),
+                                 shape={"data": (1, 3, 8, 8)})
+    # conv: 4*3*3*3+4 = 112 ; fc: 10*256+10 = 2570
+    assert total == 112 + 2570
+    out = capsys.readouterr().out
+    assert "c1 (Convolution)" in out
+    assert "(1, 4, 8, 8)" in out       # inferred output shape
+    assert "Total params: 2682" in out
+
+
+def test_print_summary_without_shape(capsys):
+    total = mx.viz.print_summary(_lenet_sym())
+    assert total == 0                  # no shapes -> no param counting
+    assert "fc1 (FullyConnected)" in capsys.readouterr().out
+
+
+def test_plot_network_gated_or_renders():
+    try:
+        dot = mx.viz.plot_network(_lenet_sym())
+    except mx.base.MXNetError as e:
+        assert "graphviz" in str(e)
+    else:
+        src = dot.source
+        assert "c1" in src and "fc1" in src
