@@ -46,6 +46,11 @@ from . import metric
 from . import callback
 from . import model
 from . import visualization
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from . import monitor
+from .monitor import Monitor
 from . import visualization as viz
 from . import checkpoint
 from . import module

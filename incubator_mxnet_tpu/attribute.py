@@ -1,0 +1,58 @@
+"""AttrScope (reference: python/mxnet/attribute.py) — a context manager
+that stamps attributes onto every symbol created inside it; the symbol-era
+spelling of layer metadata (ctx_group for manual placement, lr_mult /
+wd_mult hints, profiler scopes).
+
+TPU note: ``ctx_group``/``__ctx_group__`` is recorded for graph-JSON
+fidelity but does not drive placement — SPMD sharding rules replaced the
+reference's PlaceDevice pass (SURVEY §2.4)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["AttrScope", "current"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+class AttrScope:
+    """``with AttrScope(ctx_group='dev1', lr_mult='0.1'):`` — all symbols
+    created in the scope carry these attributes (reference: AttrScope).
+    Scopes nest; inner values win."""
+
+    def __init__(self, **attrs):
+        for v in attrs.values():
+            if not isinstance(v, str):
+                raise TypeError(
+                    "AttrScope values must be strings (reference "
+                    "restriction; got %r)" % (v,))
+        self._attrs = attrs
+
+    def get(self, attrs=None) -> Dict[str, str]:
+        merged = dict(self._attrs)
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current() -> Dict[str, str]:
+    """The merged attrs of all active scopes (inner wins)."""
+    merged: Dict[str, str] = {}
+    for scope in _stack():
+        merged.update(scope._attrs)
+    return merged

@@ -92,9 +92,13 @@ class Estimator:
     def _batches(self, data):
         for batch in data:
             if isinstance(batch, (list, tuple)) and len(batch) >= 2:
-                yield batch[0], batch[1]
+                x, y = batch[0], batch[1]
             else:  # DataBatch from a DataIter
-                yield batch.data[0], batch.label[0]
+                x, y = batch.data[0], batch.label[0]
+            if self.context is not None:
+                x = x.as_in_context(self.context)
+                y = y.as_in_context(self.context)
+            yield x, y
 
     def evaluate(self, val_data, metrics=None):
         """Run metrics over a dataset (reference: Estimator.evaluate)."""
@@ -118,6 +122,11 @@ class Estimator:
         from ... import autograd as _ag
         handlers = list(event_handlers or [])
         handlers.append(_MetricUpdater())
+        # validation must stamp fresh metrics BEFORE consumers (early
+        # stopping, logging) read them (the reference orders handlers by
+        # priority the same way)
+        handlers.sort(key=lambda h: 0 if isinstance(h, ValidationHandler)
+                      else 1)
 
         def fire(kind):
             for h in handlers:
@@ -129,6 +138,7 @@ class Estimator:
         self.stop_training = False
         self.val_metrics = []
         self.val_metrics_epoch = -1
+        self.processed_samples = 0
         fire("train_begin")
         try:
             for epoch in range(epochs):

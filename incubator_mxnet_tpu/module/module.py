@@ -250,6 +250,13 @@ class Module(BaseModule):
             self._optimizer.update(i, weight, grad,
                                    self._updater_states[i])
 
+    def install_monitor(self, mon):
+        """Attach a Monitor to this module's executor (reference:
+        Module.install_monitor — which likewise requires bind first)."""
+        if not self.binded or self._exec is None:
+            raise MXNetError("install_monitor: bind() the module first")
+        mon.install(self._exec)
+
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs
 

@@ -1,0 +1,97 @@
+"""Monitor (reference: python/mxnet/monitor.py) — periodic statistics over
+executor outputs and arguments during training; the symbol-era debugging
+lens (``Module.fit(monitor=...)``).
+
+The reference hooks a stat callback into every executor op output; here
+the executor exposes its arg/grad/output dicts after each forward/backward,
+and the Monitor samples them on ``tic()``/``toc()`` boundaries."""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr: _np.ndarray):
+    return float(_np.abs(arr).mean())
+
+
+class Monitor:
+    """reference: mx.monitor.Monitor(interval, stat_func, pattern, sort).
+
+    Usage (same flow as the reference)::
+
+        mon = Monitor(interval=10, pattern=".*weight")
+        mon.install(executor)           # or Module.install_monitor(mon)
+        for batch in data:
+            mon.tic()
+            ...forward/backward/update...
+            mon.toc_print()
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self._executors: List = []
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, object]] = []
+
+    def install(self, executor):
+        """Attach an executor whose tensors are sampled (reference:
+        Monitor.install via monitor_callback)."""
+        self._executors.append(executor)
+        return executor
+
+    def tic(self):
+        """Start sampling if this step is on the interval (reference:
+        Monitor.tic)."""
+        self.activated = (self.step % self.interval) == 0
+        self.step += 1
+        self.queue = []
+        return self.activated
+
+    def _collect(self):
+        for ex in self._executors:
+            sources = [("arg", getattr(ex, "arg_dict", {}) or {}),
+                       ("grad", {f"{k}_grad": v for k, v in
+                                 (getattr(ex, "grad_dict", {}) or
+                                  {}).items() if v is not None})]
+            outs = getattr(ex, "outputs", None) or []
+            sources.append(("out", {f"output{i}": o
+                                    for i, o in enumerate(outs)}))
+            for _, tensors in sources:
+                for name, arr in tensors.items():
+                    if arr is None or not self.re_pattern.match(name):
+                        continue
+                    value = arr.asnumpy() if isinstance(arr, NDArray) \
+                        else _np.asarray(arr)
+                    self.queue.append(
+                        (self.step, name, self.stat_func(value)))
+
+    def toc(self):
+        """Finish the sampling window; returns [(step, name, stat)]
+        (reference: Monitor.toc)."""
+        if not self.activated:
+            return []
+        self._collect()
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")

@@ -1,0 +1,56 @@
+"""NameManager / Prefix (reference: python/mxnet/name.py) — scoped control
+of the automatic names the symbolic API generates."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current():
+    st = _stack()
+    return st[-1] if st else None
+
+
+class NameManager:
+    """``with NameManager():`` — names auto-generate as ``{hint}{n}`` with
+    counters scoped to this manager (reference: NameManager)."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+class Prefix(NameManager):
+    """``with Prefix('resnet_'):`` — auto names gain the prefix
+    (reference: name.Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint) \
+            if name is None else name

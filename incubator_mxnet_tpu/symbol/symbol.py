@@ -32,6 +32,10 @@ _counters = threading.local()
 
 
 def _next_name(hint: str) -> str:
+    from .. import name as name_mod
+    mgr = name_mod.current()
+    if mgr is not None:       # scoped NameManager/Prefix wins
+        return mgr.get(None, hint)
     if not hasattr(_counters, "tbl"):
         _counters.tbl = {}
     n = _counters.tbl.get(hint, 0)
@@ -148,7 +152,12 @@ class Symbol:
     # attributes
     # ------------------------------------------------------------------
     def attr(self, key: str) -> Optional[str]:
-        v = self._outputs[0][0].attrs.get(key)
+        attrs = self._outputs[0][0].attrs
+        v = attrs.get(key)
+        if v is None:
+            # AttrScope metadata is stored dunder-wrapped so it never
+            # reaches kernel kwargs; surface it under the plain name
+            v = attrs.get(f"__{key}__")
         return None if v is None else str(v)
 
     def list_attr(self) -> Dict[str, str]:
@@ -468,7 +477,9 @@ def var(name: str, attr: Optional[dict] = None, shape=None, dtype=None,
         lr_mult=None, wd_mult=None, init=None, stype=None,
         **kwargs) -> Symbol:
     """Create a symbolic variable (reference: symbol.var / sym.Variable)."""
-    attrs = dict(attr or {})
+    from .. import attribute as attr_mod
+    attrs = {f"__{k}__": v for k, v in attr_mod.current().items()}
+    attrs.update({f"__{k}__": v for k, v in (attr or {}).items()})
     attrs.update(kwargs)
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
@@ -554,7 +565,11 @@ def apply_op(opname: str, *args, name: Optional[str] = None,
     reference's auto-named weights in the symbolic API)."""
     opdef = op_registry.get(opname)
     node_name = name or _next_name(opname.lower().replace(".", "_"))
-    attrs = dict(attr or {})
+    from .. import attribute as attr_mod
+    # ambient AttrScope attrs first (dunder-wrapped: metadata, not kernel
+    # kwargs); explicit attr= wins
+    attrs = {f"__{k}__": v for k, v in attr_mod.current().items()}
+    attrs.update({f"__{k}__": v for k, v in (attr or {}).items()})
     named_inputs: Dict[str, Symbol] = {}
     pos_inputs: List[Symbol] = []
 

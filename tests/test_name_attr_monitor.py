@@ -1,0 +1,93 @@
+"""mx.name / mx.AttrScope / mx.monitor tests (reference:
+python/mxnet/{name,attribute,monitor}.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+import incubator_mxnet_tpu.symbol as S
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("branchA_"):
+        a = S.FullyConnected(S.var("x"), num_hidden=4)
+    assert a.name.startswith("branchA_fullyconnected")
+    b = S.FullyConnected(S.var("x"), num_hidden=4)
+    assert not b.name.startswith("branchA_")
+
+
+def test_name_manager_counters_scoped():
+    with mx.name.NameManager():
+        a = S.relu(S.var("x"))
+        b = S.relu(S.var("x"))
+    assert a.name == "relu0" and b.name == "relu1"
+    with mx.name.NameManager():
+        c = S.relu(S.var("x"))
+    assert c.name == "relu0"      # fresh manager, fresh counters
+
+
+def test_attr_scope_stamps_symbols():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.5"):
+        fc = S.FullyConnected(S.var("x"), num_hidden=2, name="fc")
+        v = S.var("w2")
+    assert fc.attr("ctx_group") == "dev1"
+    assert fc.attr("lr_mult") == "0.5"
+    assert v.attr("ctx_group") == "dev1"
+    # nesting: inner wins; explicit attr= wins over scope
+    with mx.AttrScope(ctx_group="a"):
+        with mx.AttrScope(ctx_group="b"):
+            inner = S.relu(S.var("x"), name="r1")
+        expl = S.relu(S.var("x"), name="r2", attr={"ctx_group": "c"})
+    assert inner.attr("ctx_group") == "b"
+    assert expl.attr("ctx_group") == "c"
+    # outside the scope: clean
+    outside = S.relu(S.var("x"), name="r3")
+    assert outside.attr("ctx_group") is None
+
+
+def test_attr_scope_rejects_nonstring():
+    with pytest.raises(TypeError):
+        mx.AttrScope(lr_mult=0.5)
+
+
+def test_monitor_over_executor():
+    data = S.var("data")
+    out = S.FullyConnected(data, num_hidden=3, name="fc")
+    ex = out.simple_bind(data=(2, 4))
+    mon = mx.Monitor(interval=2, pattern=".*")
+    mon.install(ex)
+
+    seen = []
+    for step in range(4):
+        active = mon.tic()
+        ex.forward(is_train=True,
+                   data=mx.nd.ones((2, 4)) * (step + 1))
+        ex.backward()
+        seen.append((active, mon.toc()))
+    # interval=2: steps 0 and 2 sampled
+    assert seen[0][0] and not seen[1][0] and seen[2][0]
+    names = {n for _, n, _ in seen[0][1]}
+    assert any("fc_weight" in n for n in names)
+    assert any(n.startswith("output") for n in names)
+    assert all(np.isfinite(v) for _, _, v in seen[0][1])
+    assert seen[1][1] == []
+
+
+def test_attr_scope_symbol_still_executes():
+    """Regression: scope attrs are metadata, not kernel kwargs — a symbol
+    built under AttrScope must still infer/execute."""
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.5"):
+        out = S.FullyConnected(S.var("data"), num_hidden=4, name="fc")
+    arg, outs, _ = out.infer_shape(data=(2, 3))
+    assert outs[0] == (2, 4)
+    ex = out.simple_bind(data=(2, 3))
+    ex.forward(is_train=False, data=mx.nd.ones((2, 3)))
+    assert ex.outputs[0].shape == (2, 4)
+    assert out.attr("ctx_group") == "dev1"
+
+
+def test_monitor_before_bind_raises():
+    from incubator_mxnet_tpu.module.module import Module
+    mod = Module(S.relu(S.var("data"), name="r"), data_names=("data",),
+                 label_names=())
+    with pytest.raises(mx.base.MXNetError):
+        mod.install_monitor(mx.Monitor())
