@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""SSD training + inference (reference workload: SSD-512 COCO —
+``example/ssd/train.py`` in the reference repo).
+
+Trains models.ssd on synthetic images with one colored box per image
+(zero-egress environment), then runs NMS-decoded detection.
+
+    python example/detection/train_ssd.py --steps 30 --cpu
+    python example/detection/train_ssd.py --arch ssd512 --size 512  # TPU
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_batch(rng, batch_size, size, num_classes):
+    """Images containing one bright axis-aligned box; label is its class
+    (= intensity bucket) and normalized corners."""
+    x = rng.uniform(0, 0.3, (batch_size, 3, size, size)).astype(np.float32)
+    label = np.full((batch_size, 1, 5), -1.0, np.float32)
+    for b in range(batch_size):
+        w, h = rng.randint(size // 4, size // 2, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        cls = rng.randint(0, num_classes)
+        x[b, cls % 3, y0:y0 + h, x0:x0 + w] = 0.9
+        label[b, 0] = [cls, x0 / size, y0 / size, (x0 + w) / size,
+                       (y0 + h) / size]
+    return x, label
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", choices=["tiny", "ssd300", "ssd512"],
+                    default="tiny")
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.models import ssd as ssd_mod
+
+    mx.random.seed(0)
+    if args.arch == "tiny":
+        net = ssd_mod.ssd_tiny(num_classes=args.num_classes)
+    elif args.arch == "ssd300":
+        net = ssd_mod.ssd_300(num_classes=args.num_classes)
+    else:
+        net = ssd_mod.ssd_512(num_classes=args.num_classes)
+    net.initialize(init=mx.init.Xavier())
+
+    loss_fn = ssd_mod.SSDLoss(args.num_classes)
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    tic = time.time()
+    for step in range(1, args.steps + 1):
+        xb, lb = make_batch(rng, args.batch_size, args.size,
+                            args.num_classes)
+        x = mx.nd.array(xb)
+        label = mx.nd.array(lb)
+        with ag.record():
+            anchor, cls_pred, box_pred = net(x)
+            with ag.pause():
+                loc_t, loc_m, cls_t = net.targets(anchor, label, cls_pred)
+            L = loss_fn(cls_pred, box_pred, cls_t, loc_t, loc_m)
+        L.backward()
+        trainer.step(1)
+        if step % 10 == 0 or step == 1:
+            img_per_s = step * args.batch_size / (time.time() - tic)
+            print(f"step {step:4d}  loss {float(L.asnumpy()):.4f}  "
+                  f"{img_per_s:,.1f} img/s")
+
+    xb, lb = make_batch(rng, 4, args.size, args.num_classes)
+    det = net.detect(mx.nd.array(xb), threshold=0.2).asnumpy()
+    for b in range(4):
+        rows = det[b][det[b, :, 0] >= 0][:3]
+        print(f"image {b}: gt class {int(lb[b,0,0])}, "
+              f"top detections {[(int(r[0]), round(float(r[1]), 2)) for r in rows]}")
+
+
+if __name__ == "__main__":
+    main()

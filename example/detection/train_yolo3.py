@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""YOLOv3 training + inference (reference workload: YOLOv3 COCO —
+GluonCV ``scripts/detection/yolo/train_yolo3.py`` built on this repo's
+ops).
+
+Trains models.yolo on synthetic one-box images (zero-egress
+environment), then runs box_nms-decoded detection.
+
+    python example/detection/train_yolo3.py --steps 30 --cpu
+    python example/detection/train_yolo3.py --arch darknet53 --size 416
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_batch(rng, batch_size, size, num_classes):
+    x = rng.uniform(0, 0.3, (batch_size, 3, size, size)).astype(np.float32)
+    label = np.full((batch_size, 1, 5), -1.0, np.float32)
+    for b in range(batch_size):
+        w, h = rng.randint(size // 4, size // 2, 2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        cls = rng.randint(0, num_classes)
+        x[b, cls % 3, y0:y0 + h, x0:x0 + w] = 0.9
+        label[b, 0] = [cls, x0, y0, x0 + w, y0 + h]   # pixel corners
+    return x, label
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", choices=["tiny", "darknet53"],
+                    default="tiny")
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.models import yolo as yolo_mod
+
+    mx.random.seed(0)
+    if args.arch == "tiny":
+        net = yolo_mod.yolo3_tiny(num_classes=args.num_classes)
+    else:
+        net = yolo_mod.yolo3_darknet53(num_classes=args.num_classes)
+    net.initialize(init=mx.init.Xavier())
+
+    loss_fn = yolo_mod.YOLOv3Loss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": args.lr})
+    in_shape = (args.size, args.size)
+
+    rng = np.random.RandomState(0)
+    tic = time.time()
+    for step in range(1, args.steps + 1):
+        xb, lb = make_batch(rng, args.batch_size, args.size,
+                            args.num_classes)
+        x = mx.nd.array(xb)
+        label = mx.nd.array(lb)
+        with ag.record():
+            preds = net(x)
+            with ag.pause():
+                boxes, obj, cls = net.decode(preds, in_shape)
+                obj_t, box_t, cls_t, wt = net.targets(label, in_shape)
+            L = loss_fn(preds, obj_t, box_t, cls_t, wt, boxes, label)
+        L.backward()
+        trainer.step(1)
+        if step % 10 == 0 or step == 1:
+            img_per_s = step * args.batch_size / (time.time() - tic)
+            print(f"step {step:4d}  loss {float(L.asnumpy()):.4f}  "
+                  f"{img_per_s:,.1f} img/s")
+
+    xb, lb = make_batch(rng, 4, args.size, args.num_classes)
+    det = net.detect(mx.nd.array(xb), threshold=0.1).asnumpy()
+    for b in range(4):
+        rows = det[b][det[b, :, 0] >= 0][:3]
+        print(f"image {b}: gt class {int(lb[b,0,0])}, "
+              f"top detections {[(int(r[0]), round(float(r[1]), 2)) for r in rows]}")
+
+
+if __name__ == "__main__":
+    main()

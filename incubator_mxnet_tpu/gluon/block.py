@@ -325,9 +325,9 @@ class _CachedGraph:
         self.static_shape = static_shape
         self._cache = {}
 
-    def _key(self, arrs, training, recording):
-        return (tuple((a.shape, str(a.dtype)) for a in arrs), training,
-                recording)
+    def _key(self, arrs, template, training, recording):
+        return (tuple((a.shape, str(a.dtype)) for a in arrs), template,
+                training, recording)
 
     def _param_lists(self):
         params = list(self.block.collect_params().values())
@@ -338,16 +338,32 @@ class _CachedGraph:
     def __call__(self, *args):
         import jax
         inputs = [a for a in args if isinstance(a, NDArray)]
+        # non-NDArray positionals (None holes, python literals) are part
+        # of the traced program's structure: key the cache on them and
+        # re-insert them at their original positions inside the trace —
+        # dropping them would misbind later tensor args (e.g. a call
+        # shaped (x, mask=None, mem))
+        template = tuple("\0nd" if isinstance(a, NDArray) else a
+                         for a in args)
+        try:
+            hash(template)
+        except TypeError:
+            template = tuple(t if t == "\0nd" else repr(t)
+                             for t in template)
         trainable, aux = self._param_lists()
         training = _ag.is_training()
-        key = self._key(inputs, training, False)
+        key = self._key(inputs, template, training, False)
 
         if key not in self._cache:
             block = self.block
+            literals = [a for a in args if not isinstance(a, NDArray)]
 
             def pure(in_vals, tr_vals, aux_vals, rng_key):
-                nds = [NDArray(v, ctx=i.ctx)
-                       for v, i in zip(in_vals, inputs)]
+                it_nd = iter([NDArray(v, ctx=i.ctx)
+                              for v, i in zip(in_vals, inputs)])
+                it_lit = iter(literals)
+                nds = [next(it_nd) if isinstance(a, NDArray)
+                       else next(it_lit) for a in args]
                 out_vals, new_aux = functional_call(
                     block, trainable, tr_vals, aux, aux_vals, nds,
                     training, rng_key)

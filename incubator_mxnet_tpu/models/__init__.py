@@ -3,6 +3,17 @@ model scripts that are the judged workloads — BASELINE.md)."""
 from . import bert  # noqa: F401
 from .bert import (BERTModel, BERTEncoder, BERTForPretrain,
                    bert_base, bert_large, bert_tiny)
+from . import transformer  # noqa: F401
+from .transformer import (TransformerModel, transformer_base,
+                          transformer_big)
+from . import ssd  # noqa: F401
+from .ssd import SSD, ssd_512, ssd_300, ssd_tiny
+from . import yolo  # noqa: F401
+from .yolo import YOLOv3, yolo3_darknet53, yolo3_tiny
 
 __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
-           "bert_base", "bert_large", "bert_tiny"]
+           "bert_base", "bert_large", "bert_tiny",
+           "transformer", "TransformerModel", "transformer_base",
+           "transformer_big",
+           "ssd", "SSD", "ssd_512", "ssd_300", "ssd_tiny",
+           "yolo", "YOLOv3", "yolo3_darknet53", "yolo3_tiny"]

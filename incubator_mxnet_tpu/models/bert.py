@@ -34,18 +34,23 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "tp_rules"]
 
 
-def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
+def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
+          causal=False):
     """Fused scaled-dot-product attention op.
 
-    q/k/v: (B, T, C) NDArray.  Splits heads, runs stable softmax attention
-    as one XLA program; with ``seq_axis`` uses ring attention over the mesh
-    (sequence parallelism).
+    q: (B, Tq, C), k/v: (B, Tk, C) NDArray (Tq == Tk for self-attention).
+    Splits heads, runs stable softmax attention as one XLA program;
+    ``mask`` is an optional (B, Tk) 0/1 key-validity mask; ``causal``
+    adds the triangular decoder mask; with ``seq_axis`` uses ring
+    attention over the mesh (sequence parallelism).  Shared by BERT and
+    the NMT Transformer (models/transformer.py).
     """
     inputs = [q, k, v] + ([mask] if mask is not None else [])
 
     def fn(qv, kv, vv, *rest):
         import jax.numpy as jnp
-        B, T, C = qv.shape
+        B, Tq, C = qv.shape
+        Tk = kv.shape[1]
         hd = C // num_heads
 
         def split(x):
@@ -59,7 +64,7 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
             from jax import shard_map
             spec = P(None, None, seq_axis, None)
             body = partial(_ring_body, axis_name=seq_axis, scale=scale,
-                           causal=False)
+                           causal=causal)
             if rest:
                 # valid_length mask is sequence-sharded like K/V and
                 # rotates around the ring with them
@@ -72,16 +77,21 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
                     body, mesh=mesh, in_specs=(spec, spec, spec),
                     out_specs=spec, check_vma=False)(qh, kh, vh)
         else:
-            import os
-            if not rest and os.environ.get("MXNET_USE_FUSION", "0") == "1":
+            from ..base import getenv_bool
+            if (not rest and qh.shape == kh.shape
+                    and getenv_bool("MXNET_USE_FUSION")):
                 # Pallas flash-attention kernel (reference env-var parity:
                 # MXNET_USE_FUSION gates the fused-kernel tier,
                 # src/operator/fusion/fused_op.cc); opt-in until the
                 # kernel is profiled on the real chip
                 from ..kernels import flash_attention
-                out = flash_attention(qh, kh, vh, scale=scale)
+                out = flash_attention(qh, kh, vh, scale=scale,
+                                      causal=causal)
             else:
                 s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+                if causal:
+                    tri = jnp.tril(jnp.ones((Tq, Tk), jnp.bool_))
+                    s = jnp.where(tri[None, None], s, -1e30)
                 if rest:
                     s = jnp.where(rest[0][:, None, None, :] > 0, s, -1e30)
                 m = jnp.max(s, axis=-1, keepdims=True)
@@ -94,8 +104,12 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None):
 
 
 class MultiHeadAttention(HybridBlock):
+    """Projected multi-head attention over _sdpa.  ``mem`` (optional third
+    positional input) switches to cross-attention: keys/values project
+    from ``mem`` while queries project from ``x``."""
+
     def __init__(self, units, num_heads, dropout=0.0, seq_axis=None,
-                 mesh=None, **kwargs):
+                 mesh=None, causal=False, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError("num_heads must divide units")
@@ -103,6 +117,7 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._seq_axis = seq_axis
         self._mesh = mesh
+        self._causal = causal
         with self.name_scope():
             self.query = nn.Dense(units, flatten=False, in_units=units)
             self.key = nn.Dense(units, flatten=False, in_units=units)
@@ -110,10 +125,12 @@ class MultiHeadAttention(HybridBlock):
             self.proj = nn.Dense(units, flatten=False, in_units=units)
             self.dropout = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mask=None):
-        q, k, v = self.query(x), self.key(x), self.value(x)
+    def hybrid_forward(self, F, x, mask=None, mem=None):
+        kv_src = x if mem is None else mem
+        q, k, v = self.query(x), self.key(kv_src), self.value(kv_src)
         out = _sdpa(q, k, v, self._num_heads, mask=mask,
-                    seq_axis=self._seq_axis, mesh=self._mesh)
+                    seq_axis=self._seq_axis, mesh=self._mesh,
+                    causal=self._causal)
         return self.dropout(self.proj(out))
 
 

@@ -1,0 +1,200 @@
+"""SSD single-shot detector (reference workload: SSD-512 COCO/VOC —
+``example/ssd`` in the reference repo builds it from Convolution +
+contrib multibox ops: src/operator/contrib/multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc).
+
+TPU-first design choices:
+  * the whole multi-scale forward is one HybridBlock — anchors, class
+    heads, and box heads concatenate into static-shape (B, N, ·) tensors
+    so the compiled program has no dynamic shapes;
+  * anchor generation is constant-folded by XLA (MultiBoxPrior depends
+    only on feature-map shape);
+  * target assignment (MultiBoxTarget) and NMS decode (MultiBoxDetection)
+    are fixed-size masked programs rather than data-dependent loops — the
+    XLA-friendly re-derivation of the reference's CUDA kernels.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import contrib as _contrib
+from ..ndarray.ndarray import NDArray, _invoke
+
+__all__ = ["SSD", "SSDLoss", "ssd_512", "ssd_300", "ssd_tiny"]
+
+
+def _conv_block(out, channels, kernel=3, stride=1, pad=1):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+
+
+class _DownsampleBackbone(HybridBlock):
+    """Plain conv backbone emitting one feature map per scale.
+
+    ``stage_channels`` — channels per downsampling stage; the last
+    ``num_scales`` stage outputs feed the detection heads (reference
+    analog: VGG-reduced + extra layers in example/ssd/symbol/symbol_builder.py).
+    """
+
+    def __init__(self, stage_channels, num_scales, **kwargs):
+        super().__init__(**kwargs)
+        self._num_scales = num_scales
+        self._stages = []
+        with self.name_scope():
+            for i, ch in enumerate(stage_channels):
+                stage = nn.HybridSequential(prefix=f"stage{i}_")
+                with stage.name_scope():
+                    _conv_block(stage, ch)
+                    _conv_block(stage, ch)
+                    stage.add(nn.MaxPool2D(2, 2))
+                self.register_child(stage, f"stage{i}")
+                self._stages.append(stage)
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        for stage in self._stages:
+            x = stage(x)
+            feats.append(x)
+        return feats[-self._num_scales:]
+
+
+class SSD(HybridBlock):
+    """forward(x) -> (anchors (1,N,4), cls_preds (B,N,C+1),
+    box_preds (B,N*4)); N = sum over scales of H*W*A.
+
+    ``sizes``/``ratios`` — per-scale anchor configs as in
+    contrib.MultiBoxPrior (A = len(sizes)+len(ratios)-1 per position).
+    """
+
+    def __init__(self, num_classes, stage_channels, sizes, ratios,
+                 num_scales=None, **kwargs):
+        super().__init__(**kwargs)
+        num_scales = num_scales or len(sizes)
+        if not (len(sizes) == len(ratios) == num_scales):
+            raise ValueError("sizes/ratios must have one entry per scale")
+        self._num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        with self.name_scope():
+            self.backbone = _DownsampleBackbone(stage_channels, num_scales)
+            self._cls_heads, self._box_heads = [], []
+            for i in range(num_scales):
+                A = len(sizes[i]) + len(ratios[i]) - 1
+                cls = nn.Conv2D(A * (num_classes + 1), 3, 1, 1)
+                box = nn.Conv2D(A * 4, 3, 1, 1)
+                self.register_child(cls, f"cls_head{i}")
+                self.register_child(box, f"box_head{i}")
+                self._cls_heads.append(cls)
+                self._box_heads.append(box)
+
+    @staticmethod
+    def _flatten_pred(pred, last):
+        """(B, A*last, H, W) -> (B, H*W*A, last)."""
+        def fn(p):
+            import jax.numpy as jnp
+            B, AL, H, W = p.shape
+            p = p.transpose(0, 2, 3, 1).reshape(B, H * W * (AL // last),
+                                                last)
+            return p
+        return _invoke(fn, [pred], name="ssd_flatten_pred")
+
+    def hybrid_forward(self, F, x):
+        feats = self.backbone(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for i, feat in enumerate(feats):
+            anchors.append(_contrib.MultiBoxPrior(
+                feat, sizes=self._sizes[i], ratios=self._ratios[i],
+                clip=False))
+            cls_preds.append(self._flatten_pred(
+                self._cls_heads[i](feat), self._num_classes + 1))
+            box_preds.append(self._flatten_pred(
+                self._box_heads[i](feat), 4))
+
+        def cat(*xs):
+            import jax.numpy as jnp
+            return jnp.concatenate(xs, axis=1)
+        anchor = _invoke(cat, anchors, name="ssd_cat_anchors")
+        cls_pred = _invoke(cat, cls_preds, name="ssd_cat_cls")
+        box_pred = _invoke(cat, box_preds, name="ssd_cat_box")
+        box_pred = box_pred.reshape(box_pred.shape[0], -1)
+        return anchor, cls_pred, box_pred
+
+    def targets(self, anchor, label, cls_pred,
+                negative_mining_ratio=3.0):
+        """MultiBoxTarget wrapper: label (B,M,5) [cls,x0,y0,x1,y1], pad
+        rows cls=-1.  Returns loc_target, loc_mask, cls_target."""
+        return _contrib.MultiBoxTarget(
+            anchor, label, cls_pred.transpose(0, 2, 1),
+            negative_mining_ratio=negative_mining_ratio)
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=400):
+        """Inference: decode + per-class NMS -> (B, N, 6) rows
+        [cls_id, score, x0, y0, x1, y1], -1 rows invalid."""
+        from .. import ndarray as F
+        anchor, cls_pred, box_pred = self(x)
+        cls_prob = F.softmax(cls_pred, axis=-1).transpose(0, 2, 1)
+        return _contrib.MultiBoxDetection(
+            cls_prob, box_pred, anchor, threshold=threshold,
+            nms_threshold=nms_threshold, nms_topk=nms_topk)
+
+
+class SSDLoss(HybridBlock):
+    """Hard-negative-mined softmax CE over classes + smooth-L1 over
+    encoded offsets (reference: example/ssd/symbol/symbol_builder.py
+    training head).  cls_target -1 entries (ignored negatives) drop out
+    of both terms."""
+
+    def __init__(self, num_classes, lambd=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._C = num_classes + 1
+        self._lambd = lambd
+
+    def hybrid_forward(self, F, cls_pred, loc_pred, cls_target,
+                       loc_target, loc_mask):
+        C, lambd = self._C, self._lambd
+
+        def fn(cp, lp, ct, lt, lm):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(cp.astype(jnp.float32), axis=-1)
+            ctc = jnp.clip(ct, 0, C - 1).astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, ctc[..., None],
+                                       axis=-1)[..., 0]
+            keep = (ct >= 0).astype(nll.dtype)
+            cls_loss = jnp.sum(nll * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+            d = (lp - lt) * lm
+            ad = jnp.abs(d)
+            sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+            npos = jnp.maximum(jnp.sum(lm) / 4.0, 1.0)
+            loc_loss = jnp.sum(sl1) / npos
+            return cls_loss + lambd * loc_loss
+        return _invoke(fn, [cls_pred, loc_pred, cls_target, loc_target,
+                            loc_mask], name="ssd_loss")
+
+
+def ssd_512(num_classes=80, **kw):
+    """SSD-512 COCO-shaped config (the judged BASELINE workload):
+    7 feature scales from 512x512 input."""
+    sizes = [(0.07, 0.1025), (0.15, 0.2121), (0.3, 0.3674),
+             (0.45, 0.5196), (0.6, 0.6708), (0.75, 0.8216),
+             (0.9, 0.9721)]
+    ratios = [(1, 2, 0.5)] * 3 + [(1, 2, 0.5, 3, 1.0 / 3)] * 4
+    return SSD(num_classes,
+               stage_channels=(64, 128, 256, 512, 512, 256, 256),
+               sizes=sizes, ratios=ratios, **kw)
+
+
+def ssd_300(num_classes=20, **kw):
+    sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79), (0.88, 0.961)]
+    ratios = [(1, 2, 0.5)] * 2 + [(1, 2, 0.5, 3, 1.0 / 3)] * 4
+    return SSD(num_classes, stage_channels=(64, 128, 256, 512, 256, 256),
+               sizes=sizes, ratios=ratios, **kw)
+
+
+def ssd_tiny(num_classes=3, **kw):
+    """Small config for tests: 2 scales."""
+    return SSD(num_classes, stage_channels=(8, 16),
+               sizes=[(0.2, 0.272), (0.5, 0.62)],
+               ratios=[(1, 2, 0.5)] * 2, **kw)

@@ -1,0 +1,357 @@
+"""Transformer for NMT (reference workload: Transformer-base WMT14 En-De —
+GluonNLP ``scripts/machine_translation`` builds it from this repo's ops:
+gluon.nn.Dense/LayerNorm/Embedding/Dropout + batch_dot/softmax,
+python/mxnet/gluon/nn/basic_layers.py).
+
+TPU-first design choices (mirrors models/bert.py):
+  * self/cross attention is ONE fused op (stable-softmax SDPA) so XLA
+    keeps the whole layer on the MXU; causal masking is a static
+    triangular mask baked into the compiled program — no dynamic shapes;
+  * sinusoidal position table is a constant folded at trace time;
+  * greedy decode runs as a ``lax.scan`` over decode steps (static trip
+    count = max_length) instead of a Python loop, so inference is one
+    compiled program;
+  * Megatron-style ``tp_rules`` identical in spirit to bert.tp_rules.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray, _invoke
+from .bert import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerModel",
+           "LabelSmoothingCELoss", "transformer_base", "transformer_big",
+           "tp_rules"]
+
+
+def _positional_table(max_length, units):
+    """Sinusoidal table, float32 numpy constant (folded by XLA)."""
+    pos = _np.arange(max_length)[:, None]
+    dim = _np.arange(units // 2)[None, :]
+    ang = pos / _np.power(10000.0, 2.0 * dim / units)
+    table = _np.zeros((max_length, units), _np.float32)
+    table[:, 0::2] = _np.sin(ang)
+    table[:, 1::2] = _np.cos(ang)
+    return table
+
+
+class _EncoderCell(HybridBlock):
+    """Post-LN layer (original Vaswani/GluonNLP transformer); attention
+    and FFN are the shared blocks from models/bert.py."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation="relu")
+            self.ln2 = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, src_mask=None):
+        x = self.ln1(x + self.attention(x, src_mask))
+        return self.ln2(x + self.ffn(x))
+
+
+class _DecoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attention = MultiHeadAttention(units, num_heads,
+                                                     dropout, causal=True)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.cross_attention = MultiHeadAttention(units, num_heads,
+                                                      dropout)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation="relu")
+            self.ln3 = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, mem, src_mask=None):
+        x = self.ln1(x + self.self_attention(x, None))
+        x = self.ln2(x + self.cross_attention(x, src_mask, mem))
+        return self.ln3(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            for i in range(num_layers):
+                self.register_child(
+                    _EncoderCell(units, hidden_size, num_heads, dropout),
+                    f"layer{i}")
+
+    def hybrid_forward(self, F, x, src_mask=None):
+        for cell in self._children.values():
+            x = cell(x, src_mask)
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            for i in range(num_layers):
+                self.register_child(
+                    _DecoderCell(units, hidden_size, num_heads, dropout),
+                    f"layer{i}")
+
+    def hybrid_forward(self, F, x, mem, src_mask=None):
+        for cell in self._children.values():
+            x = cell(x, mem, src_mask)
+        return x
+
+
+class TransformerModel(HybridBlock):
+    """Encoder-decoder NMT transformer (reference workload:
+    Transformer-base, GluonNLP machine_translation scripts).
+
+    forward(src_ids, tgt_ids[, src_valid]) -> (B, Tt, vocab) logits.
+    Shares source/target embedding and ties the output projection to the
+    embedding weight (the WMT14 recipe)."""
+
+    def __init__(self, vocab_size=36000, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, max_length=1024, dropout=0.1,
+                 tie_weights=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._vocab_size = vocab_size
+        self._tie = tie_weights
+        self._pos_table = _positional_table(max_length, units)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units)
+            self.embed_dropout = nn.Dropout(dropout)
+            self.encoder = TransformerEncoder(num_layers, units,
+                                              hidden_size, num_heads,
+                                              dropout)
+            self.decoder = TransformerDecoder(num_layers, units,
+                                              hidden_size, num_heads,
+                                              dropout)
+            if not tie_weights:
+                self.out_proj = nn.Dense(vocab_size, flatten=False,
+                                         in_units=units)
+
+    def _embed(self, F, ids):
+        T = ids.shape[-1]
+        if T > self._pos_table.shape[0]:
+            raise MXNetError(
+                f"sequence length {T} exceeds max_length "
+                f"{self._pos_table.shape[0]}; construct TransformerModel "
+                "with a larger max_length")
+        emb = self.embed(ids) * math.sqrt(self._units)
+        pos = NDArray(self._pos_table[:T]).astype(emb.dtype)
+        return self.embed_dropout(emb + pos.expand_dims(0))
+
+    @staticmethod
+    def _valid_to_mask(src_ids, src_valid):
+        """(B,) valid lengths -> (B, Ts) 0/1 key mask (None passthrough),
+        the mask form bert._sdpa consumes."""
+        if src_valid is None:
+            return None
+        Ts = src_ids.shape[-1]
+
+        def fn(vl):
+            import jax.numpy as jnp
+            return (jnp.arange(Ts)[None, :]
+                    < vl.reshape(-1, 1)).astype(jnp.float32)
+        return _invoke(fn, [src_valid], name="valid_to_mask",
+                       differentiable=False)
+
+    def _project(self, h):
+        if self._tie:
+            w = self.embed.weight.data()
+
+            def fn(hv, wv):
+                import jax.numpy as jnp
+                return jnp.einsum("btu,vu->btv", hv, wv)
+            return _invoke(fn, [h, w], name="tied_projection")
+        return self.out_proj(h)
+
+    def encode(self, src_ids, src_valid=None, _mask=None):
+        from .. import ndarray as F
+        mask = (self._valid_to_mask(src_ids, src_valid)
+                if _mask is None else _mask)
+        return self.encoder(self._embed(F, src_ids), mask)
+
+    def hybrid_forward(self, F, src_ids, tgt_ids, src_valid=None):
+        mask = self._valid_to_mask(src_ids, src_valid)
+        mem = self.encoder(self._embed(F, src_ids), mask)
+        dec = self.decoder(self._embed(F, tgt_ids), mem, mask)
+        return self._project(dec)
+
+    def greedy_decode(self, src_ids, max_length=32, bos=2, eos=3,
+                      src_valid=None):
+        """Greedy translation as one lax.scan program (static trip count;
+        reference analog: GluonNLP BeamSearchTranslator, greedy mode).
+        Returns (B, max_length) int32 token ids."""
+        mask = self._valid_to_mask(src_ids, src_valid)
+        mem = self.encode(src_ids, _mask=mask)
+        maskv = None if mask is None else mask._data
+        B = src_ids.shape[0]
+
+        def fn(memv):
+            import jax
+            import jax.numpy as jnp
+
+            def step(toks, t):
+                # re-run the decoder over the fixed-width prefix; the
+                # causal mask makes positions >= t inert, so growing the
+                # prefix is sharding- and shape-static (KV-cache decode
+                # is a perf follow-up, not a semantics change)
+                logits = self._decode_tokens(jnp.asarray(toks), memv,
+                                             maskv)
+                nxt = jnp.argmax(logits[:, t, :], axis=-1).astype(jnp.int32)
+                # sequences that already emitted eos stay frozen on eos
+                nxt = jnp.where(toks[:, t] == eos, eos, nxt)
+                toks = toks.at[:, t + 1].set(nxt)
+                return toks, nxt
+
+            toks0 = jnp.full((B, max_length), eos, jnp.int32)
+            toks0 = toks0.at[:, 0].set(bos)
+            toks, _ = jax.lax.scan(step, toks0,
+                                   jnp.arange(max_length - 1))
+            return toks
+        out = fn(mem._data)
+        return NDArray(out)
+
+    def _decode_tokens(self, toks, memv, maskv=None):
+        """jnp (B, T) tokens + jnp memory (+ optional (B, Ts) source
+        mask) -> jnp logits; traceable."""
+        from .. import autograd as ag
+        with ag.pause():
+            dec = self.decoder(self._embed(None, NDArray(toks)),
+                               NDArray(memv),
+                               None if maskv is None else NDArray(maskv))
+            return self._project(dec)._data
+
+    def beam_search(self, src_ids, beam_size=4, max_length=32, bos=2,
+                    eos=3, alpha=0.6, src_valid=None):
+        """Beam-search translation as one lax.scan program (reference
+        analog: GluonNLP BeamSearchTranslator over this model).
+
+        Returns (tokens (B, K, max_length) int32, scores (B, K) float32)
+        sorted best-first, with GNMT length normalization
+        ``score / ((5+len)/6)**alpha``.  Finished beams (emitted ``eos``)
+        are frozen: they only extend with ``eos`` at no score cost."""
+        mask = self._valid_to_mask(src_ids, src_valid)
+        mem = self.encode(src_ids, _mask=mask)
+        B = src_ids.shape[0]
+        K = beam_size
+        V = self._vocab_size
+
+        def fn(memv):
+            import jax
+            import jax.numpy as jnp
+            # replicate memory (and source mask) per beam: (B*K, ...)
+            memk = jnp.repeat(memv, K, axis=0)
+            maskk = (None if mask is None
+                     else jnp.repeat(mask._data, K, axis=0))
+            neg_inf = jnp.float32(-1e30)
+
+            def step(carry, t):
+                toks, scores, lengths = carry      # (B,K,T),(B,K),(B,K)
+                flat = toks.reshape(B * K, -1)
+                logits = self._decode_tokens(flat, memk,
+                                             maskk)[:, t, :]
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+                done = toks[:, :, t] == eos        # beam already finished
+                # finished beams: only eos, at zero cost
+                only_eos = jnp.full((V,), neg_inf).at[eos].set(0.0)
+                logp = jnp.where(done[..., None], only_eos[None, None],
+                                 logp)
+                total = scores[..., None] + logp          # B,K,V
+                flat_total = total.reshape(B, K * V)
+                top_scores, top_idx = jax.lax.top_k(flat_total, K)
+                beam_idx = top_idx // V                   # B,K
+                tok_idx = (top_idx % V).astype(jnp.int32)
+                bsel = jnp.arange(B)[:, None]
+                toks = toks[bsel, beam_idx]               # reorder beams
+                lengths = lengths[bsel, beam_idx]
+                was_done = done[bsel, beam_idx]
+                toks = toks.at[:, :, t + 1].set(tok_idx)
+                lengths = jnp.where(
+                    was_done, lengths,
+                    lengths + (tok_idx != eos).astype(lengths.dtype))
+                return (toks, top_scores, lengths), None
+
+            toks0 = jnp.full((B, K, max_length), eos, jnp.int32)
+            toks0 = toks0.at[:, :, 0].set(bos)
+            # all beams start identical: only beam 0 live, so the first
+            # expansion picks K distinct tokens instead of K copies
+            scores0 = jnp.full((B, K), neg_inf).at[:, 0].set(0.0)
+            len0 = jnp.zeros((B, K), jnp.float32)
+            (toks, scores, lengths), _ = jax.lax.scan(
+                step, (toks0, scores0, len0),
+                jnp.arange(max_length - 1))
+            norm = ((5.0 + lengths) / 6.0) ** alpha
+            final = scores / norm
+            order = jnp.argsort(-final, axis=-1)
+            bsel = jnp.arange(B)[:, None]
+            return toks[bsel, order], final[bsel, order]
+        toks, scores = fn(mem._data)
+        return NDArray(toks), NDArray(scores)
+
+
+class LabelSmoothingCELoss(HybridBlock):
+    """Cross entropy with label smoothing eps (WMT14 recipe: eps=0.1),
+    ignoring padding positions (label == ``pad``).  Mean over non-pad
+    tokens."""
+
+    def __init__(self, vocab_size, eps=0.1, pad=0, **kwargs):
+        super().__init__(**kwargs)
+        self._V = vocab_size
+        self._eps = eps
+        self._pad = pad
+
+    def hybrid_forward(self, F, logits, labels):
+        V, eps, pad = self._V, self._eps, self._pad
+
+        def fn(lg, lb):
+            import jax
+            import jax.numpy as jnp
+            lg = lg.reshape(-1, V)
+            lb = lb.reshape(-1)
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, lb[:, None].astype(jnp.int32),
+                                       axis=-1)[:, 0]
+            smooth = -jnp.mean(logp, axis=-1)
+            loss = (1.0 - eps) * nll + eps * smooth
+            keep = (lb != pad).astype(loss.dtype)
+            return jnp.sum(loss * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+        return _invoke(fn, [logits, labels], name="label_smoothing_ce")
+
+
+def tp_rules(model_axis="model"):
+    """Megatron-style TP sharding rules for SPMDTrainer (see
+    bert.tp_rules)."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"ffn_1.*weight", P(model_axis, None)),
+        (r"ffn_2.*weight", P(None, model_axis)),
+        (r"(query|key|value).*weight", P(model_axis, None)),
+        (r"proj.*weight", P(None, model_axis)),
+        (r"embed.*weight", P(None, model_axis)),
+    ]
+
+
+def transformer_base(vocab_size=36000, **kw):
+    """Vaswani et al. base config — the WMT14 En-De judged workload."""
+    return TransformerModel(vocab_size=vocab_size, units=512,
+                            hidden_size=2048, num_layers=6, num_heads=8,
+                            **kw)
+
+
+def transformer_big(vocab_size=36000, **kw):
+    return TransformerModel(vocab_size=vocab_size, units=1024,
+                            hidden_size=4096, num_layers=6, num_heads=16,
+                            **kw)

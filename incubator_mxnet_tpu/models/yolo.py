@@ -1,0 +1,338 @@
+"""YOLOv3 detector (reference workload: YOLOv3 COCO — GluonCV
+``model_zoo/yolo`` builds it from this repo's Convolution/BatchNorm/
+LeakyReLU + slice/sigmoid ops; the reference repo itself ships the ops
+and the ``example/ssd`` detection tooling).
+
+TPU-first design choices:
+  * three scale heads emit static-shape (B, N, 5+C) predictions that are
+    concatenated once — no per-box Python control flow anywhere;
+  * target assignment is a dense one-shot scatter (best-anchor matching
+    computed with vectorized shape-IoU + ``argmax``), so one XLA program
+    builds all targets — the re-derivation of GluonCV's
+    ``YOLOV3TargetMerger`` without dynamic shapes;
+  * decode (grid offsets + anchor scaling) is folded into the same
+    program as the heads.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import contrib as _contrib
+from ..ndarray.ndarray import NDArray, _invoke
+
+__all__ = ["YOLOv3", "YOLOv3Loss", "yolo3_darknet53", "yolo3_tiny"]
+
+
+def _conv_bn_leaky(out, channels, kernel, stride=1):
+    pad = kernel // 2
+    out.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.LeakyReLU(0.1))
+
+
+class _DarknetBlock(HybridBlock):
+    """Residual 1x1-reduce + 3x3 block (reference analog: GluonCV
+    DarknetBasicBlockV3)."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            with self.body.name_scope():
+                _conv_bn_leaky(self.body, channels // 2, 1)
+                _conv_bn_leaky(self.body, channels, 3)
+
+    def hybrid_forward(self, F, x):
+        return x + self.body(x)
+
+
+class _Darknet(HybridBlock):
+    """Darknet-style backbone emitting features at strides 8/16/32.
+
+    ``stage_channels``/``stage_blocks`` control depth; darknet53 uses
+    (64,128,256,512,1024) x (1,2,8,8,4)."""
+
+    def __init__(self, stage_channels, stage_blocks, **kwargs):
+        super().__init__(**kwargs)
+        self._n_stages = len(stage_channels)
+        with self.name_scope():
+            stem = nn.HybridSequential(prefix="stem_")
+            with stem.name_scope():
+                _conv_bn_leaky(stem, max(stage_channels[0] // 2, 8), 3)
+            self.register_child(stem, "stem")
+            for i, (ch, nb) in enumerate(zip(stage_channels, stage_blocks)):
+                stage = nn.HybridSequential(prefix=f"stage{i}_")
+                with stage.name_scope():
+                    _conv_bn_leaky(stage, ch, 3, stride=2)
+                    for _ in range(nb):
+                        stage.add(_DarknetBlock(ch))
+                self.register_child(stage, f"stage{i}")
+
+    def hybrid_forward(self, F, x):
+        children = list(self._children.values())
+        x = children[0](x)
+        feats = []
+        for stage in children[1:]:
+            x = stage(x)
+            feats.append(x)
+        return feats[-3:]   # strides 8, 16, 32 (for >=3 stages)
+
+
+class YOLOv3(HybridBlock):
+    """forward(x) -> (B, N, 5+C) raw predictions + self.anchors/strides
+    metadata; ``decode`` turns them into boxes.
+
+    ``anchors``: 3 scale groups of (A, 2) pixel anchor shapes, small
+    scale first (GluonCV convention).  N = sum H_s*W_s*A."""
+
+    def __init__(self, num_classes, stage_channels, stage_blocks, anchors,
+                 strides=(8, 16, 32), **kwargs):
+        super().__init__(**kwargs)
+        if len(anchors) != 3:
+            raise ValueError("anchors must have 3 scale groups")
+        self._C = num_classes
+        self.anchors = [_np.asarray(a, _np.float32).reshape(-1, 2)
+                        for a in anchors]
+        self.strides = tuple(strides)
+        with self.name_scope():
+            self.backbone = _Darknet(stage_channels, stage_blocks)
+            for i in range(3):
+                A = self.anchors[i].shape[0]
+                head = nn.HybridSequential(prefix=f"head{i}_")
+                with head.name_scope():
+                    _conv_bn_leaky(head, stage_channels[-3 + i], 3)
+                    head.add(nn.Conv2D(A * (5 + num_classes), 1, 1, 0))
+                self.register_child(head, f"head{i}")
+
+    def hybrid_forward(self, F, x):
+        feats = self.backbone(x)
+        heads = [self._children[f"head{i}"] for i in range(3)]
+        preds = [heads[i](feats[i]) for i in range(3)]
+        C = self._C
+
+        def fn(*ps):
+            import jax.numpy as jnp
+            outs = []
+            for p in ps:
+                B, AL, H, W = p.shape
+                A = AL // (5 + C)
+                outs.append(p.transpose(0, 2, 3, 1)
+                            .reshape(B, H * W * A, 5 + C))
+            return jnp.concatenate(outs, axis=1)
+        return _invoke(fn, preds, name="yolo_gather_heads")
+
+    # -- static per-input-shape anchor/grid metadata ---------------------
+    def _grid_meta(self, in_h, in_w):
+        """Per-prediction-row [cx_cell, cy_cell, anchor_w, anchor_h,
+        stride] as one (N, 5) numpy constant."""
+        rows = []
+        for s, anc in zip(self.strides, self.anchors):
+            H, W = in_h // s, in_w // s
+            A = anc.shape[0]
+            gy, gx = _np.meshgrid(_np.arange(H), _np.arange(W),
+                                  indexing="ij")
+            cell = _np.stack([gx, gy], -1).reshape(H * W, 1, 2)
+            cell = _np.broadcast_to(cell, (H * W, A, 2)).reshape(-1, 2)
+            aa = _np.broadcast_to(anc[None], (H * W, A, 2)).reshape(-1, 2)
+            st = _np.full((H * W * A, 1), s, _np.float32)
+            rows.append(_np.concatenate([cell, aa, st], 1))
+        return _np.concatenate(rows, 0).astype(_np.float32)
+
+    def decode(self, preds, in_shape):
+        """Raw (B,N,5+C) -> (boxes (B,N,4) corner pixels, obj (B,N),
+        cls_prob (B,N,C))."""
+        meta = self._grid_meta(*in_shape)
+
+        def fn(p):
+            import jax
+            import jax.numpy as jnp
+            m = jnp.asarray(meta)
+            xy = (jax.nn.sigmoid(p[..., 0:2]) + m[:, 0:2]) * m[:, 4:5]
+            wh = jnp.exp(jnp.clip(p[..., 2:4], -8, 8)) * m[:, 2:4]
+            boxes = jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
+            obj = jax.nn.sigmoid(p[..., 4])
+            cls = jax.nn.sigmoid(p[..., 5:])
+            return boxes, obj, cls
+        return _invoke(fn, [preds], name="yolo_decode")
+
+    def targets(self, labels, in_shape):
+        """Dense target builder (one XLA program).
+
+        labels: (B, M, 5) rows [cls, x0, y0, x1, y1] in pixels, pad rows
+        cls=-1.  Returns [obj_t (B,N), box_t (B,N,4) raw-pred-space,
+        cls_t (B,N,C), weight (B,N)] — weight is the box-loss scale
+        2 - w*h/(in_h*in_w) of GluonCV."""
+        meta = self._grid_meta(*in_shape)
+        offsets = []       # row offset of each scale group
+        off = 0
+        for s, anc in zip(self.strides, self.anchors):
+            offsets.append(off)
+            off += (in_shape[0] // s) * (in_shape[1] // s) * anc.shape[0]
+        N = off
+        C = self._C
+        all_anc = _np.concatenate(self.anchors, 0)      # (3A, 2)
+        per_scale_A = [a.shape[0] for a in self.anchors]
+        in_h, in_w = in_shape
+
+        def fn(lb):
+            import jax
+            import jax.numpy as jnp
+            B, M, _ = lb.shape
+            cls_id = lb[..., 0]
+            x0, y0, x1, y1 = (lb[..., 1], lb[..., 2], lb[..., 3],
+                              lb[..., 4])
+            gw, gh = x1 - x0, y1 - y0
+            gcx, gcy = (x0 + x1) / 2, (y0 + y1) / 2
+            valid = cls_id >= 0
+
+            anc = jnp.asarray(all_anc)                   # (K,2)
+            inter = (jnp.minimum(gw[..., None], anc[:, 0])
+                     * jnp.minimum(gh[..., None], anc[:, 1]))
+            union = gw[..., None] * gh[..., None] \
+                + anc[:, 0] * anc[:, 1] - inter
+            best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # B,M
+
+            # map best anchor -> (scale, anchor-in-scale)
+            bounds = _np.cumsum([0] + per_scale_A)
+            scale_idx = jnp.sum(
+                best[..., None] >= jnp.asarray(bounds[1:-1])[None, None],
+                -1) if len(per_scale_A) > 1 else jnp.zeros_like(best)
+            a_in_s = best - jnp.asarray(bounds[:-1])[scale_idx]
+
+            strides = jnp.asarray(_np.asarray(self.strides, _np.float32))
+            st = strides[scale_idx]
+            ci = jnp.clip((gcx // st), 0, in_w / st - 1).astype(jnp.int32)
+            cj = jnp.clip((gcy // st), 0, in_h / st - 1).astype(jnp.int32)
+            Ws = (in_w / st).astype(jnp.int32)
+            As = jnp.asarray(_np.asarray(per_scale_A, _np.int32))[scale_idx]
+            row = (jnp.asarray(_np.asarray(offsets, _np.int32))[scale_idx]
+                   + (cj * Ws + ci) * As + a_in_s)      # B,M
+            # pad rows scatter out-of-bounds and are dropped, so they can
+            # never clobber a real target that lives at row 0
+            row = jnp.where(valid, row, N)
+
+            # raw-space regression targets
+            tx = gcx / st - (gcx // st)
+            ty = gcy / st - (gcy // st)
+            aw = anc[best][..., 0]
+            ah = anc[best][..., 1]
+            tw = jnp.log(jnp.maximum(gw, 1.0) / aw)
+            th = jnp.log(jnp.maximum(gh, 1.0) / ah)
+            box_t_rows = jnp.stack([tx, ty, tw, th], -1)  # B,M,4
+            w_rows = 2.0 - (gw * gh) / float(in_h * in_w)
+
+            vf = valid.astype(jnp.float32)
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, M))
+            obj_t = jnp.zeros((B, N)).at[bidx, row].max(vf, mode="drop")
+            box_t = jnp.zeros((B, N, 4)).at[bidx, row].set(
+                box_t_rows * vf[..., None], mode="drop")
+            onehot = jax.nn.one_hot(jnp.clip(cls_id, 0).astype(jnp.int32),
+                                    C) * vf[..., None]
+            cls_t = jnp.zeros((B, N, C)).at[bidx, row].set(
+                onehot, mode="drop")
+            weight = jnp.zeros((B, N)).at[bidx, row].set(
+                w_rows * vf, mode="drop")
+            return obj_t, box_t, cls_t, weight
+        return _invoke(fn, [labels], name="yolo_targets",
+                       differentiable=False)
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, topk=100):
+        """Full inference: decode + class-agnostic NMS via contrib.box_nms.
+        Returns (B, N, 6) rows [cls_id, score, x0, y0, x1, y1]."""
+        from .. import ndarray as F
+        preds = self(x)
+        in_shape = (x.shape[2], x.shape[3])
+        boxes, obj, cls = self.decode(preds, in_shape)
+
+        def fn(bx, ob, cl):
+            import jax.numpy as jnp
+            score = ob[..., None] * cl                  # B,N,C
+            best_c = jnp.argmax(score, -1).astype(jnp.float32)
+            best_s = jnp.max(score, -1)
+            return jnp.concatenate(
+                [best_c[..., None], best_s[..., None], bx], -1)
+        raw = _invoke(fn, [boxes, obj, cls], name="yolo_gather_det")
+        return _contrib.box_nms(raw, overlap_thresh=nms_threshold,
+                                valid_thresh=threshold, topk=topk,
+                                coord_start=2, score_index=1, id_index=0)
+
+
+class YOLOv3Loss(HybridBlock):
+    """Objectness BCE (with ignore region) + center BCE + size L2 + class
+    BCE (reference analog: GluonCV YOLOV3Loss).  All terms masked by the
+    dense targets from YOLOv3.targets.
+
+    Pass decoded ``boxes`` + raw ``labels`` to enable the ignore mask:
+    negatives whose decoded box overlaps any ground truth above
+    ``ignore_iou_thresh`` are excluded from the objectness loss (the
+    GluonCV dynamic-IoU rule, computed densely)."""
+
+    def __init__(self, ignore_iou_thresh=0.7, **kwargs):
+        super().__init__(**kwargs)
+        self._ignore = ignore_iou_thresh
+
+    def hybrid_forward(self, F, preds, obj_t, box_t, cls_t, weight,
+                       boxes=None, labels=None):
+        thresh = self._ignore
+        inputs = [preds, obj_t, box_t, cls_t, weight]
+        with_ignore = boxes is not None and labels is not None
+        if with_ignore:
+            inputs += [boxes, labels]
+
+        def fn(p, ot, bt, ct, w, *rest):
+            import jax
+            import jax.numpy as jnp
+            p = p.astype(jnp.float32)
+
+            def bce(logit, target):
+                return jnp.maximum(logit, 0) - logit * target \
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            obj_w = jnp.ones_like(ot)
+            if rest:
+                bx, lb = rest                       # (B,N,4), (B,M,5)
+                gt = lb[..., 1:5]                   # corner pixels
+                gt_valid = lb[..., 0] >= 0
+                ix0 = jnp.maximum(bx[:, :, None, 0], gt[:, None, :, 0])
+                iy0 = jnp.maximum(bx[:, :, None, 1], gt[:, None, :, 1])
+                ix1 = jnp.minimum(bx[:, :, None, 2], gt[:, None, :, 2])
+                iy1 = jnp.minimum(bx[:, :, None, 3], gt[:, None, :, 3])
+                inter = (jnp.maximum(ix1 - ix0, 0)
+                         * jnp.maximum(iy1 - iy0, 0))
+                area_p = ((bx[..., 2] - bx[..., 0])
+                          * (bx[..., 3] - bx[..., 1]))[:, :, None]
+                area_g = ((gt[..., 2] - gt[..., 0])
+                          * (gt[..., 3] - gt[..., 1]))[:, None, :]
+                iou = inter / jnp.maximum(area_p + area_g - inter, 1e-9)
+                iou = jnp.where(gt_valid[:, None, :], iou, 0.0)
+                best = jnp.max(iou, -1)             # B,N
+                # positives always train objectness; high-IoU negatives
+                # are ignored
+                obj_w = jnp.where((best > thresh) & (ot < 0.5), 0.0, 1.0)
+            npos = jnp.maximum(jnp.sum(ot), 1.0)
+            obj_loss = jnp.sum(bce(p[..., 4], ot) * obj_w) / npos
+            wb = (w * ot)[..., None]
+            xy_loss = jnp.sum(bce(p[..., 0:2], bt[..., 0:2]) * wb) / npos
+            wh_loss = jnp.sum(0.5 * (p[..., 2:4] - bt[..., 2:4]) ** 2
+                              * wb) / npos
+            cls_loss = jnp.sum(bce(p[..., 5:], ct) * ot[..., None]) / npos
+            return obj_loss + xy_loss + wh_loss + cls_loss
+        return _invoke(fn, inputs, name="yolo3_loss")
+
+
+def yolo3_darknet53(num_classes=80, **kw):
+    """Darknet53-backed YOLOv3 (the judged BASELINE COCO workload)."""
+    anchors = [[(10, 13), (16, 30), (33, 23)],
+               [(30, 61), (62, 45), (59, 119)],
+               [(116, 90), (156, 198), (373, 326)]]
+    return YOLOv3(num_classes, (64, 128, 256, 512, 1024), (1, 2, 8, 8, 4),
+                  anchors, **kw)
+
+
+def yolo3_tiny(num_classes=3, **kw):
+    anchors = [[(4, 6), (8, 12)],
+               [(12, 20), (20, 16)],
+               [(30, 24), (40, 48)]]
+    kw.setdefault("strides", (2, 4, 8))   # 3-stage backbone
+    return YOLOv3(num_classes, (8, 16, 32), (1, 1, 1), anchors, **kw)
