@@ -60,6 +60,8 @@ from . import numpy_extension as npx
 from . import engine
 from . import profiler
 from . import test_utils
+from . import library
+from .feedforward import FeedForward
 from . import runtime
 from . import contrib
 

@@ -1,0 +1,172 @@
+"""Custom-op shared-library loader (reference:
+python/mxnet/library.py ``load`` + ``MXLoadLib`` in src/c_api/c_api.cc,
+ABI in include/mxnet/lib_api.h).
+
+TPU-native re-design: the library implements the flat C surface declared
+in ``native/lib_api.h`` (host float32 kernels + shape inference).  Each
+loaded op is wrapped in ``jax.pure_callback`` with the library-inferred
+output shape, then registered under ``mx.nd.<name>`` — so it runs inside
+jitted programs as a host callback while everything around it stays
+compiled.  Loaded ops are not differentiable (the reference's loadable
+backward is a follow-up; autograd raises if a grad is requested through
+one).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_ops"]
+
+_MAX_NDIM = 8
+_loaded = {}     # path -> set of op names
+_handles = []    # keep CDLLs alive for the process lifetime
+
+
+def loaded_ops():
+    """Mapping of library path -> list of op names loaded from it."""
+    return {path: sorted(ops) for path, ops in _loaded.items()}
+
+
+def _check(lib, sym):
+    if not hasattr(lib, sym):
+        raise MXNetError(
+            f"library does not export required symbol '{sym}' "
+            "(see incubator_mxnet_tpu/native/lib_api.h)")
+
+
+def _make_op(lib, name):
+    """Build the Python-callable op for a library op ``name``."""
+    from .context import current_context
+    from .ndarray.ndarray import NDArray, _invoke
+
+    def infer_shape(shapes):
+        n = len(shapes)
+        ndims = (ctypes.c_int * n)(*[len(s) for s in shapes])
+        arrs = [(ctypes.c_int64 * len(s))(*s) for s in shapes]
+        ptrs = (ctypes.POINTER(ctypes.c_int64) * n)(
+            *[ctypes.cast(a, ctypes.POINTER(ctypes.c_int64))
+              for a in arrs])
+        out = (ctypes.c_int64 * _MAX_NDIM)()
+        nd = lib.mxtpu_lib_op_infer_shape(name.encode(), n, ptrs, ndims,
+                                          out)
+        if nd < 0:
+            raise MXNetError(
+                f"custom op '{name}': infer_shape failed (code {nd}) for "
+                f"input shapes {shapes}")
+        if nd > _MAX_NDIM:
+            raise MXNetError(
+                f"custom op '{name}': infer_shape returned ndim {nd} > "
+                f"MXTPU_LIB_MAX_NDIM ({_MAX_NDIM}) — broken library")
+        return tuple(int(out[i]) for i in range(nd))
+
+    def host_compute(out_shape, *arrays):
+        arrays = [_np.ascontiguousarray(a, _np.float32) for a in arrays]
+        n = len(arrays)
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        sarrs = [(ctypes.c_int64 * a.ndim)(*a.shape) for a in arrays]
+        sptrs = (ctypes.POINTER(ctypes.c_int64) * n)(
+            *[ctypes.cast(s, ctypes.POINTER(ctypes.c_int64))
+              for s in sarrs])
+        iptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        out = _np.empty(out_shape, _np.float32)
+        oshape = (ctypes.c_int64 * len(out_shape))(*out_shape)
+        rc = lib.mxtpu_lib_op_compute(
+            name.encode(), n, iptrs, sptrs, ndims,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), oshape,
+            len(out_shape))
+        if rc != 0:
+            raise MXNetError(f"custom op '{name}': compute failed "
+                             f"(code {rc})")
+        return out
+
+    def op(*inputs, **kwargs):
+        if kwargs:
+            raise MXNetError(
+                f"custom op '{name}' takes only tensor inputs")
+        nds = [x if isinstance(x, NDArray)
+               else NDArray(_np.asarray(x, _np.float32))
+               for x in inputs]
+        out_shape = infer_shape([x.shape for x in nds])
+
+        def fn(*jarrs):
+            import functools
+            import jax
+            import jax.numpy as jnp
+            return jax.pure_callback(
+                functools.partial(host_compute, out_shape),
+                jax.ShapeDtypeStruct(out_shape, jnp.float32),
+                *[a.astype(jnp.float32) for a in jarrs],
+                vmap_method="sequential")
+        return _invoke(fn, nds, name=name, differentiable=False)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = (f"Custom op '{name}' loaded from a shared library "
+                  "(host float32 kernel via jax.pure_callback; "
+                  "not differentiable).")
+    return op
+
+
+def load(path, verbose=True):
+    """Load a custom-op library and register its ops under ``mx.nd``
+    (reference: mx.library.load -> MXLoadLib).  Returns the list of op
+    names registered."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        raise MXNetError(f"cannot load library {path}: {e}") from e
+    for sym in ("mxtpu_lib_api_version", "mxtpu_lib_num_ops",
+                "mxtpu_lib_op_name", "mxtpu_lib_op_infer_shape",
+                "mxtpu_lib_op_compute"):
+        _check(lib, sym)
+    lib.mxtpu_lib_op_name.restype = ctypes.c_char_p
+    version = lib.mxtpu_lib_api_version()
+    if version != 1:
+        raise MXNetError(
+            f"library {path} has ABI version {version}; this build "
+            "supports version 1")
+
+    from . import ndarray as nd_mod
+    # validate and build every op first, register atomically after — a
+    # bad op must not leave earlier ops half-registered.  Only names this
+    # same path registered before are overwritable (idempotent reload);
+    # clashes with built-ins OR with other libraries' ops are refused.
+    already = _loaded.get(path, set())
+    ops = {}
+    for i in range(lib.mxtpu_lib_num_ops()):
+        raw = lib.mxtpu_lib_op_name(i)
+        if not raw:
+            raise MXNetError(f"library {path}: op {i} has no name")
+        name = raw.decode()
+        if not name.isidentifier():
+            raise MXNetError(
+                f"library {path}: op name '{name}' is not a valid "
+                "identifier")
+        if hasattr(nd_mod, name) and name not in already:
+            raise MXNetError(
+                f"library {path}: op name '{name}' collides with an "
+                "existing mx.nd function; rename the op")
+        ops[name] = _make_op(lib, name)
+    for stale in already - set(ops):
+        # reloaded library no longer exports this op
+        if hasattr(nd_mod, stale):
+            delattr(nd_mod, stale)
+    for name, fn in ops.items():
+        setattr(nd_mod, name, fn)
+        if verbose:
+            import logging
+            logging.getLogger(__name__).info(
+                "loaded custom op '%s' from %s", name, path)
+    _loaded[path] = set(ops)
+    _handles.append(lib)
+    return sorted(ops)

@@ -1,0 +1,82 @@
+// Example custom-op library (reference analog:
+// example/extensions/lib_custom_op/gemm_lib.cc — the 1.7 loadable-op
+// sample).  Exports two ops over the mxtpu lib ABI:
+//   my_gemm(a, b)  — (M,K) x (K,N) -> (M,N) matmul
+//   my_relu6(x)    — min(max(x, 0), 6) elementwise
+//
+// Build:  g++ -O2 -shared -fPIC -o libcustom_ops.so example_custom_ops.cc
+#include <algorithm>
+#include <cstring>
+
+#include "lib_api.h"
+
+namespace {
+
+int64_t numel(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_lib_api_version(void) { return MXTPU_LIB_API_VERSION; }
+
+int mxtpu_lib_num_ops(void) { return 2; }
+
+const char* mxtpu_lib_op_name(int idx) {
+  switch (idx) {
+    case 0: return "my_gemm";
+    case 1: return "my_relu6";
+    default: return nullptr;
+  }
+}
+
+int mxtpu_lib_op_infer_shape(const char* op, int n_in,
+                             const int64_t* const* shapes,
+                             const int* ndims, int64_t* out_shape) {
+  if (std::strcmp(op, "my_gemm") == 0) {
+    if (n_in != 2 || ndims[0] != 2 || ndims[1] != 2) return -2;
+    if (shapes[0][1] != shapes[1][0]) return -3;
+    out_shape[0] = shapes[0][0];
+    out_shape[1] = shapes[1][1];
+    return 2;
+  }
+  if (std::strcmp(op, "my_relu6") == 0) {
+    if (n_in != 1) return -2;
+    for (int i = 0; i < ndims[0]; ++i) out_shape[i] = shapes[0][i];
+    return ndims[0];
+  }
+  return -1;
+}
+
+int mxtpu_lib_op_compute(const char* op, int n_in,
+                         const float* const* inputs,
+                         const int64_t* const* shapes, const int* ndims,
+                         float* output, const int64_t* out_shape,
+                         int out_ndim) {
+  if (std::strcmp(op, "my_gemm") == 0) {
+    const int64_t M = shapes[0][0], K = shapes[0][1], N = shapes[1][1];
+    const float* a = inputs[0];
+    const float* b = inputs[1];
+    for (int64_t i = 0; i < M; ++i) {
+      for (int64_t j = 0; j < N; ++j) {
+        float acc = 0.f;
+        for (int64_t k = 0; k < K; ++k) acc += a[i * K + k] * b[k * N + j];
+        output[i * N + j] = acc;
+      }
+    }
+    return 0;
+  }
+  if (std::strcmp(op, "my_relu6") == 0) {
+    const int64_t n = numel(shapes[0], ndims[0]);
+    for (int64_t i = 0; i < n; ++i)
+      output[i] = std::min(std::max(inputs[0][i], 0.f), 6.f);
+    return 0;
+  }
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,64 @@
+/* Custom-op shared-library ABI (reference: include/mxnet/lib_api.h,
+ * the 1.7 external-op loader used by MXLoadLib / python/mxnet/library.py).
+ *
+ * TPU-native re-design: instead of the reference's operator-registry
+ * struct protocol (which plugs kernels into the engine), a library
+ * exports a flat, versioned C surface of host-side float32 kernels.
+ * The Python loader (incubator_mxnet_tpu/library.py) wraps each op in
+ * jax.pure_callback, so loaded ops compose with jit/vmap-of-callback
+ * like any other host op while the rest of the program stays on the
+ * accelerator.
+ *
+ * A library implements:
+ *   int         mxtpu_lib_api_version(void);      // MXTPU_LIB_API_VERSION
+ *   int         mxtpu_lib_num_ops(void);
+ *   const char* mxtpu_lib_op_name(int idx);
+ *   int         mxtpu_lib_op_infer_shape(...);    // -> out ndim, <0 error
+ *   int         mxtpu_lib_op_compute(...);        // -> 0 ok, <0 error
+ *
+ * All tensors are dense float32, max MXTPU_LIB_MAX_NDIM dims, one
+ * output per op.  Thread safety: compute may be called concurrently.
+ */
+#ifndef MXTPU_LIB_API_H_
+#define MXTPU_LIB_API_H_
+
+#include <stdint.h>
+
+#define MXTPU_LIB_API_VERSION 1
+#define MXTPU_LIB_MAX_NDIM 8
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ABI version of the library; the loader refuses a mismatch. */
+int mxtpu_lib_api_version(void);
+
+/* Number of ops exported. */
+int mxtpu_lib_num_ops(void);
+
+/* Name of op `idx` (0 <= idx < mxtpu_lib_num_ops()). */
+const char* mxtpu_lib_op_name(int idx);
+
+/* Output shape of `op` for the given input shapes.
+ * shapes[i][0..ndims[i]-1] are input i's dims.  Writes up to
+ * MXTPU_LIB_MAX_NDIM dims into out_shape, returns the output ndim,
+ * or a negative error code. */
+int mxtpu_lib_op_infer_shape(const char* op, int n_in,
+                             const int64_t* const* shapes,
+                             const int* ndims, int64_t* out_shape);
+
+/* Run `op`: inputs are dense float32 buffers with the given shapes;
+ * output buffer is pre-allocated to the inferred shape.  Returns 0 on
+ * success, negative on error. */
+int mxtpu_lib_op_compute(const char* op, int n_in,
+                         const float* const* inputs,
+                         const int64_t* const* shapes, const int* ndims,
+                         float* output, const int64_t* out_shape,
+                         int out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_LIB_API_H_ */

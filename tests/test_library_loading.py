@@ -1,0 +1,134 @@
+"""Custom-op shared-library loader tests (reference model:
+tests/python/unittest/test_library_loading.py + the
+example/extensions/lib_custom_op sample).  Compiles the in-tree example
+library with g++ at test time and loads it through mx.library.load."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import library
+
+NATIVE_DIR = os.path.join(os.path.dirname(mx.__file__), "native")
+
+
+@pytest.fixture(scope="module")
+def custom_lib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    out = tmp_path_factory.mktemp("libs") / "libcustom_ops.so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", str(out),
+         os.path.join(NATIVE_DIR, "example_custom_ops.cc")],
+        check=True, cwd=NATIVE_DIR)
+    return str(out)
+
+
+def test_load_registers_ops(custom_lib):
+    ops = library.load(custom_lib)
+    assert ops == ["my_gemm", "my_relu6"]
+    assert hasattr(mx.nd, "my_gemm")
+    assert custom_lib in library.loaded_ops()
+
+
+def test_custom_gemm_matches_numpy(custom_lib):
+    library.load(custom_lib)
+    a = mx.nd.random.uniform(shape=(5, 7))
+    b = mx.nd.random.uniform(shape=(7, 3))
+    out = mx.nd.my_gemm(a, b)
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() @ b.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_custom_relu6(custom_lib):
+    library.load(custom_lib)
+    x = mx.nd.array(np.array([-3.0, 0.5, 9.0], np.float32))
+    np.testing.assert_allclose(mx.nd.my_relu6(x).asnumpy(),
+                               [0.0, 0.5, 6.0])
+
+
+def test_custom_op_inside_jitted_block(custom_lib):
+    """Loaded ops must compose with hybridize (pure_callback under jit)."""
+    library.load(custom_lib)
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+    class Net(HybridBlock):
+        def hybrid_forward(self, F, x, w):
+            return mx.nd.my_relu6(mx.nd.my_gemm(x, w))
+
+    net = Net()
+    x = mx.nd.random.uniform(shape=(4, 6))
+    w = mx.nd.random.uniform(shape=(6, 2), low=-1, high=1)
+    eager = net(x, w).asnumpy()
+    net.hybridize()
+    hyb = net(x, w).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-6)
+    ref = np.minimum(np.maximum(x.asnumpy() @ w.asnumpy(), 0), 6)
+    np.testing.assert_allclose(eager, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_shape_mismatch_raises(custom_lib):
+    library.load(custom_lib)
+    a = mx.nd.random.uniform(shape=(5, 7))
+    b = mx.nd.random.uniform(shape=(8, 3))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.my_gemm(a, b)
+
+
+def test_name_collision_rejected(custom_lib, tmp_path):
+    """An op whose name shadows an existing mx.nd function is refused
+    (regression: load() once silently clobbered built-ins)."""
+    src = tmp_path / "clash.cc"
+    src.write_text("""
+#include <cstring>
+extern "C" {
+int mxtpu_lib_api_version(void) { return 1; }
+int mxtpu_lib_num_ops(void) { return 1; }
+const char* mxtpu_lib_op_name(int idx) { return "zeros"; }
+int mxtpu_lib_op_infer_shape(const char* op, int n_in,
+                             const long long* const* shapes,
+                             const int* ndims, long long* out_shape) {
+  out_shape[0] = 1; return 1;
+}
+int mxtpu_lib_op_compute(const char* op, int n_in,
+                         const float* const* inputs,
+                         const long long* const* shapes, const int* ndims,
+                         float* output, const long long* out_shape,
+                         int out_ndim) { output[0] = 0.f; return 0; }
+}
+""")
+    bad = tmp_path / "libclash.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(bad), str(src)],
+                   check=True)
+    before = mx.nd.zeros
+    with pytest.raises(mx.MXNetError, match="collides"):
+        library.load(str(bad))
+    assert mx.nd.zeros is before            # builtin untouched
+
+
+def test_reload_same_library_is_idempotent(custom_lib):
+    first = library.load(custom_lib)
+    second = library.load(custom_lib)
+    assert first == second
+
+
+def test_missing_library_raises():
+    with pytest.raises(mx.MXNetError):
+        library.load("/nonexistent/libfoo.so")
+
+
+def test_bogus_library_rejected(tmp_path):
+    # a real .so that lacks the ABI symbols must be refused cleanly
+    bogus = tmp_path / "libbogus.so"
+    src = tmp_path / "bogus.c"
+    src.write_text("int not_the_abi(void) { return 42; }\n")
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(bogus),
+                    str(src)], check=True)
+    with pytest.raises(mx.MXNetError, match="symbol"):
+        library.load(str(bogus))
