@@ -1,0 +1,179 @@
+"""Legacy FeedForward model API (reference:
+python/mxnet/model.py class FeedForward — deprecated in 1.x in favor of
+Module, but still part of the public surface and of old tutorials).
+
+Implemented as a thin veneer over Module (exactly how users were told to
+migrate): ``fit`` binds a Module on the data iter's shapes and trains,
+``predict``/``score`` evaluate, ``save``/``load`` use the shared
+``prefix-symbol.json`` / ``prefix-NNNN.params`` checkpoint format.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from .context import cpu
+from . import model as model_mod
+from .module.module import Module
+
+__all__ = ["FeedForward"]
+
+
+class FeedForward:
+    """Legacy training façade.  ``FeedForward(symbol, ctx, num_epoch=N,
+    optimizer='sgd', **opt_args)`` then ``.fit(train_iter)`` (reference:
+    model.py FeedForward.fit)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 epoch_size=None, optimizer="sgd", initializer=None,
+                 arg_params=None, aux_params=None, begin_epoch=0,
+                 **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else [cpu()]
+        if not isinstance(self.ctx, (list, tuple)):
+            self.ctx = [self.ctx]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    @staticmethod
+    def _as_iter(X, y=None, batch_size=128):
+        """Accept the legacy call forms: a DataIter, or raw
+        numpy/NDArray X (+ y) which get wrapped in an NDArrayIter
+        (reference: model.py _init_iter)."""
+        if hasattr(X, "provide_data"):
+            return X
+        from .io.io import NDArrayIter
+        import numpy as _np
+        from .ndarray.ndarray import NDArray
+        if isinstance(X, NDArray):
+            X = X.asnumpy()
+        if isinstance(y, NDArray):
+            y = y.asnumpy()
+        X = _np.asarray(X)
+        n = X.shape[0]
+        bs = min(batch_size, n)
+        data = {"data": X}
+        label = None if y is None else {"softmax_label": _np.asarray(y)}
+        return NDArrayIter(data, label, batch_size=bs)
+
+    # -- training ------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None):
+        """Train for ``num_epoch`` epochs over data iter ``X`` (or raw
+        numpy ``X``/``y``, wrapped per the legacy API)
+        (reference: FeedForward.fit -> module fit path)."""
+        if self.num_epoch is None:
+            raise MXNetError("FeedForward.fit requires num_epoch")
+        X = self._as_iter(X, y)
+        logger = logger or logging.getLogger(__name__)
+        opt_params = dict(self.kwargs)
+        if "rescale_grad" not in opt_params:
+            # reference FeedForward.fit defaults rescale_grad to
+            # 1/batch_size (model.py _init_iter era behavior)
+            bs = getattr(X, "batch_size", None) \
+                or X.provide_data[0][1][0]
+            opt_params["rescale_grad"] = 1.0 / float(bs)
+        mod = Module(self.symbol,
+                     data_names=tuple(d[0] for d in X.provide_data),
+                     label_names=tuple(l[0] for l in X.provide_label),
+                     logger=logger, context=self.ctx)
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback,
+                kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=opt_params,
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch, monitor=monitor)
+        self._module = mod
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def _require_trained(self, X=None):
+        if self._module is None:
+            if self.arg_params is not None and X is not None:
+                # loaded-from-checkpoint path: bind on the iter's shapes
+                # (reference: FeedForward.predict binds lazily)
+                mod = Module(
+                    self.symbol,
+                    data_names=tuple(d[0] for d in X.provide_data),
+                    label_names=tuple(l[0] for l in
+                                      (X.provide_label or [])),
+                    context=self.ctx)
+                mod.bind(X.provide_data, X.provide_label,
+                         for_training=False)
+                mod.init_params(arg_params=self.arg_params,
+                                aux_params=self.aux_params)
+                self._module = mod
+            else:
+                raise MXNetError("model has not been trained or loaded; "
+                                 "call fit() or FeedForward.load() first")
+        return self._module
+
+    def predict(self, X, num_batch=None):
+        """Forward over an iter (or raw numpy X); returns outputs
+        merged over batches."""
+        X = self._as_iter(X)
+        return self._require_trained(X).predict(X, num_batch=num_batch)
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None):
+        X = self._as_iter(X, y)
+        mod = self._require_trained(X)
+        res = mod.score(X, eval_metric, num_batch=num_batch)
+        return res[0][1] if res else None
+
+    # -- checkpointing -------------------------------------------------
+    def save(self, prefix, epoch=None):
+        """Write ``prefix-symbol.json`` + ``prefix-NNNN.params``
+        (reference checkpoint format; see model.save_checkpoint)."""
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        if self._module is not None:
+            arg_params, aux_params = self._module.get_params()
+        elif self.arg_params is not None:
+            # loaded-but-never-bound model: the stored params ARE the
+            # checkpoint
+            arg_params, aux_params = self.arg_params, self.aux_params or {}
+        else:
+            raise MXNetError("model has not been trained or loaded; "
+                             "call fit() or FeedForward.load() first")
+        model_mod.save_checkpoint(prefix, epoch, self.symbol, arg_params,
+                                  aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Reload from a reference-format checkpoint pair; the result can
+        ``predict``/``score`` immediately and ``fit`` to continue."""
+        symbol, arg_params, aux_params = model_mod.load_checkpoint(
+            prefix, epoch)
+        ff = FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                         aux_params=aux_params, begin_epoch=epoch,
+                         **kwargs)
+        return ff
+
+    def bind_for_inference(self, data_shapes, label_shapes=None):
+        """Explicitly bind a Module holding the stored params (predict/
+        score also bind lazily from the iter's shapes)."""
+        from .module.module import _canon_shapes
+        data_names = tuple(d.name for d in _canon_shapes(data_shapes))
+        label_names = (tuple(l.name for l in _canon_shapes(label_shapes))
+                       if label_shapes else ())
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=label_names, context=self.ctx)
+        mod.bind(data_shapes, label_shapes, for_training=False)
+        mod.init_params(arg_params=self.arg_params,
+                        aux_params=self.aux_params)
+        self._module = mod
+        return self

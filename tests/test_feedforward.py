@@ -1,0 +1,111 @@
+"""Legacy FeedForward API tests (reference model: python/mxnet/model.py
+FeedForward + tests/python/train/test_mlp.py's era of usage)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import FeedForward
+
+
+def _toy_iter(n=200, batch=20, seed=0, shuffle=False):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    return mx.io.NDArrayIter({"data": x}, {"softmax_label": y},
+                             batch_size=batch, shuffle=shuffle)
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_fit_and_score():
+    mx.random.seed(0)
+    ff = FeedForward(_mlp_symbol(), num_epoch=10, optimizer="sgd",
+                     learning_rate=0.5)
+    ff.fit(_toy_iter(shuffle=True))
+    acc = ff.score(_toy_iter(seed=1))
+    assert acc is not None and acc > 0.8, acc
+
+
+def test_predict_shapes():
+    mx.random.seed(0)
+    ff = FeedForward(_mlp_symbol(), num_epoch=2, optimizer="sgd",
+                     learning_rate=0.1)
+    ff.fit(_toy_iter())
+    out = ff.predict(_toy_iter())
+    arr = out[0] if isinstance(out, (list, tuple)) else out
+    assert arr.shape[-1] == 2
+
+
+def test_save_load_roundtrip(tmp_path):
+    mx.random.seed(0)
+    ff = FeedForward(_mlp_symbol(), num_epoch=2, optimizer="sgd",
+                     learning_rate=0.5)
+    ff.fit(_toy_iter())
+    prefix = str(tmp_path / "ffmodel")
+    ff.save(prefix, epoch=2)
+
+    ff2 = FeedForward.load(prefix, 2)
+    it = _toy_iter(seed=1)
+    a = ff.predict(it)
+    it.reset()
+    b = ff2.predict(it)   # binds lazily from the iter's shapes
+    arr_a = a[0] if isinstance(a, (list, tuple)) else a
+    arr_b = b[0] if isinstance(b, (list, tuple)) else b
+    np.testing.assert_allclose(arr_a.asnumpy(), arr_b.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_untrained_predict_raises():
+    import pytest
+    ff = FeedForward(_mlp_symbol(), num_epoch=1)
+    with pytest.raises(mx.MXNetError, match="trained"):
+        ff.predict(_toy_iter())
+
+
+def test_fit_raw_numpy_xy():
+    """The canonical legacy call form: fit(X, y) with raw numpy."""
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (200, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    ff = FeedForward(_mlp_symbol(), num_epoch=40, optimizer="sgd",
+                     learning_rate=0.5)
+    ff.fit(x, y)
+    acc = ff.score(x, y)
+    assert acc > 0.8, acc
+    out = ff.predict(x)
+    arr = out[0] if isinstance(out, (list, tuple)) else out
+    assert arr.shape == (200, 2)
+
+
+def test_save_after_load_without_bind(tmp_path):
+    """save() straight after load() must work from the stored params."""
+    mx.random.seed(0)
+    ff = FeedForward(_mlp_symbol(), num_epoch=2, optimizer="sgd",
+                     learning_rate=0.5)
+    ff.fit(_toy_iter())
+    p1 = str(tmp_path / "m1")
+    ff.save(p1, epoch=2)
+    ff2 = FeedForward.load(p1, 2)
+    p2 = str(tmp_path / "m2")
+    ff2.save(p2, epoch=2)       # never bound — uses stored params
+    a = mx.nd.utils.load(p1 + "-0002.params")
+    b = mx.nd.utils.load(p2 + "-0002.params")
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k].asnumpy(), b[k].asnumpy())
+
+
+def test_monitor_installed_through_fit(capsys):
+    mx.random.seed(0)
+    mon = mx.Monitor(interval=1)
+    ff = FeedForward(_mlp_symbol(), num_epoch=1, optimizer="sgd",
+                     learning_rate=0.1)
+    ff.fit(_toy_iter(), monitor=mon)
+    out = capsys.readouterr().out
+    assert "Batch" in out or len(out) > 0   # monitor printed stats
