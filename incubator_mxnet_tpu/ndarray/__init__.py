@@ -12,6 +12,8 @@ from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
 from .optimizer_ops import *  # noqa: F401,F403
 from .optimizer_ops import __all__ as _opt_all
+from .ops_ext import *  # noqa: F401,F403
+from .ops_ext import __all__ as _ext_all
 from . import random  # noqa: F401
 from . import ops as op  # alias: mx.nd.op.xxx parity
 from . import utils  # noqa: F401
@@ -22,4 +24,5 @@ from .utils import save, load, load_frombuffer  # noqa: F401
 __all__ = (["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
             "eye", "linspace", "from_jax", "concatenate", "waitall", "random",
             "op", "utils", "save", "load", "load_frombuffer", "sparse"]
-           + list(_ops_all) + list(_nn_all) + list(_opt_all))
+           + list(_ops_all) + list(_nn_all) + list(_opt_all)
+           + list(_ext_all))

@@ -1,0 +1,621 @@
+"""Operator-corpus extensions: linalg family, flat samplers, spatial
+ops, and assorted tensor ops (reference: src/operator/tensor/la_op.cc,
+src/operator/random/sample_op.cc, src/operator/spatial_transformer.cc,
+bilinear_sampler.cc, roi_pooling.cc, correlation.cc, lrn.cc,
+src/operator/tensor/matrix_op.cc depth/space ops, contrib fft).
+
+Same design as ops.py: each op is a pure jnp/lax function funneled
+through ``_invoke`` (async dispatch + tape autograd via jax VJP).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _invoke
+
+__all__: list = []  # populated at bottom
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _nd(x):
+    from .ndarray import array as _array
+    return x if isinstance(x, NDArray) else _array(x)
+
+
+# ---------------------------------------------------------------------------
+# linalg_* family (reference: src/operator/tensor/la_op.cc).  Batched over
+# leading dims like the reference; compute in the input dtype.
+# ---------------------------------------------------------------------------
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C_out = alpha * op(A) @ op(B) + beta * C.  ``axis`` relocates the
+    matrix-row axis as in the reference (default -2)."""
+    def fn(a, b, c):
+        jnp = _jnp()
+        if axis != -2:
+            a = jnp.moveaxis(a, axis, -2)
+            b = jnp.moveaxis(b, axis, -2)
+            c = jnp.moveaxis(c, axis, -2)
+        a = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        b = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        out = alpha * jnp.matmul(a, b) + beta * c
+        return jnp.moveaxis(out, -2, axis) if axis != -2 else out
+    return _invoke(fn, [_nd(A), _nd(B), _nd(C)], name="linalg_gemm")
+
+
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """alpha * A @ A^T (or A^T @ A when transpose)."""
+    def fn(a):
+        jnp = _jnp()
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose
+                        else jnp.matmul(a, at))
+    return _invoke(fn, [_nd(A)], name="linalg_syrk")
+
+
+def linalg_potrf(A):
+    """Cholesky factor (lower) of a PD matrix."""
+    def fn(a):
+        import jax
+        return jax.numpy.linalg.cholesky(a)
+    return _invoke(fn, [_nd(A)], name="linalg_potrf")
+
+
+def linalg_potri(A):
+    """Inverse from a Cholesky factor L: (L L^T)^-1."""
+    def fn(l):
+        jnp = _jnp()
+        eye = jnp.broadcast_to(jnp.eye(l.shape[-1], dtype=l.dtype),
+                               l.shape)
+        import jax
+        linv = jax.scipy.linalg.solve_triangular(l, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+    return _invoke(fn, [_nd(A)], name="linalg_potri")
+
+
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B when rightside)."""
+    def fn(a, b):
+        import jax
+        jnp = _jnp()
+        if rightside:
+            # X A = B  <=>  A^T X^T = B^T
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2),
+                lower=not lower, trans=1 if transpose else 0)
+            return alpha * jnp.swapaxes(sol, -1, -2)
+        return alpha * jax.scipy.linalg.solve_triangular(
+            a, b, lower=lower, trans=1 if transpose else 0)
+    return _invoke(fn, [_nd(A), _nd(B)], name="linalg_trsm")
+
+
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """alpha * op(tri(A)) @ B (or B @ op(tri(A)))."""
+    def fn(a, b):
+        jnp = _jnp()
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        tri = jnp.swapaxes(tri, -1, -2) if transpose else tri
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+    return _invoke(fn, [_nd(A), _nd(B)], name="linalg_trmm")
+
+
+def linalg_det(A):
+    def fn(a):
+        return _jnp().linalg.det(a)
+    return _invoke(fn, [_nd(A)], name="linalg_det")
+
+
+def linalg_slogdet(A):
+    def fn(a):
+        sign, logabs = _jnp().linalg.slogdet(a)
+        return sign, logabs
+    return _invoke(fn, [_nd(A)], name="linalg_slogdet")
+
+
+def linalg_inverse(A):
+    def fn(a):
+        return _jnp().linalg.inv(a)
+    return _invoke(fn, [_nd(A)], name="linalg_inverse")
+
+
+def linalg_extractdiag(A, offset=0):
+    def fn(a):
+        return _jnp().diagonal(a, offset=offset, axis1=-2, axis2=-1)
+    return _invoke(fn, [_nd(A)], name="linalg_extractdiag")
+
+
+def linalg_makediag(A, offset=0):
+    def fn(a):
+        jnp = _jnp()
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return base.at[..., r, c].set(a)
+    return _invoke(fn, [_nd(A)], name="linalg_makediag")
+
+
+def _trian_indices(n, offset, lower):
+    """Reference semantics (la_op.cc): the offset SIGN selects the
+    triangle — offset>0 the upper triangle starting at that diagonal,
+    offset<0 the lower one; ``lower`` applies only at offset 0."""
+    if offset > 0:
+        return _np.triu_indices(n, k=offset)
+    if offset < 0:
+        return _np.tril_indices(n, k=offset)
+    return _np.tril_indices(n) if lower else _np.triu_indices(n)
+
+
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Pack a triangle into a vector (row-major packing)."""
+    def fn(a):
+        rows, cols = _trian_indices(a.shape[-1], offset, lower)
+        return a[..., rows, cols]
+    return _invoke(fn, [_nd(A)], name="linalg_extracttrian")
+
+
+def linalg_maketrian(A, offset=0, lower=True):
+    def fn(a):
+        jnp = _jnp()
+        m = a.shape[-1]
+        # m = q(q+1)/2 where q = n - |offset|
+        q = int((_np.sqrt(8 * m + 1) - 1) / 2)
+        n = q + abs(offset)
+        rows, cols = _trian_indices(n, offset, lower)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return base.at[..., rows, cols].set(a)
+    return _invoke(fn, [_nd(A)], name="linalg_maketrian")
+
+
+# ---------------------------------------------------------------------------
+# Flat samplers (reference: src/operator/random/sample_op.cc): per-element
+# distribution params as arrays; output shape = param shape (+ shape tail).
+# ---------------------------------------------------------------------------
+def _sample(name, draw, params, shape=None, dtype="float32"):
+    from .. import random as _random
+    from ..context import current_context
+    nds = [_nd(p) for p in params]
+    ctx = nds[0]._ctx if nds else current_context()
+    key = _random.new_key(ctx)
+    tail = () if shape is None else (
+        tuple(shape) if isinstance(shape, (tuple, list)) else (shape,))
+
+    def fn(*ps):
+        jnp = _jnp()
+        out = draw(key, ps, tail)
+        return out.astype(_np.dtype(dtype))
+    return _invoke(fn, nds, name=name, differentiable=False)
+
+
+def sample_uniform(low, high, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        low, high = ps
+        out_shape = tuple(low.shape) + tail
+        u = jax.random.uniform(key, out_shape)
+        return low.reshape(low.shape + (1,) * len(tail)) + u * (
+            (high - low).reshape(low.shape + (1,) * len(tail)))
+    return _sample("sample_uniform", draw, [low, high], shape, dtype)
+
+
+def sample_normal(mu, sigma, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        mu, sigma = ps
+        out_shape = tuple(mu.shape) + tail
+        z = jax.random.normal(key, out_shape)
+        ex = (1,) * len(tail)
+        return mu.reshape(mu.shape + ex) + z * sigma.reshape(
+            sigma.shape + ex)
+    return _sample("sample_normal", draw, [mu, sigma], shape, dtype)
+
+
+def sample_gamma(alpha, beta, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        alpha, beta = ps
+        ex = (1,) * len(tail)
+        out_shape = tuple(alpha.shape) + tail
+        g = jax.random.gamma(key, alpha.reshape(alpha.shape + ex),
+                             shape=out_shape)
+        return g * beta.reshape(beta.shape + ex)
+    return _sample("sample_gamma", draw, [alpha, beta], shape, dtype)
+
+
+def sample_exponential(lam, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        (lam,) = ps
+        out_shape = tuple(lam.shape) + tail
+        e = jax.random.exponential(key, out_shape)
+        return e / lam.reshape(lam.shape + (1,) * len(tail))
+    return _sample("sample_exponential", draw, [lam], shape, dtype)
+
+
+def sample_poisson(lam, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        (lam,) = ps
+        out_shape = tuple(lam.shape) + tail
+        return jax.random.poisson(
+            key, lam.reshape(lam.shape + (1,) * len(tail)),
+            shape=out_shape).astype(_np.float32)
+    return _sample("sample_poisson", draw, [lam], shape, dtype)
+
+
+def sample_negative_binomial(k, p, shape=None, dtype="float32", **kw):
+    def draw(key, ps, tail):
+        import jax
+        k_, p_ = ps
+        ex = (1,) * len(tail)
+        out_shape = tuple(k_.shape) + tail
+        k1, k2 = jax.random.split(key)
+        # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+        lam = jax.random.gamma(key=k1, a=k_.reshape(k_.shape + ex),
+                               shape=out_shape) \
+            * ((1.0 - p_) / p_).reshape(p_.shape + ex)
+        return jax.random.poisson(k2, lam).astype(_np.float32)
+    return _sample("sample_negative_binomial", draw, [k, p], shape, dtype)
+
+
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                       **kw):
+    """Categorical draws from (..., K) probabilities (reference:
+    sample_multinomial)."""
+    from . import random as _rnd
+    return _rnd.multinomial(_nd(data), shape=shape, get_prob=get_prob,
+                            dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Spatial ops
+# ---------------------------------------------------------------------------
+def _bilinear_gather(x, gx, gy):
+    """Sample (B,C,H,W) at per-pixel float coords gx/gy (B,Ho,Wo), with
+    zero padding outside — the shared kernel of BilinearSampler /
+    SpatialTransformer / GridGenerator (reference:
+    bilinear_sampler.cc BilinearSamplerForward)."""
+    jnp = _jnp()
+    B, C, H, W = x.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(xi, yi):
+        inb = ((xi >= 0) & (xi < W) & (yi >= 0) & (yi < H))
+        xi_ = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi_ = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        # gather per batch: (B,Ho,Wo) indices into (B,C,H,W)
+        bidx = jnp.arange(B)[:, None, None]
+        v = x[bidx, :, yi_, xi_]          # (B,Ho,Wo,C)
+        return v * inb[..., None]
+    v00 = tap(x0, y0)
+    v01 = tap(x0 + 1, y0)
+    v10 = tap(x0, y0 + 1)
+    v11 = tap(x0 + 1, y0 + 1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+           + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return out.transpose(0, 3, 1, 2)      # (B,C,Ho,Wo)
+
+
+def BilinearSampler(data, grid, **kw):
+    """data (B,C,H,W), grid (B,2,Ho,Wo) with normalized coords in
+    [-1,1] (x then y) — reference: bilinear_sampler.cc."""
+    def fn(x, g):
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        gx = (g[:, 0] + 1.0) * (W - 1) / 2.0
+        gy = (g[:, 1] + 1.0) * (H - 1) / 2.0
+        return _bilinear_gather(x, gx, gy)
+    return _invoke(fn, [_nd(data), _nd(grid)], name="BilinearSampler")
+
+
+def GridGenerator(data, transform_type="affine", target_shape=(0, 0),
+                  **kw):
+    """affine: data (B,6) -> sampling grid (B,2,H,W) over target_shape;
+    warp: data (B,2,H,W) flow -> grid (reference: grid_generator.cc)."""
+    H, W = target_shape
+
+    def fn(d):
+        jnp = _jnp()
+        if transform_type == "affine":
+            B = d.shape[0]
+            theta = d.reshape(B, 2, 3)
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3,HW)
+            out = jnp.einsum("bij,jk->bik", theta, base)        # (B,2,HW)
+            return out.reshape(B, 2, H, W)
+        # warp: displacement field added to the identity grid,
+        # normalized per reference (flow in pixels)
+        B, _, Hf, Wf = d.shape
+        ys = jnp.arange(Hf, dtype=d.dtype)
+        xs = jnp.arange(Wf, dtype=d.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        px = gx + d[:, 0]
+        py = gy + d[:, 1]
+        nx = 2.0 * px / max(Wf - 1, 1) - 1.0
+        ny = 2.0 * py / max(Hf - 1, 1) - 1.0
+        return jnp.stack([nx, ny], 1)
+    return _invoke(fn, [_nd(data)], name="GridGenerator")
+
+
+def SpatialTransformer(data, loc, target_shape=(0, 0),
+                       transform_type="affine", sampler_type="bilinear",
+                       **kw):
+    """Affine spatial transformer = GridGenerator + BilinearSampler
+    (reference: spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine + bilinear")
+    grid = GridGenerator(loc, "affine", target_shape)
+    return BilinearSampler(data, grid)
+
+
+def ROIPooling(data, rois, pooled_size, spatial_scale, **kw):
+    """Max-pooling over ROI bins (reference: roi_pooling.cc).  data
+    (B,C,H,W); rois (R,5) [batch_idx,x0,y0,x1,y1] image coords."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def fn(x, r):
+        import jax
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        neg = jnp.finfo(x.dtype).min
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x0 = jnp.round(roi[1] * spatial_scale)
+            y0 = jnp.round(roi[2] * spatial_scale)
+            x1 = jnp.round(roi[3] * spatial_scale)
+            y1 = jnp.round(roi[4] * spatial_scale)
+            rw = jnp.maximum(x1 - x0 + 1, 1.0)
+            rh = jnp.maximum(y1 - y0 + 1, 1.0)
+            img = x[bidx]                  # (C,H,W)
+            iy = jnp.arange(H, dtype=x.dtype)
+            ix = jnp.arange(W, dtype=x.dtype)
+            # reference bins OVERLAP on shared boundary pixels:
+            # bin i covers [floor(i*rh/ph), ceil((i+1)*rh/ph))
+            bins = []
+            for i in range(ph):
+                ys = y0 + jnp.floor(i * rh / ph)
+                ye = y0 + jnp.ceil((i + 1) * rh / ph)
+                my = (iy >= ys) & (iy < ye) & (iy >= y0) & (iy <= y1)
+                for j in range(pw):
+                    xs = x0 + jnp.floor(j * rw / pw)
+                    xe = x0 + jnp.ceil((j + 1) * rw / pw)
+                    mxv = (ix >= xs) & (ix < xe) & (ix >= x0) & (ix <= x1)
+                    m = my[:, None] & mxv[None, :]        # (H,W)
+                    # where+max fuses into one reduction under XLA; no
+                    # (ph,pw,C,H,W) intermediate is materialized
+                    v = jnp.max(jnp.where(m[None], img, neg),
+                                axis=(-1, -2))            # (C,)
+                    bins.append(jnp.where(m.any(), v, 0.0))
+            out = jnp.stack(bins, -1)                     # (C, ph*pw)
+            return out.reshape(C, ph, pw)
+        return jax.vmap(one_roi)(r)        # (R,C,ph,pw)
+    return _invoke(fn, [_nd(data), _nd(rois)], name="ROIPooling")
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True, **kw):
+    """Optical-flow correlation layer (reference: correlation.cc),
+    single-pixel kernel form: output channel (dy, dx) holds
+    mean_c a(y, x) * b(y+dy, x+dx) over the (2m+1)^2 displacement
+    window (is_multiply=False: mean |a - b| as in the reference)."""
+    if kernel_size != 1 or stride1 != 1 or stride2 != 1 or pad_size != 0:
+        raise MXNetError(
+            "Correlation: only kernel_size=1, stride1=stride2=1, "
+            "pad_size=0 are implemented in this build")
+    m = max_displacement
+
+    def fn(a, b):
+        jnp = _jnp()
+        H, W = b.shape[2], b.shape[3]
+        outs = []
+        for dy in range(-m, m + 1):
+            for dx in range(-m, m + 1):
+                # out(y,x) pairs a(y,x) with b(y+dy, x+dx):
+                # roll by (-dy,-dx) brings b[y+dy, x+dx] to (y, x)
+                shifted = jnp.roll(b, (-dy, -dx), axis=(2, 3))
+                # zero positions whose partner fell outside the image
+                mask = jnp.ones((H, W), b.dtype)
+                if dy > 0:
+                    mask = mask.at[H - dy:, :].set(0)
+                elif dy < 0:
+                    mask = mask.at[:-dy, :].set(0)
+                if dx > 0:
+                    mask = mask.at[:, W - dx:].set(0)
+                elif dx < 0:
+                    mask = mask.at[:, :-dx].set(0)
+                prod = a * shifted * mask if is_multiply \
+                    else jnp.abs(a - shifted) * mask
+                outs.append(jnp.mean(prod, axis=1))
+        return jnp.stack(outs, 1)
+    return _invoke(fn, [_nd(data1), _nd(data2)], name="Correlation")
+
+
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """Local response normalization across channels (reference:
+    lrn.cc / AlexNet)."""
+    def fn(x):
+        jnp = _jnp()
+        sq = x * x
+        half = nsize // 2
+        pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + x.shape[1]] for i in range(nsize))
+        return x / (knorm + alpha / nsize * acc) ** beta
+    return _invoke(fn, [_nd(data)], name="LRN")
+
+
+# ---------------------------------------------------------------------------
+# Tensor-op odds and ends
+# ---------------------------------------------------------------------------
+def depth_to_space(data, block_size):
+    def fn(x):
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        b = block_size
+        y = x.reshape(B, b, b, C // (b * b), H, W)
+        y = y.transpose(0, 3, 4, 1, 5, 2)
+        return y.reshape(B, C // (b * b), H * b, W * b)
+    return _invoke(fn, [_nd(data)], name="depth_to_space")
+
+
+def space_to_depth(data, block_size):
+    def fn(x):
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        b = block_size
+        y = x.reshape(B, C, H // b, b, W // b, b)
+        y = y.transpose(0, 3, 5, 1, 2, 4)
+        return y.reshape(B, C * b * b, H // b, W // b)
+    return _invoke(fn, [_nd(data)], name="space_to_depth")
+
+
+def unravel_index(data, shape):
+    def fn(x):
+        jnp = _jnp()
+        out = jnp.unravel_index(x.astype(jnp.int64), tuple(shape))
+        return jnp.stack(out, 0).astype(x.dtype)
+    return _invoke(fn, [_nd(data)], name="unravel_index",
+                   differentiable=False)
+
+
+def ravel_multi_index(data, shape):
+    def fn(x):
+        jnp = _jnp()
+        idx = tuple(x[i].astype(jnp.int64) for i in range(x.shape[0]))
+        return jnp.ravel_multi_index(idx, tuple(shape),
+                                     mode="clip").astype(x.dtype)
+    return _invoke(fn, [_nd(data)], name="ravel_multi_index",
+                   differentiable=False)
+
+
+def logsumexp(data, axis=None, keepdims=False):
+    def fn(x):
+        import jax
+        return jax.scipy.special.logsumexp(x, axis=axis,
+                                           keepdims=keepdims)
+    return _invoke(fn, [_nd(data)], name="logsumexp")
+
+
+def cumprod(data, axis=None):
+    def fn(x):
+        jnp = _jnp()
+        return jnp.cumprod(x if axis is not None else x.ravel(),
+                           axis=axis if axis is not None else 0)
+    return _invoke(fn, [_nd(data)], name="cumprod")
+
+
+def trace(data, offset=0, axis1=-2, axis2=-1):
+    def fn(x):
+        return _jnp().trace(x, offset=offset, axis1=axis1, axis2=axis2)
+    return _invoke(fn, [_nd(data)], name="trace")
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    def fn(x):
+        return _jnp().clip(alpha * x + beta, 0.0, 1.0)
+    return _invoke(fn, [_nd(data)], name="hard_sigmoid")
+
+
+def multi_all_finite(*data, num_arrays=None, init_output=True):
+    """1 if every element of every input is finite (reference:
+    multi_all_finite.cc, the AMP overflow check)."""
+    nds = [_nd(d) for d in data]
+
+    def fn(*xs):
+        jnp = _jnp()
+        ok = jnp.array(True)
+        for x in xs:
+            ok = ok & jnp.isfinite(x).all()
+        return ok.astype(jnp.float32).reshape(1)
+    return _invoke(fn, nds, name="multi_all_finite",
+                   differentiable=False)
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Extract sliding patches: (B,C,H,W) -> (B, C*kh*kw, L) (reference:
+    src/operator/nn/im2col.h)."""
+    kh, kw = kernel
+
+    def fn(x):
+        import jax
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), tuple(stride),
+            padding=((pad[0], pad[0]), (pad[1], pad[1])),
+            rhs_dilation=tuple(dilate))
+        # patches: (B, C*kh*kw, Ho, Wo)
+        return patches.reshape(B, C * kh * kw, -1)
+    return _invoke(fn, [_nd(data)], name="im2col")
+
+
+def col2im(data, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Scatter-add patches back to an image — the adjoint of im2col
+    (reference: src/operator/nn/im2col.h col2im)."""
+    kh, kw = kernel
+    H, W = output_size
+
+    def fn(cols):
+        import jax
+        jnp = _jnp()
+        B, CKK, L = cols.shape
+        C = CKK // (kh * kw)
+
+        # adjoint of im2col = VJP of im2col at a zero image
+        def fwd(img):
+            p = jax.lax.conv_general_dilated_patches(
+                img, (kh, kw), tuple(stride),
+                padding=((pad[0], pad[0]), (pad[1], pad[1])),
+                rhs_dilation=tuple(dilate))
+            return p.reshape(B, CKK, -1)
+        zero = jnp.zeros((B, C, H, W), cols.dtype)
+        _, vjp = jax.vjp(fwd, zero)
+        return vjp(cols)[0]
+    return _invoke(fn, [_nd(data)], name="col2im")
+
+
+def fft(data, compute_size=128):
+    """Real-to-complex FFT over the last axis, packed interleaved
+    [re, im] like the reference (contrib fft.cc): (..., d) -> (..., 2d)."""
+    def fn(x):
+        jnp = _jnp()
+        out = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+        re = jnp.real(out)
+        im = jnp.imag(out)
+        return jnp.stack([re, im], -1).reshape(*x.shape[:-1],
+                                               2 * x.shape[-1])
+    return _invoke(fn, [_nd(data)], name="fft")
+
+
+def ifft(data, compute_size=128):
+    """Inverse of ``fft``'s packed layout: (..., 2d) -> (..., d)."""
+    def fn(x):
+        jnp = _jnp()
+        d = x.shape[-1] // 2
+        z = x.reshape(*x.shape[:-1], d, 2)
+        comp = z[..., 0] + 1j * z[..., 1]
+        return jnp.real(jnp.fft.ifft(comp, axis=-1)) * d
+    return _invoke(fn, [_nd(data)], name="ifft")
+
+
+__all__ = [n for n in dir() if not n.startswith("_") and n not in
+           ("NDArray", "MXNetError", "annotations")]

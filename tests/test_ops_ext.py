@@ -1,0 +1,351 @@
+"""Tests for the operator-corpus extensions (reference models:
+tests/python/unittest/test_operator.py sections for la_op, sample ops,
+spatial transformer, bilinear sampler, roi pooling, correlation, lrn,
+matrix ops, contrib fft)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+
+nd = mx.nd
+
+
+class TestLinalg:
+    def test_gemm(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        c = rng.randn(2, 3, 5).astype(np.float32)
+        out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                             alpha=2.0, beta=0.5)
+        np.testing.assert_allclose(out.asnumpy(), 2 * (a @ b) + 0.5 * c,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gemm_transpose(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 3).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        c = np.zeros((3, 5), np.float32)
+        out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                             transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_potrf_potri(self):
+        rng = np.random.RandomState(0)
+        m = rng.randn(4, 4).astype(np.float32)
+        spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        L = nd.linalg_potrf(nd.array(spd))
+        np.testing.assert_allclose(
+            (L.asnumpy() @ L.asnumpy().T), spd, rtol=1e-4, atol=1e-4)
+        inv = nd.linalg_potri(L)
+        np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_trsm(self):
+        rng = np.random.RandomState(0)
+        L = np.tril(rng.randn(4, 4)).astype(np.float32) \
+            + 3 * np.eye(4, dtype=np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        x = nd.linalg_trsm(nd.array(L), nd.array(b))
+        np.testing.assert_allclose(L @ x.asnumpy(), b, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_trmm_syrk(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(3, 3).astype(np.float32)
+        out = nd.linalg_trmm(nd.array(a), nd.array(b))
+        np.testing.assert_allclose(out.asnumpy(), np.tril(a) @ b,
+                                   rtol=1e-5, atol=1e-5)
+        s = nd.linalg_syrk(nd.array(a), alpha=1.5)
+        np.testing.assert_allclose(s.asnumpy(), 1.5 * (a @ a.T),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_det_slogdet_inverse(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(3, 3).astype(np.float32) + 2 * np.eye(3,
+                                                            dtype=np.float32)
+        assert nd.linalg_det(nd.array(a)).asnumpy() == pytest.approx(
+            np.linalg.det(a), rel=1e-4)
+        sign, logabs = nd.linalg_slogdet(nd.array(a))
+        es, el = np.linalg.slogdet(a)
+        assert sign.asnumpy() == pytest.approx(es)
+        assert logabs.asnumpy() == pytest.approx(el, rel=1e-4)
+        np.testing.assert_allclose(
+            nd.linalg_inverse(nd.array(a)).asnumpy(), np.linalg.inv(a),
+            rtol=1e-4, atol=1e-4)
+
+    def test_diag_roundtrip(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 4, 4).astype(np.float32)
+        d = nd.linalg_extractdiag(nd.array(a))
+        np.testing.assert_allclose(
+            d.asnumpy(), np.diagonal(a, axis1=-2, axis2=-1))
+        m = nd.linalg_makediag(d)
+        np.testing.assert_allclose(
+            np.diagonal(m.asnumpy(), axis1=-2, axis2=-1), d.asnumpy())
+
+    def test_trian_roundtrip(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32)
+        v = nd.linalg_extracttrian(nd.array(a))
+        assert v.shape == (10,)
+        back = nd.linalg_maketrian(v)
+        np.testing.assert_allclose(back.asnumpy(), np.tril(a), rtol=1e-6)
+
+    def test_trian_offset_selects_band(self):
+        """offset>0 extracts the strict upper triangle (reference
+        semantics; regression: offset sign was ignored)."""
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32)
+        v = nd.linalg_extracttrian(nd.array(a), offset=1)
+        assert v.shape == (6,)
+        rows, cols = np.triu_indices(4, k=1)
+        np.testing.assert_allclose(v.asnumpy(), a[rows, cols])
+        back = nd.linalg_maketrian(v, offset=1)
+        assert back.shape == (4, 4)
+        np.testing.assert_allclose(back.asnumpy(),
+                                   np.triu(a, k=1), rtol=1e-6)
+
+    def test_gemm_axis_param(self):
+        rng = np.random.RandomState(0)
+        # row axis relocated to axis 0: (3, B, 4) x (4, B, 5) -> (3, B, 5)
+        a = rng.randn(3, 2, 4).astype(np.float32)
+        b = rng.randn(4, 2, 5).astype(np.float32)
+        c = np.zeros((3, 2, 5), np.float32)
+        out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                             axis=0).asnumpy()
+        ref = np.einsum("ibk,kbj->ibj", a, b)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_potrf_gradient_flows(self):
+        m = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+        x = nd.array(m)
+        x.attach_grad()
+        with ag.record():
+            y = nd.linalg_potrf(x).sum()
+        y.backward()
+        assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+class TestSamplers:
+    def test_sample_shapes_and_ranges(self):
+        mx.random.seed(0)
+        low = nd.array(np.array([0.0, 10.0], np.float32))
+        high = nd.array(np.array([1.0, 20.0], np.float32))
+        s = nd.sample_uniform(low, high, shape=(1000,))
+        assert s.shape == (2, 1000)
+        a = s.asnumpy()
+        assert (a[0] >= 0).all() and (a[0] <= 1).all()
+        assert (a[1] >= 10).all() and (a[1] <= 20).all()
+
+    def test_sample_normal_moments(self):
+        mx.random.seed(0)
+        mu = nd.array(np.array([0.0, 5.0], np.float32))
+        sig = nd.array(np.array([1.0, 0.1], np.float32))
+        s = nd.sample_normal(mu, sig, shape=(4000,)).asnumpy()
+        assert abs(s[0].mean()) < 0.1
+        assert abs(s[1].mean() - 5.0) < 0.05
+        assert abs(s[0].std() - 1.0) < 0.1
+
+    def test_sample_gamma_exponential_poisson(self):
+        mx.random.seed(0)
+        al = nd.array(np.array([2.0], np.float32))
+        be = nd.array(np.array([3.0], np.float32))
+        g = nd.sample_gamma(al, be, shape=(4000,)).asnumpy()
+        assert abs(g.mean() - 6.0) < 0.5          # E = alpha*beta
+        lam = nd.array(np.array([4.0], np.float32))
+        e = nd.sample_exponential(lam, shape=(4000,)).asnumpy()
+        assert abs(e.mean() - 0.25) < 0.05
+        p = nd.sample_poisson(lam, shape=(4000,)).asnumpy()
+        assert abs(p.mean() - 4.0) < 0.3
+
+    def test_sample_negative_binomial(self):
+        mx.random.seed(0)
+        k = nd.array(np.array([5.0], np.float32))
+        p = nd.array(np.array([0.5], np.float32))
+        s = nd.sample_negative_binomial(k, p, shape=(4000,)).asnumpy()
+        assert abs(s.mean() - 5.0) < 0.5          # E = k(1-p)/p
+
+
+class TestSpatial:
+    def test_bilinear_sampler_identity(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 5, 7).astype(np.float32)
+        gy, gx = np.meshgrid(np.linspace(-1, 1, 5),
+                             np.linspace(-1, 1, 7), indexing="ij")
+        grid = np.stack([gx, gy], 0)[None].astype(np.float32)
+        out = nd.BilinearSampler(nd.array(x), nd.array(grid))
+        np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_spatial_transformer_identity_affine(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+        out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                    target_shape=(6, 6))
+        np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_spatial_transformer_shift(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 1.0
+        # translate by one pixel right+down (normalized: 2/(n-1))
+        t = 2.0 / 3.0
+        theta = np.array([[1, 0, -t, 0, 1, -t]], np.float32)
+        out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                    target_shape=(4, 4)).asnumpy()
+        assert out[0, 0, 2, 2] == pytest.approx(1.0, abs=1e-5)
+
+    def test_grid_generator_warp(self):
+        flow = np.zeros((1, 2, 4, 4), np.float32)   # zero flow = identity
+        grid = nd.GridGenerator(nd.array(flow), "warp").asnumpy()
+        assert grid.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(grid[0, 0, :, 0], -1.0)
+        np.testing.assert_allclose(grid[0, 0, :, -1], 1.0)
+
+    def test_roi_pooling(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+        out = nd.ROIPooling(nd.array(x), nd.array(rois), (2, 2),
+                            1.0).asnumpy()
+        np.testing.assert_allclose(out[0, 0],
+                                   [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_roi_pooling_overlapping_bins(self):
+        """ROI height 3 pooled to 2: boundary row contributes to BOTH
+        bins (reference ceil/floor bin edges; regression: each pixel
+        once landed in exactly one bin)."""
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, :] = 9.0                # max sits on the shared row
+        rois = np.array([[0, 0, 0, 3, 2]], np.float32)  # rows 0..2
+        out = nd.ROIPooling(nd.array(x), nd.array(rois), (2, 2),
+                            1.0).asnumpy()
+        # bin 0 covers rows {0,1}, bin 1 rows {1,2}: both see the 9
+        assert out[0, 0, 0, 0] == pytest.approx(9.0)
+        assert out[0, 0, 1, 0] == pytest.approx(9.0)
+
+    def test_correlation_self_is_meansquare(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 5, 5).astype(np.float32)
+        out = nd.Correlation(nd.array(x), nd.array(x),
+                             max_displacement=1).asnumpy()
+        assert out.shape == (1, 9, 5, 5)
+        center = out[0, 4]                 # zero displacement plane
+        np.testing.assert_allclose(center, (x[0] ** 2).mean(0),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_correlation_displacement_orientation(self):
+        """Reference: channel (dy,dx) pairs a(y,x) with b(y+dy,x+dx) —
+        a rightward-shifted copy peaks in the dx=+1 plane (regression:
+        planes were mirrored)."""
+        x = np.zeros((1, 1, 5, 5), np.float32)
+        x[0, 0, 2, 2] = 1.0
+        y = np.roll(x, 1, axis=3)          # y(r, c) = x(r, c-1)
+        out = nd.Correlation(nd.array(x), nd.array(y),
+                             max_displacement=1).asnumpy()[0]
+        # planes ordered dy-major: (dy,dx)=(0,+1) is index 5
+        assert out[5, 2, 2] == pytest.approx(1.0)
+        assert out[3, 2, 2] == pytest.approx(0.0)   # (0,-1) empty
+
+    def test_correlation_subtract_mode_positive(self):
+        a = nd.array(np.zeros((1, 1, 3, 3), np.float32))
+        b = nd.array(np.ones((1, 1, 3, 3), np.float32))
+        out = nd.Correlation(a, b, max_displacement=0,
+                             is_multiply=False).asnumpy()
+        np.testing.assert_allclose(out[0, 0], 1.0)
+
+    def test_correlation_unsupported_config_raises(self):
+        a = nd.array(np.zeros((1, 1, 3, 3), np.float32))
+        with pytest.raises(mx.MXNetError, match="Correlation"):
+            nd.Correlation(a, a, kernel_size=3)
+
+    def test_lrn_matches_formula(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, 3, 3).astype(np.float32)
+        alpha, beta, k, n = 1e-3, 0.75, 2.0, 5
+        out = nd.LRN(nd.array(x), alpha=alpha, beta=beta, knorm=k,
+                     nsize=n).asnumpy()
+        ref = np.empty_like(x)
+        half = n // 2
+        for c in range(6):
+            lo, hi = max(0, c - half), min(6, c + half + 1)
+            acc = (x[:, lo:hi] ** 2).sum(axis=1)
+            ref[:, c] = x[:, c] / (k + alpha / n * acc) ** beta
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestTensorOdds:
+    def test_depth_space_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 8, 3, 5).astype(np.float32)
+        d = nd.depth_to_space(nd.array(x), 2)
+        assert d.shape == (2, 2, 6, 10)
+        back = nd.space_to_depth(d, 2)
+        np.testing.assert_allclose(back.asnumpy(), x)
+
+    def test_unravel_ravel(self):
+        idx = nd.array(np.array([0, 5, 11], np.float32))
+        un = nd.unravel_index(idx, (3, 4)).asnumpy()
+        np.testing.assert_array_equal(un, [[0, 1, 2], [0, 1, 3]])
+        back = nd.ravel_multi_index(nd.array(un), (3, 4)).asnumpy()
+        np.testing.assert_array_equal(back, [0, 5, 11])
+
+    def test_logsumexp_cumprod_trace(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            nd.logsumexp(nd.array(x), axis=1).asnumpy(),
+            np.log(np.exp(x).sum(1)), rtol=1e-5)
+        np.testing.assert_allclose(
+            nd.cumprod(nd.array(x), axis=1).asnumpy(),
+            np.cumprod(x, axis=1), rtol=1e-5)
+        sq = rng.randn(4, 4).astype(np.float32)
+        assert nd.trace(nd.array(sq)).asnumpy() == pytest.approx(
+            np.trace(sq), rel=1e-5)
+
+    def test_hard_sigmoid(self):
+        x = nd.array(np.array([-10.0, 0.0, 10.0], np.float32))
+        np.testing.assert_allclose(nd.hard_sigmoid(x).asnumpy(),
+                                   [0.0, 0.5, 1.0])
+
+    def test_multi_all_finite(self):
+        a = nd.array(np.ones((2, 2), np.float32))
+        b = nd.array(np.array([1.0, np.inf], np.float32))
+        assert nd.multi_all_finite(a).asnumpy()[0] == 1.0
+        assert nd.multi_all_finite(a, b).asnumpy()[0] == 0.0
+
+    def test_im2col_col2im(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        cols = nd.im2col(nd.array(x), (2, 2), stride=(1, 1))
+        assert cols.shape == (1, 8, 9)
+        # col2im is the adjoint: ones-cols scatter counts patch coverage
+        ones = nd.array(np.ones((1, 8, 9), np.float32))
+        img = nd.col2im(ones, (4, 4), (2, 2), stride=(1, 1)).asnumpy()
+        # center pixels are covered by 4 patches per channel
+        assert img[0, 0, 1, 1] == pytest.approx(4.0)
+        assert img[0, 0, 0, 0] == pytest.approx(1.0)
+
+    def test_fft_ifft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 8).astype(np.float32)
+        f = nd.fft(nd.array(x))
+        assert f.shape == (3, 16)
+        back = nd.ifft(f).asnumpy()
+        # reference (cuFFT) semantics: unnormalized inverse -> x * d
+        np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+    def test_grads_flow_through_ext_ops(self):
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.randn(2, 8, 4, 4).astype(np.float32))
+        x.attach_grad()
+        with ag.record():
+            y = nd.depth_to_space(x, 2)
+            z = nd.logsumexp(y)
+        z.backward()
+        assert np.isfinite(x.grad.asnumpy()).all()
+        assert np.abs(x.grad.asnumpy()).sum() > 0
