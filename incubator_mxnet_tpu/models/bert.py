@@ -133,6 +133,11 @@ class MultiHeadAttention(HybridBlock):
                     causal=self._causal)
         return self.dropout(self.proj(out))
 
+    def project_kv(self, mem):
+        """Precompute this head's K/V projections of an encoder memory —
+        the cross-attention half of a KV cache (incremental decoding)."""
+        return self.key(mem), self.value(mem)
+
 
 class PositionwiseFFN(HybridBlock):
     def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",

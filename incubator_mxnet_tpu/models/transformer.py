@@ -10,7 +10,9 @@ TPU-first design choices (mirrors models/bert.py):
   * sinusoidal position table is a constant folded at trace time;
   * greedy decode runs as a ``lax.scan`` over decode steps (static trip
     count = max_length) instead of a Python loop, so inference is one
-    compiled program;
+    compiled program; the default path carries per-layer KV caches in
+    the scan state (O(T) per step), with the full-prefix re-run kept as
+    the tested oracle;
   * Megatron-style ``tp_rules`` identical in spirit to bert.tp_rules.
 """
 from __future__ import annotations
@@ -77,6 +79,53 @@ class _DecoderCell(HybridBlock):
         x = self.ln1(x + self.self_attention(x, None))
         x = self.ln2(x + self.cross_attention(x, src_mask, mem))
         return self.ln3(x + self.ffn(x))
+
+    def step(self, x, cache_k, cache_v, t, mem_k, mem_v, src_mask=None):
+        """One-position incremental decode step with a KV cache.
+
+        x (B,1,C) current-position activations; cache_k/cache_v
+        (B,Tmax,C) this layer's self-attention cache; t scalar step
+        index; mem_k/mem_v (B,Ts,C) precomputed cross-attention
+        projections (MultiHeadAttention.project_kv).  Returns
+        (y (B,1,C), cache_k', cache_v').  O(Tmax) per step instead of
+        re-running the full prefix."""
+        sa = self.self_attention
+        nh = sa._num_heads
+        q = sa.query(x)
+        k_new = sa.key(x)
+        v_new = sa.value(x)
+
+        def self_attn(qv, kn, vn, ck, cv, tv):
+            import jax.numpy as jnp
+            B, _, C = qv.shape
+            hd = C // nh
+            Tm = ck.shape[1]
+            ck = ck.at[:, tv].set(kn[:, 0])
+            cv = cv.at[:, tv].set(vn[:, 0])
+            qh = qv.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
+            kh = ck.reshape(B, Tm, nh, hd).transpose(0, 2, 1, 3)
+            vh = cv.reshape(B, Tm, nh, hd).transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(hd)
+            s = jnp.where(jnp.arange(Tm)[None, None, None, :] <= tv,
+                          s, -1e30)
+            p = jnp.exp(s - jnp.max(s, -1, keepdims=True))
+            p = p / jnp.sum(p, -1, keepdims=True)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
+            return out.transpose(0, 2, 1, 3).reshape(B, 1, C), ck, cv
+        out, ck, cv = _invoke(self_attn,
+                              [q, k_new, v_new, cache_k, cache_v, t],
+                              name="decode_self_attn")
+        x = self.ln1(x + sa.dropout(sa.proj(out)))
+
+        ca = self.cross_attention
+        # cross-attention over the precomputed K/V is exactly bert._sdpa's
+        # masked non-causal path — reuse it for bit-identical numerics
+        # with the full-prefix oracle
+        from .bert import _sdpa
+        out2 = _sdpa(ca.query(x), mem_k, mem_v, ca._num_heads,
+                     mask=src_mask)
+        x = self.ln2(x + ca.dropout(ca.proj(out2)))
+        return self.ln3(x + self.ffn(x)), ck, cv
 
 
 class TransformerEncoder(HybridBlock):
@@ -189,10 +238,18 @@ class TransformerModel(HybridBlock):
         return self._project(dec)
 
     def greedy_decode(self, src_ids, max_length=32, bos=2, eos=3,
-                      src_valid=None):
+                      src_valid=None, use_cache=True):
         """Greedy translation as one lax.scan program (static trip count;
         reference analog: GluonNLP BeamSearchTranslator, greedy mode).
-        Returns (B, max_length) int32 token ids."""
+        Returns (B, max_length) int32 token ids.
+
+        ``use_cache=True`` (default) runs KV-cache incremental decoding —
+        O(T) single-position steps; ``use_cache=False`` re-runs the full
+        prefix per step (the simpler oracle both paths are tested
+        against)."""
+        if use_cache:
+            return self._greedy_decode_cached(src_ids, max_length, bos,
+                                              eos, src_valid)
         mask = self._valid_to_mask(src_ids, src_valid)
         mem = self.encode(src_ids, _mask=mask)
         maskv = None if mask is None else mask._data
@@ -205,8 +262,7 @@ class TransformerModel(HybridBlock):
             def step(toks, t):
                 # re-run the decoder over the fixed-width prefix; the
                 # causal mask makes positions >= t inert, so growing the
-                # prefix is sharding- and shape-static (KV-cache decode
-                # is a perf follow-up, not a semantics change)
+                # prefix is sharding- and shape-static
                 logits = self._decode_tokens(jnp.asarray(toks), memv,
                                              maskv)
                 nxt = jnp.argmax(logits[:, t, :], axis=-1).astype(jnp.int32)
@@ -222,6 +278,64 @@ class TransformerModel(HybridBlock):
             return toks
         out = fn(mem._data)
         return NDArray(out)
+
+    def _greedy_decode_cached(self, src_ids, max_length, bos, eos,
+                              src_valid):
+        """KV-cache greedy decode: one lax.scan whose carry holds each
+        decoder layer's (B, max_length, C) self-attention K/V cache;
+        cross-attention K/V are projected once from the encoder memory."""
+        import jax
+        import jax.numpy as jnp
+        from .. import autograd as ag
+
+        if max_length > self._pos_table.shape[0]:
+            raise MXNetError(
+                f"decode length {max_length} exceeds max_length "
+                f"{self._pos_table.shape[0]}; construct TransformerModel "
+                "with a larger max_length")
+        mask = self._valid_to_mask(src_ids, src_valid)
+        mem = self.encode(src_ids, _mask=mask)
+        B = src_ids.shape[0]
+        C = self._units
+        cells = list(self.decoder._children.values())
+        with ag.pause():
+            mem_kv = [cell.cross_attention.project_kv(mem)
+                      for cell in cells]
+        pos = self._pos_table
+        sqrt_d = math.sqrt(C)
+
+        def embed_pos(e, tv):
+            def fn(ev, t_):
+                return ev * sqrt_d + jnp.asarray(pos)[t_][None, None, :]
+            return _invoke(fn, [e, tv], name="decode_embed_pos")
+
+        def step(carry, t):
+            toks, cks, cvs = carry
+            with ag.pause():
+                x = self.embed(NDArray(toks[:, t][:, None]))
+                x = embed_pos(x, NDArray(t))
+                new_cks, new_cvs = [], []
+                for l, cell in enumerate(cells):
+                    x, ck, cv = cell.step(
+                        x, NDArray(cks[l]), NDArray(cvs[l]), NDArray(t),
+                        mem_kv[l][0], mem_kv[l][1], mask)
+                    new_cks.append(ck._data)
+                    new_cvs.append(cv._data)
+                logits = self._project(x)._data[:, 0]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(toks[:, t] == eos, eos, nxt)
+            toks = toks.at[:, t + 1].set(nxt)
+            return (toks, tuple(new_cks), tuple(new_cvs)), None
+
+        toks0 = jnp.full((B, max_length), eos, jnp.int32)
+        toks0 = toks0.at[:, 0].set(bos)
+        # cache in the model's compute dtype (bf16 after net.cast stays
+        # bf16 — same numerics as the full-prefix oracle)
+        zeros = tuple(jnp.zeros((B, max_length, C), mem._data.dtype)
+                      for _ in cells)
+        (toks, _, _), _ = jax.lax.scan(
+            step, (toks0, zeros, zeros), jnp.arange(max_length - 1))
+        return NDArray(toks)
 
     def _decode_tokens(self, toks, memv, maskv=None):
         """jnp (B, T) tokens + jnp memory (+ optional (B, Ts) source

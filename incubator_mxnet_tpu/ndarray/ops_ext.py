@@ -449,6 +449,62 @@ def Correlation(data1, data2, kernel_size=1, max_displacement=1,
     return _invoke(fn, [_nd(data1), _nd(data2)], name="Correlation")
 
 
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), pad=(1, 1), dilate=(1, 1),
+                          num_deformable_group=1, num_filter=0, **kw):
+    """Deformable convolution v1 (reference:
+    src/operator/contrib/deformable_convolution.cc, Dai et al. 2017).
+
+    data (B,C,H,W); offset (B, 2*G*kh*kw, Ho, Wo) — per-output-position
+    (dy, dx) displacement for every kernel tap, G deformable groups over
+    the channel dim; weight (Cout, C, kh, kw).
+
+    TPU-first shape: one bilinear gather per kernel tap (static kh*kw
+    loop) + a single einsum onto the MXU — no im2col buffer, no
+    data-dependent control flow."""
+    kh, kw = kernel
+    G = num_deformable_group
+    w_shape = tuple(_nd(weight).shape)
+    if num_filter not in (0, w_shape[0]):
+        raise MXNetError(
+            f"DeformableConvolution: num_filter={num_filter} does not "
+            f"match weight.shape[0]={w_shape[0]}")
+
+    def fn(x, off, w, *rest):
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+        Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+        oy = jnp.arange(Ho) * stride[0] - pad[0]
+        ox = jnp.arange(Wo) * stride[1] - pad[1]
+        base_y = oy[:, None]                      # (Ho,1)
+        base_x = ox[None, :]                      # (1,Wo)
+        off = off.reshape(B, G, kh * kw, 2, Ho, Wo)
+        cg = C // G
+        taps = []
+        for k in range(kh * kw):
+            ky, kx = divmod(k, kw)
+            groups = []
+            for g in range(G):
+                dy = off[:, g, k, 0]              # (B,Ho,Wo)
+                dx = off[:, g, k, 1]
+                gy = base_y[None] + ky * dilate[0] + dy
+                gx = base_x[None] + kx * dilate[1] + dx
+                xg = x[:, g * cg:(g + 1) * cg]
+                groups.append(_bilinear_gather(xg, gx, gy))
+            taps.append(jnp.concatenate(groups, 1))  # (B,C,Ho,Wo)
+        stacked = jnp.stack(taps, 2)              # (B,C,kh*kw,Ho,Wo)
+        out = jnp.einsum("bckhw,ock->bohw",
+                         stacked, w.reshape(w.shape[0], C, kh * kw))
+        if rest:
+            out = out + rest[0][None, :, None, None]
+        return out
+    inputs = [_nd(data), _nd(offset), _nd(weight)]
+    if bias is not None:
+        inputs.append(_nd(bias))
+    return _invoke(fn, inputs, name="DeformableConvolution")
+
+
 def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
     """Local response normalization across channels (reference:
     lrn.cc / AlexNet)."""

@@ -122,6 +122,23 @@ class TestTransformer:
             losses.append(float(L.asnumpy()))
         assert losses[-1] < losses[0] * 0.7, losses
 
+    def test_kv_cache_decode_matches_full_prefix(self):
+        """Cached O(T) incremental decode must reproduce the full-prefix
+        oracle token-for-token, masked and unmasked."""
+        net = _tiny_transformer()
+        src = mx.nd.array(np.random.randint(1, 50, (3, 6)), dtype="int32")
+        sv = mx.nd.array(np.array([6, 4, 5]), dtype="int32")
+        a = net.greedy_decode(src, max_length=10,
+                              use_cache=False).asnumpy()
+        b = net.greedy_decode(src, max_length=10,
+                              use_cache=True).asnumpy()
+        np.testing.assert_array_equal(a, b)
+        am = net.greedy_decode(src, max_length=10, src_valid=sv,
+                               use_cache=False).asnumpy()
+        bm = net.greedy_decode(src, max_length=10, src_valid=sv,
+                               use_cache=True).asnumpy()
+        np.testing.assert_array_equal(am, bm)
+
     def test_beam_search(self):
         net = _tiny_transformer()
         src = mx.nd.array(np.random.randint(1, 50, (2, 6)), dtype="int32")

@@ -263,6 +263,57 @@ class TestSpatial:
         with pytest.raises(mx.MXNetError, match="Correlation"):
             nd.Correlation(a, a, kernel_size=3)
 
+    def test_deformable_conv_zero_offset_is_conv(self):
+        """With zero offsets, deformable conv must equal ordinary
+        convolution (the defining property; reference:
+        test_contrib_operator.py deformable tests)."""
+        import jax
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        out = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            pad=(1, 1)).asnumpy()
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), ((1, 1), (1, 1)))
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_deformable_conv_integer_offset_shifts(self):
+        """A uniform integer offset equals convolving a shifted input
+        (interior pixels)."""
+        import jax
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 8, 8), np.float32)
+        off[:, 0::2] = 1.0                 # dy=+1 for every tap
+        out = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            pad=(1, 1)).asnumpy()
+        shifted = np.roll(x, -1, axis=2)
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            shifted, w, (1, 1), ((1, 1), (1, 1))))
+        np.testing.assert_allclose(out[:, :, 2:-2, 2:-2],
+                                   ref[:, :, 2:-2, 2:-2], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_deformable_conv_grads(self):
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+        w = nd.array(rng.randn(2, 2, 3, 3).astype(np.float32))
+        off = nd.array(rng.randn(1, 18, 5, 5).astype(np.float32) * 0.1)
+        for t in (x, w, off):
+            t.attach_grad()
+        with ag.record():
+            y = nd.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                         pad=(1, 1)).sum()
+        y.backward()
+        for t in (x, w, off):
+            g = t.grad.asnumpy()
+            assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
     def test_lrn_matches_formula(self):
         rng = np.random.RandomState(0)
         x = rng.randn(2, 6, 3, 3).astype(np.float32)
