@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Canonical "how to run everything" script (reference analog:
+# ci/docker/runtime_functions.sh).  All suites run on a virtual
+# 8-device CPU mesh unless a TPU tier is requested.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+usage() {
+    cat <<EOF
+usage: ci/run_tests.sh <function>
+  unittest_cpu          full CPU suite (single run; ~12 min on 1 core)
+  unittest_cpu_chunked  CPU suite in two halves (for constrained runners)
+  unittest_tpu          TPU tier (tests_tpu/: op sweep on the live chip
+                        + CPU-vs-TPU consistency; self-skips without one)
+  smoke                 60-second end-to-end slice (gluon MNIST)
+  bench                 judged benchmark (prints one JSON line)
+  multichip_dryrun      8-virtual-device full-train-step compile+run
+EOF
+    exit 1
+}
+
+unittest_cpu() {
+    python -m pytest tests/ -q
+}
+
+unittest_cpu_chunked() {
+    mapfile -t files < <(ls tests/test_*.py | sort)
+    half=$(( (${#files[@]} + 1) / 2 ))
+    python -m pytest "${files[@]:0:half}" -q -p no:cacheprovider
+    python -m pytest "${files[@]:half}" -q -p no:cacheprovider
+}
+
+unittest_tpu() {
+    python -m pytest tests_tpu/ -q
+}
+
+smoke() {
+    python example/gluon/mnist.py --cpu --epochs 1
+}
+
+bench() {
+    python bench.py
+}
+
+multichip_dryrun() {
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+}
+
+[ $# -eq 1 ] || usage
+declare -F "$1" >/dev/null || usage
+"$1"

@@ -1,0 +1,36 @@
+"""Console entry points (reference analog: the reference ships its CLI
+as ``tools/*.py`` scripts; packaging exposes them as ``im2rec`` and
+``mxtpu-launch`` commands).
+
+In a source checkout the implementations live in ``tools/`` next to the
+package; when only the wheel is installed the source scripts are absent
+and we fail with a clear message rather than a stack trace.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_tool(name):
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "tools", f"{name}.py")
+    if not os.path.exists(path):
+        raise SystemExit(
+            f"{name}: the '{name}' tool ships in the source tree "
+            f"(tools/{name}.py) — run from a checkout of the repository")
+    spec = importlib.util.spec_from_file_location(f"_tool_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def im2rec_main():
+    """Pack an image list into RecordIO (tools/im2rec.py)."""
+    sys.exit(_load_tool("im2rec").main())
+
+
+def launch_main():
+    """Spawn a multi-process training job (tools/launch.py)."""
+    sys.exit(_load_tool("launch").main())

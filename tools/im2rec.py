@@ -16,6 +16,15 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# a data-packing CLI must never grab an accelerator (and must not hang
+# when one is configured but unreachable) — pin jax to CPU before the
+# framework import can touch the default backend.  The env var covers
+# interpreters where jax is imported later; config.update covers a
+# sitecustomize that already imported jax (env alone is too late there).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
 _EXTS = (".jpg", ".jpeg", ".png")
 
 
