@@ -12,8 +12,9 @@ from ...base import MXNetError
 from ..block import HybridBlock
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+           "VariationalDropoutCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -282,6 +283,59 @@ class ResidualCell(_ModifierCell):
     def _fwd(self, x, states):
         out, new_states = self.base_cell(x, states)
         return out + x, new_states
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Dropout with masks sampled ONCE per sequence and reused across
+    time steps (Gal & Ghahramani; reference:
+    gluon/rnn/rnn_cell.py VariationalDropoutCell).  Call ``reset()``
+    between sequences to draw fresh masks."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        self._di = drop_inputs
+        self._ds = drop_states
+        self._do = drop_outputs
+        super().__init__(base_cell, **kwargs)   # base __init__ resets
+
+    def reset(self):
+        super().reset()
+        self._mask_in = None
+        self._mask_states = None
+        self._mask_out = None
+
+    def _mask(self, p, like):
+        from ... import ndarray as F
+        return F.dropout(F.ones_like(like), p=p)
+
+    def _fwd(self, x, states):
+        from ... import autograd as ag
+        if ag.is_training():
+            if self._di > 0:
+                if self._mask_in is None:
+                    self._mask_in = self._mask(self._di, x)
+                x = x * self._mask_in
+            if self._ds > 0:
+                if self._mask_states is None:
+                    self._mask_states = [self._mask(self._ds, s)
+                                         for s in states]
+                states = [s * m for s, m in zip(states,
+                                                self._mask_states)]
+        out, new_states = self.base_cell(x, states)
+        if ag.is_training() and self._do > 0:
+            if self._mask_out is None:
+                self._mask_out = self._mask(self._do, out)
+            out = out * self._mask_out
+        return out, new_states
+    # no unroll override needed: RecurrentCell.unroll resets first, so
+    # each unrolled sequence draws fresh masks
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybrid-capable stack of cells (reference:
+    HybridSequentialRNNCell).  Cells here are jit-traceable by
+    construction, so this is the same machinery under the reference's
+    name."""
 
 
 class BidirectionalCell(RecurrentCell):

@@ -17,7 +17,7 @@ from .base import MXNetError
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "InitDesc", "register", "create"]
+           "Mixed", "InitDesc", "register", "create"]
 
 _REGISTRY = {}
 
@@ -251,3 +251,35 @@ class LSTMBias(Initializer):
         num_hidden = arr.shape[0] // 4
         b[num_hidden:2 * num_hidden] = self.forget_bias  # [i, f, g, o] order
         self._set(arr, b)
+
+
+@register
+class Mixed(Initializer):
+    """Route parameters to initializers by regex on the parameter name
+    (reference: mx.init.Mixed).  First matching pattern wins; a '.*'
+    catch-all is conventional as the last entry.  ``initializers``
+    entries may be Initializer objects or dumps()-style ``[name,
+    kwargs]`` specs (so Mixed itself round-trips through dumps())."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: len(patterns) != len(initializers)")
+        initializers = [
+            _REGISTRY[i[0]](**i[1]) if isinstance(i, (list, tuple))
+            else i for i in initializers]
+        super().__init__(
+            patterns=list(patterns),
+            initializers=[json.loads(i.dumps()) for i in initializers])
+        self._map = [(re.compile(p), init) for p, init in
+                     zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        if not isinstance(name, str):
+            name = str(name)
+        for pat, init in self._map:
+            if pat.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"Mixed: no pattern matched parameter '{name}'; add a '.*' "
+            "catch-all as the last entry")

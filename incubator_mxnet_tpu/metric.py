@@ -14,9 +14,9 @@ import numpy as _np
 from .base import MXNetError
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
-           "Perplexity", "Loss", "PearsonCorrelation", "CustomMetric",
-           "create", "np"]
+           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "Perplexity", "Loss",
+           "PearsonCorrelation", "CustomMetric", "create", "np"]
 
 _REGISTRY: Dict[str, type] = {}
 
@@ -162,6 +162,49 @@ class F1(EvalMetric):
         rec = self._tp / max(self._tp + self._fn, 1e-12)
         f1 = 2 * prec * rec / max(prec + rec, 1e-12)
         self.sum_metric = f1 * self.num_inst
+
+
+@_register
+class MCC(EvalMetric):
+    """Binary Matthews correlation coefficient (reference: metric.MCC).
+    Accumulates the confusion matrix across updates."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._tp = self._fp = self._tn = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).reshape(-1).astype(_np.int64)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                if pred.shape[-1] != 2:
+                    raise MXNetError(
+                        "MCC is a binary metric; got "
+                        f"{pred.shape[-1]} prediction classes")
+                pred = pred.argmax(axis=-1)
+            else:
+                pred = (pred.reshape(-1) > 0.5).astype(_np.int64)
+            if ((label != 0) & (label != 1)).any():
+                raise MXNetError("MCC is a binary metric; labels must "
+                                 "be 0/1")
+            pred = pred.reshape(-1)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+        denom = float(_np.sqrt((self._tp + self._fp)
+                               * (self._tp + self._fn)
+                               * (self._tn + self._fp)
+                               * (self._tn + self._fn)))
+        mcc = ((self._tp * self._tn - self._fp * self._fn)
+               / max(denom, 1e-12))
+        self.sum_metric = mcc * self.num_inst
 
 
 @_register

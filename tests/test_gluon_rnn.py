@@ -120,3 +120,50 @@ def test_residual_and_dropout_cells():
     dc = rnn.DropoutCell(0.3)
     out, states = dc(mx.nd.ones((2, 4)), [])
     assert out.shape == (2, 4)
+
+
+def test_hybrid_sequential_cell():
+    stack = rnn.HybridSequentialRNNCell()
+    stack.add(rnn.LSTMCell(5, input_size=4))
+    stack.add(rnn.GRUCell(5, input_size=5))
+    stack.initialize()
+    out, _ = stack.unroll(3, mx.nd.ones((2, 3, 4)), layout="NTC")
+    assert out.shape == (2, 3, 5)
+    assert len(stack) == 2
+    assert isinstance(stack[0], rnn.LSTMCell)
+
+
+def test_variational_dropout_cell_mask_reuse():
+    """The same dropout mask must apply at every time step within a
+    sequence (Gal & Ghahramani), and refresh between sequences."""
+    import numpy as np
+    from incubator_mxnet_tpu import autograd as ag
+    mx.random.seed(0)
+
+    class _Identity(rnn.RecurrentCell):
+        def state_info(self, batch_size=0):
+            return []
+
+        def _fwd(self, x, states):
+            return x, states
+
+    vd = rnn.VariationalDropoutCell(_Identity(), drop_inputs=0.5)
+    x = mx.nd.ones((2, 6, 4))
+    with ag.record(train_mode=True):
+        out, _ = vd.unroll(6, x, layout="NTC", merge_outputs=True)
+    o = out.asnumpy()
+    # every time step saw the SAME mask: columns are constant over time
+    for t in range(1, 6):
+        np.testing.assert_array_equal(o[:, t], o[:, 0])
+    # some entries dropped, survivors scaled by 1/(1-p)
+    assert (o == 0).any() and (o > 1.5).any()
+    # fresh mask next sequence (statistically: try a few unrolls)
+    masks = set()
+    for _ in range(5):
+        with ag.record(train_mode=True):
+            out, _ = vd.unroll(6, x, layout="NTC", merge_outputs=True)
+        masks.add(tuple((out.asnumpy()[:, 0] == 0).reshape(-1)))
+    assert len(masks) > 1
+    # inference mode: no dropout at all
+    out, _ = vd.unroll(6, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_array_equal(out.asnumpy(), x.asnumpy())

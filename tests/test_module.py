@@ -78,6 +78,42 @@ def test_topk():
     assert m.get()[1] == pytest.approx(0.5)
 
 
+def test_mcc():
+    m = metric.MCC()
+    # perfect binary prediction -> MCC = 1
+    m.update([nd.array([0, 1, 1, 0])],
+             [nd.array([[0.9, 0.1], [0.2, 0.8], [0.1, 0.9], [0.8, 0.2]])])
+    assert m.get()[1] == pytest.approx(1.0)
+    # compare a mixed case against sklearn's closed form
+    m.reset()
+    labels = np.array([1, 1, 1, 0, 0, 1, 0, 0])
+    preds = np.array([1, 0, 1, 0, 1, 1, 0, 0])
+    onehot = np.stack([1.0 - preds, preds.astype(float)], -1)
+    m.update([nd.array(labels)], [nd.array(onehot)])
+    tp, fp = 3.0, 1.0
+    tn, fn = 3.0, 1.0
+    want = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    assert m.get()[1] == pytest.approx(want, rel=1e-6)
+
+
+def test_mixed_initializer():
+    import incubator_mxnet_tpu as mx
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    # weights routed to Constant(7), everything else (incl. bias, which
+    # keeps the bias->zero suffix rule) to the catch-all
+    net.initialize(init=mx.init.Mixed(
+        [".*weight", ".*"], [mx.init.Constant(7.0), mx.init.One()]))
+    assert (net.weight.data().asnumpy() == 7.0).all()
+    assert (net.bias.data().asnumpy() == 0.0).all()
+    # no matching pattern -> clear error
+    with pytest.raises(mx.MXNetError, match="no pattern"):
+        mx.init.Mixed(["foo.*"], [mx.init.Zero()])("bar_weight",
+                                                   mx.nd.zeros((2,)))
+    with pytest.raises(mx.MXNetError, match="patterns"):
+        mx.init.Mixed([".*"], [mx.init.Zero(), mx.init.One()])
+
+
 def test_mse_rmse_mae():
     lab = nd.array([1.0, 2.0, 3.0])
     pred = nd.array([1.0, 2.0, 5.0])
