@@ -19,9 +19,9 @@ from ..ndarray.ndarray import NDArray
 from ..ndarray import optimizer_ops as _oo
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "Adamax", "Nadam", "AdaGrad",
-           "RMSProp", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LAMB",
-           "SGLD", "DCASGD", "Test", "create", "register", "get_updater",
-           "Updater"]
+           "RMSProp", "AdaDelta", "Ftrl", "FTML", "Signum", "SignSGD",
+           "LAMB", "LARS", "AdamW", "SGLD", "DCASGD", "Test", "create",
+           "register", "get_updater", "Updater"]
 
 _REGISTRY = {}
 
@@ -513,6 +513,120 @@ class DCASGD(Optimizer):
             d = new_mom
         prev._set_data(weight._data)
         weight._set_data(weight._data + d)
+
+
+@register
+class FTML(Optimizer):
+    """Follow The Moving Leader (reference: optimizer.FTML /
+    src/operator/optimizer_op.cc ftml_update; Zheng & Kwok 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight),
+                _zeros_like(weight))           # d, v, z
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        v_t = self.beta2 * v._data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z_t = self.beta1 * z._data + (1 - self.beta1) * g \
+            - sigma * weight._data
+        d._set_data(d_t)
+        v._set_data(v_t)
+        z._set_data(z_t)
+        weight._set_data(-z_t / d_t)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling SGD (reference: optimizer.LARS,
+    1.6+; You et al. 2017).  Per-layer trust ratio
+    eta*||w|| / (||g|| + wd*||w||) scales the learning rate before a
+    momentum-SGD step."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_norm = jnp.linalg.norm(weight._data.ravel())
+        g_norm = jnp.linalg.norm(g.ravel())
+        ratio = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0)
+        step = (lr * ratio) * (g + wd * weight._data)
+        if state is not None:
+            m_t = self.momentum * state._data + step
+            state._set_data(m_t)
+            weight._set_data(weight._data - m_t)
+        else:
+            weight._set_data(weight._data - step)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference: contrib.adamw /
+    mx.optimizer AdamW in later 1.x; Loshchilov & Hutter 2019)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m_t = self.beta1 * mean._data + (1 - self.beta1) * g
+        v_t = self.beta2 * var._data + (1 - self.beta2) * g * g
+        m_hat = m_t / (1 - self.beta1 ** t)
+        v_hat = v_t / (1 - self.beta2 ** t)
+        mean._set_data(m_t)
+        var._set_data(v_t)
+        weight._set_data(
+            weight._data - lr * (m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+                                 + wd * weight._data))
 
 
 @register

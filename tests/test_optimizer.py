@@ -108,6 +108,77 @@ def test_lamb_runs_and_descends():
     assert after < before  # moved against the gradient
 
 
+def test_ftml_matches_numpy():
+    w, g = _setup()
+    b1, b2, eps, lr = 0.6, 0.999, 1e-8, 0.0025
+    o = opt.create("ftml", learning_rate=lr, wd=0.0)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    d = np.zeros_like(w)
+    v = np.zeros_like(w)
+    z = np.zeros_like(w)
+    cur = w.copy()
+    for t in range(1, 4):
+        o.update(0, mw, mg, state)
+        v = b2 * v + (1 - b2) * g * g
+        d_t = (1 - b1 ** t) / lr * (np.sqrt(v / (1 - b2 ** t)) + eps)
+        sigma = d_t - b1 * d
+        z = b1 * z + (1 - b1) * g - sigma * cur
+        d = d_t
+        cur = -z / d_t
+    np.testing.assert_allclose(mw.asnumpy(), cur, rtol=1e-5)
+
+
+def test_adamw_matches_numpy():
+    w, g = _setup()
+    lr, wd = 0.01, 0.1
+    o = opt.create("adamw", learning_rate=lr, wd=wd)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    cur = w.copy()
+    for t in range(1, 4):
+        o.update(0, mw, mg, state)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        m_hat = m / (1 - 0.9 ** t)
+        v_hat = v / (1 - 0.999 ** t)
+        # decoupled decay: wd applies to the weight, NOT through m/v
+        cur = cur - lr * (m_hat / (np.sqrt(v_hat) + 1e-8) + wd * cur)
+    np.testing.assert_allclose(mw.asnumpy(), cur, rtol=1e-5)
+
+
+def test_adamw_decay_is_decoupled():
+    """With zero gradient, AdamW still shrinks weights (decay decoupled
+    from the gradient moments) while Adam with wd folded in would not
+    behave identically."""
+    w = np.full((3,), 2.0, np.float32)
+    o = opt.create("adamw", learning_rate=0.1, wd=0.5)
+    mw = mx.nd.array(w)
+    mg = mx.nd.array(np.zeros_like(w))
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    np.testing.assert_allclose(mw.asnumpy(), w - 0.1 * 0.5 * w,
+                               rtol=1e-6)
+
+
+def test_lars_trust_ratio():
+    w, g = _setup()
+    lr, eta, mom = 0.1, 0.001, 0.9
+    o = opt.create("lars", learning_rate=lr, eta=eta, momentum=mom,
+                   wd=0.0)
+    mw, mg = mx.nd.array(w), mx.nd.array(g)
+    state = o.create_state(0, mw)
+    o.update(0, mw, mg, state)
+    ratio = eta * np.linalg.norm(w) / (np.linalg.norm(g) + 1e-8)
+    ref = w - lr * ratio * g
+    np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-5)
+    # a second step applies momentum
+    o.update(0, mw, mg, state)
+    assert not np.allclose(mw.asnumpy(), ref - lr * ratio * g)
+
+
 def test_multi_precision_sgd():
     w = np.random.randn(3, 3).astype(np.float16)
     g = np.random.randn(3, 3).astype(np.float16)
