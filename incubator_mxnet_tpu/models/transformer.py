@@ -279,12 +279,14 @@ class TransformerModel(HybridBlock):
         out = fn(mem._data)
         return NDArray(out)
 
-    def _greedy_decode_cached(self, src_ids, max_length, bos, eos,
-                              src_valid):
-        """KV-cache greedy decode: one lax.scan whose carry holds each
-        decoder layer's (B, max_length, C) self-attention K/V cache;
-        cross-attention K/V are projected once from the encoder memory."""
-        import jax
+    def _cached_decode_setup(self, src_ids, max_length, src_valid,
+                             beams=1):
+        """Shared setup for the KV-cached decode paths: max_length guard,
+        source mask, encoder memory, per-layer cross K/V (replicated per
+        beam — a K-fold copy XLA keeps live for the scan; acceptable for
+        inference, candidate for a broadcast-aware attention later), and
+        the position-embedding helper (cast to the activation dtype so
+        bf16 models stay bf16, matching the full-prefix oracle)."""
         import jax.numpy as jnp
         from .. import autograd as ag
 
@@ -295,19 +297,40 @@ class TransformerModel(HybridBlock):
                 "with a larger max_length")
         mask = self._valid_to_mask(src_ids, src_valid)
         mem = self.encode(src_ids, _mask=mask)
-        B = src_ids.shape[0]
-        C = self._units
         cells = list(self.decoder._children.values())
         with ag.pause():
-            mem_kv = [cell.cross_attention.project_kv(mem)
-                      for cell in cells]
+            mem_kv = []
+            for cell in cells:
+                k, v = cell.cross_attention.project_kv(mem)
+                if beams > 1:
+                    k = NDArray(jnp.repeat(k._data, beams, axis=0))
+                    v = NDArray(jnp.repeat(v._data, beams, axis=0))
+                mem_kv.append((k, v))
+        if mask is not None and beams > 1:
+            mask = NDArray(jnp.repeat(mask._data, beams, axis=0))
         pos = self._pos_table
-        sqrt_d = math.sqrt(C)
+        sqrt_d = math.sqrt(self._units)
 
         def embed_pos(e, tv):
             def fn(ev, t_):
-                return ev * sqrt_d + jnp.asarray(pos)[t_][None, None, :]
+                p_ = jnp.asarray(pos)[t_][None, None, :].astype(ev.dtype)
+                return ev * jnp.asarray(sqrt_d, ev.dtype) + p_
             return _invoke(fn, [e, tv], name="decode_embed_pos")
+        return mask, mem, cells, mem_kv, embed_pos
+
+    def _greedy_decode_cached(self, src_ids, max_length, bos, eos,
+                              src_valid):
+        """KV-cache greedy decode: one lax.scan whose carry holds each
+        decoder layer's (B, max_length, C) self-attention K/V cache;
+        cross-attention K/V are projected once from the encoder memory."""
+        import jax
+        import jax.numpy as jnp
+        from .. import autograd as ag
+
+        mask, mem, cells, mem_kv, embed_pos = self._cached_decode_setup(
+            src_ids, max_length, src_valid)
+        B = src_ids.shape[0]
+        C = self._units
 
         def step(carry, t):
             toks, cks, cvs = carry
@@ -348,14 +371,26 @@ class TransformerModel(HybridBlock):
             return self._project(dec)._data
 
     def beam_search(self, src_ids, beam_size=4, max_length=32, bos=2,
-                    eos=3, alpha=0.6, src_valid=None):
+                    eos=3, alpha=0.6, src_valid=None, use_cache=True):
         """Beam-search translation as one lax.scan program (reference
         analog: GluonNLP BeamSearchTranslator over this model).
 
         Returns (tokens (B, K, max_length) int32, scores (B, K) float32)
         sorted best-first, with GNMT length normalization
         ``score / ((5+len)/6)**alpha``.  Finished beams (emitted ``eos``)
-        are frozen: they only extend with ``eos`` at no score cost."""
+        are frozen: they only extend with ``eos`` at no score cost.
+
+        ``use_cache=True`` (default) decodes incrementally with per-layer
+        KV caches over the flattened (B*K) beam batch — O(T) per step;
+        beam reorders gather the caches.  ``use_cache=False`` re-runs the
+        full prefix per step (the tested oracle).  In float32 the two
+        paths are token-exact; in bfloat16 the differently-ordered
+        reductions can swap near-tied lower-ranked beams (scores agree
+        to bf16 precision; the best beam is stable in practice)."""
+        if use_cache:
+            return self._beam_search_cached(src_ids, beam_size,
+                                            max_length, bos, eos, alpha,
+                                            src_valid)
         mask = self._valid_to_mask(src_ids, src_valid)
         mem = self.encode(src_ids, _mask=mask)
         B = src_ids.shape[0]
@@ -414,6 +449,79 @@ class TransformerModel(HybridBlock):
             return toks[bsel, order], final[bsel, order]
         toks, scores = fn(mem._data)
         return NDArray(toks), NDArray(scores)
+
+    def _beam_search_cached(self, src_ids, beam_size, max_length, bos,
+                            eos, alpha, src_valid):
+        """KV-cache beam search: caches live on the flattened (B*K) beam
+        batch; each top-k reorder gathers the caches along the beam
+        axis so every beam's cache matches its token prefix."""
+        import jax
+        import jax.numpy as jnp
+        from .. import autograd as ag
+
+        K = beam_size
+        maskk, mem, cells, mem_kv, embed_pos = self._cached_decode_setup(
+            src_ids, max_length, src_valid, beams=K)
+        B = src_ids.shape[0]
+        V = self._vocab_size
+        C = self._units
+        neg_inf = jnp.float32(-1e30)
+
+        def step(carry, t):
+            toks, scores, lengths, cks, cvs = carry
+            with ag.pause():
+                x = self.embed(
+                    NDArray(toks[:, :, t].reshape(B * K, 1)))
+                x = embed_pos(x, NDArray(t))
+                new_cks, new_cvs = [], []
+                for l, cell in enumerate(cells):
+                    x, ck, cv = cell.step(
+                        x, NDArray(cks[l]), NDArray(cvs[l]), NDArray(t),
+                        mem_kv[l][0], mem_kv[l][1], maskk)
+                    new_cks.append(ck._data)
+                    new_cvs.append(cv._data)
+                logits = self._project(x)._data[:, 0]       # (B*K, V)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+            done = toks[:, :, t] == eos
+            only_eos = jnp.full((V,), neg_inf).at[eos].set(0.0)
+            logp = jnp.where(done[..., None], only_eos[None, None], logp)
+            total = scores[..., None] + logp
+            top_scores, top_idx = jax.lax.top_k(total.reshape(B, K * V),
+                                                K)
+            beam_idx = top_idx // V
+            tok_idx = (top_idx % V).astype(jnp.int32)
+            bsel = jnp.arange(B)[:, None]
+            toks = toks[bsel, beam_idx]
+            lengths = lengths[bsel, beam_idx]
+            was_done = done[bsel, beam_idx]
+            toks = toks.at[:, :, t + 1].set(tok_idx)
+            lengths = jnp.where(
+                was_done, lengths,
+                lengths + (tok_idx != eos).astype(lengths.dtype))
+            # caches follow their beams through the reorder
+            new_cks = tuple(
+                c.reshape(B, K, *c.shape[1:])[bsel, beam_idx]
+                .reshape(B * K, *c.shape[1:]) for c in new_cks)
+            new_cvs = tuple(
+                c.reshape(B, K, *c.shape[1:])[bsel, beam_idx]
+                .reshape(B * K, *c.shape[1:]) for c in new_cvs)
+            return (toks, top_scores, lengths, new_cks, new_cvs), None
+
+        toks0 = jnp.full((B, K, max_length), eos, jnp.int32)
+        toks0 = toks0.at[:, :, 0].set(bos)
+        scores0 = jnp.full((B, K), neg_inf).at[:, 0].set(0.0)
+        len0 = jnp.zeros((B, K), jnp.float32)
+        zeros = tuple(jnp.zeros((B * K, max_length, C), mem._data.dtype)
+                      for _ in cells)
+        (toks, scores, lengths, _, _), _ = jax.lax.scan(
+            step, (toks0, scores0, len0, zeros, zeros),
+            jnp.arange(max_length - 1))
+        norm = ((5.0 + lengths) / 6.0) ** alpha
+        final = scores / norm
+        order = jnp.argsort(-final, axis=-1)
+        bsel = jnp.arange(B)[:, None]
+        return NDArray(toks[bsel, order]), NDArray(final[bsel, order])
 
 
 class LabelSmoothingCELoss(HybridBlock):

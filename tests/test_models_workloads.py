@@ -139,6 +139,34 @@ class TestTransformer:
                                use_cache=True).asnumpy()
         np.testing.assert_array_equal(am, bm)
 
+    def test_beam_search_cached_matches_oracle(self):
+        """Cached beam search (caches gathered through beam reorders)
+        must reproduce the full-prefix oracle: same tokens and scores."""
+        net = _tiny_transformer()
+        src = mx.nd.array(np.random.randint(1, 50, (2, 6)), dtype="int32")
+        sv = mx.nd.array(np.array([6, 4]), dtype="int32")
+        for valid in (None, sv):
+            t_o, s_o = net.beam_search(src, beam_size=3, max_length=8,
+                                       bos=2, eos=3, src_valid=valid,
+                                       use_cache=False)
+            t_c, s_c = net.beam_search(src, beam_size=3, max_length=8,
+                                       bos=2, eos=3, src_valid=valid,
+                                       use_cache=True)
+            np.testing.assert_array_equal(t_o.asnumpy(), t_c.asnumpy())
+            np.testing.assert_allclose(s_o.asnumpy(), s_c.asnumpy(),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_cached_decode_bf16_parity(self):
+        """After net.cast('bfloat16') the cached paths must stay bf16
+        (position table cast to the activation dtype) and agree with the
+        full-prefix oracle (regression: f32 pos add promoted bf16)."""
+        net = _tiny_transformer()
+        net.cast("bfloat16")
+        src = mx.nd.array(np.random.randint(1, 50, (2, 5)), dtype="int32")
+        a = net.greedy_decode(src, max_length=7, use_cache=False).asnumpy()
+        b = net.greedy_decode(src, max_length=7, use_cache=True).asnumpy()
+        np.testing.assert_array_equal(a, b)
+
     def test_beam_search(self):
         net = _tiny_transformer()
         src = mx.nd.array(np.random.randint(1, 50, (2, 6)), dtype="int32")
