@@ -6,7 +6,8 @@ import time
 from collections import namedtuple
 
 __all__ = ["Speedometer", "do_checkpoint", "log_uniform_checkpoint",
-           "ProgressBar", "LogValidationMetricsCallback", "BatchEndParam"]
+           "module_checkpoint", "log_train_metric", "ProgressBar",
+           "LogValidationMetricsCallback", "BatchEndParam"]
 
 BatchEndParam = namedtuple("BatchEndParam",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -89,3 +90,30 @@ def do_checkpoint(prefix, period=1):
 
 def log_uniform_checkpoint(prefix, period=1):
     return do_checkpoint(prefix, period)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving a Module checkpoint (reference:
+    callback.module_checkpoint → Module.save_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1,
+                                save_optimizer_states)
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the train metric every ``period``
+    batches (reference: callback.log_train_metric)."""
+    import logging
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback

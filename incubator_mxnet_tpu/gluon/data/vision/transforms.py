@@ -12,7 +12,8 @@ from ...nn.basic_layers import Sequential, HybridSequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "CropResize"]
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomGray", "RandomLighting", "CropResize"]
 
 
 class Compose(Sequential):
@@ -221,6 +222,76 @@ class RandomSaturation(Block):
         xf = x.astype(_np.float32)
         gray = xf.mean(axis=-1, keepdims=True)
         return (gray + (xf - gray) * f).clip(0, 255).astype(x.dtype)
+
+
+class RandomHue(Block):
+    """Random hue rotation by up to ±hue (reference: RandomHue; the
+    reference's YIQ-rotation formulation)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        from .... import random as mxrand
+        from ....ndarray import ndarray as _ndmod
+        f = mxrand.numpy_rng().uniform(-self._h, self._h)
+        if f == 0.0:
+            return x
+        theta = _np.pi * f
+        # YIQ rotation (same matrix family the reference image_aug uses);
+        # the RGB<-YIQ side uses the exact inverse so f->0 is identity
+        u, w = _np.cos(theta), _np.sin(theta)
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], _np.float32)
+        t_rgb = _np.linalg.inv(t_yiq).astype(_np.float32)
+        rot = _np.array([[1, 0, 0], [0, u, -w], [0, w, u]], _np.float32)
+        m = t_rgb @ rot @ t_yiq
+        out = x.asnumpy().astype(_np.float32) @ m.T
+        return _ndmod.array(out.clip(0, 255)).astype(x.dtype)
+
+
+class RandomGray(Block):
+    """Convert to 3-channel grayscale with probability p (reference:
+    contrib-era RandomGray / torchvision parity)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from .... import random as mxrand
+        if mxrand.numpy_rng().uniform() >= self._p:
+            return x
+        xf = x.astype(_np.float32)
+        gray = (xf * _np.array([0.299, 0.587, 0.114],
+                               _np.float32)).sum(axis=-1, keepdims=True)
+        return gray.broadcast_to(x.shape).astype(x.dtype)
+
+
+class RandomColorJitter(Block):
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (reference: RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness > 0:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast > 0:
+            self._ts.append(RandomContrast(contrast))
+        if saturation > 0:
+            self._ts.append(RandomSaturation(saturation))
+        if hue > 0:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        from .... import random as mxrand
+        order = mxrand.numpy_rng().permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
 
 
 class RandomLighting(Block):

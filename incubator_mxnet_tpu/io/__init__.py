@@ -6,8 +6,10 @@ from . import recordio
 from .recordio import (MXRecordIO, MXIndexedRecordIO, IndexedRecordIO,
                        IRHeader, pack, unpack, pack_img, unpack_img)
 from .image_iter import ImageRecordIter
+from .text_iters import CSVIter, LibSVMIter, MNISTIter
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter", "recordio", "MXRecordIO",
            "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader", "pack",
-           "unpack", "pack_img", "unpack_img", "ImageRecordIter"]
+           "unpack", "pack_img", "unpack_img", "ImageRecordIter",
+           "CSVIter", "LibSVMIter", "MNISTIter"]

@@ -1,0 +1,187 @@
+"""File-backed legacy iterators: CSVIter, LibSVMIter, MNISTIter
+(reference: src/io/iter_csv.cc, iter_libsvm.cc, iter_mnist.cc — the
+C++-backed DataIters exposed as mx.io.*).
+
+TPU-native re-design: parsing happens once into numpy at construction
+(these formats are small-data-era; the packed RecordIO path is the
+scale path), batching reuses NDArrayIter's padded round-robin
+semantics.  LibSVMIter emits CSRNDArray batches like the reference.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from .io import DataBatch, DataDesc, DataIter, NDArrayIter
+
+__all__ = ["CSVIter", "LibSVMIter", "MNISTIter"]
+
+
+class CSVIter(NDArrayIter):
+    """Batches from a CSV of floats (reference: io.CSVIter).
+
+    data_csv/label_csv: paths; data_shape/label_shape: per-sample
+    shapes (rows are reshaped)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32,
+                           ndmin=2)
+        n = data.shape[0]
+        data = data.reshape((n,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",",
+                                dtype=_np.float32, ndmin=2)
+            if label.shape[0] != n:
+                raise MXNetError(
+                    f"CSVIter: label file has {label.shape[0]} rows but "
+                    f"data file has {n}")
+            label = label.reshape((n,) + tuple(label_shape))
+        # reference semantics: round_batch pads/rolls the last partial
+        # batch; round_batch=0 discards it
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="roll_over" if round_batch
+                         else "discard")
+
+
+class LibSVMIter(DataIter):
+    """Batches of CSRNDArray from a libsvm-format file (reference:
+    io.LibSVMIter): lines ``label idx:val idx:val ...`` with ZERO-based
+    feature indices (the reference's convention); ``data_shape`` fixes
+    the feature dimension.  ``label_libsvm`` optionally reads labels
+    from a separate libsvm file (first column per line).  The trailing
+    partial batch is padded with wrap-around samples and reported via
+    ``getpad()`` like NDArrayIter."""
+
+    @staticmethod
+    def _parse(path):
+        labels, indptr, indices, values = [], [0], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    indices.append(int(idx))
+                    values.append(float(val))
+                indptr.append(len(indices))
+        return (_np.asarray(labels, _np.float32),
+                _np.asarray(indptr, _np.int64),
+                _np.asarray(indices, _np.int64),
+                _np.asarray(values, _np.float32))
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, **kwargs):
+        self._dim = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        self._label, self._indptr, self._indices, self._values = \
+            self._parse(data_libsvm)
+        if label_libsvm is not None:
+            lab, _, _, _ = self._parse(label_libsvm)
+            if len(lab) != len(self._label):
+                raise MXNetError(
+                    f"LibSVMIter: label file has {len(lab)} rows but "
+                    f"data file has {len(self._label)}")
+            self._label = lab
+        if len(self._indices) and self._indices.max() >= self._dim:
+            raise MXNetError(
+                f"LibSVMIter: feature index {self._indices.max()} "
+                f">= data_shape {self._dim} (indices are zero-based)")
+        super().__init__(batch_size)
+        self._n = len(self._label)
+        if self._n < batch_size:
+            raise MXNetError("LibSVMIter: fewer samples than batch_size")
+        self._cursor = 0
+        self._pad = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._dim))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+        self._pad = 0
+
+    def iter_next(self):
+        return self._cursor < self._n
+
+    def getpad(self):
+        return self._pad
+
+    def _rows(self, idx):
+        """CSR pieces for sample rows ``idx`` (list of ints)."""
+        vals, inds, ptr = [], [], [0]
+        for i in idx:
+            lo, hi = self._indptr[i], self._indptr[i + 1]
+            vals.append(self._values[lo:hi])
+            inds.append(self._indices[lo:hi])
+            ptr.append(ptr[-1] + (hi - lo))
+        return (_np.concatenate(vals) if vals else
+                _np.zeros(0, _np.float32),
+                _np.concatenate(inds) if inds else
+                _np.zeros(0, _np.int64),
+                _np.asarray(ptr, _np.int64))
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray import sparse as _sp
+        from ..ndarray.ndarray import array as _array
+        s = self._cursor
+        e = min(s + self.batch_size, self._n)
+        self._pad = self.batch_size - (e - s)
+        # pad wraps around to the start (reference pad semantics)
+        rows = list(range(s, e)) + list(range(self._pad))
+        self._cursor = s + self.batch_size
+        vals, inds, ptr = self._rows(rows)
+        csr = _sp.csr_matrix((vals, inds, ptr),
+                             shape=(self.batch_size, self._dim))
+        label = _array(self._label[rows])
+        return DataBatch(data=[csr], label=[label], pad=self._pad)
+
+
+def _read_idx(path):
+    """Read an MNIST idx file (optionally .gz)."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return _np.frombuffer(f.read(), _np.uint8).reshape(shape)
+
+
+class MNISTIter(NDArrayIter):
+    """Batches from raw MNIST idx files (reference: io.MNISTIter).
+
+    image/label: paths to ``train-images-idx3-ubyte``-style files
+    (``.gz`` accepted); ``flat=True`` yields (B, 784) else
+    (B, 1, 28, 28); pixel values scaled to [0, 1] like the reference."""
+
+    def __init__(self, image, label, batch_size=1, shuffle=False,
+                 flat=False, seed=0, **kwargs):
+        for p in (image, label):
+            if not os.path.exists(p):
+                raise MXNetError(f"MNISTIter: file not found: {p}")
+        imgs = _read_idx(image).astype(_np.float32) / 255.0
+        labs = _read_idx(label).astype(_np.float32)
+        if imgs.shape[0] != labs.shape[0]:
+            raise MXNetError("MNISTIter: image/label count mismatch")
+        imgs = imgs.reshape(imgs.shape[0], -1) if flat \
+            else imgs.reshape(imgs.shape[0], 1, *imgs.shape[1:])
+        if shuffle:
+            order = _np.random.RandomState(seed).permutation(
+                imgs.shape[0])
+            imgs, labs = imgs[order], labs[order]
+        super().__init__(imgs, labs, batch_size=batch_size)

@@ -1,0 +1,206 @@
+"""CSVIter / LibSVMIter / MNISTIter + new transforms/callback tests
+(reference models: tests/python/unittest/test_io.py, test_gluon_data.py
+transforms section)."""
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io
+from incubator_mxnet_tpu.gluon.data.vision import transforms
+
+
+class TestCSVIter:
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.uniform(size=(10, 6)).astype(np.float32)
+        label = rng.randint(0, 3, (10, 1)).astype(np.float32)
+        dcsv = tmp_path / "d.csv"
+        lcsv = tmp_path / "l.csv"
+        np.savetxt(dcsv, data, delimiter=",")
+        np.savetxt(lcsv, label, delimiter=",")
+        it = io.CSVIter(str(dcsv), (2, 3), str(lcsv), (1,), batch_size=5)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].data[0].shape == (5, 2, 3)
+        got = np.concatenate([b.data[0].asnumpy() for b in batches])
+        np.testing.assert_allclose(got.reshape(10, 6), data, rtol=1e-6)
+        got_l = np.concatenate([b.label[0].asnumpy() for b in batches])
+        np.testing.assert_allclose(got_l, label, rtol=1e-6)
+
+
+class TestLibSVMIter:
+    def test_batches_are_csr(self, tmp_path):
+        path = tmp_path / "data.svm"
+        path.write_text(
+            "1 0:1.5 3:2.0\n"
+            "0 1:0.5\n"
+            "1 2:3.0 4:1.0\n"
+            "0 0:2.5\n")
+        it = io.LibSVMIter(str(path), data_shape=(5,), batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        from incubator_mxnet_tpu.ndarray import sparse as sp
+        b0 = batches[0]
+        assert isinstance(b0.data[0], sp.CSRNDArray)
+        dense = b0.data[0].todense().asnumpy()
+        np.testing.assert_allclose(
+            dense, [[1.5, 0, 0, 2.0, 0], [0, 0.5, 0, 0, 0]])
+        np.testing.assert_allclose(b0.label[0].asnumpy(), [1.0, 0.0])
+        it.reset()
+        again = list(it)
+        np.testing.assert_allclose(
+            again[0].data[0].todense().asnumpy(), dense)
+
+    def test_out_of_range_index_raises(self, tmp_path):
+        path = tmp_path / "bad.svm"
+        path.write_text("1 7:1.0\n0 0:1.0\n")
+        with pytest.raises(mx.MXNetError, match="data_shape"):
+            io.LibSVMIter(str(path), data_shape=(5,), batch_size=1)
+
+    def test_partial_last_batch_pads(self, tmp_path):
+        """Trailing samples are served with wrap-around padding and a
+        pad count (regression: they were silently dropped)."""
+        path = tmp_path / "data.svm"
+        path.write_text("\n".join(f"{i} 0:{i}.0" for i in range(5)) + "\n")
+        it = io.LibSVMIter(str(path), data_shape=(2,), batch_size=2)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].pad == 0 and batches[2].pad == 1
+        last = batches[2]
+        np.testing.assert_allclose(last.label[0].asnumpy(), [4.0, 0.0])
+        np.testing.assert_allclose(
+            last.data[0].todense().asnumpy()[:, 0], [4.0, 0.0])
+
+    def test_separate_label_file(self, tmp_path):
+        d = tmp_path / "d.svm"
+        l = tmp_path / "l.svm"
+        d.write_text("0 0:1.0\n0 1:2.0\n")
+        l.write_text("7\n9\n")
+        it = io.LibSVMIter(str(d), data_shape=(2,),
+                           label_libsvm=str(l), batch_size=2)
+        b = next(iter(it))
+        np.testing.assert_allclose(b.label[0].asnumpy(), [7.0, 9.0])
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.tobytes())
+
+
+class TestMNISTIter:
+    def test_reads_idx_files(self, tmp_path):
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 256, (8, 28, 28), dtype=np.uint8)
+        labs = rng.randint(0, 10, (8,), dtype=np.uint8)
+        ip, lp = str(tmp_path / "imgs"), str(tmp_path / "labs")
+        _write_idx_images(ip, imgs)
+        _write_idx_labels(lp, labs)
+        it = io.MNISTIter(image=ip, label=lp, batch_size=4)
+        b = next(iter(it))
+        assert b.data[0].shape == (4, 1, 28, 28)
+        np.testing.assert_allclose(b.data[0].asnumpy(),
+                                   imgs[:4, None] / 255.0, rtol=1e-6)
+        np.testing.assert_allclose(b.label[0].asnumpy(), labs[:4])
+        # flat form
+        it2 = io.MNISTIter(image=ip, label=lp, batch_size=4, flat=True)
+        assert next(iter(it2)).data[0].shape == (4, 784)
+
+    def test_gzip_accepted(self, tmp_path):
+        imgs = np.zeros((2, 28, 28), np.uint8)
+        raw = struct.pack(">I", 0x00000803) \
+            + struct.pack(">III", *imgs.shape) + imgs.tobytes()
+        ip = tmp_path / "imgs.gz"
+        with gzip.open(ip, "wb") as f:
+            f.write(raw)
+        labs = np.zeros((2,), np.uint8)
+        lp = str(tmp_path / "labs")
+        _write_idx_labels(lp, labs)
+        it = io.MNISTIter(image=str(ip), label=lp, batch_size=2)
+        assert next(iter(it)).data[0].shape == (2, 1, 28, 28)
+
+    def test_missing_file_raises(self):
+        with pytest.raises(mx.MXNetError, match="not found"):
+            io.MNISTIter(image="/nope", label="/nope2", batch_size=1)
+
+
+class TestNewTransforms:
+    def _img(self):
+        rng = np.random.RandomState(0)
+        return mx.nd.array(rng.randint(0, 256, (8, 8, 3)).astype(
+            np.float32))
+
+    def test_random_hue_preserves_shape_and_range(self):
+        mx.random.seed(0)
+        t = transforms.RandomHue(0.3)
+        out = t(self._img())
+        assert out.shape == (8, 8, 3)
+        a = out.asnumpy()
+        assert (a >= 0).all() and (a <= 255).all()
+
+    def test_random_hue_zero_is_identity(self):
+        t = transforms.RandomHue(0.0)
+        x = self._img()
+        np.testing.assert_array_equal(t(x).asnumpy(), x.asnumpy())
+
+    def test_random_gray(self):
+        mx.random.seed(0)
+        x = self._img()
+        g = transforms.RandomGray(1.0)(x).asnumpy()
+        assert g.shape == (8, 8, 3)
+        np.testing.assert_allclose(g[..., 0], g[..., 1])
+        np.testing.assert_allclose(g[..., 1], g[..., 2])
+        # p=0: no-op
+        same = transforms.RandomGray(0.0)(x).asnumpy()
+        np.testing.assert_array_equal(same, x.asnumpy())
+
+    def test_random_color_jitter_composes(self):
+        mx.random.seed(0)
+        t = transforms.RandomColorJitter(brightness=0.2, contrast=0.2,
+                                         saturation=0.2, hue=0.1)
+        out = t(self._img())
+        assert out.shape == (8, 8, 3)
+        assert np.isfinite(out.asnumpy()).all()
+
+
+class TestNewCallbacks:
+    def test_log_train_metric(self, caplog):
+        import logging
+        from incubator_mxnet_tpu import callback, metric
+        m = metric.Accuracy()
+        m.update([mx.nd.array([1, 1])],
+                 [mx.nd.array([[0.1, 0.9], [0.2, 0.8]])])
+        cb = callback.log_train_metric(1, auto_reset=True)
+        param = callback.BatchEndParam(epoch=0, nbatch=1, eval_metric=m,
+                                      locals=None)
+        with caplog.at_level(logging.INFO):
+            cb(param)
+        assert any("Train-accuracy" in r.message for r in caplog.records)
+        assert m.num_inst == 0     # auto_reset applied
+
+    def test_module_checkpoint(self, tmp_path):
+        from incubator_mxnet_tpu import callback
+        from incubator_mxnet_tpu import io as mxio
+        data = mx.sym.var("data")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(data, num_hidden=2), name="softmax")
+        mod = mx.mod.Module(net)
+        mod.bind([("data", (4, 3))], [("softmax_label", (4,))])
+        mod.init_params(initializer=mx.init.Uniform(0.1))
+        prefix = str(tmp_path / "modcp")
+        cb = callback.module_checkpoint(mod, prefix, period=1)
+        cb(0)
+        import os
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0001.params")
