@@ -20,6 +20,7 @@ from ..ndarray.ndarray import NDArray
 __all__ = [
     "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
     "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "scale_down", "copyMakeBorder",
     "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
     "ForceResizeAug", "CastAug", "RandomCropAug", "RandomSizedCropAug",
     "CenterCropAug", "BrightnessJitterAug", "ContrastJitterAug",
@@ -91,6 +92,50 @@ def resize_short(src, size, interp=2) -> NDArray:
     else:
         new_h, new_w = size, int(w * size / h)
     return imresize(src, new_w, new_h, interp)
+
+
+def scale_down(src_size, size):
+    """Shrink the crop size (w, h) proportionally to fit inside
+    src_size if it overflows (reference: image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0.0):
+    """Pad an image with a constant border (reference:
+    mx.image.copyMakeBorder, cv2-compatible; only BORDER_CONSTANT
+    ``type=0`` is meaningful on this backend).  ``values`` is a scalar
+    or a per-channel fill color."""
+    if type != 0:
+        raise MXNetError(
+            "copyMakeBorder: only type=0 (constant border) is supported")
+    s = _to_nd(src)
+
+    def fn(x):
+        import jax.numpy as jnp
+        pad = [(top, bot), (left, right)] + [(0, 0)] * (x.ndim - 2)
+        vals = _np.asarray(values, _np.float32).reshape(-1)
+        if vals.size == 1:
+            return jnp.pad(x, pad, constant_values=float(vals[0]))
+        if x.ndim < 3 or vals.size != x.shape[2]:
+            raise MXNetError(
+                f"copyMakeBorder: values has {vals.size} entries but "
+                f"image has {x.shape[2] if x.ndim >= 3 else 1} channels")
+        out = jnp.pad(x, pad)
+        h, w = x.shape[0], x.shape[1]
+        iy = jnp.arange(out.shape[0])
+        ix = jnp.arange(out.shape[1])
+        border = ~((iy[:, None] >= top) & (iy[:, None] < top + h)
+                   & (ix[None, :] >= left) & (ix[None, :] < left + w))
+        fill = jnp.asarray(vals, x.dtype)[None, None, :]
+        return jnp.where(border[..., None], fill, out)
+    from ..ndarray.ndarray import _invoke
+    return _invoke(fn, [s], name="copyMakeBorder")
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:

@@ -9,6 +9,8 @@ shardings + one compiled step:
   shard_params                regex→PartitionSpec tensor parallelism
   ring_attention              sequence parallelism over the mesh (beyond
                               reference parity)
+  ulysses_attention           all-to-all sequence parallelism (DeepSpeed-
+                              Ulysses schedule; beyond reference parity)
   distributed.initialize      multi-host bootstrap (DMLC_* env compat)
 """
 from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
@@ -16,6 +18,7 @@ from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
                    device_put_sharded)
 from .spmd import SPMDTrainer, shard_params, data_sharding
 from .ring import ring_attention, local_flash_attention
+from .ulysses import ulysses_attention
 from . import optim
 from . import distributed
 
@@ -23,4 +26,4 @@ __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "replicated", "shard_spec", "named_sharding",
            "device_put_sharded", "SPMDTrainer", "shard_params",
            "data_sharding", "ring_attention", "local_flash_attention",
-           "optim", "distributed"]
+           "ulysses_attention", "optim", "distributed"]

@@ -19,12 +19,13 @@ __all__ = ["ring_attention", "local_flash_attention", "ring_attention_nd"]
 
 
 def local_flash_attention(q, k, v, scale=None, causal=False,
-                          q_offset=0, k_offset=0):
+                          q_offset=0, k_offset=0, key_mask=None):
     """Single-device exact attention with numerically-stable softmax.
 
     q: (..., Tq, D), k/v: (..., Tk, D).  q_offset/k_offset are the global
     positions of the first query/key element — used by the ring schedule's
-    causal masking.
+    causal masking.  ``key_mask``: optional (B, Tk) validity indicator
+    (>0 = valid) broadcast over heads/queries.
     """
     import jax.numpy as jnp
     if scale is None:
@@ -35,6 +36,8 @@ def local_flash_attention(q, k, v, scale=None, causal=False,
         qpos = q_offset + jnp.arange(tq)[:, None]
         kpos = k_offset + jnp.arange(tk)[None, :]
         s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, -jnp.inf)
     m = jnp.max(s, axis=-1, keepdims=True)
     m = jnp.where(jnp.isneginf(m), 0.0, m)  # fully-masked rows
     p = jnp.exp(s - m)

@@ -204,3 +204,44 @@ class TestNewCallbacks:
         import os
         assert os.path.exists(prefix + "-symbol.json")
         assert os.path.exists(prefix + "-0001.params")
+
+
+class TestImageBorderScale:
+    def test_scale_down(self):
+        from incubator_mxnet_tpu import image
+        assert image.scale_down((100, 50), (60, 60)) == (50, 50)
+        assert image.scale_down((40, 100), (60, 30)) == (40, 20)
+        assert image.scale_down((100, 100), (60, 30)) == (60, 30)
+
+    def test_copy_make_border_scalar(self):
+        from incubator_mxnet_tpu import image
+        x = mx.nd.ones((2, 3, 3))
+        out = image.copyMakeBorder(x, 1, 1, 2, 2, values=7.0)
+        assert out.shape == (4, 7, 3)
+        a = out.asnumpy()
+        assert (a[0] == 7.0).all() and (a[-1] == 7.0).all()
+        assert (a[1:3, 2:5] == 1.0).all()
+
+    def test_copy_make_border_per_channel(self):
+        """cv2-style per-channel fill color (regression: sequence values
+        were misread as per-axis pad pairs)."""
+        from incubator_mxnet_tpu import image
+        x = mx.nd.zeros((2, 2, 3))
+        out = image.copyMakeBorder(x, 1, 0, 0, 1, values=(10, 20, 30))
+        a = out.asnumpy()
+        assert a.shape == (3, 3, 3)
+        np.testing.assert_allclose(a[0, 0], [10, 20, 30])
+        np.testing.assert_allclose(a[1, -1], [10, 20, 30])
+        np.testing.assert_allclose(a[1:, :2], 0.0)
+
+    def test_copy_make_border_bad_values_raises(self):
+        from incubator_mxnet_tpu import image
+        x = mx.nd.zeros((2, 2, 3))
+        with pytest.raises(mx.MXNetError, match="channels"):
+            image.copyMakeBorder(x, 1, 1, 1, 1, values=(1, 2))
+
+    def test_reference_kwarg_name(self):
+        from incubator_mxnet_tpu import image
+        x = mx.nd.zeros((2, 2, 3))
+        with pytest.raises(mx.MXNetError, match="type=0"):
+            image.copyMakeBorder(x, 1, 1, 1, 1, type=1)
