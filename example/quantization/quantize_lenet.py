@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Post-training INT8 quantization (reference workload:
+example/quantization/imagenet_gen_qsym_mkldnn.py — the fork owner's
+specialty area, re-targeted at int8 MXU matmuls).
+
+Trains a small conv net on synthetic data, calibrates it (naive min-max
+or entropy) over a calibration iterator, quantizes, and compares fp32 vs
+int8 accuracy and agreement.
+
+    python example/quantization/quantize_lenet.py --cpu
+    python example/quantization/quantize_lenet.py --calib-mode entropy
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_data(rng, n, size=12):
+    """Class = which image quadrant holds the bright blob."""
+    x = rng.uniform(0, 0.2, (n, 1, size, size)).astype(np.float32)
+    y = rng.randint(0, 4, n)
+    half = size // 2
+    for i, cls in enumerate(y):
+        r, c = divmod(int(cls), 2)
+        x[i, 0, r * half:(r + 1) * half, c * half:(c + 1) * half] += 0.7
+    return x, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calib-mode", choices=["naive", "entropy"],
+                    default="naive")
+    ap.add_argument("--num-calib-batches", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.contrib import quantization as q
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    xtr, ytr = make_data(rng, 256)
+    xte, yte = make_data(rng, 128)
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, 1, 1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, 3, 1, 1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(4))
+    net.initialize(init=mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 2e-3})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(args.epochs):
+        with ag.record():
+            L = loss_fn(net(mx.nd.array(xtr)), mx.nd.array(ytr)).mean()
+        L.backward()
+        trainer.step(1)
+    def acc(model, x, y):
+        out = model(mx.nd.array(x)).asnumpy()
+        return (out.argmax(1) == y).mean()
+    fp32_acc = acc(net, xte, yte)
+    print(f"fp32 accuracy: {fp32_acc:.3f}")
+
+    calib = mx.io.NDArrayIter({"data": xtr[:args.num_calib_batches * 16]},
+                              batch_size=16)
+    qnet = q.quantize_net(net, calib_data=calib,
+                          calib_mode=args.calib_mode,
+                          num_calib_batches=args.num_calib_batches)
+    t0 = time.time()
+    int8_acc = acc(qnet, xte, yte)
+    print(f"int8 ({args.calib_mode}) accuracy: {int8_acc:.3f} "
+          f"(eval {time.time() - t0:.2f}s)")
+    agree = (net(mx.nd.array(xte)).asnumpy().argmax(1)
+             == qnet(mx.nd.array(xte)).asnumpy().argmax(1)).mean()
+    print(f"fp32/int8 prediction agreement: {agree:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -282,7 +282,13 @@ def _calibrate(net, calib_data, collector, num_calib_batches=None,
                 if num_calib_batches is not None \
                         and i >= num_calib_batches:
                     break
-                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                from ..io.io import DataBatch as _DataBatch
+                if isinstance(batch, _DataBatch):  # legacy io.DataBatch
+                    x = batch.data[0]
+                elif isinstance(batch, (tuple, list)):
+                    x = batch[0]
+                else:
+                    x = batch
                 if not isinstance(x, NDArray):
                     from ..ndarray import ndarray as _ndmod
                     x = _ndmod.array(_np.asarray(x))

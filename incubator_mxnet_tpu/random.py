@@ -99,3 +99,24 @@ def current_key(ctx=None):
         if k not in _streams:
             _streams[k] = jax.random.PRNGKey(_DEFAULT_SEED)
         return _streams[k]
+
+
+# reference parity (docs/env_var.md): MXNET_SEED seeds every context's
+# stream at import when set.  Only the module-global default changes —
+# streams stay lazily created, so no jax backend is initialized at
+# import time (users may still configure the platform afterwards).
+def _seed_from_env():
+    global _DEFAULT_SEED
+    from .base import getenv
+    v = getenv("MXNET_SEED")
+    if v is not None and str(v).strip():
+        try:
+            _DEFAULT_SEED = int(v)
+            _streams.clear()
+        except ValueError:
+            import logging
+            logging.getLogger(__name__).warning(
+                "MXNET_SEED=%r is not an integer; ignored", v)
+
+
+_seed_from_env()

@@ -203,3 +203,39 @@ def test_amp_convert_hybrid_block():
     assert all(onp.dtype(p.dtype) == onp.float32 for p in bn_p)
     out = net(mx.nd.ones((2, 4)))
     assert out.shape == (2, 2)
+
+
+def test_mxnet_seed_env_honored():
+    """MXNET_SEED at import seeds the key streams (docs/env_var.md
+    contract; regression: the var was documented but unread)."""
+    import os
+    import subprocess
+    import sys
+
+    def run(extra_env):
+        code = ("import jax; jax.config.update('jax_platforms','cpu');"
+                "import incubator_mxnet_tpu as mx;"
+                "print(mx.nd.random.uniform(shape=(3,))"
+                ".asnumpy().tolist())")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", **extra_env}
+        env.pop("MXNET_SEED", None) if not extra_env else None
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stderr
+        return r.stdout.strip().splitlines()[-1]
+
+    with_seed = run({"MXNET_SEED": "77"})
+    # env seed must match the same seed set in-process...
+    code2 = ("import jax; jax.config.update('jax_platforms','cpu');"
+             "import incubator_mxnet_tpu as mx; mx.random.seed(77);"
+             "print(mx.nd.random.uniform(shape=(3,))"
+             ".asnumpy().tolist())")
+    r2 = subprocess.run([sys.executable, "-c", code2],
+                        capture_output=True, text=True,
+                        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r2.returncode == 0, r2.stderr
+    in_process = r2.stdout.strip().splitlines()[-1]
+    assert with_seed == in_process
+    # ...and differ from the unseeded default
+    default = run({})
+    assert with_seed != default

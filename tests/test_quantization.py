@@ -130,3 +130,23 @@ def test_quantized_dense_nonrelu_activation():
     out = net(x).asnumpy()
     assert ((out > 0) & (out < 1)).all()      # sigmoid range
     np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.02)
+
+
+def test_calibrate_accepts_legacy_databatch_iter():
+    """quantize_net over an mx.io.NDArrayIter (DataBatch-yielding)
+    calibration source — the reference's calling convention
+    (regression: DataBatch was np.asarray'd to an object array)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.contrib import quantization as q
+    from incubator_mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=6), nn.Dense(3))
+    net.initialize(init=mx.init.Xavier())
+    x = np.random.RandomState(0).uniform(-1, 1, (32, 6)).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": x}, batch_size=8)
+    qnet = q.quantize_net(net, calib_data=it, calib_mode="naive")
+    out = qnet(mx.nd.array(x[:4]))
+    ref = net(mx.nd.array(x[:4]))
+    assert np.abs(out.asnumpy() - ref.asnumpy()).max() < 0.2
