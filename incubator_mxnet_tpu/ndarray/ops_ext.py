@@ -673,5 +673,64 @@ def ifft(data, compute_size=128):
     return _invoke(fn, [_nd(data)], name="ifft")
 
 
+# ---------------------------------------------------------------------------
+# remaining flat-name parity ops
+# ---------------------------------------------------------------------------
+def cast_storage(data, stype):
+    """Convert storage type (reference: cast_storage op).  Always
+    produces a fresh output (the reference op never aliases its
+    input)."""
+    arr = _nd(data)
+    if arr.stype == stype:
+        if stype == "default":
+            return _invoke(lambda x: x + 0, [arr], name="cast_storage")
+        return arr.tostype("default").tostype(stype)
+    return arr.tostype(stype)
+
+
+def crop(data, begin, end, step=None, **kw):
+    """Legacy alias of slice (reference: crop/slice)."""
+    if kw:
+        raise MXNetError(f"crop: unsupported arguments {sorted(kw)}")
+    from .ops import slice as _slice
+    if step is not None:
+        return _slice(_nd(data), begin=begin, end=end, step=step)
+    return _slice(_nd(data), begin=begin, end=end)
+
+
+def moments(data, axes=None, keepdims=False):
+    """Mean and variance over ``axes`` (reference: moments op)."""
+    def fn(x):
+        jnp = _jnp()
+        ax = tuple(axes) if isinstance(axes, (tuple, list)) \
+            else (axes,) if axes is not None else None
+        mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+        var = jnp.var(x, axis=ax, keepdims=keepdims)
+        return mean, var
+    return _invoke(fn, [_nd(data)], name="moments")
+
+
+def softmin(data, axis=-1):
+    """softmax of -x (reference: softmin op)."""
+    def fn(x):
+        import jax
+        return jax.nn.softmax(-x, axis=axis)
+    return _invoke(fn, [_nd(data)], name="softmin")
+
+
+def argwhere(data):
+    """Indices of non-zero elements, (N, ndim), int32 (reference-era
+    contrib.boolean ops; note: data-dependent output shape, so this op
+    is eager-only — inside jit use topk/where patterns instead)."""
+    from .ndarray import array as _array
+    return _array(_np.argwhere(_nd(data).asnumpy()), dtype=_np.int32)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, **kw):
+    """Flat alias of mx.nd.random.normal (reference: nd.normal)."""
+    from . import random as _rnd
+    return _rnd.normal(loc=loc, scale=scale, shape=shape, **kw)
+
+
 __all__ = [n for n in dir() if not n.startswith("_") and n not in
            ("NDArray", "MXNetError", "annotations")]

@@ -400,3 +400,58 @@ class TestTensorOdds:
         z.backward()
         assert np.isfinite(x.grad.asnumpy()).all()
         assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+class TestFlatParityOps:
+    def test_moments(self):
+        x = nd.array(np.arange(6.0).reshape(2, 3))
+        m, v = nd.moments(x, axes=1)
+        np.testing.assert_allclose(m.asnumpy(), [1.0, 4.0])
+        np.testing.assert_allclose(v.asnumpy(), [2.0 / 3] * 2, rtol=1e-6)
+        m2, v2 = nd.moments(x)
+        assert m2.asnumpy() == pytest.approx(2.5)
+
+    def test_softmin_is_softmax_of_negation(self):
+        x = nd.array(np.array([[1.0, 2.0, 3.0]], np.float32))
+        out = nd.softmin(x).asnumpy()
+        ref = np.exp(-x.asnumpy())
+        ref /= ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_argwhere(self):
+        x = nd.array(np.array([[0, 1], [2, 0]], np.float32))
+        np.testing.assert_array_equal(nd.argwhere(x).asnumpy(),
+                                      [[0, 1], [1, 0]])
+
+    def test_crop_alias(self):
+        x = nd.array(np.arange(9.0).reshape(3, 3))
+        out = nd.crop(x, begin=(1, 0), end=(3, 2))
+        np.testing.assert_allclose(out.asnumpy(), [[3, 4], [6, 7]])
+
+    def test_cast_storage_roundtrip(self):
+        x = nd.array(np.eye(3, dtype=np.float32))
+        csr = nd.cast_storage(x, "csr")
+        assert csr.stype == "csr"
+        np.testing.assert_allclose(
+            nd.cast_storage(csr, "default").asnumpy(), np.eye(3))
+
+    def test_normal_alias_seeded(self):
+        mx.random.seed(5)
+        a = nd.normal(shape=(4,)).asnumpy()
+        mx.random.seed(5)
+        b = nd.normal(shape=(4,)).asnumpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_crop_step_and_bad_kwargs(self):
+        x = nd.array(np.arange(9.0).reshape(3, 3))
+        out = nd.crop(x, begin=(0, 0), end=(3, 3), step=(2, 2))
+        np.testing.assert_allclose(out.asnumpy(), [[0, 2], [6, 8]])
+        with pytest.raises(mx.MXNetError, match="unsupported"):
+            nd.crop(x, begin=(0, 0), end=(2, 2), bogus=1)
+
+    def test_cast_storage_never_aliases(self):
+        x = nd.array(np.ones((2, 2), np.float32))
+        y = nd.cast_storage(x, "default")
+        assert y is not x
+        y[:] = 0.0
+        np.testing.assert_allclose(x.asnumpy(), 1.0)
