@@ -171,3 +171,62 @@ def test_train_step_consistency():
     for name in w0:
         tu.assert_almost_equal(w0[name], w1[name], rtol=2e-2, atol=1e-3,
                                names=(f"{name}@{k0}", f"{name}@{k1}"))
+
+
+# ---------------------------------------------------------------------------
+# round-3 op-corpus extensions (linalg / spatial / misc) on the chip
+# ---------------------------------------------------------------------------
+def test_linalg_family_consistency():
+    rng = onp.random.default_rng(30)
+    a = rng.standard_normal((4, 4)).astype(onp.float32)
+    spd = a @ a.T + 4 * onp.eye(4, dtype=onp.float32)
+    b = rng.standard_normal((4, 3)).astype(onp.float32)
+    c = onp.zeros((4, 3), onp.float32)
+    # matmul-family ops ride the MXU: same loosened tolerance as
+    # test_dot_consistency (default TPU matmul precision rounds
+    # operands to bf16)
+    tu.check_consistency(
+        lambda x, y, z: nd.linalg_gemm(x, y, z, alpha=1.5),
+        [a, b, c], ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+    tu.check_consistency(lambda x: nd.linalg_potrf(x), [spd],
+                         ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+    tu.check_consistency(lambda x: nd.linalg_syrk(x), [a],
+                         ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+    tu.check_consistency(lambda x: nd.linalg_inverse(x), [spd],
+                         ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+
+
+def test_spatial_ops_consistency():
+    rng = onp.random.default_rng(31)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(onp.float32)
+    theta = onp.array([1, 0, 0.1, 0, 1, -0.1], onp.float32).reshape(1, 6)
+    # einsum inside GridGenerator / DeformableConvolution rides the MXU:
+    # loosened tolerance like the other matmul-path checks
+    tu.check_consistency(
+        lambda d, t: nd.SpatialTransformer(d, t, target_shape=(6, 6)),
+        [x, theta], ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+    tu.check_consistency(lambda d: nd.LRN(d, nsize=3), [x],
+                         ctx_list=_ctx_list(), rtol=1e-4, atol=1e-5)
+    off = onp.zeros((1, 2 * 9, 6, 6), onp.float32)
+    w = rng.standard_normal((3, 2, 3, 3)).astype(onp.float32)
+    tu.check_consistency(
+        lambda d, o, wt: nd.DeformableConvolution(d, o, wt,
+                                                  kernel=(3, 3),
+                                                  pad=(1, 1)),
+        [x, off, w], ctx_list=_ctx_list(), rtol=2e-2, atol=1e-3)
+
+
+def test_misc_ext_consistency():
+    rng = onp.random.default_rng(32)
+    x = rng.standard_normal((2, 8, 4, 4)).astype(onp.float32)
+    tu.check_consistency(lambda d: nd.depth_to_space(d, 2), [x],
+                         ctx_list=_ctx_list(), rtol=1e-6, atol=1e-6)
+    flat = rng.standard_normal((3, 8)).astype(onp.float32)
+    tu.check_consistency(lambda d: nd.logsumexp(d, axis=1), [flat],
+                         ctx_list=_ctx_list(), rtol=1e-5, atol=1e-5)
+    tu.check_consistency(lambda d: nd.ifft(nd.fft(d)), [flat],
+                         ctx_list=_ctx_list(), rtol=1e-3, atol=1e-3)
+    # moments returns a pair; compare via concat
+    tu.check_consistency(
+        lambda d: nd.concat(*nd.moments(d, axes=1), dim=0), [flat],
+        ctx_list=_ctx_list(), rtol=1e-5, atol=1e-5)
