@@ -461,15 +461,67 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     dot(csr, dense) and dot(csr.T, dense) lower through BCOO so XLA compiles
     the gather/scatter; other combinations densify."""
     from . import ops as _ops
+    from .ndarray import _invoke
     if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
                                                       BaseSparseNDArray):
+        import jax
         mat = lhs._bcoo()
         if transpose_a:
             mat = mat.T
-        r = rhs._data if isinstance(rhs, NDArray) else _jnp().asarray(rhs)
-        if transpose_b:
-            r = r.T
-        return NDArray((mat @ r), ctx=lhs._ctx)
+        rhs_nd = rhs if isinstance(rhs, NDArray) \
+            else NDArray(_jnp().asarray(rhs), ctx=lhs._ctx)
+
+        from .. import autograd as _ag_mod
+        rhs_active = (_ag_mod.is_recording()
+                      and rhs_nd._tape_entry_active()
+                      and not isinstance(rhs_nd._data, jax.core.Tracer))
+        if rhs_active and not transpose_b:
+            # custom tape node with a DIRECTLY-sparse cotangent: only the
+            # rows of rhs the csr structure touches are materialized
+            # (never a dense (dim, k) buffer — the reference's
+            # dot(csr, dense) backward is likewise row_sparse,
+            # src/operator/tensor/dot-inl.h DotCsrDenseGrad)
+            jnp = _jnp()
+            out = NDArray(mat @ rhs_nd._data, ctx=lhs._ctx)
+            vals = lhs._cs_data
+            cols = lhs._cs_indices
+            indptr = lhs._cs_indptr
+            m = lhs.shape[0]
+            row_of_nnz = jnp.repeat(
+                jnp.arange(m), jnp.diff(indptr),
+                total_repeat_length=cols.shape[0])
+            wshape, wctx = rhs_nd.shape, rhs_nd.ctx
+
+            def sparse_vjp(cot):
+                # grad[j] = sum over nnz (i, j, v) of v * cot[i]   (no
+                # transpose_a);  transpose_a: grad[i] += v * cot[j]
+                tgt = cols if not transpose_a else row_of_nnz
+                src = row_of_nnz if not transpose_a else cols
+                rows_np = _np.unique(_np.asarray(tgt))
+                seg = _np.searchsorted(rows_np, _np.asarray(tgt))
+                contrib = vals[:, None] * cot[src]
+                data = jax.ops.segment_sum(
+                    contrib, jnp.asarray(seg), num_segments=len(rows_np))
+                return (RowSparseNDArray(data, rows_np, wshape,
+                                         ctx=wctx),)
+
+            node = _ag_mod._TapeNode(fun=None, inputs=[rhs_nd],
+                                     vjp_fn=sparse_vjp,
+                                     out_is_tuple=False,
+                                     name="sparse_dot(row_sparse_grad)",
+                                     custom=True)
+            node.out_avals = [(out.shape, out.dtype)]
+            out._ag_node = node
+            out._ag_idx = 0
+            return out
+
+        # fallback (not recording / transpose_b / under trace): route
+        # through _invoke so the tape records with a dense vjp
+        def fn(r):
+            return mat @ (r.T if transpose_b else r)
+        out = _invoke(fn, [rhs_nd], name="sparse_dot")
+        out._ctx = lhs._ctx          # placement follows the csr operand
+        return out
     a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
     b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
     return _ops.dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -328,3 +328,49 @@ def test_kvstore_row_sparse_pull():
     expect = np.zeros_like(w)
     expect[[1, 3, 9]] = w[[1, 3, 9]]
     np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_sparse_dot_records_on_tape():
+    """dot(csr, dense) must record for autograd: gradients flow to the
+    dense rhs, materialized only on touched rows with a row_sparse grad
+    buffer (regression: the csr fast path bypassed the tape and silently
+    produced zero gradients)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    w = mx.nd.zeros((10, 3))
+    w.attach_grad(stype="row_sparse")
+    x = sp.csr_matrix((np.array([1.0, 2.0, 3.0], np.float32),
+                       np.array([1, 4, 4]), np.array([0, 1, 3])),
+                      shape=(2, 10))
+    with ag.record():
+        out = mx.nd.sparse.dot(x, w)
+        L = (out * out).sum() + out.sum()
+    L.backward()
+    g = w.grad
+    assert g.stype == "row_sparse"
+    d = g.todense().asnumpy()
+    touched = sorted(np.nonzero(d.any(1))[0].tolist())
+    assert touched == [1, 4]
+    # analytic check: dL/dout = 2*out + 1 = 1 (w=0) -> dL/dw = x.T @ 1
+    np.testing.assert_allclose(d[1], [1.0, 1.0, 1.0])
+    np.testing.assert_allclose(d[4], [5.0, 5.0, 5.0])
+
+
+def test_sparse_dot_transpose_grad():
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    w = mx.nd.array(np.ones((2, 3), np.float32))
+    w.attach_grad()
+    x = sp.csr_matrix((np.array([2.0], np.float32), np.array([1]),
+                       np.array([0, 1, 1])), shape=(2, 5))
+    with ag.record():
+        out = mx.nd.sparse.dot(x, w, transpose_a=True)   # (5, 3)
+        L = out.sum()
+    L.backward()
+    # d/dw (x.T w).sum() = x.sum(axis=0) broadcast: row0 gets 2, row1 0
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               [[2.0, 2.0, 2.0], [0.0, 0.0, 0.0]])
