@@ -72,7 +72,8 @@ class SPMDTrainer:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="data", sharding_rules=None,
-                 extra_input_shardings=None, donate=True):
+                 extra_input_shardings=None, donate=True,
+                 shard_optimizer_state=False):
         import jax
         self._net = net
         self._loss = loss_fn
@@ -119,8 +120,51 @@ class SPMDTrainer:
         # zeros_like inside opt.init makes each state leaf inherit its
         # param's sharding (XLA propagates NamedSharding through zeros_like)
         self._opt_state = self._opt.init(self._tr_vals)
+        # ZeRO-1-style weight-update sharding (paper: "Automatic
+        # Cross-Replica Sharding of Weight Update in Data-Parallel
+        # Training", arXiv:2004.13336): optimizer state — normally
+        # replicated over the data axis — is sharded over it instead;
+        # GSPMD turns the gradient psum + sharded update into
+        # reduce-scatter + local update + all-gather automatically.
+        self._shard_opt_state = bool(shard_optimizer_state)
+        self._opt_state_shardings = None
+        if self._shard_opt_state:
+            self._opt_state_shardings = self._make_state_shardings()
+            self._opt_state = jax.tree.map(
+                lambda v, s: jax.device_put(v, s),
+                self._opt_state, self._opt_state_shardings)
         self._step_count = 0
         self._jit_cache = {}
+
+    def _make_state_shardings(self):
+        """Per-leaf shardings for the optimizer state: each leaf keeps
+        its own inherited sharding (zeros_like in opt.init propagates
+        the param's) with the data axis added on the first unsharded,
+        divisible dim; leaves already sharded over the data axis (FSDP-
+        style rules) are left as they are."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n = self._mesh.shape[self._data_axis]
+
+        def _axes_in(entry):
+            if entry is None:
+                return ()
+            return entry if isinstance(entry, tuple) else (entry,)
+
+        def leaf_sharding(v):
+            base = getattr(v, "sharding", None)
+            spec = list(base.spec) if base is not None \
+                and hasattr(base, "spec") else []
+            spec += [None] * (v.ndim - len(spec))
+            used = {a for e in spec for a in _axes_in(e)}
+            if self._data_axis not in used:
+                for d in range(v.ndim):
+                    if spec[d] is None and v.shape[d] > 0 \
+                            and v.shape[d] % n == 0:
+                        spec[d] = self._data_axis
+                        break
+            return NamedSharding(self._mesh, P(*spec))
+        import jax
+        return jax.tree.map(leaf_sharding, self._opt_state)
 
     # ------------------------------------------------------------------
     @property
@@ -164,7 +208,7 @@ class SPMDTrainer:
         return jax.jit(
             pure_step,
             out_shardings=(None, self._tr_shardings, self._aux_shardings,
-                           None),
+                           self._opt_state_shardings),
             donate_argnums=donate)
 
     def _shard_batch(self, arr):
