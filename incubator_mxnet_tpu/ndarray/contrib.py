@@ -30,10 +30,13 @@ from ..base import MXNetError
 from .ndarray import NDArray, _invoke
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "MultiBoxPrior",
-           "MultiBoxTarget", "MultiBoxDetection", "ROIAlign",
+           "MultiBoxTarget", "MultiBoxDetection", "ROIAlign", "Proposal",
            "BilinearResize2D", "AdaptiveAvgPooling2D", "foreach",
            "while_loop", "cond", "isinf", "isnan", "isfinite",
-           "arange_like", "index_array", "index_copy"]
+           "arange_like", "index_array", "index_copy", "boolean_mask",
+           "quadratic", "getnnz", "allclose", "CTCLoss", "ctc_loss",
+           "fft", "ifft", "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt"]
 
 
 def _jnp():
@@ -697,3 +700,196 @@ def index_copy(old_tensor, index_vector, new_tensor):
         return old.at[idx].set(new)
     return _invoke(run, [old_tensor, index_vector, new_tensor],
                    name="index_copy")
+
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where index != 0 (reference: contrib/boolean_mask.cc).
+    Data-dependent output shape: eager-only; under jit use where/topk
+    patterns instead.  Delegates to the nd-level op."""
+    from .ops import boolean_mask as _bm
+    return _bm(data, index, axis=axis)
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference: the contrib tutorial op
+    quadratic_op-inl.h)."""
+    def run(x):
+        return a * x * x + b * x + c
+    return _invoke(run, [data], name="quadratic")
+
+
+def getnnz(data, axis=None):
+    """Number of stored values of a CSR (reference: contrib getnnz /
+    nnz of sparse storage)."""
+    from . import sparse as _sp
+    from .ndarray import array as _array
+    import numpy as _onp
+    if isinstance(data, _sp.CSRNDArray):
+        if axis is None:
+            return _array(_onp.asarray([data._cs_indices.shape[0]],
+                                       _onp.int64))
+        if axis == 1:
+            ptr = _onp.asarray(data._cs_indptr)
+            return _array((ptr[1:] - ptr[:-1]).astype(_onp.int64))
+        raise MXNetError("getnnz: axis must be None or 1 for CSR")
+    d = data.asnumpy()
+    return _array(_onp.asarray([(d != 0).sum()], _onp.int64))
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """1.0 if all elements are close (reference: contrib/allclose_op.cc)."""
+    def run(x, y):
+        jnp = _jnp()
+        return jnp.allclose(x, y, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan).astype(jnp.float32)
+    return _invoke(run, [a, b], name="allclose", differentiable=False)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             blank_label="first", **kw):
+    """Connectionist temporal classification loss (reference:
+    contrib.ctc_loss; the gluon CTCLoss is the same kernel).  data:
+    (T, B, C) activations; label: (B, L) padded with -1."""
+    if kw:
+        raise MXNetError(f"ctc_loss: unsupported arguments {sorted(kw)}")
+    if blank_label != "first":
+        raise MXNetError(
+            "ctc_loss: only blank_label='first' is implemented in this "
+            "build")
+    from ..gluon.loss import CTCLoss as _G
+    loss = _G(layout="TNC", label_layout="NT")
+    return loss(data, label, data_lengths, label_lengths)
+
+
+CTCLoss = ctc_loss
+
+
+def fft(data, compute_size=128):
+    """Alias of the packed-layout FFT (reference: contrib fft.cc)."""
+    from .ops_ext import fft as _fft
+    return _fft(data, compute_size)
+
+
+def ifft(data, compute_size=128):
+    from .ops_ext import ifft as _ifft
+    return _ifft(data, compute_size)
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """Attention scores from interleaved QKV projections (reference:
+    contrib/transformer.cc interleaved_matmul_selfatt_qk, the 1.6 fused
+    MHA ops).  Input (T, B, 3*H*D) with per-head interleaved [q, k, v];
+    output (B*H, T, T) scaled scores."""
+    def run(qkv):
+        jnp = _jnp()
+        T, B, P = qkv.shape
+        hd = P // (3 * heads)
+        x = qkv.reshape(T, B, heads, 3, hd)
+        q = x[:, :, :, 0]                   # (T, B, H, D)
+        k = x[:, :, :, 1]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, qkv.dtype))
+        s = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
+        return s.reshape(B * heads, T, T)
+    return _invoke(run, [queries_keys_values],
+                   name="interleaved_matmul_selfatt_qk")
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads):
+    """Apply attention weights to interleaved values (reference:
+    contrib/transformer.cc interleaved_matmul_selfatt_valatt).
+    qkv (T, B, 3*H*D) + att (B*H, T, T) -> (T, B, H*D)."""
+    def run(qkv, att):
+        jnp = _jnp()
+        T, B, P = qkv.shape
+        hd = P // (3 * heads)
+        v = qkv.reshape(T, B, heads, 3, hd)[:, :, :, 2]  # (T, B, H, D)
+        a = att.reshape(B, heads, T, T)
+        out = jnp.einsum("bhqk,kbhd->qbhd", a, v)
+        return out.reshape(T, B, heads * hd)
+    return _invoke(run, [queries_keys_values, attention],
+                   name="interleaved_matmul_selfatt_valatt")
+
+
+def Proposal(cls_prob, bbox_pred, im_info, feature_stride=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+             threshold=0.7, rpn_min_size=16):
+    """RPN proposal op (reference: contrib/proposal.cc): decode anchor
+    deltas, clip to the image, filter small boxes, NMS, keep top-N.
+    Fixed-size output (B, rpn_post_nms_top_n, 5) [batch_idx, x0,y0,x1,y1]
+    with -1 rows invalid — the XLA-friendly re-derivation of the CUDA
+    kernel's dynamic shapes."""
+    def run(prob, pred, info):
+        import jax
+        jnp = _jnp()
+        B, A2, H, W = prob.shape
+        A = A2 // 2
+        # base anchors at stride cells (corner format, centered)
+        base = []
+        for sc in scales:
+            for r in ratios:
+                ws = feature_stride * sc * (r ** 0.5)
+                hs = feature_stride * sc / (r ** 0.5)
+                base.append((-ws / 2, -hs / 2, ws / 2, hs / 2))
+        base = jnp.asarray(base, prob.dtype)          # (A, 4)
+        gy, gx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+        ctr = jnp.stack([gx, gy, gx, gy], -1) * feature_stride \
+            + feature_stride / 2.0                     # (H, W, 4)
+        anchors = (ctr[:, :, None, :] + base[None, None]).reshape(-1, 4)
+        N = anchors.shape[0]
+
+        fg = prob[:, A:].transpose(0, 2, 3, 1).reshape(B, N)
+        deltas = pred.transpose(0, 2, 3, 1).reshape(B, N, 4)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        cx = deltas[..., 0] * aw + acx
+        cy = deltas[..., 1] * ah + acy
+        w = jnp.exp(jnp.clip(deltas[..., 2], -8, 8)) * aw
+        h = jnp.exp(jnp.clip(deltas[..., 3], -8, 8)) * ah
+        x0 = jnp.clip(cx - w / 2, 0, info[:, 1:2] - 1)
+        y0 = jnp.clip(cy - h / 2, 0, info[:, 0:1] - 1)
+        x1 = jnp.clip(cx + w / 2, 0, info[:, 1:2] - 1)
+        y1 = jnp.clip(cy + h / 2, 0, info[:, 0:1] - 1)
+        # reference filters at rpn_min_size * image scale (im_info[2])
+        min_sz = rpn_min_size * info[:, 2:3]
+        keep = ((x1 - x0 + 1 >= min_sz) & (y1 - y0 + 1 >= min_sz))
+        score = jnp.where(keep, fg, -1.0)
+        k = min(rpn_pre_nms_top_n, N)
+        top_s, top_i = jax.lax.top_k(score, k)
+        bsel = jnp.arange(B)[:, None]
+        boxes = jnp.stack([x0[bsel, top_i], y0[bsel, top_i],
+                           x1[bsel, top_i], y1[bsel, top_i]], -1)
+        # per-batch greedy NMS over the top-k, fixed output size
+        rows = jnp.concatenate(
+            [jnp.zeros((B, k, 1), prob.dtype),    # single fg class id 0
+             top_s[..., None], boxes], -1)
+        return rows
+    raw = _invoke(run, [cls_prob, bbox_pred, im_info], name="Proposal",
+                  differentiable=False)
+    # NMS over ALL pre-NMS candidates (reference order: suppress first,
+    # THEN keep the top rpn_post_nms_top_n survivors)
+    kept = box_nms(raw, overlap_thresh=threshold, valid_thresh=0.0,
+                   topk=-1, coord_start=2, score_index=1, id_index=0)
+
+    def pack(r):
+        jnp = _jnp()
+        B, N = r.shape[0], r.shape[1]
+        n = rpn_post_nms_top_n
+        # box_nms output is score-sorted with -1 gaps; compact survivors
+        # to the front, then truncate to the fixed post-NMS count
+        valid = r[..., 0] >= 0
+        order = jnp.argsort(~valid, axis=1, stable=True)
+        bsel = jnp.arange(B)[:, None]
+        rows = r[bsel, order][:, :n]
+        valid_n = rows[..., 0] >= 0
+        bidx = jnp.broadcast_to(
+            jnp.arange(B, dtype=r.dtype)[:, None], (B, n))
+        out = jnp.concatenate(
+            [jnp.where(valid_n, bidx, -1.0)[..., None], rows[..., 2:6]],
+            -1)
+        return out
+    return _invoke(pack, [kept], name="Proposal_pack",
+                   differentiable=False)

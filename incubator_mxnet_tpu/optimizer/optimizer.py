@@ -20,7 +20,7 @@ from ..ndarray import optimizer_ops as _oo
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "Adamax", "Nadam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "FTML", "Signum", "SignSGD",
-           "LAMB", "LARS", "AdamW", "SGLD", "DCASGD", "Test", "create",
+           "LAMB", "LARS", "AdamW", "GroupAdaGrad", "SGLD", "DCASGD", "Test", "create",
            "register", "get_updater", "Updater"]
 
 _REGISTRY = {}
@@ -592,6 +592,41 @@ class LARS(Optimizer):
             weight._set_data(weight._data - m_t)
         else:
             weight._set_data(weight._data - step)
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with ONE accumulated scalar per row (reference:
+    contrib.optimizer GroupAdaGrad — the GluonNLP sparse-embedding
+    optimizer).  history[i] += mean(grad[i]^2); w[i] -= lr * g[i] /
+    (sqrt(history[i]) + eps)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+        return NDArray(jnp.zeros((weight.shape[0],) + (1,)
+                                 * (len(weight.shape) - 1),
+                                 weight._data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        if self._get_wd(index):
+            raise MXNetError("GroupAdaGrad does not support weight decay "
+                             "(reference parity)")
+        g = _oo._as_dense_grad(grad)._data * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        axes = tuple(range(1, g.ndim))
+        hist = state._data + jnp.mean(g * g, axis=axes, keepdims=True)
+        state._set_data(hist)
+        # epsilon INSIDE the sqrt (reference kernel + our adagrad_update)
+        weight._set_data(
+            weight._data - lr * g / jnp.sqrt(hist + self.epsilon))
 
 
 @register

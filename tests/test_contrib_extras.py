@@ -314,3 +314,112 @@ def test_estimator_early_stopping_stops():
     e.fit(train, epochs=50,
           event_handlers=[est.EarlyStoppingHandler(patience=2)])
     assert e.current_epoch < 49          # stopped early (frozen metric)
+
+
+# ---------------------------------------------------------------------------
+# round-3 contrib batch
+# ---------------------------------------------------------------------------
+class TestContribBatch:
+    def test_boolean_mask(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        d = mx.nd.array(np.arange(12.0).reshape(4, 3))
+        idx = mx.nd.array(np.array([1, 0, 1, 0], np.float32))
+        out = c.boolean_mask(d, idx)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   [[0, 1, 2], [6, 7, 8]])
+
+    def test_quadratic(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        from incubator_mxnet_tpu import autograd as ag
+        x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+        x.attach_grad()
+        with ag.record():
+            y = c.quadratic(x, a=2.0, b=3.0, c=1.0).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [7.0, 11.0])  # 4x+3
+
+    def test_getnnz_and_allclose(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        from incubator_mxnet_tpu.ndarray import sparse as sp
+        csr = sp.csr_matrix((np.array([1.0, 2.0, 3.0], np.float32),
+                             np.array([0, 2, 1]), np.array([0, 2, 2, 3])),
+                            shape=(3, 4))
+        assert c.getnnz(csr).asnumpy()[0] == 3
+        np.testing.assert_array_equal(c.getnnz(csr, axis=1).asnumpy(),
+                                      [2, 0, 1])
+        a = mx.nd.array(np.ones(3, np.float32))
+        assert c.allclose(a, a).asnumpy() == 1.0
+        assert c.allclose(a, a * 2).asnumpy() == 0.0
+
+    def test_interleaved_selfatt_matches_reference_math(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        T, B, H, D = 5, 2, 2, 4
+        rng = np.random.RandomState(0)
+        qkv = rng.randn(T, B, 3 * H * D).astype(np.float32)
+        s = c.interleaved_matmul_selfatt_qk(mx.nd.array(qkv), H)
+        assert s.shape == (B * H, T, T)
+        att = mx.nd.softmax(s, axis=-1)
+        out = c.interleaved_matmul_selfatt_valatt(mx.nd.array(qkv), att, H)
+        assert out.shape == (T, B, H * D)
+        # reference math: deinterleave and compute plain attention
+        x = qkv.reshape(T, B, H, 3, D)
+        q, k, v = x[:, :, :, 0], x[:, :, :, 1], x[:, :, :, 2]
+        sc = np.einsum("qbhd,kbhd->bhqk", q / np.sqrt(D), k)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,kbhd->qbhd", p, v).reshape(T, B, H * D)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_proposal_shapes_and_validity(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        rng = np.random.RandomState(0)
+        B, A, H, W = 2, 6, 4, 4        # 2 scales x 3 ratios = 6 anchors
+        cls_prob = mx.nd.array(
+            rng.uniform(0, 1, (B, 2 * A, H, W)).astype(np.float32))
+        bbox_pred = mx.nd.array(
+            rng.uniform(-0.2, 0.2, (B, 4 * A, H, W)).astype(np.float32))
+        im_info = mx.nd.array(np.array([[64, 64, 1], [64, 64, 1]],
+                                       np.float32))
+        out = c.Proposal(cls_prob, bbox_pred, im_info, feature_stride=16,
+                         scales=(2, 4), ratios=(0.5, 1, 2),
+                         rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                         rpn_min_size=4)
+        assert out.shape == (2, 10, 5)
+        o = out.asnumpy()
+        valid = o[..., 0] >= 0
+        assert valid.any()
+        boxes = o[valid]
+        assert (boxes[:, 1] >= 0).all() and (boxes[:, 3] <= 63.01).all()
+
+    def test_ctc_loss_alias(self):
+        import numpy as np
+        from incubator_mxnet_tpu.ndarray import contrib as c
+        T, B, C = 6, 2, 5
+        rng = np.random.RandomState(0)
+        data = mx.nd.array(rng.randn(T, B, C).astype(np.float32))
+        label = mx.nd.array(np.array([[1, 2, -1], [3, -1, -1]],
+                                     np.float32))
+        out = c.ctc_loss(data, label)
+        assert out.shape[0] == B
+        assert np.isfinite(out.asnumpy()).all()
+
+    def test_group_adagrad(self):
+        import numpy as np
+        from incubator_mxnet_tpu import optimizer as opt
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3).astype(np.float32)
+        g = rng.randn(4, 3).astype(np.float32)
+        o = opt.create("groupadagrad", learning_rate=0.1)
+        mw, mg = mx.nd.array(w), mx.nd.array(g)
+        state = o.create_state(0, mw)
+        assert state.shape == (4, 1)
+        o.update(0, mw, mg, state)
+        hist = (g * g).mean(axis=1, keepdims=True)
+        ref = w - 0.1 * g / np.sqrt(hist + 1e-5)
+        np.testing.assert_allclose(mw.asnumpy(), ref, rtol=1e-5)
