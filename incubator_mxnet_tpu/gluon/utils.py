@@ -10,7 +10,8 @@ from ..context import Context
 from ..ndarray import ndarray as _ndmod
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download", "shape_is_known"]
 
 
 def split_data(data: NDArray, num_slice: int, batch_axis=0,
@@ -62,3 +63,95 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
         for a in arrays:
             a *= scale
     return total
+
+
+def check_sha1(filename, sha1_hash):
+    """True if the file's sha1 matches (reference: gluon.utils.check_sha1)."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (reference: gluon.utils.download).  Zero-egress
+    environments: file:// URLs and existing local paths work; http(s)
+    uses urllib.  Writes to a temp file and renames atomically so an
+    interrupted transfer never poisons the cache path."""
+    import os
+    import shutil
+    import time
+    import urllib.error
+    import urllib.request
+    fname = url.split("/")[-1].split("?")[0]
+    if path is None:
+        path = fname
+    elif os.path.isdir(path):
+        path = os.path.join(path, fname)
+    if os.path.exists(path) and not overwrite and (
+            sha1_hash is None or check_sha1(path, sha1_hash)):
+        return path
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".part"
+    try:
+        if url.startswith("file://"):
+            shutil.copyfile(url[len("file://"):], tmp)
+        elif os.path.exists(url):
+            shutil.copyfile(url, tmp)
+        else:
+            last = None
+            for attempt in range(max(1, retries)):
+                try:
+                    import ssl
+                    ctx = (None if verify_ssl
+                           else ssl._create_unverified_context())
+                    with urllib.request.urlopen(url, context=ctx) as r, \
+                            open(tmp, "wb") as f:
+                        shutil.copyfileobj(r, f)
+                    last = None
+                    break
+                except urllib.error.HTTPError as e:
+                    if 400 <= e.code < 500:      # permanent — fail fast
+                        raise MXNetError(
+                            f"download failed for {url!r}: {e}") from e
+                    last = e
+                    time.sleep(min(2 ** attempt, 8))
+                except Exception as e:  # noqa: BLE001 — transient retry
+                    last = e
+                    time.sleep(min(2 ** attempt, 8))
+            if last is not None:
+                raise MXNetError(f"download failed for {url!r}: {last}")
+        if sha1_hash is not None and not check_sha1(tmp, sha1_hash):
+            raise MXNetError(f"downloaded file {path} sha1 mismatch")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path
+
+
+def shape_is_known(shape):
+    """True if a shape is fully known (reference: mxnet.util
+    shape_is_known): the unknown-dim sentinel is -1 under np semantics
+    (``npx.set_np()``, where size-0 dims are legal) and 0 in legacy
+    mode; a 0-dim shape () is only meaningful under np semantics."""
+    if shape is None:
+        return False
+    from .. import numpy_extension as _npx
+    np_mode = _npx.is_np_shape()
+    unknown = -1 if np_mode else 0
+    if len(shape) == 0:
+        return bool(np_mode)
+    for d in shape:
+        if d is None or d == unknown or d < -1:
+            return False
+        if not np_mode and d < 0:
+            return False
+    return True

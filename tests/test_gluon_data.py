@@ -161,3 +161,25 @@ def test_loader_multiprocess_beats_gil():
     list(gdata.DataLoader(BusyDataset(), batch_size=4, num_workers=4))
     par = time.perf_counter() - t0
     assert par < serial * 0.8, (serial, par)
+
+
+def test_gluon_utils_download_and_sha1(tmp_path):
+    """file:// download + sha1 verification + caching (reference:
+    gluon.utils.download/check_sha1)."""
+    import hashlib
+    from incubator_mxnet_tpu.gluon import utils as gu
+    src = tmp_path / "weights.bin"
+    src.write_bytes(b"payload")
+    h = hashlib.sha1(b"payload").hexdigest()
+    out = gu.download(f"file://{src}", path=str(tmp_path / "dl.bin"),
+                      sha1_hash=h)
+    assert open(out, "rb").read() == b"payload"
+    assert gu.check_sha1(out, h)
+    # wrong hash raises
+    import pytest as _pytest
+    import incubator_mxnet_tpu as mx
+    with _pytest.raises(mx.MXNetError, match="sha1"):
+        gu.download(f"file://{src}", path=str(tmp_path / "dl2.bin"),
+                    sha1_hash="0" * 40)
+    assert gu.shape_is_known((3, 4))
+    assert not gu.shape_is_known((3, -1))
