@@ -29,6 +29,8 @@ __all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
            "digamma", "sequence_mask", "sequence_last", "sequence_reverse",
            "reshape_like", "smooth_l1", "gather_nd", "scatter_nd",
            "stop_gradient", "erf", "erfinv", "arange_like",
+           "slice_axis", "roi_align", "box_nms", "multibox_detection",
+           "nonzero", "sample_categorical",
            "broadcast_like", "batch_flatten", "shape_array",
            "softmax_cross_entropy", "slice_like", "index_array",
            "index_copy", "foreach", "while_loop", "cond",
@@ -147,6 +149,7 @@ gather_nd = _np_face(_nd_ops.gather_nd, "gather_nd")
 scatter_nd = _np_face(_nd_ops.scatter_nd, "scatter_nd")
 stop_gradient = _np_face(_nd_ops.stop_gradient, "stop_gradient")
 gammaln = _np_face(_nd_ops.gammaln, "gammaln")
+slice_axis = _np_face(_nd_ops.slice_axis, "slice_axis")
 digamma = _np_face(_nd_ops.digamma, "digamma")
 sequence_last = _np_face(_nd_ops.SequenceLast, "sequence_last")
 sequence_reverse = _np_face(_nd_ops.SequenceReverse, "sequence_reverse")
@@ -158,12 +161,30 @@ softmax_cross_entropy = _np_face(_nd_ops.softmax_cross_entropy,
 slice_like = _np_face(_nd_ops.slice_like, "slice_like")
 
 
-def _contrib_face(name):
+def _contrib_face(name, alias=None):
     from ..ndarray import contrib as _nd_contrib
-    return _np_face(getattr(_nd_contrib, name), name)
+    return _np_face(getattr(_nd_contrib, name), alias or name)
 
 
 arange_like = _contrib_face("arange_like")
+roi_align = _contrib_face("ROIAlign", "roi_align")
+box_nms = _contrib_face("box_nms")
+multibox_detection = _contrib_face("MultiBoxDetection",
+                                   "multibox_detection")
+
+
+def nonzero(a):
+    """Indices of non-zero elements as an (ndim, N) array (reference:
+    npx nonzero; eager-only — data-dependent shape)."""
+    from ..ndarray.ops_ext import argwhere as _aw
+    return _reclass(_aw(a).T)
+
+
+def sample_categorical(prob, shape=None, dtype="int32"):
+    """Categorical draws from probabilities (reference: npx sampling
+    face of sample_multinomial)."""
+    from ..ndarray.ops_ext import sample_multinomial as _sm
+    return _reclass(_sm(prob, shape=shape, dtype=dtype))
 index_array = _contrib_face("index_array")
 index_copy = _contrib_face("index_copy")
 foreach = _contrib_face("foreach")
