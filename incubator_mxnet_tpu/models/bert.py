@@ -58,24 +58,40 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
         qh, kh, vh = split(qv), split(kv), split(vv)
         scale = 1.0 / math.sqrt(hd)
         if seq_axis is not None:
-            from ..parallel.ring import _ring_body
-            from functools import partial
-            from jax.sharding import PartitionSpec as P
-            from jax import shard_map
-            spec = P(None, None, seq_axis, None)
-            body = partial(_ring_body, axis_name=seq_axis, scale=scale,
-                           causal=causal)
-            if rest:
-                # valid_length mask is sequence-sharded like K/V and
-                # rotates around the ring with them
-                out = shard_map(
-                    body, mesh=mesh,
-                    in_specs=(spec, spec, spec, P(None, seq_axis)),
-                    out_specs=spec, check_vma=False)(qh, kh, vh, rest[0])
+            from ..base import getenv
+            sp_impl = (getenv("MXNET_SP_IMPL") or "ring").lower()
+            if sp_impl == "ulysses":
+                # all-to-all schedule (docs/parallelism.md: constant
+                # collective count, needs heads % axis_size == 0)
+                from ..parallel.ulysses import ulysses_attention
+                out = ulysses_attention(
+                    qh, kh, vh, mesh=mesh, axis_name=seq_axis,
+                    scale=scale, causal=causal,
+                    mask=rest[0] if rest else None)
+            elif sp_impl == "ring":
+                from ..parallel.ring import _ring_body
+                from functools import partial
+                from jax.sharding import PartitionSpec as P
+                from jax import shard_map
+                spec = P(None, None, seq_axis, None)
+                body = partial(_ring_body, axis_name=seq_axis,
+                               scale=scale, causal=causal)
+                if rest:
+                    # valid_length mask is sequence-sharded like K/V and
+                    # rotates around the ring with them
+                    out = shard_map(
+                        body, mesh=mesh,
+                        in_specs=(spec, spec, spec, P(None, seq_axis)),
+                        out_specs=spec, check_vma=False)(qh, kh, vh,
+                                                         rest[0])
+                else:
+                    out = shard_map(
+                        body, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(qh, kh, vh)
             else:
-                out = shard_map(
-                    body, mesh=mesh, in_specs=(spec, spec, spec),
-                    out_specs=spec, check_vma=False)(qh, kh, vh)
+                raise MXNetError(
+                    f"MXNET_SP_IMPL={sp_impl!r} unknown; use 'ring' or "
+                    "'ulysses'")
         else:
             from ..base import getenv_bool
             if (not rest and qh.shape == kh.shape

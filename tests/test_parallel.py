@@ -342,3 +342,33 @@ def test_sharded_optimizer_state_with_tp():
     leaf = tr._opt_state["m"][0]     # (64, 16) weight moment
     spec = tuple(leaf.sharding.spec)
     assert spec[0] == "model" and spec[1] == "data"
+
+
+def test_sp_impl_env_routes_model_attention(monkeypatch):
+    """MXNET_SP_IMPL routes the models' sequence-parallel attention
+    (bert._sdpa) through ring or ulysses; both match the dense path."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.models.bert import _sdpa
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    mesh = parallel.make_mesh({"seq": -1})
+    B, T, C, H = 2, 32, 32, 8      # 8 heads: both schedules legal
+    rng = np.random.default_rng(0)
+    q = NDArray(jnp.asarray(rng.standard_normal((B, T, C)),
+                            jnp.float32))
+    k = NDArray(jnp.asarray(rng.standard_normal((B, T, C)),
+                            jnp.float32))
+    v = NDArray(jnp.asarray(rng.standard_normal((B, T, C)),
+                            jnp.float32))
+    dense = _sdpa(q, k, v, H).asnumpy()
+
+    monkeypatch.setenv("MXNET_SP_IMPL", "ring")
+    ring = _sdpa(q, k, v, H, seq_axis="seq", mesh=mesh).asnumpy()
+    np.testing.assert_allclose(ring, dense, rtol=1e-4, atol=1e-5)
+
+    monkeypatch.setenv("MXNET_SP_IMPL", "ulysses")
+    uly = _sdpa(q, k, v, H, seq_axis="seq", mesh=mesh).asnumpy()
+    np.testing.assert_allclose(uly, dense, rtol=1e-4, atol=1e-5)
+
+    monkeypatch.setenv("MXNET_SP_IMPL", "bogus")
+    with pytest.raises(mx.MXNetError, match="MXNET_SP_IMPL"):
+        _sdpa(q, k, v, H, seq_axis="seq", mesh=mesh)
