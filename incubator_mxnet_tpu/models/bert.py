@@ -35,7 +35,7 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
 
 
 def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
-          causal=False):
+          causal=False, fuse_ok=True):
     """Fused scaled-dot-product attention op.
 
     q: (B, Tq, C), k/v: (B, Tk, C) NDArray (Tq == Tk for self-attention).
@@ -94,7 +94,7 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
                     "'ulysses'")
         else:
             from ..base import getenv_bool
-            if (not rest and qh.shape == kh.shape
+            if (fuse_ok and not rest and qh.shape == kh.shape
                     and getenv_bool("MXNET_USE_FUSION")):
                 # Pallas flash-attention kernel (reference env-var parity:
                 # MXNET_USE_FUSION gates the fused-kernel tier,

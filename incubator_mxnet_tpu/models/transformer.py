@@ -83,12 +83,15 @@ class _DecoderCell(HybridBlock):
     def step(self, x, cache_k, cache_v, t, mem_k, mem_v, src_mask=None):
         """One-position incremental decode step with a KV cache.
 
-        x (B,1,C) current-position activations; cache_k/cache_v
-        (B,Tmax,C) this layer's self-attention cache; t scalar step
-        index; mem_k/mem_v (B,Ts,C) precomputed cross-attention
-        projections (MultiHeadAttention.project_kv).  Returns
-        (y (B,1,C), cache_k', cache_v').  O(Tmax) per step instead of
-        re-running the full prefix."""
+        x (B*K, 1, C) current-position activations (K = beams, rows
+        ordered b*K+k; K=1 for greedy); cache_k/cache_v (B*K, Tmax, C)
+        this layer's self-attention cache; t scalar step index;
+        mem_k/mem_v (B, Ts, C) UNREPLICATED cross-attention projections
+        (MultiHeadAttention.project_kv) — x's batch must be an exact
+        K-multiple of theirs, and the K beams of a batch row fold into
+        the cross-attention query axis.  Returns (y (B*K, 1, C),
+        cache_k', cache_v').  O(Tmax) per step instead of re-running the
+        full prefix."""
         sa = self.self_attention
         nh = sa._num_heads
         q = sa.query(x)
@@ -120,10 +123,27 @@ class _DecoderCell(HybridBlock):
         ca = self.cross_attention
         # cross-attention over the precomputed K/V is exactly bert._sdpa's
         # masked non-causal path — reuse it for bit-identical numerics
-        # with the full-prefix oracle
+        # with the full-prefix oracle.  Beam search runs with a flattened
+        # (B*K, 1, C) query against UNREPLICATED (B, Ts, C) memory: each
+        # beam is an independent single query, so beams fold into the
+        # query-position axis ((B, K, C)) instead of replicating K/V
+        # K-fold — same numbers, 1/K the memory
         from .bert import _sdpa
-        out2 = _sdpa(ca.query(x), mem_k, mem_v, ca._num_heads,
-                     mask=src_mask)
+        q2 = ca.query(x)
+        if q2.shape[0] % mem_k.shape[0]:
+            raise MXNetError(
+                f"step: query batch {q2.shape[0]} is not a multiple of "
+                f"the memory batch {mem_k.shape[0]}")
+        kfold = q2.shape[0] // mem_k.shape[0]
+        if kfold > 1:
+            q2 = q2.reshape(mem_k.shape[0], kfold, q2.shape[-1])
+        # fuse_ok=False: the beam fold can make q/k shapes coincide,
+        # which must not flip this cross-attention onto the flash-kernel
+        # path the oracle does not take (parity contract)
+        out2 = _sdpa(q2, mem_k, mem_v, ca._num_heads, mask=src_mask,
+                     fuse_ok=False)
+        if kfold > 1:
+            out2 = out2.reshape(x.shape[0], 1, out2.shape[-1])
         x = self.ln2(x + ca.dropout(ca.proj(out2)))
         return self.ln3(x + self.ffn(x)), ck, cv
 
@@ -279,14 +299,13 @@ class TransformerModel(HybridBlock):
         out = fn(mem._data)
         return NDArray(out)
 
-    def _cached_decode_setup(self, src_ids, max_length, src_valid,
-                             beams=1):
+    def _cached_decode_setup(self, src_ids, max_length, src_valid):
         """Shared setup for the KV-cached decode paths: max_length guard,
-        source mask, encoder memory, per-layer cross K/V (replicated per
-        beam — a K-fold copy XLA keeps live for the scan; acceptable for
-        inference, candidate for a broadcast-aware attention later), and
-        the position-embedding helper (cast to the activation dtype so
-        bf16 models stay bf16, matching the full-prefix oracle)."""
+        source mask, encoder memory, per-layer cross K/V (NOT replicated
+        per beam — _DecoderCell.step folds beams into the query axis, so
+        K/V and mask stay (B, Ts, ·)), and the position-embedding helper
+        (cast to the activation dtype so bf16 models stay bf16, matching
+        the full-prefix oracle)."""
         import jax.numpy as jnp
         from .. import autograd as ag
 
@@ -299,15 +318,8 @@ class TransformerModel(HybridBlock):
         mem = self.encode(src_ids, _mask=mask)
         cells = list(self.decoder._children.values())
         with ag.pause():
-            mem_kv = []
-            for cell in cells:
-                k, v = cell.cross_attention.project_kv(mem)
-                if beams > 1:
-                    k = NDArray(jnp.repeat(k._data, beams, axis=0))
-                    v = NDArray(jnp.repeat(v._data, beams, axis=0))
-                mem_kv.append((k, v))
-        if mask is not None and beams > 1:
-            mask = NDArray(jnp.repeat(mask._data, beams, axis=0))
+            mem_kv = [cell.cross_attention.project_kv(mem)
+                      for cell in cells]
         pos = self._pos_table
         sqrt_d = math.sqrt(self._units)
 
@@ -460,8 +472,8 @@ class TransformerModel(HybridBlock):
         from .. import autograd as ag
 
         K = beam_size
-        maskk, mem, cells, mem_kv, embed_pos = self._cached_decode_setup(
-            src_ids, max_length, src_valid, beams=K)
+        mask, mem, cells, mem_kv, embed_pos = self._cached_decode_setup(
+            src_ids, max_length, src_valid)
         B = src_ids.shape[0]
         V = self._vocab_size
         C = self._units
@@ -477,7 +489,7 @@ class TransformerModel(HybridBlock):
                 for l, cell in enumerate(cells):
                     x, ck, cv = cell.step(
                         x, NDArray(cks[l]), NDArray(cvs[l]), NDArray(t),
-                        mem_kv[l][0], mem_kv[l][1], maskk)
+                        mem_kv[l][0], mem_kv[l][1], mask)
                     new_cks.append(ck._data)
                     new_cvs.append(cv._data)
                 logits = self._project(x)._data[:, 0]       # (B*K, V)
