@@ -1,0 +1,39 @@
+"""Example-script smoke tests (reference model: the reference CI runs
+example trainings in tests/tutorials + nightly).  Each example runs as a
+user would — a fresh subprocess on CPU with tiny configs."""
+import os
+import subprocess
+import sys
+
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=240):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=ROOT)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+def test_example_sparse_linear():
+    out = _run("example/sparse/linear_classification.py", "--cpu",
+               "--dim", "500", "--samples", "300", "--epochs", "3",
+               "--batch-size", "50")
+    assert "train accuracy" in out
+
+
+def test_example_quantize_lenet():
+    out = _run("example/quantization/quantize_lenet.py", "--cpu",
+               "--epochs", "4")
+    assert "int8" in out and "agreement" in out
+
+
+def test_example_transformer_short():
+    out = _run("example/machine_translation/train_transformer.py",
+               "--cpu", "--steps", "6", "--seq-len", "8",
+               "--batch-size", "8")
+    assert "greedy reversal accuracy" in out
