@@ -68,7 +68,72 @@ def _default_suite():
                       no_bias=True),
                       [_mk((32, 64, 56, 56)), _mk((64, 64, 3, 3))]),
                   2 * 32 * 64 * 64 * 9 * 56 * 56))
+    # linalg family (round-3 extensions; matmul-class FLOPs)
+    suite.append(("linalg_gemm", (512, 512, 512),
+                  lambda: (_nd().linalg_gemm,
+                           [_mk((512, 512)), _mk((512, 512)),
+                            _mk((512, 512))]), 2 * 512 ** 3))
+    suite.append(("linalg_potrf", (512, 512),
+                  lambda: (_nd().linalg_potrf, [_spd(512)]),
+                  512 ** 3 // 3))
+    suite.append(("linalg_trsm", (512, 512),
+                  lambda: (_nd().linalg_trsm,
+                           [_tril(512), _mk((512, 256))]),
+                  512 * 512 * 256))
+    # spatial / attention-adjacent ops
+    suite.append(("BilinearSampler", (8, 16, 64, 64),
+                  lambda: (_nd().BilinearSampler,
+                           [_mk((8, 16, 64, 64)), _grid(8, 64, 64)]),
+                  None))
+    suite.append(("LRN", (8, 64, 56, 56),
+                  lambda: (_nd().LRN, [_mk((8, 64, 56, 56))]), None))
+    # attention split into its two fused stages (QK scores; value apply)
+    suite.append(("sdpa_qk_interleaved", (64, 8, 16),
+                  lambda: (lambda qkv: _contrib().
+                           interleaved_matmul_selfatt_qk(qkv, 16),
+                           [_mk((64, 8, 3 * 16 * 64))]),
+                  2 * 8 * 16 * 64 * 64 * 64))
+    suite.append(("sdpa_valatt_interleaved", (64, 8, 16),
+                  lambda: (lambda qkv, att: _contrib().
+                           interleaved_matmul_selfatt_valatt(qkv, att,
+                                                             16),
+                           [_mk((64, 8, 3 * 16 * 64)),
+                            _mk((8 * 16, 64, 64))]),
+                  2 * 8 * 16 * 64 * 64 * 64))
+    suite.append(("depth_to_space", (16, 64, 32, 32),
+                  lambda: (lambda x: _nd().depth_to_space(x, 2),
+                           [_mk((16, 64, 32, 32))]), None))
     return suite
+
+
+def _contrib():
+    from incubator_mxnet_tpu.ndarray import contrib
+    return contrib
+
+
+def _spd(n, seed=5):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    return mx.nd.array(a @ a.T + n * np.eye(n, dtype=np.float32))
+
+
+def _tril(n, seed=6):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    a = np.tril(rng.standard_normal((n, n))).astype(np.float32)
+    return mx.nd.array(a + 3 * np.eye(n, dtype=np.float32))
+
+
+def _grid(b, h, w):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    gy, gx = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+                         indexing="ij")
+    g = np.stack([gx, gy], 0)[None].astype(np.float32)
+    return mx.nd.array(np.broadcast_to(g, (b, 2, h, w)).copy())
 
 
 def _nd():
