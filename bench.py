@@ -57,20 +57,29 @@ def _peak_flops(kind):
 def _probe_backend(timeout=90):
     """Probe the default (axon TPU tunnel) backend in a SUBPROCESS so a
     hung PJRT init cannot take the bench down with it (round-1 failure
-    mode: rc=1/rc=124 and no JSON emitted)."""
+    mode: rc=1/rc=124 and no JSON emitted).  Returns (platform, kind,
+    probe_error): probe_error is None on success and otherwise records WHY
+    the accelerator was unreachable, so a CPU-fallback record is never
+    ambiguous about whether a TPU was attempted (round-3 failure mode:
+    "device": "cpu:" with no trace of the dead tunnel)."""
     code = ("import jax; d=jax.devices()[0]; "
             "print(d.platform, '|', getattr(d,'device_kind',''))")
-    for _ in range(2):
+    errs = []
+    for attempt in range(2):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 text=True, timeout=timeout)
             if out.returncode == 0 and out.stdout.strip():
                 platform, _, kind = out.stdout.strip().partition("|")
-                return platform.strip(), kind.strip()
+                return platform.strip(), kind.strip(), None
+            tail = (out.stderr or out.stdout or "").strip().splitlines()
+            errs.append(f"attempt {attempt + 1}: rc={out.returncode} "
+                        + (tail[-1][:160] if tail else "no output"))
         except subprocess.TimeoutExpired:
-            pass
-    return None, None
+            errs.append(f"attempt {attempt + 1}: probe hung >{timeout}s "
+                        "(PJRT init never returned — tunnel down?)")
+    return None, None, "; ".join(errs)[:400]
 
 
 def _model_flops_per_step(cfg, batch, seqlen):
@@ -88,19 +97,23 @@ def _model_flops_per_step(cfg, batch, seqlen):
     return matmul + attn + head
 
 
-def _bench_bert(on_accel, kind, dev):
+def _bench_bert(on_accel, kind, dev, seq_len=None, batch_ladder=None,
+                steps=None):
+    """One BERT-pretrain throughput measurement.  Defaults are the phase-1
+    anchor (seq 128); pass seq_len=512 + a smaller ladder for the phase-2
+    config."""
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import parallel
     from incubator_mxnet_tpu.models import bert as bert_mod
 
     if on_accel:
-        # the anchor config itself: BERT-large, phase-1 seq length
+        # the anchor config itself: BERT-large
         cfg = dict(vocab_size=30522, units=1024, hidden_size=4096,
                    num_layers=24, num_heads=16, max_length=512)
-        T = 128
-        batch_ladder = [32, 16, 8]
-        steps, warmup = 20, 3
+        T = seq_len or 128
+        batch_ladder = batch_ladder or [64, 32, 16, 8]
+        steps, warmup = steps or 20, 3
     else:
         cfg = dict(vocab_size=1024, units=128, hidden_size=256,
                    num_layers=2, num_heads=2, max_length=128)
@@ -326,10 +339,17 @@ def _scaling_dryrun(timeout=900):
 
 
 def main():
+    # The anchor must measure the DEFAULT config: a pre-set
+    # MXNET_USE_FUSION would silently fuse the anchor run and turn the
+    # fusion_on delta into fused/fused ~1.0.  Force-unset it; the
+    # explicit fusion_on sub-record below measures the fused config.
+    preset_fusion = os.environ.pop("MXNET_USE_FUSION", None)
+    probe_error = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         platform, kind = "cpu", ""
+        probe_error = os.environ.get("BENCH_PROBE_ERROR") or None
     else:
-        platform, kind = _probe_backend()
+        platform, kind, probe_error = _probe_backend()
     on_accel = platform not in (None, "cpu")
 
     import jax
@@ -353,7 +373,9 @@ def main():
                 [sys.executable, os.path.abspath(__file__)],
                 capture_output=True, text=True, timeout=1800,
                 env={**os.environ, "JAX_PLATFORMS": "cpu",
-                     "BENCH_FORCE_CPU": "1"})
+                     "BENCH_FORCE_CPU": "1",
+                     "BENCH_PROBE_ERROR":
+                         f"accel reached then died mid-run: {accel_error}"})
             line = out.stdout.strip().splitlines()[-1] \
                 if out.stdout.strip() else "{}"
             rec = json.loads(line)
@@ -364,6 +386,32 @@ def main():
         rec["accel_error"] = accel_error
         print(json.dumps(rec))
         return
+
+    phase2 = fusion = None
+    if on_accel:
+        # phase-2 (seq 512) + fusion-on delta at the phase-1 batch: these
+        # are secondary records — a failure must not cost the anchor
+        try:
+            s2, b2, t2, mfu2 = _bench_bert(
+                on_accel, kind, dev, seq_len=512,
+                batch_ladder=[16, 8, 4], steps=10)
+            phase2 = {"samples_per_sec": round(s2, 2), "batch_size": b2,
+                      "seq_len": t2,
+                      "mfu": round(mfu2, 4) if mfu2 is not None else None}
+        except Exception as e:
+            phase2 = {"error": str(e)[:200]}
+        try:
+            os.environ["MXNET_USE_FUSION"] = "1"
+            sf, bf, _, mfuf = _bench_bert(
+                on_accel, kind, dev, batch_ladder=[B_used], steps=10)
+            fusion = {
+                "samples_per_sec": round(sf, 2), "batch_size": bf,
+                "mfu": round(mfuf, 4) if mfuf is not None else None,
+                "speedup_vs_xla": round(sf / samples_per_sec, 3)}
+        except Exception as e:
+            fusion = {"error": str(e)[:200]}
+        finally:
+            os.environ.pop("MXNET_USE_FUSION", None)
 
     try:
         resnet = _bench_resnet50(on_accel, kind, dev)
@@ -389,6 +437,16 @@ def main():
         "resnet50": resnet,
         "dp_scaling": scaling,
     }
+    if probe_error:
+        out["probe_error"] = probe_error
+    if phase2 is not None:
+        out["phase2_seq512"] = phase2
+    if fusion is not None:
+        out["fusion_on"] = fusion
+    if preset_fusion is not None:
+        out["note"] = ("MXNET_USE_FUSION was pre-set in the env and "
+                       "ignored: the anchor always measures the default "
+                       "XLA path; see fusion_on for the fused config")
     print(json.dumps(out))
 
 

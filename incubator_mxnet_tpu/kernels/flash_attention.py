@@ -11,8 +11,12 @@ Scope/contract:
 * forward-only Pallas; the backward recomputes attention under XLA via a
   ``jax.custom_vjp`` (correct gradients, standard-memory backward — the
   usual first deployment step for custom kernels);
-* dense (non-causal or causal) attention, no additive mask — callers with
-  masks use the XLA path;
+* dense (non-causal or causal) attention, with an optional (B, Tk) 0/1
+  key-validity mask (the shape every padded BERT batch carries as
+  ``valid_length``) applied as an additive -1e30 bias streamed through
+  VMEM per K block; rows must keep >= 1 valid key (valid_length >= 1),
+  same contract as the XLA path.  Arbitrary (Tq, Tk) score masks are NOT
+  supported — those callers use the XLA path;
 * K/V for one (batch, head) stay VMEM-resident and are block-streamed
   from there, so the (T, T) score matrix never exists but T is bounded
   by the VMEM budget (~8MB for K+V).  Longer sequences fall back to XLA
@@ -37,10 +41,12 @@ _BLOCK_Q = 128
 _BLOCK_K = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
+                seq_len, has_bias):
     from jax.experimental import pallas as pl
 
+    b_ref = rest[0] if has_bias else None
+    o_ref = rest[-1]
     q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
     block_q = q.shape[0]
     qi = pl.program_id(1)
@@ -52,6 +58,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_bias:
+            # (1, block_k) additive key bias (0 valid / -1e30 masked),
+            # broadcast over the query rows
+            s = s + b_ref[0, :, pl.ds(j * block_k, block_k)]
         if causal:
             iq = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -84,9 +94,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _xla_attention(q, k, v, scale, causal):
+def _xla_attention(q, k, v, scale, causal, bias=None):
+    """(BH, T, D) reference path; ``bias`` is an optional (BH, 1, Tk)
+    additive score bias (0 valid / -1e30 masked)."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias
     if causal:
         T = q.shape[1]
         iq = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
@@ -97,12 +111,10 @@ def _xla_attention(q, k, v, scale, causal):
         q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, interpret):
-    return _flash_fwd_impl(q, k, v, scale, causal, interpret)
-
-
-def _flash_fwd_impl(q, k, v, scale, causal, interpret):
+def _flash_fwd_impl(q, k, v, bias, scale, causal, interpret, n_heads):
+    """``bias``: None, or a (B, 1, Tk) float32 additive key bias shared by
+    the batch's ``n_heads`` grid rows (indexed bh -> bh // n_heads, so the
+    per-head copies never materialize in HBM)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -110,52 +122,90 @@ def _flash_fwd_impl(q, k, v, scale, causal, interpret):
     block_q = min(_BLOCK_Q, T)
     block_k = min(_BLOCK_K, T)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_len=T)
+                               block_k=block_k, seq_len=T,
+                               has_bias=bias is not None)
     grid = (BH, T // block_q)
     spec_q = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
                           memory_space=pltpu.VMEM)
     spec_kv = pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0),
                            memory_space=pltpu.VMEM)
+    in_specs = [spec_q, spec_kv, spec_kv]
+    operands = [q, k, v]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, T), lambda bh, qi: (bh // n_heads, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(bias)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         grid=grid,
-        in_specs=[spec_q, spec_kv, spec_kv],
+        in_specs=in_specs,
         out_specs=spec_q,
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
-def _flash_fwd(q, k, v, scale, causal, interpret):
-    return _flash_fwd_impl(q, k, v, scale, causal, interpret), (q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, causal, interpret, n_heads):
+    """One custom_vjp covers both paths: ``bias`` is None (dense) or the
+    (B, 1, Tk) additive key bias (None is an empty pytree to JAX, so the
+    masked/unmasked cases share this plumbing)."""
+    return _flash_fwd_impl(q, k, v, bias, scale, causal, interpret,
+                           n_heads)
 
 
-def _flash_bwd(scale, causal, interpret, res, g):
+def _flash_fwd(q, k, v, bias, scale, causal, interpret, n_heads):
+    out = _flash(q, k, v, bias, scale, causal, interpret, n_heads)
+    return out, (q, k, v, bias)
+
+
+def _flash_bwd(scale, causal, interpret, n_heads, res, g):
     # backward by recomputation under XLA: same math, standard memory
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
-        q_, k_, v_, scale, causal), q, k, v)
+    q, k, v, bias = res
+    BH = q.shape[0]
+    if bias is None:
+        _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
+            q_, k_, v_, scale, causal), q, k, v)
+        return vjp(g) + (None,)
+    # broadcast the (B, 1, Tk) bias to the (BH, 1, Tk) the reference path
+    # wants, summing the head axis back out of its cotangent
+    def ref(q_, k_, v_, b_):
+        bb = jnp.broadcast_to(
+            b_[:, None], (b_.shape[0], n_heads) + b_.shape[1:]).reshape(
+                (BH,) + b_.shape[1:])
+        return _xla_attention(q_, k_, v_, scale, causal, bias=bb)
+    _, vjp = jax.vjp(ref, q, k, v, bias)
     return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False):
+def flash_attention(q, k, v, scale=None, causal=False, mask=None):
     """Online-softmax attention over (B, H, T, D) jax arrays.
 
-    Falls back to the XLA implementation when shapes don't fit the kernel
-    contract (T not divisible by the block size)."""
+    ``mask``: optional (B, Tk) key-validity array (nonzero = attend), the
+    ``valid_length``-derived mask every padded batch carries; rows must
+    keep >= 1 valid key.  Falls back to the XLA implementation when shapes
+    don't fit the kernel contract (T not divisible by the block size)."""
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
+    bias = None
+    if mask is not None:
+        bias = jnp.where(mask > 0, 0.0, -1e30).astype(
+            jnp.float32).reshape(B, 1, T)
     kv_bytes = 2 * T * D * q.dtype.itemsize
     if T % _BLOCK_Q or kv_bytes > 8 * 2 ** 20:
         # not tile-aligned, or K+V would blow the VMEM budget: XLA path
+        bb = None if bias is None else jnp.broadcast_to(
+            bias[:, None], (B, H, 1, T)).reshape(B * H, 1, T)
         return _xla_attention(
             q.reshape(B * H, T, D), k.reshape(B * H, T, D),
-            v.reshape(B * H, T, D), scale, causal).reshape(B, H, T, D)
+            v.reshape(B * H, T, D), scale, causal,
+            bias=bb).reshape(B, H, T, D)
     interpret = jax.default_backend() == "cpu"
-    out = _flash(q.reshape(B * H, T, D), k.reshape(B * H, T, D),
-                 v.reshape(B * H, T, D), scale, causal, interpret)
+    qf, kf, vf = (x.reshape(B * H, T, D) for x in (q, k, v))
+    out = _flash(qf, kf, vf, bias, scale, causal, interpret, H)
     return out.reshape(B, H, T, D)

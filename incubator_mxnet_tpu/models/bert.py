@@ -94,15 +94,18 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
                     "'ulysses'")
         else:
             from ..base import getenv_bool
-            if (fuse_ok and not rest and qh.shape == kh.shape
+            if (fuse_ok and qh.shape == kh.shape
                     and getenv_bool("MXNET_USE_FUSION")):
                 # Pallas flash-attention kernel (reference env-var parity:
                 # MXNET_USE_FUSION gates the fused-kernel tier,
                 # src/operator/fusion/fused_op.cc); opt-in until the
-                # kernel is profiled on the real chip
+                # kernel is profiled on the real chip.  The (B, Tk)
+                # key-validity mask rides through the kernel as an
+                # additive bias, so padded batches stay on the fused path.
                 from ..kernels import flash_attention
                 out = flash_attention(qh, kh, vh, scale=scale,
-                                      causal=causal)
+                                      causal=causal,
+                                      mask=rest[0] if rest else None)
             else:
                 s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
                 if causal:

@@ -6,14 +6,16 @@ import pytest
 import incubator_mxnet_tpu as mx  # noqa: F401  (jax config via conftest)
 
 
-def _ref(q, k, v, causal=False):
+def _ref(q, k, v, causal=False, mask=None):
     import jax.numpy as jnp
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
     if causal:
         T = q.shape[2]
-        mask = np.tril(np.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
+        tri = np.tril(np.ones((T, T), bool))
+        s = jnp.where(tri[None, None], s, -1e30)
     import jax
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
@@ -67,6 +69,98 @@ def test_flash_gradients():
     for gf, gr in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["dense", "causal"])
+def test_flash_masked_matches_xla(causal):
+    """(B, Tk) key-validity mask (padded-batch valid_length shape) through
+    the kernel's additive-bias path vs the XLA reference."""
+    from incubator_mxnet_tpu.kernels import flash_attention
+    T = 128
+    q = _rand((3, 2, T, 64), 10)
+    k = _rand((3, 2, T, 64), 11)
+    v = _rand((3, 2, T, 64), 12)
+    # ragged valid lengths incl. one full-length row
+    mask = np.zeros((3, T), np.int32)
+    for b, vl in enumerate([37, T, 90]):
+        mask[b, :vl] = 1
+    out = flash_attention(q, k, v, causal=causal, mask=mask)
+    ref = _ref(q, k, v, causal=causal, mask=mask)
+    # compare only valid query rows: padded rows attend to garbage by
+    # construction in both impls but are masked out downstream
+    out, ref = np.asarray(out), np.asarray(ref)
+    for b, vl in enumerate([37, T, 90]):
+        np.testing.assert_allclose(out[b, :, :vl], ref[b, :, :vl],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_masked_gradients():
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.kernels import flash_attention
+    T = 128
+    q = _rand((2, 2, T, 32), 13)
+    k = _rand((2, 2, T, 32), 14)
+    v = _rand((2, 2, T, 32), 15)
+    mask = np.zeros((2, T), np.int32)
+    mask[0, :50] = 1
+    mask[1, :] = 1
+    # weight the loss by the valid-query mask so padded rows don't
+    # contribute garbage gradients in either impl
+    wq = mask[:, None, :, None].astype(np.float32)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum((flash_attention(q_, k_, v_, mask=mask) * wq) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum((_ref(q_, k_, v_, mask=mask) * wq) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_masked_fallback_odd_seq():
+    """Masked XLA fallback (odd T) matches the reference too."""
+    from incubator_mxnet_tpu.kernels import flash_attention
+    T = 100
+    q = _rand((2, 2, T, 32), 16)
+    mask = np.zeros((2, T), np.int32)
+    mask[0, :60] = 1
+    mask[1, :] = 1
+    out = np.asarray(flash_attention(q, q, q, mask=mask))
+    ref = np.asarray(_ref(q, q, q, mask=mask))
+    for b, vl in enumerate([60, T]):
+        np.testing.assert_allclose(out[b, :, :vl], ref[b, :, :vl],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_sdpa_fusion_gate_masked(monkeypatch):
+    """MXNET_USE_FUSION=1 routes the model-level SDPA (with a padded
+    valid_length mask) through the Pallas kernel and matches the XLA
+    path — the every-real-batch case VERDICT r03 flagged as falling back."""
+    from incubator_mxnet_tpu.models.bert import _sdpa
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    B, T, C, H = 2, 128, 64, 2
+    rng = np.random.default_rng(20)
+    q = NDArray(jnp.asarray(rng.standard_normal((B, T, C)), jnp.float32))
+    k = NDArray(jnp.asarray(rng.standard_normal((B, T, C)), jnp.float32))
+    v = NDArray(jnp.asarray(rng.standard_normal((B, T, C)), jnp.float32))
+    m = np.zeros((B, T), np.int32)
+    m[0, :77] = 1
+    m[1, :] = 1
+    mask = NDArray(jnp.asarray(m))
+
+    monkeypatch.delenv("MXNET_USE_FUSION", raising=False)
+    base = _sdpa(q, k, v, H, mask=mask).asnumpy()
+    monkeypatch.setenv("MXNET_USE_FUSION", "1")
+    fused = _sdpa(q, k, v, H, mask=mask).asnumpy()
+    np.testing.assert_allclose(fused[0, :77], base[0, :77],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(fused[1], base[1], rtol=2e-4, atol=2e-5)
 
 
 def test_flash_under_jit():
