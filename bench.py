@@ -339,11 +339,14 @@ def _scaling_dryrun(timeout=900):
 
 
 def main():
-    # The anchor must measure the DEFAULT config: a pre-set
-    # MXNET_USE_FUSION would silently fuse the anchor run and turn the
-    # fusion_on delta into fused/fused ~1.0.  Force-unset it; the
-    # explicit fusion_on sub-record below measures the fused config.
-    preset_fusion = os.environ.pop("MXNET_USE_FUSION", None)
+    # The anchor must measure the DEFAULT config: a pre-set fusion flag
+    # (either spelling — base.getenv gives MXTPU_* precedence) would
+    # silently fuse the anchor run and turn the fusion_on delta into
+    # fused/fused ~1.0.  Force-unset both; the explicit fusion_on
+    # sub-record below measures the fused config.
+    preset_fusion = (os.environ.pop("MXNET_USE_FUSION", None)
+                     or os.environ.pop("MXTPU_USE_FUSION", None))
+    os.environ.pop("MXTPU_USE_FUSION", None)
     probe_error = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         platform, kind = "cpu", ""
