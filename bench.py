@@ -344,9 +344,11 @@ def main():
     # silently fuse the anchor run and turn the fusion_on delta into
     # fused/fused ~1.0.  Force-unset both; the explicit fusion_on
     # sub-record below measures the fused config.
-    preset_fusion = (os.environ.pop("MXNET_USE_FUSION", None)
-                     or os.environ.pop("MXTPU_USE_FUSION", None))
-    os.environ.pop("MXTPU_USE_FUSION", None)
+    _preset = {k: os.environ.pop(k) for k in
+               ("MXNET_USE_FUSION", "MXTPU_USE_FUSION")
+               if k in os.environ}
+    preset_fusion = ", ".join(f"{k}={v}" for k, v in _preset.items()) \
+        or None
     probe_error = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         platform, kind = "cpu", ""
@@ -447,9 +449,9 @@ def main():
     if fusion is not None:
         out["fusion_on"] = fusion
     if preset_fusion is not None:
-        out["note"] = ("MXNET_USE_FUSION was pre-set in the env and "
-                       "ignored: the anchor always measures the default "
-                       "XLA path; see fusion_on for the fused config")
+        out["note"] = (f"pre-set fusion flag ignored ({preset_fusion}): "
+                       "the anchor always measures the default XLA path; "
+                       "see fusion_on for the fused config")
     print(json.dumps(out))
 
 

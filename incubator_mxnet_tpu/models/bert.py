@@ -18,6 +18,7 @@ TPU-first design choices:
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import math
 
 import numpy as _np
@@ -31,7 +32,7 @@ from ..ndarray.ndarray import NDArray, _invoke
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "BERTEncoder", "BERTModel", "BERTForPretrain", "MLMPretrainLoss",
            "BERTMLMOnly", "bert_tiny", "bert_base", "bert_large",
-           "tp_rules"]
+           "tp_rules", "dense_attention"]
 
 
 def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
@@ -156,6 +157,27 @@ class MultiHeadAttention(HybridBlock):
         """Precompute this head's K/V projections of an encoder memory —
         the cross-attention half of a KV cache (incremental decoding)."""
         return self.key(mem), self.value(mem)
+
+
+@_contextlib.contextmanager
+def dense_attention(net):
+    """Temporarily run every attention cell of ``net`` on the dense
+    (non-sequence-parallel) path.  Needed when a seq-parallel model must
+    do a one-off eager forward on a single device — e.g. settling
+    deferred parameter shapes before an SPMDTrainer builds — where the
+    shard_map path cannot execute.  Shapes do not depend on the
+    schedule, so the settled state is identical."""
+    cells = []
+    net.apply(lambda b: cells.append(b)
+              if isinstance(b, MultiHeadAttention) else None)
+    saved = [(c, c._seq_axis) for c in cells]
+    try:
+        for c in cells:
+            c._seq_axis = None
+        yield net
+    finally:
+        for c, s in saved:
+            c._seq_axis = s
 
 
 class PositionwiseFFN(HybridBlock):

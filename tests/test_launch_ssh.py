@@ -1,0 +1,100 @@
+"""--launcher ssh: 3-process DCN sum through the full ssh code path
+(reference: dmlc-core tracker/dmlc_tracker/ssh.py run against localhost).
+
+No ssh client exists in this image, so the test injects a shim via
+--ssh-cmd that executes the launcher-built remote command locally —
+everything the ssh launcher is responsible for (env-contract export
+string, quoting, cwd hop, rank/host assignment) still runs for real;
+only the transport is faked.  The degrade path (no ssh on PATH) is
+asserted separately."""
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SHIM = """\
+#!/usr/bin/env python3
+# fake-ssh: argv = [host, remote_command]; run the command locally the
+# way sshd would (login shell -c) after recording the host it was for.
+import subprocess, sys
+host, cmd = sys.argv[-2], sys.argv[-1]
+sys.stderr.write(f"[fake-ssh] host={host}\\n")
+sys.exit(subprocess.call(["/bin/sh", "-c", cmd]))
+"""
+
+
+def _write_shim(tmp_path):
+    shim = tmp_path / "fake-ssh"
+    shim.write_text(_SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+    return str(shim)
+
+
+@pytest.mark.timeout(600)
+def test_three_process_ssh_launcher(tmp_path):
+    """3 workers round-robined over a 2-host hostfile, full DCN kvstore
+    sum + SPMDTrainer oracle in every worker."""
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("# comment line\nlocalhost\n\n127.0.0.1\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "3", "--launcher", "ssh", "-H", str(hostfile),
+         "--ssh-cmd", _write_shim(tmp_path), "--host", "127.0.0.1", "--",
+         sys.executable, os.path.join(_REPO, "tests",
+                                      "distributed_worker.py")],
+        capture_output=True, text=True, timeout=540, env=env, cwd=_REPO)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    for r in range(3):
+        assert f"WORKER-{r}-OK" in out.stdout
+    # ranks were round-robined over both hostfile entries
+    assert "[fake-ssh] host=localhost" in out.stderr
+    assert "[fake-ssh] host=127.0.0.1" in out.stderr
+
+
+def test_ssh_launcher_degrades_without_client(tmp_path):
+    """No ssh client on PATH -> a clear actionable error, not a hang."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "--hosts", "a,b",
+         "--ssh-cmd", "definitely-not-a-real-ssh", "--",
+         "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0
+    assert "not found on PATH" in out.stderr
+
+
+def test_mpi_launcher_degrades(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi", "--", "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0
+    assert "mpi" in out.stderr and "ssh" in out.stderr
+
+
+def test_ssh_remote_command_contract():
+    """The export string reproduces the DMLC contract with safe quoting."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    env = {"DMLC_PS_ROOT_URI": "10.0.0.1", "DMLC_WORKER_ID": "1",
+           "PYTHONPATH": "/path with space:/b", "HOME": "/root",
+           "MXNET_SP_IMPL": "ring"}
+    cmd = launch._remote_command(env, ["python", "train.py", "--lr=0.1 x"],
+                                 "/work dir")
+    assert "export DMLC_PS_ROOT_URI=10.0.0.1" in cmd
+    assert "export PYTHONPATH='/path with space:/b'" in cmd
+    assert "export MXNET_SP_IMPL=ring" in cmd
+    assert "HOME" not in cmd                  # only the passthrough set
+    assert "cd '/work dir'" in cmd
+    assert cmd.endswith("exec python train.py '--lr=0.1 x'")
