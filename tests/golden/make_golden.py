@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Generate the golden .params fixtures from the DOCUMENTED reference
+byte format only — struct/numpy/json, deliberately ZERO imports from
+incubator_mxnet_tpu — so tests/test_golden.py proves the package's
+reader/writer against an independent assembly of the format, not against
+itself.  (Reference format spec: src/c_api/c_api.cc MXNDArraySave — list
+magic 0x112; src/ndarray/ndarray.cc NDArray::Save — V2 magic 0xF993FAC9,
+int32 stype, int32 ndim + int64 dims, int32 dev_type/dev_id, int32
+mshadow type flag, raw buffer; V1 magic 0xF993FAC8 drops the stype; the
+pre-V1 legacy layout stored ndim where the magic now lives with uint32
+dims.)
+
+Run from this directory:  python make_golden.py
+The committed binaries are what the day-one interop diff will be taken
+against when genuine reference artifacts become available (VERDICT r03
+item 6 — the mount has been empty every round so far).
+"""
+import json
+import struct
+
+import numpy as np
+
+LIST_MAGIC = 0x112
+V1 = 0xF993FAC8
+V2 = 0xF993FAC9
+
+# mshadow flags: fp32 0, fp64 1, fp16 2, uint8 3, int32 4, int8 5, int64 6
+FLAG = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+        np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+        np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+        np.dtype(np.int64): 6}
+
+
+def v2_chunk(a):
+    b = struct.pack("<I", V2)
+    b += struct.pack("<i", 0)                       # stype: dense
+    b += struct.pack("<i", a.ndim)
+    b += struct.pack(f"<{a.ndim}q", *a.shape)
+    b += struct.pack("<ii", 1, 0)                   # Context cpu(0)
+    b += struct.pack("<i", FLAG[a.dtype])
+    return b + a.tobytes()
+
+
+def v1_chunk(a):
+    b = struct.pack("<I", V1)
+    b += struct.pack("<i", a.ndim)
+    b += struct.pack(f"<{a.ndim}q", *a.shape)
+    b += struct.pack("<ii", 1, 0)
+    b += struct.pack("<i", FLAG[a.dtype])
+    return b + a.tobytes()
+
+
+def v0_chunk(a):
+    b = struct.pack("<I", a.ndim)                   # legacy: ndim as magic
+    b += struct.pack(f"<{a.ndim}I", *a.shape)       # uint32 dims
+    b += struct.pack("<ii", 1, 0)
+    b += struct.pack("<i", FLAG[a.dtype])
+    return b + a.tobytes()
+
+
+def file_bytes(chunks, names):
+    b = struct.pack("<QQ", LIST_MAGIC, 0)
+    b += struct.pack("<Q", len(chunks))
+    b += b"".join(chunks)
+    b += struct.pack("<Q", len(names))
+    for n in names:
+        e = n.encode("utf-8")
+        b += struct.pack("<Q", len(e)) + e
+    return b
+
+
+def arrays_v2():
+    """Insertion order matters: the byte-exact writer test depends on it.
+    Dtypes deliberately exclude int64/float64: JAX holds arrays in 32-bit
+    by default (jax_enable_x64 off), so those chunks load value-truncated
+    — the V0 float64 fixture documents that caveat; real checkpoints are
+    fp32/fp16 weights."""
+    return {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.array([0.5, 1.5, 2.5, 3.5], np.float16),
+        "idx": np.array([[1, -2], [3, -4]], np.int32),
+        "small": np.array([-3, 7], np.int8),
+        "bytes": np.array([0, 127, 255], np.uint8),
+    }
+
+
+def main():
+    d = arrays_v2()
+    with open("list_v2.params", "wb") as f:
+        f.write(file_bytes([v2_chunk(a) for a in d.values()],
+                           list(d.keys())))
+
+    with open("list_v1.params", "wb") as f:
+        f.write(file_bytes([v1_chunk(np.array([1.0, 2.0, 3.0],
+                                              np.float32))], []))
+
+    with open("list_v0.params", "wb") as f:
+        f.write(file_bytes([v0_chunk(np.array([[1.25, -2.5],
+                                               [3.75, 4.0]],
+                                              np.float64))], []))
+
+    # module-style checkpoint: arg:/aux: prefixes (reference:
+    # python/mxnet/model.py save_checkpoint naming)
+    ck = {
+        "arg:fc_weight": np.linspace(-1, 1, 8, dtype=np.float32
+                                     ).reshape(2, 4),
+        "arg:fc_bias": np.array([0.1, -0.2], np.float32),
+        "aux:bn_mean": np.array([5.0, 6.0], np.float32),
+    }
+    with open("ckpt-0007.params", "wb") as f:
+        f.write(file_bytes([v2_chunk(a) for a in ck.values()],
+                           list(ck.keys())))
+
+    # matching nnvm -symbol.json (schema: nodes/arg_nodes/node_row_ptr/
+    # heads; reference: nnvm graph.cc SaveJSON)
+    sym = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": []},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "attrs": {"num_hidden": "2"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "node_row_ptr": [0, 1, 2, 3, 4],
+        "heads": [[3, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+    with open("ckpt-symbol.json", "w") as f:
+        json.dump(sym, f, indent=2)
+    print("golden fixtures written")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Golden-fixture interop proofs (VERDICT r03 item 6).
+
+The fixtures in tests/golden/ are assembled by make_golden.py from the
+DOCUMENTED reference byte format with zero package imports, so these
+tests prove mx.nd.save/load against an independent encoding of the
+format — not merely against themselves.  When genuine reference
+artifacts appear, the interop diff is: load theirs, byte-compare ours.
+Reference format: src/ndarray/ndarray.cc NDArray::Save/Load,
+src/c_api/c_api.cc MXNDArraySave; checkpoint naming:
+python/mxnet/model.py save_checkpoint."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+_GOLD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_golden_v2_loads_exact():
+    d = mx.nd.load(os.path.join(_GOLD, "list_v2.params"))
+    assert list(d.keys()) == ["w", "b", "idx", "small", "bytes"]
+    np.testing.assert_array_equal(
+        d["w"].asnumpy(), np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert d["w"].dtype == np.float32
+    np.testing.assert_array_equal(
+        d["b"].asnumpy(), np.array([0.5, 1.5, 2.5, 3.5], np.float16))
+    assert d["b"].dtype == np.float16
+    np.testing.assert_array_equal(
+        d["idx"].asnumpy(), np.array([[1, -2], [3, -4]], np.int32))
+    np.testing.assert_array_equal(
+        d["small"].asnumpy(), np.array([-3, 7], np.int8))
+    np.testing.assert_array_equal(
+        d["bytes"].asnumpy(), np.array([0, 127, 255], np.uint8))
+
+
+def test_golden_v1_and_v0_load():
+    (a,) = mx.nd.load(os.path.join(_GOLD, "list_v1.params"))
+    np.testing.assert_array_equal(a.asnumpy(),
+                                  np.array([1, 2, 3], np.float32))
+    # float64 chunk: bytes decode correctly; the in-framework array is
+    # held at float32 (JAX x64 off) — values here are fp32-exact
+    (b,) = mx.nd.load(os.path.join(_GOLD, "list_v0.params"))
+    np.testing.assert_array_equal(
+        b.asnumpy(), np.array([[1.25, -2.5], [3.75, 4.0]], np.float32))
+
+
+def test_writer_byte_exact_vs_golden(tmp_path):
+    """mx.nd.save must reproduce the independently-assembled bytes
+    EXACTLY — the strongest interop claim available without real
+    reference artifacts."""
+    sys.path.insert(0, _GOLD)
+    try:
+        import make_golden
+    finally:
+        sys.path.pop(0)
+    d = {k: mx.nd.array(v, dtype=v.dtype)
+         for k, v in make_golden.arrays_v2().items()}
+    out = tmp_path / "roundtrip.params"
+    mx.nd.save(str(out), d)
+    with open(os.path.join(_GOLD, "list_v2.params"), "rb") as f:
+        golden = f.read()
+    assert out.read_bytes() == golden
+
+
+def test_checkpoint_golden_load_and_bind():
+    """load_checkpoint on the golden module checkpoint: prefixes split,
+    symbol JSON parses, and the bound executor computes the forward."""
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        os.path.join(_GOLD, "ckpt"), 7)
+    assert set(arg_params) == {"fc_weight", "fc_bias"}
+    assert set(aux_params) == {"bn_mean"}
+    W = arg_params["fc_weight"].asnumpy()
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    ex = sym.bind(args={"data": mx.nd.array(x),
+                        "fc_weight": arg_params["fc_weight"],
+                        "fc_bias": arg_params["fc_bias"]})
+    (out,) = ex.forward()
+    np.testing.assert_allclose(
+        out.asnumpy(), x @ W.T + arg_params["fc_bias"].asnumpy(),
+        rtol=1e-6)
+
+
+def test_import_params_cli(tmp_path):
+    """tools/import_params.py: reference checkpoint -> gluon layout,
+    loadable by a gluon net through the documented rename flags."""
+    dst = tmp_path / "imported.params"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "import_params.py"),
+         os.path.join(_GOLD, "ckpt-0007.params"), str(dst),
+         "--map", "fc_weight=dense.weight",
+         "--map", "fc_bias=dense.bias",
+         "--map", "bn_mean=ignored_stat"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": _REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    assert out.returncode == 0, out.stderr[-2000:]
+    loaded = mx.nd.load(str(dst))
+    assert set(loaded) == {"dense.weight", "dense.bias", "ignored_stat"}
+
+    from incubator_mxnet_tpu.gluon import nn
+
+    class Wrap(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = nn.Dense(2, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            return self.dense(x)
+
+    w = Wrap()
+    w.initialize()
+    w(mx.nd.ones((1, 4)))
+    w.load_parameters(str(dst), ignore_extra=True)
+    np.testing.assert_allclose(
+        w.dense.weight.data().asnumpy(),
+        np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4))
+
+
+def test_import_params_collision_refused(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import import_params
+    finally:
+        sys.path.pop(0)
+    with pytest.raises(SystemExit, match="collision"):
+        import_params.convert({"arg:a": 1, "aux:b": 2},
+                              maps=[("a", "x"), ("b", "x")])

@@ -40,6 +40,18 @@ import shutil
 import socket
 import subprocess
 import sys
+import time
+
+
+def _worker_env(host, port, num_workers, rank):
+    """The DMLC env contract one worker sees (reference: dmlc_tracker)."""
+    env = dict(os.environ)
+    env["DMLC_PS_ROOT_URI"] = host
+    env["DMLC_PS_ROOT_PORT"] = str(port)
+    env["DMLC_NUM_WORKER"] = str(num_workers)
+    env["DMLC_WORKER_ID"] = str(rank)
+    env["DMLC_ROLE"] = "worker"
+    return env
 
 
 def _free_port():
@@ -105,8 +117,8 @@ def main():
                          "(options are split shell-style)")
     ap.add_argument("--host", default=None,
                     help="coordinator address workers dial; defaults to "
-                         "127.0.0.1 (local) or this machine's primary "
-                         "address (ssh)")
+                         "127.0.0.1 (local) or the FIRST hostfile entry "
+                         "(ssh — rank 0 runs there)")
     ap.add_argument("--port", type=int, default=0,
                     help="coordinator port (0 = pick a free one)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -151,36 +163,43 @@ def main():
                   "will not reach it; pass --host", file=sys.stderr)
         cwd = os.getcwd()
         for rank in range(args.num_workers):
-            env = dict(os.environ)
-            env["DMLC_PS_ROOT_URI"] = host
-            env["DMLC_PS_ROOT_PORT"] = str(port)
-            env["DMLC_NUM_WORKER"] = str(args.num_workers)
-            env["DMLC_WORKER_ID"] = str(rank)
-            env["DMLC_ROLE"] = "worker"
+            env = _worker_env(host, port, args.num_workers, rank)
             target = hosts[rank % len(hosts)]
             remote = _remote_command(env, args.command, cwd)
             procs.append(subprocess.Popen(ssh_argv + [target, remote]))
     else:   # local
         host = args.host or "127.0.0.1"
         for rank in range(args.num_workers):
-            env = dict(os.environ)
-            env["DMLC_PS_ROOT_URI"] = host
-            env["DMLC_PS_ROOT_PORT"] = str(port)
-            env["DMLC_NUM_WORKER"] = str(args.num_workers)
-            env["DMLC_WORKER_ID"] = str(rank)
-            env["DMLC_ROLE"] = "worker"
+            env = _worker_env(host, port, args.num_workers, rank)
             procs.append(subprocess.Popen(args.command, env=env))
 
+    # supervise ALL workers at once: a crash in any rank while the others
+    # block in collectives must tear the job down, not hang the launcher
+    # behind an in-order wait
     rc = 0
-    for rank, p in enumerate(procs):
-        r = p.wait()
-        if r != 0:
-            print(f"[launch] worker {rank} exited rc={r}", file=sys.stderr)
-            rc = rc or r
-    if rc:  # one failed: don't leave the rest hanging on collectives
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+    live = dict(enumerate(procs))
+    while live:
+        for rank in list(live):
+            r = live[rank].poll()
+            if r is None:
+                continue
+            del live[rank]
+            if r != 0:
+                print(f"[launch] worker {rank} exited rc={r}",
+                      file=sys.stderr)
+                rc = rc or r
+        if rc:   # one failed: kill the rest
+            for p in live.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p in live.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            break
+        if live:
+            time.sleep(0.2)
     sys.exit(rc)
 
 
