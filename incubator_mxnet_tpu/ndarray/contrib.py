@@ -36,7 +36,8 @@ __all__ = ["box_iou", "box_nms", "bipartite_matching", "MultiBoxPrior",
            "arange_like", "index_array", "index_copy", "boolean_mask",
            "quadratic", "getnnz", "allclose", "CTCLoss", "ctc_loss",
            "fft", "ifft", "interleaved_matmul_selfatt_qk",
-           "interleaved_matmul_selfatt_valatt"]
+           "interleaved_matmul_selfatt_valatt", "count_sketch",
+           "PSROIPooling", "psroipooling"]
 
 
 def _jnp():
@@ -414,22 +415,64 @@ def ROIAlign(data, rois, pooled_size, spatial_scale, sample_ratio=-1,
     return _invoke(run, [data, rois], name="ROIAlign")
 
 
-def BilinearResize2D(data, height=None, width=None, scale_height=None,
-                     scale_width=None, mode="size", align_corners=True):
-    """Bilinear resize (reference: bilinear_resize.cc).  Only
-    ``mode='size'`` (explicit height/width or scale factors) is
-    implemented; the parity modes ('odd_scale', 'like', 'to_even_*',
-    'to_odd_*') raise rather than silently mis-resize."""
-    if mode != "size":
-        raise MXNetError(f"BilinearResize2D: mode={mode!r} is not "
-                         "implemented in this build (only 'size')")
+_BRS2D_MODES = ("size", "odd_scale", "like", "to_even_down", "to_even_up",
+                "to_odd_down", "to_odd_up")
+
+
+def BilinearResize2D(data, like=None, height=None, width=None,
+                     scale_height=None, scale_width=None, mode="size",
+                     align_corners=True):
+    """Bilinear resize (reference: src/operator/contrib/
+    bilinear_resize.cc).  ``mode`` selects how the output size derives
+    from the input's (H, W):
+
+    * ``size``        — explicit ``height``/``width`` (or scale factors);
+    * ``odd_scale``   — scale then force odd: even dims give
+      ``d*scale + 1``, odd dims ``(d-1)*scale + 1``;
+    * ``like``        — match the spatial size of the second input;
+    * ``to_even_down``/``to_even_up``/``to_odd_down``/``to_odd_up`` —
+      nearest even/odd dimension below/above (no scaling).
+    """
+    if mode not in _BRS2D_MODES:
+        raise MXNetError(f"BilinearResize2D: unknown mode={mode!r} "
+                         f"(choose from {_BRS2D_MODES})")
+    if mode == "like" and like is None:
+        raise MXNetError("BilinearResize2D: mode='like' needs a second "
+                         "input to take the target size from")
+    if mode == "odd_scale" and not (scale_height and scale_width):
+        raise MXNetError("BilinearResize2D: mode='odd_scale' needs "
+                         "scale_height and scale_width")
+
+    def _target(H, W, like_shape):
+        if mode == "size":
+            h = int(height) if height \
+                else int(round(H * (scale_height or 1)))
+            w = int(width) if width \
+                else int(round(W * (scale_width or 1)))
+        elif mode == "odd_scale":
+            h = (int(H * scale_height) + 1 if H % 2 == 0
+                 else int((H - 1) * scale_height) + 1)
+            w = (int(W * scale_width) + 1 if W % 2 == 0
+                 else int((W - 1) * scale_width) + 1)
+        elif mode == "like":
+            h, w = int(like_shape[2]), int(like_shape[3])
+        elif mode == "to_even_down":
+            h, w = H - (H % 2), W - (W % 2)
+        elif mode == "to_even_up":
+            h, w = H + (H % 2), W + (W % 2)
+        elif mode == "to_odd_down":
+            h, w = H - 1 + (H % 2), W - 1 + (W % 2)
+        else:                        # to_odd_up
+            h, w = H + 1 - (H % 2), W + 1 - (W % 2)
+        return max(h, 1), max(w, 1)
+
+    like_shape = tuple(like.shape) if like is not None else None
 
     def run(x):
         import jax
         jnp = _jnp()
         B, C, H, W = x.shape
-        h = int(height) if height else int(round(H * (scale_height or 1)))
-        w = int(width) if width else int(round(W * (scale_width or 1)))
+        h, w = _target(H, W, like_shape)
         if align_corners and h > 1 and w > 1:
             ys = jnp.linspace(0, H - 1, h)
             xs = jnp.linspace(0, W - 1, w)
@@ -762,6 +805,82 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
 
 
 CTCLoss = ctc_loss
+
+
+def count_sketch(data, h, s, out_dim, processing_batch_size=32,
+                 **_ignored):
+    """Count-sketch projection (reference: src/operator/contrib/
+    count_sketch.cc — the compact-bilinear-pooling primitive):
+    ``out[n, h[i]] += s[i] * data[n, i]``.  ``h`` holds hash buckets in
+    [0, out_dim), ``s`` signs of +-1; both may carry the reference's
+    leading singleton axis.  One scatter-add on TPU — XLA lowers the
+    duplicate-index .at[].add to a sorted segment reduction, and its
+    VJP (dx = s * dout[:, h]) is a plain gather, so no custom gradient
+    is needed.  processing_batch_size is the reference's GPU chunking
+    knob — meaningless here, accepted for parity."""
+    def run(x, hh, ss):
+        jnp = _jnp()
+        hh = hh.reshape(-1).astype(jnp.int32)
+        ss = ss.reshape(-1).astype(x.dtype)
+        out = jnp.zeros(x.shape[:-1] + (int(out_dim),), x.dtype)
+        return out.at[..., hh].add(x * ss)
+    return _invoke(run, [data, h, s], name="count_sketch")
+
+
+def PSROIPooling(data, rois, spatial_scale, output_dim, pooled_size,
+                 group_size=0, **_ignored):
+    """Position-sensitive ROI pooling (reference: src/operator/contrib/
+    psroi_pooling.cc — the R-FCN head).  data (B, output_dim*group^2,
+    H, W); rois (R, 5) [batch_idx, x0, y0, x1, y1] in image coords.
+    Output bin (i, j) of channel d AVERAGES input channel
+    (d*group + gi)*group + gj over the bin's pixels, where (gi, gj) is
+    the bin's position group.  Empty bins give 0, matching the
+    reference."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+
+    def run(x, r):
+        import jax
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        if C != output_dim * g * g:
+            raise MXNetError(
+                f"PSROIPooling: data has {C} channels, needs "
+                f"output_dim*group_size^2 = {output_dim * g * g}")
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x0 = jnp.round(roi[1]) * spatial_scale
+            y0 = jnp.round(roi[2]) * spatial_scale
+            x1 = jnp.round(roi[3] + 1.0) * spatial_scale
+            y1 = jnp.round(roi[4] + 1.0) * spatial_scale
+            rw = jnp.maximum(x1 - x0, 0.1)   # reference's min extent
+            rh = jnp.maximum(y1 - y0, 0.1)
+            img = x[bidx].reshape(output_dim, g * g, H, W)
+            iy = jnp.arange(H, dtype=x.dtype)
+            ix = jnp.arange(W, dtype=x.dtype)
+            bins = []
+            for i in range(p):
+                ys = jnp.floor(y0 + i * rh / p)
+                ye = jnp.ceil(y0 + (i + 1) * rh / p)
+                my = (iy >= ys) & (iy < ye)
+                gi = min(i * g // p, g - 1)
+                for j in range(p):
+                    xs = jnp.floor(x0 + j * rw / p)
+                    xe = jnp.ceil(x0 + (j + 1) * rw / p)
+                    mxv = (ix >= xs) & (ix < xe)
+                    m = (my[:, None] & mxv[None, :]).astype(x.dtype)
+                    gj = min(j * g // p, g - 1)
+                    cnt = jnp.maximum(jnp.sum(m), 1.0)
+                    # slice the bin's position-group channel plane
+                    plane = img[:, gi * g + gj]          # (D, H, W)
+                    bins.append(jnp.sum(plane * m[None], (-1, -2)) / cnt)
+            return jnp.stack(bins, -1).reshape(output_dim, p, p)
+        return jax.vmap(one_roi)(r)          # (R, D, p, p)
+    return _invoke(run, [data, rois], name="PSROIPooling")
+
+
+psroipooling = PSROIPooling
 
 
 def fft(data, compute_size=128):

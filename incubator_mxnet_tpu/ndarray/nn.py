@@ -33,7 +33,8 @@ __all__ = ["FullyConnected", "fully_connected", "Convolution", "convolution",
            "InstanceNorm", "instance_norm", "GroupNorm", "group_norm",
            "RNN", "rnn", "rnn_param_size", "SoftmaxOutput", "softmax_output",
            "LinearRegressionOutput", "MAERegressionOutput",
-           "LogisticRegressionOutput", "UpSampling"]
+           "LogisticRegressionOutput", "UpSampling", "SVMOutput",
+           "svm_output", "Convolution_v1"]
 
 
 def _jnp():
@@ -587,6 +588,63 @@ LogisticRegressionOutput = _regression_output(
     lambda jnp, out, lab: out - lab)
 
 
+def SVMOutput(data, label, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False, **_ignored):
+    """Multiclass SVM output head (reference: src/operator/
+    svm_output.cc).  Forward is the identity on the scores; backward is
+    the analytic hinge gradient — per class j != y the margin violation
+    is l_j = max(0, margin + x_j - x_y), and
+
+    * L2-SVM (default):       dx_j = 2*c*l_j,     dx_y = -2*c*sum_j l_j
+    * L1-SVM (use_linear):    dx_j = c*[l_j > 0], dx_y = -c*#{l_j > 0}
+
+    with the incoming head cotangent ignored, like the other legacy
+    output ops (SoftmaxOutput/regression heads)."""
+    import jax
+    jnp = _jnp()
+    c = regularization_coefficient
+
+    @jax.custom_vjp
+    def svm(x, lab):
+        return x
+
+    def svm_fwd(x, lab):
+        return x, (x, lab)
+
+    def svm_bwd(resid, g):
+        x, lab = resid
+        n_class = x.shape[-1]
+        onehot = (lab[..., None] ==
+                  jnp.arange(n_class, dtype=lab.dtype)).astype(x.dtype)
+        x_y = jnp.sum(x * onehot, axis=-1, keepdims=True)
+        viol = jnp.maximum(0.0, margin + x - x_y) * (1.0 - onehot)
+        if use_linear:
+            active = (viol > 0).astype(x.dtype)
+            gx = c * (active - onehot * jnp.sum(active, -1, keepdims=True))
+        else:
+            gx = 2.0 * c * (viol - onehot * jnp.sum(viol, -1,
+                                                    keepdims=True))
+        return gx, jnp.zeros_like(lab)
+
+    svm.defvjp(svm_fwd, svm_bwd)
+    return _invoke(lambda x, lab: svm(x, lab), [data, label],
+                   name="SVMOutput")
+
+
+def Convolution_v1(data, weight=None, bias=None, **kwargs):
+    """Legacy pre-nnvm convolution (reference: src/operator/
+    convolution_v1.cc).  Semantically the modern op minus the features
+    v1 never had; delegates to Convolution after rejecting them."""
+    for bad in ("dilate",):
+        d = kwargs.get(bad)
+        if d is not None and any(int(v) != 1 for v in
+                                 (d if isinstance(d, (tuple, list))
+                                  else (d,))):
+            raise MXNetError(f"Convolution_v1 does not support {bad}"
+                             " (use Convolution)")
+    return Convolution(data, weight, bias, **kwargs)
+
+
 def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,
                **_ignored):
     """Nearest-neighbor upsampling (reference: src/operator/upsampling.cc).
@@ -615,3 +673,4 @@ instance_norm = InstanceNorm
 group_norm = GroupNorm
 rnn = RNN
 softmax_output = SoftmaxOutput
+svm_output = SVMOutput

@@ -657,6 +657,34 @@ def slice_like(data, shape_like, axes=None):
     return _invoke(lambda x: x[key], [d], name="slice_like")
 
 
+def Crop(data, crop_like=None, offset=(0, 0), h_w=(0, 0),
+         center_crop=False, num_args=1, **_ignored):
+    """Legacy 2D crop on NCHW (reference: src/operator/crop.cc).  Target
+    (h, w) comes from ``h_w`` or from a second input's spatial dims;
+    position from ``offset`` or, with ``center_crop``, the center."""
+    d = _nd(data)
+    if crop_like is not None:
+        th, tw = _nd(crop_like).shape[2], _nd(crop_like).shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+        if th <= 0 or tw <= 0:
+            raise MXNetError("Crop needs h_w or a crop_like input")
+    H, W = d.shape[2], d.shape[3]
+    if th > H or tw > W:
+        raise MXNetError(f"Crop target ({th},{tw}) exceeds input "
+                         f"({H},{W})")
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    if oy < 0 or ox < 0 or oy + th > H or ox + tw > W:
+        raise MXNetError(f"Crop offset {offset} with size ({th},{tw}) "
+                         f"leaves the ({H},{W}) input")
+    key = (builtins.slice(None), builtins.slice(None),
+           builtins.slice(oy, oy + th), builtins.slice(ox, ox + tw))
+    return _invoke(lambda x: x[key], [d], name="Crop")
+
+
 def tile(data, reps):
     return _invoke(lambda x: _jnp().tile(x, tuple(reps)), [_nd(data)],
                    name="tile")

@@ -301,4 +301,31 @@ def _register_layer_ops():
                  special="dropout")
 
 
+def _register_legacy_ops():
+    """Ops whose OPTIONAL tensor inputs (defaulted parameters) the
+    signature-derived autoregistration cannot see — without explicit
+    arg_names the symbolic frontend would silently drop those inputs at
+    graph construction (reference analog: their FListInputNames)."""
+    from ..ndarray import nn as _nn
+    from ..ndarray import ops as _ops
+    from ..ndarray import contrib as _contrib
+    register("Convolution_v1", fn=_nn.Convolution_v1,
+             arg_names=["data", "weight", "bias"],
+             param_shape_fn=_conv_shapes,
+             required_fn=_no_bias_required(["data", "weight", "bias"]))
+    register("Crop", fn=_ops.Crop,
+             arg_names=["data", "crop_like"],
+             required_fn=lambda attrs: (
+                 ["data", "crop_like"]
+                 if int(attrs.get("num_args", 1)) == 2 else ["data"]))
+    # pre-registered under the name symbol.contrib resolves to, so the
+    # mode='like' second input survives graph construction
+    register("_contrib_BilinearResize2D", fn=_contrib.BilinearResize2D,
+             arg_names=["data", "like"],
+             required_fn=lambda attrs: (
+                 ["data", "like"] if str(attrs.get("mode")) == "like"
+                 else ["data"]))
+
+
 _register_layer_ops()
+_register_legacy_ops()

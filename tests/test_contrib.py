@@ -73,6 +73,50 @@ def test_bilinear_resize():
     assert float(r.asnumpy()[0, 0, -1, -1]) == 15.0
 
 
+def test_bilinear_resize_parity_modes():
+    """The reference's size-derivation modes (bilinear_resize.cc):
+    odd_scale/like/to_even_*/to_odd_*."""
+    d97 = mx.nd.array(onp.zeros((1, 1, 9, 7), "float32"))
+    d46 = mx.nd.array(onp.zeros((1, 1, 4, 6), "float32"))
+
+    # odd_scale: even dim -> d*s+1, odd dim -> (d-1)*s+1 (always odd)
+    r = C.BilinearResize2D(d46, scale_height=2, scale_width=3,
+                           mode="odd_scale")
+    assert r.shape == (1, 1, 9, 19)          # 4*2+1, 6*3+1
+    r = C.BilinearResize2D(d97, scale_height=2, scale_width=2,
+                           mode="odd_scale")
+    assert r.shape == (1, 1, 17, 13)         # (9-1)*2+1, (7-1)*2+1
+
+    # like: spatial size of the second input
+    r = C.BilinearResize2D(d46, like=d97, mode="like")
+    assert r.shape == (1, 1, 9, 7)
+
+    assert C.BilinearResize2D(d97, mode="to_even_down").shape \
+        == (1, 1, 8, 6)
+    assert C.BilinearResize2D(d97, mode="to_even_up").shape \
+        == (1, 1, 10, 8)
+    assert C.BilinearResize2D(d46, mode="to_odd_down").shape \
+        == (1, 1, 3, 5)
+    assert C.BilinearResize2D(d46, mode="to_odd_up").shape \
+        == (1, 1, 5, 7)
+    # even/odd no-ops keep the size
+    assert C.BilinearResize2D(d46, mode="to_even_down").shape \
+        == (1, 1, 4, 6)
+    assert C.BilinearResize2D(d97, mode="to_odd_up").shape == (1, 1, 9, 7)
+
+    # values: identity-size 'like' must reproduce the input
+    src = mx.nd.array(onp.arange(12, dtype="float32").reshape(1, 1, 3, 4))
+    same = C.BilinearResize2D(src, like=src, mode="like")
+    onp.testing.assert_allclose(same.asnumpy(), src.asnumpy(), rtol=1e-6)
+
+    with pytest.raises(mx.MXNetError, match="mode='like'"):
+        C.BilinearResize2D(d46, mode="like")
+    with pytest.raises(mx.MXNetError, match="odd_scale"):
+        C.BilinearResize2D(d46, mode="odd_scale")
+    with pytest.raises(mx.MXNetError, match="unknown mode"):
+        C.BilinearResize2D(d46, mode="bogus")
+
+
 def test_adaptive_avg_pooling():
     data = mx.nd.array(onp.arange(64, dtype="float32").reshape(1, 1, 8, 8))
     ap = C.AdaptiveAvgPooling2D(data, output_size=(2, 2)).asnumpy()
