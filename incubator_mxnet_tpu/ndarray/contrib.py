@@ -848,12 +848,17 @@ def PSROIPooling(data, rois, spatial_scale, output_dim, pooled_size,
                 f"PSROIPooling: data has {C} channels, needs "
                 f"output_dim*group_size^2 = {output_dim * g * g}")
 
+        def cround(v):
+            # C round(): half away from zero — jnp.round is half-to-even,
+            # which would shift bins for *.5 proposal coords
+            return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
         def one_roi(roi):
             bidx = roi[0].astype(jnp.int32)
-            x0 = jnp.round(roi[1]) * spatial_scale
-            y0 = jnp.round(roi[2]) * spatial_scale
-            x1 = jnp.round(roi[3] + 1.0) * spatial_scale
-            y1 = jnp.round(roi[4] + 1.0) * spatial_scale
+            x0 = cround(roi[1]) * spatial_scale
+            y0 = cround(roi[2]) * spatial_scale
+            x1 = cround(roi[3] + 1.0) * spatial_scale
+            y1 = cround(roi[4] + 1.0) * spatial_scale
             rw = jnp.maximum(x1 - x0, 0.1)   # reference's min extent
             rh = jnp.maximum(y1 - y0, 0.1)
             img = x[bidx].reshape(output_dim, g * g, H, W)

@@ -162,8 +162,57 @@ ndarray.__hash__ = None  # rich __eq__ → unhashable, like numpy
 # ---------------------------------------------------------------------------
 # generic wrapper: jax.numpy function → eager autograd-recorded np function
 # ---------------------------------------------------------------------------
+
+# reductions whose ``where=`` selects which ELEMENTS participate —
+# jax.numpy implements these natively, so the kwarg passes straight
+# through; for everything else ``where=`` is the ufunc output mask and is
+# emulated below with jnp.where
+_WHERE_REDUCTIONS = frozenset({
+    "sum", "prod", "mean", "std", "var", "max", "min", "amax", "amin",
+    "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmax",
+    "nanmin", "all", "any", "count_nonzero", "average",
+})
+
+
+def _apply_out(res, out, name):
+    """NumPy ``out=`` semantics: cast into out's dtype, write in place,
+    return the SAME object (so ``np.add(a, b, out=c) is c``)."""
+    if isinstance(out, tuple):
+        if len(out) != 1:
+            raise MXNetError(f"{name}: out must be an ndarray or a "
+                             "1-tuple of one")
+        out = out[0]
+    if not isinstance(out, NDArray):
+        raise MXNetError(f"{name}: out must be an mx.np ndarray, got "
+                         f"{type(out).__name__}")
+    if tuple(out.shape) != tuple(res.shape):
+        raise MXNetError(
+            f"{name}: non-broadcastable output operand with shape "
+            f"{tuple(out.shape)} doesn't match the result shape "
+            f"{tuple(res.shape)}")
+    if out.dtype != res.dtype:
+        res = res.astype(out.dtype)      # numpy same-kind casts into out
+    out[:] = res                          # in-place write (cuts out's tape)
+    # ...then graft the RESULT's tape node onto the out object, so
+    # differentiating through `np.op(a, b, out=c)` sees the op — the
+    # write above only replaced the buffer.  An attach_grad'ed buffer
+    # stays attached (OR, not overwrite): a plain `buf[:] = ...` write
+    # keeps that invariant, so out= must too.
+    out._ag_node, out._ag_idx = res._ag_node, res._ag_idx
+    out._require_grad = res._require_grad or out._require_grad
+    return _reclass(out)
+
+
 def _np_op(jfn, name):
     def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        where = None
+        if name not in _WHERE_REDUCTIONS and "where" in kwargs:
+            where = kwargs.pop("where")
+        if kwargs.get("order") in ("A", "K"):
+            # device arrays have no strides: every array is logically
+            # C-contiguous, so numpy's layout-dependent orders collapse
+            kwargs["order"] = "C"
         # NDArrays may sit anywhere in the argument pytree (e.g.
         # concatenate([a, b])); flatten, lift them out, and rebuild inside
         # the recorded fun so autograd sees every array input.
@@ -181,11 +230,44 @@ def _np_op(jfn, name):
             a, kw = jax.tree_util.tree_unflatten(treedef, ls)
             return jfn(*a, **kw)
 
-        return _reclass(_invoke(run, arrs, name=name))
+        if where is None:
+            res = _reclass(_invoke(run, arrs, name=name))
+        else:
+            # ufunc mask semantics via the double-where trick: masked-OUT
+            # positions (a) read 1 instead of the real input, so sqrt(-1)
+            # etc. can't produce NaN values OR NaN gradients there, and
+            # (b) take out's prior value in the result (0 with no out —
+            # numpy leaves them uninitialized; 0 is the deterministic
+            # instance of that)
+            other = out[0] if isinstance(out, tuple) else out
+            n_arr = len(arrs)
+
+            def run_masked(*jall):
+                jnp = _jnp()
+                jarrs, w = jall[:n_arr], jall[n_arr]
+                o = jall[n_arr + 1] if len(jall) > n_arr + 1 else None
+                ls = list(leaves)
+                for i, j in zip(arr_idx, jarrs):
+                    ls[i] = jnp.where(w, j, jnp.ones((), j.dtype))
+                a, kw = jax.tree_util.tree_unflatten(treedef, ls)
+                r = jfn(*a, **kw)
+                base = (o.astype(r.dtype) if o is not None
+                        else jnp.zeros((), r.dtype))
+                return jnp.where(w, r, base)
+
+            masked_in = arrs + [asarray(where)] \
+                + ([asarray(other)] if other is not None else [])
+            res = _reclass(_invoke(run_masked, masked_in, name=name))
+        if out is not None:
+            return _apply_out(res, out, name)
+        return res
     fn.__name__ = name
     fn.__qualname__ = name
     fn.__doc__ = (f"NumPy-compatible ``{name}`` lowered through jax.numpy "
-                  f"(reference: python/mxnet/numpy {name}).")
+                  f"(reference: python/mxnet/numpy {name}); supports "
+                  "``out=`` (in-place write, same-object return), ufunc "
+                  "``where=`` masks, and C/F/A/K ``order`` where numpy "
+                  "has them.")
     return fn
 
 
@@ -299,9 +381,13 @@ def __getattr__(name):
 # ---------------------------------------------------------------------------
 # creation functions (need ctx/device handling, hence explicit)
 # ---------------------------------------------------------------------------
-def array(object, dtype=None, ctx=None, device=None):
+def array(object, dtype=None, ctx=None, device=None, order=None):
     """Create an np ndarray (reference: numpy/multiarray.py array).
-    NDArray sources stay on device (_nd_array copies device-to-device)."""
+    NDArray sources stay on device (_nd_array copies device-to-device).
+    ``order`` is accepted for numpy signature parity and ignored: device
+    arrays carry no strides, so C/F layout is indistinguishable."""
+    if order not in (None, "C", "F", "A", "K"):
+        raise MXNetError(f"array: unknown order {order!r}")
     return _reclass(_nd_array(object, ctx=device or ctx, dtype=dtype))
 
 

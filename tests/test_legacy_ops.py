@@ -97,17 +97,23 @@ class TestPSROIPooling:
         rng = np.random.default_rng(3)
         D, g, p = 2, 2, 2
         x = rng.standard_normal((1, D * g * g, 6, 6)).astype(np.float32)
+        # incl. half-integer coords: C round() is half-AWAY-from-zero
+        # (2.5 -> 3), not banker's rounding
         rois = np.array([[0, 0, 0, 3, 3],
-                         [0, 1, 2, 5, 5]], np.float32)
+                         [0, 1, 2, 5, 5],
+                         [0, 2.5, 0.5, 4.5, 3.5]], np.float32)
         out = C.PSROIPooling(mx.nd.array(x), mx.nd.array(rois),
                              spatial_scale=1.0, output_dim=D,
                              pooled_size=p, group_size=g).asnumpy()
 
+        def cround(v):
+            return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
         def oracle(roi):
-            x0 = round(roi[1]) * 1.0
-            y0 = round(roi[2]) * 1.0
-            x1 = round(roi[3] + 1) * 1.0
-            y1 = round(roi[4] + 1) * 1.0
+            x0 = cround(roi[1]) * 1.0
+            y0 = cround(roi[2]) * 1.0
+            x1 = cround(roi[3] + 1) * 1.0
+            y1 = cround(roi[4] + 1) * 1.0
             rw, rh = max(x1 - x0, 0.1), max(y1 - y0, 0.1)
             res = np.zeros((D, p, p), np.float32)
             for i in range(p):
@@ -125,7 +131,7 @@ class TestPSROIPooling:
                         res[d, i, j] = patch.mean() if patch.size else 0.0
             return res
 
-        for r in range(2):
+        for r in range(3):
             np.testing.assert_allclose(out[r], oracle(rois[r]),
                                        rtol=1e-5, atol=1e-6)
 
