@@ -205,7 +205,14 @@ def flash_attention(q, k, v, scale=None, causal=False, mask=None):
             q.reshape(B * H, T, D), k.reshape(B * H, T, D),
             v.reshape(B * H, T, D), scale, causal,
             bias=bb).reshape(B, H, T, D)
-    interpret = jax.default_backend() == "cpu"
+    # interpret on CPU: decide from where the DATA lives (a concrete
+    # array on the CPU backend of a TPU-default process must interpret);
+    # tracers have no devices — fall back to the default backend
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    interpret = platform == "cpu"
     qf, kf, vf = (x.reshape(B * H, T, D) for x in (q, k, v))
     out = _flash(qf, kf, vf, bias, scale, causal, interpret, H)
     return out.reshape(B, H, T, D)

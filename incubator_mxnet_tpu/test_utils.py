@@ -21,7 +21,37 @@ __all__ = [
     "rand_ndarray", "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
     "check_numeric_gradient", "check_symbolic_forward",
     "check_symbolic_backward", "check_consistency", "simple_forward",
+    "probe_accelerator",
 ]
+
+
+def probe_accelerator(timeout=120):
+    """Probe the default (accelerator) jax backend in a SUBPROCESS, so a
+    hung PJRT init (single-client tunnel already claimed, relay down)
+    cannot hang the caller.  Returns ``(platform, device_kind, error)``:
+    platform is None (with ``error`` saying why) when nothing answered,
+    'cpu' when only the host backend exists.  Single source of truth for
+    the tests_tpu gate and tools/run_tpu_tier.py (reference analog: the
+    GPU tier's device availability check)."""
+    import subprocess
+    import sys
+    code = ("import jax; d = jax.devices()[0]; "
+            "import jax.numpy as jnp; "
+            "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+            "print(d.platform, '|', getattr(d, 'device_kind', ''))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout)
+        if out.returncode == 0 and out.stdout.strip():
+            platform, _, kind = out.stdout.strip().partition("|")
+            return platform.strip(), kind.strip(), None
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        return None, None, (f"probe rc={out.returncode}: "
+                            + (tail[-1][:200] if tail else "no output"))
+    except subprocess.TimeoutExpired:
+        return None, None, (f"probe hung >{timeout}s (PJRT init never "
+                            "returned — tunnel down?)")
 
 _default_ctx: Context | None = None
 

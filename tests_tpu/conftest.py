@@ -10,7 +10,6 @@ gates the whole tier with a clean skip.
 Run:  python -m pytest tests_tpu/ -q        (NOT part of `pytest tests/`)
 """
 import os
-import subprocess
 import sys
 
 import numpy as _np
@@ -18,21 +17,10 @@ import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
-_PROBE = ("import jax; d = jax.devices()[0]; "
-          "import jax.numpy as jnp; "
-          "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
-          "print(d.platform)")
-
-
 def _tpu_reachable(timeout=120):
-    try:
-        out = subprocess.run([sys.executable, "-c", _PROBE],
-                             capture_output=True, text=True,
-                             timeout=timeout)
-        return (out.returncode == 0
-                and out.stdout.strip() not in ("", "cpu"))
-    except subprocess.TimeoutExpired:
-        return False
+    from incubator_mxnet_tpu.test_utils import probe_accelerator
+    platform, _, _ = probe_accelerator(timeout=timeout)
+    return platform not in (None, "cpu")
 
 
 def pytest_collection_modifyitems(config, items):
