@@ -18,7 +18,7 @@ rewrite).  tests/test_amp.py asserts the four lists exactly cover the
 # ---------------------------------------------------------------------------
 TARGET_DTYPE_OPS = [
     "FullyConnected", "fully_connected",
-    "Convolution", "convolution",
+    "Convolution", "convolution", "Convolution_v1",
     "Deconvolution", "deconvolution",
     "RNN", "rnn",
     "dot", "batch_dot", "matmul", "linalg_gemm2", "khatri_rao",
@@ -32,7 +32,7 @@ LOW_PRECISION_OPS = TARGET_DTYPE_OPS  # back-compat alias
 FP32_OPS = [
     # softmax / loss heads
     "softmax", "log_softmax", "softmax_cross_entropy",
-    "SoftmaxOutput", "softmax_output",
+    "SoftmaxOutput", "softmax_output", "SVMOutput", "svm_output",
     "LinearRegressionOutput", "LogisticRegressionOutput",
     "MAERegressionOutput", "make_loss", "smooth_l1",
     # normalization (fp32 statistics)
@@ -87,7 +87,7 @@ TARGET_SAFE_OPS = [
     "SwapAxis", "swapaxes", "expand_dims", "squeeze", "broadcast_to",
     "broadcast_like", "broadcast_axes", "broadcast_axis",
     "Pad", "pad", "tile", "repeat", "flip", "reverse",
-    "slice", "slice_axis", "slice_like", "SliceChannel", "split",
+    "slice", "slice_axis", "slice_like", "SliceChannel", "split", "Crop",
     "split_v2", "diag", "shape_array", "size_array",
     # indexing / gather / scatter
     "take", "batch_take", "pick", "gather_nd", "scatter_nd",

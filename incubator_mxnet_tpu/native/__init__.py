@@ -28,18 +28,21 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
-    # compile to a per-pid temp path, then atomic-rename into place:
-    # concurrent processes (launch.py workers) each build their own copy
-    # and the rename races are last-writer-wins on a COMPLETE binary
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           "-o", tmp, _SRC]
+def _compile(src, so, extra_flags=(), timeout=180) -> bool:
+    """Compile ``src`` into shared object ``so``: per-pid temp path, then
+    atomic rename — concurrent processes (launch.py workers) each build
+    their own copy and rename races are last-writer-wins on a COMPLETE
+    binary."""
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+           + [f for f in extra_flags if f.startswith("-I")]
+           + ["-o", tmp, src]
+           + [f for f in extra_flags if not f.startswith("-I")])
     try:
         out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=180)
+                             timeout=timeout)
         if out.returncode == 0 and os.path.isfile(tmp):
-            os.replace(tmp, _SO)
+            os.replace(tmp, so)
             return True
         return False
     except (OSError, subprocess.TimeoutExpired):
@@ -51,6 +54,21 @@ def _build() -> bool:
             pass
 
 
+def _needs_build(so, src) -> bool:
+    """True when the .so must be (re)built; False when an up-to-date .so
+    exists OR only the .so exists (source stripped in deployment — use
+    the prebuilt binary rather than failing)."""
+    if not os.path.isfile(so):
+        return True
+    if not os.path.isfile(src):
+        return False
+    return os.path.getmtime(so) < os.path.getmtime(src)
+
+
+def _build() -> bool:
+    return _compile(_SRC, _SO)
+
+
 def load():
     """The recordio core library, or None when unavailable."""
     global _lib, _tried
@@ -58,9 +76,7 @@ def load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        need_build = (not os.path.isfile(_SO)
-                      or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if need_build and not _build():
+        if _needs_build(_SO, _SRC) and not _build():
             return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -149,6 +165,39 @@ class NativeRecordReader:
             raise IOError(f"recordio batched read error {rc} "
                           f"in {self.path}")
         return out
+
+
+_PREDICT_SRC = os.path.join(_DIR, "c_predict_api.cc")
+_PREDICT_SO = os.path.join(_DIR, "_c_predict_api.so")
+
+
+def _python_embed_flags():
+    """Compiler/linker flags to embed THIS interpreter (what
+    `python3-config --includes --embed --ldflags` prints, resolved via
+    sysconfig so the right Python is always used)."""
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    cflags = [f"-I{inc}"]
+    ldflags = [f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ver}"]
+    return cflags, ldflags
+
+
+def build_predict_api():
+    """Build the C predict ABI shared object (c_predict_api.cc) if
+    needed; returns its path, or None when the toolchain/embed libs are
+    unavailable (callers and tests skip with that reason)."""
+    if not _needs_build(_PREDICT_SO, _PREDICT_SRC):
+        return _PREDICT_SO
+    try:
+        cflags, ldflags = _python_embed_flags()
+    except Exception:
+        return None
+    if _compile(_PREDICT_SRC, _PREDICT_SO,
+                extra_flags=cflags + ldflags, timeout=300):
+        return _PREDICT_SO
+    return None
 
 
 def scan_index(path: str):

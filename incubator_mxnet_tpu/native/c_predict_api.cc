@@ -1,0 +1,264 @@
+// C predict API (reference: include/mxnet/c_predict_api.h +
+// src/c_api/c_predict_api.cc — the flat ABI C/C++ applications link
+// against to run a trained checkpoint without any Python on THEIR side).
+//
+// TPU-native re-design: the reference backs this ABI with its C++ graph
+// executor; here the executor IS a jit-compiled XLA program, so the
+// native layer embeds CPython and drives the same
+// incubator_mxnet_tpu executor a Python caller would get — the C caller
+// still sees only this ABI (handles + float buffers + MXGetLastError),
+// and the heavy lifting stays in the compiled XLA program.
+//
+// ABI subset implemented (signatures match the reference):
+//   MXGetLastError, MXPredCreate, MXPredSetInput, MXPredForward,
+//   MXPredGetOutputShape, MXPredGetOutput, MXPredFree
+//
+// Build (the test does this; python3-config supplies the embed flags):
+//   g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc \
+//       $(python3-config --includes) $(python3-config --embed --ldflags) \
+//       -o _c_predict_api.so
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+using mx_uint = uint32_t;
+using PredictorHandle = void*;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Predictor {
+  PyObject* obj = nullptr;                  // predict_bridge.Predictor
+  std::vector<mx_uint> shape_buf;           // owns MXPredGetOutputShape
+};
+
+// Initialize an interpreter if the host process doesn't have one (a pure
+// C caller); release the GIL afterwards so every entry point can use the
+// PyGILState API uniformly.  call_once: concurrent first MXPredCreate
+// calls from a multithreaded C host must not race Py_InitializeEx.
+std::once_flag g_py_init_once;
+bool g_py_init_ok = false;
+
+bool ensure_python() {
+  std::call_once(g_py_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      if (!Py_IsInitialized()) return;
+      PyEval_SaveThread();
+    }
+    g_py_init_ok = true;
+  });
+  if (!g_py_init_ok) {
+    g_last_error = "embedded Python interpreter failed to initialize";
+  }
+  return g_py_init_ok;
+}
+
+// capture the current Python exception into g_last_error
+void take_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = std::string(where) + ": ";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error += c != nullptr ? c : "<unprintable>";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error += "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  // PyObject_Str/PyUnicode_AsUTF8 may themselves have raised; the next
+  // CPython call on this thread must start exception-clean
+  PyErr_Clear();
+}
+
+PyObject* bridge() {
+  // imported once per process; returns a borrowed-module new reference
+  return PyImport_ImportModule(
+      "incubator_mxnet_tpu.native.predict_bridge");
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  if (out == nullptr || symbol_json_str == nullptr) {
+    g_last_error = "MXPredCreate: null argument";
+    return -1;
+  }
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    take_py_error("MXPredCreate: import predict_bridge");
+    return -1;
+  }
+  // inputs: [(key, (d0, d1, ...)), ...]
+  PyObject* inputs = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    const mx_uint begin = input_shape_indptr[i];
+    const mx_uint end = input_shape_indptr[i + 1];
+    PyObject* shape = PyTuple_New(end - begin);
+    for (mx_uint d = begin; d < end; ++d) {
+      PyTuple_SET_ITEM(shape, d - begin,
+                       PyLong_FromUnsignedLong(input_shape_data[d]));
+    }
+    PyObject* pair = PyTuple_New(2);
+    PyTuple_SET_ITEM(pair, 0, PyUnicode_FromString(input_keys[i]));
+    PyTuple_SET_ITEM(pair, 1, shape);
+    PyList_SET_ITEM(inputs, i, pair);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* res = PyObject_CallMethod(
+      mod, "create", "sOiiO", symbol_json_str, params, dev_type, dev_id,
+      inputs);
+  Py_DECREF(params);
+  Py_DECREF(inputs);
+  Py_DECREF(mod);
+  if (res == nullptr) {
+    take_py_error("MXPredCreate");
+    return -1;
+  }
+  auto* pred = new Predictor();
+  pred->obj = res;
+  *out = pred;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr || key == nullptr || data == nullptr) {
+    g_last_error = "MXPredSetInput: null argument";
+    return -1;
+  }
+  Gil gil;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * 4);
+  PyObject* res =
+      PyObject_CallMethod(pred->obj, "set_input", "sO", key, bytes);
+  Py_DECREF(bytes);
+  if (res == nullptr) {
+    take_py_error("MXPredSetInput");
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr) {
+    g_last_error = "MXPredForward: null handle";
+    return -1;
+  }
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(pred->obj, "forward", nullptr);
+  if (res == nullptr) {
+    take_py_error("MXPredForward");
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr || shape_data == nullptr || shape_ndim == nullptr) {
+    g_last_error = "MXPredGetOutputShape: null argument";
+    return -1;
+  }
+  Gil gil;
+  PyObject* res = PyObject_CallMethod(pred->obj, "get_output_shape", "I",
+                                      index);
+  if (res == nullptr) {
+    take_py_error("MXPredGetOutputShape");
+    return -1;
+  }
+  const Py_ssize_t n = PyTuple_Size(res);
+  pred->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    pred->shape_buf[static_cast<size_t>(i)] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = pred->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr || data == nullptr) {
+    g_last_error = "MXPredGetOutput: null argument";
+    return -1;
+  }
+  Gil gil;
+  PyObject* res =
+      PyObject_CallMethod(pred->obj, "get_output", "I", index);
+  if (res == nullptr) {
+    take_py_error("MXPredGetOutput");
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    Py_DECREF(res);
+    take_py_error("MXPredGetOutput: bytes");
+    return -1;
+  }
+  if (static_cast<Py_ssize_t>(size) * 4 != n) {
+    g_last_error = "MXPredGetOutput: buffer size " +
+                   std::to_string(size) + " floats != output " +
+                   std::to_string(n / 4) + " floats";
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(n));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr) return 0;
+  {
+    Gil gil;
+    Py_XDECREF(pred->obj);
+  }
+  delete pred;
+  return 0;
+}
+
+}  // extern "C"

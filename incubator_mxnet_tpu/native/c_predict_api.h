@@ -1,0 +1,72 @@
+/* C predict API — the flat ABI C/C++ applications link against to run a
+ * trained checkpoint (reference: include/mxnet/c_predict_api.h; this
+ * header matches the reference signatures for the implemented subset).
+ *
+ * Usage sketch (error handling elided; every function returns 0 on
+ * success, -1 with MXGetLastError() set otherwise):
+ *
+ *   PredictorHandle h;
+ *   const char* keys[] = {"data"};
+ *   mx_uint indptr[] = {0, 2};
+ *   mx_uint shape[] = {1, 4};
+ *   MXPredCreate(symbol_json, param_bytes, param_size, 1, 0,
+ *                1, keys, indptr, shape, &h);
+ *   MXPredSetInput(h, "data", x, 4);
+ *   MXPredForward(h);
+ *   mx_uint *oshape, ondim;
+ *   MXPredGetOutputShape(h, 0, &oshape, &ondim);
+ *   MXPredGetOutput(h, 0, out, n);
+ *   MXPredFree(h);
+ */
+#ifndef INCUBATOR_MXNET_TPU_C_PREDICT_API_H_
+#define INCUBATOR_MXNET_TPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef uint32_t mx_uint;
+typedef void* PredictorHandle;
+
+/* Last error message of the calling thread (empty string if none). */
+const char* MXGetLastError(void);
+
+/* Create a predictor from an nnvm -symbol.json string and the raw bytes
+ * of a .params checkpoint (arg:/aux: key convention).
+ * dev_type: 1 = cpu, 2 = accelerator; dev_id: ordinal.
+ * Input shapes arrive CSR-style: input_shape_indptr has
+ * num_input_nodes+1 entries delimiting each input's dims in
+ * input_shape_data. */
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+
+/* Copy `size` float32 values into the named input. */
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size);
+
+/* Run the forward pass on the current inputs. */
+int MXPredForward(PredictorHandle handle);
+
+/* Shape of output `index`; the returned pointer stays valid until the
+ * next MXPredGetOutputShape call on the same handle (or MXPredFree). */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+
+/* Copy output `index` into `data` (`size` = element count, must match
+ * the output exactly). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size);
+
+/* Release the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* INCUBATOR_MXNET_TPU_C_PREDICT_API_H_ */

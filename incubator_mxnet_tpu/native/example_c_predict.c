@@ -1,0 +1,90 @@
+/* Pure-C consumer of the predict ABI (reference analog:
+ * example/image-classification/predict-cpp/ — the deployment demo).
+ * Loads a -symbol.json + .params checkpoint from argv, runs one forward
+ * on a fixed input, prints "shape d0 d1 ..." then the output floats —
+ * no Python anywhere in THIS translation unit; the interpreter is an
+ * implementation detail behind the ABI.
+ *
+ * Built and executed by tests/test_c_predict_api.py. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_predict_api.h"
+
+static char* read_file(const char* path, long* size_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc((size_t)n + 1);
+  if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[n] = '\0';
+  fclose(f);
+  *size_out = n;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s model-symbol.json model.params\n", argv[0]);
+    return 2;
+  }
+  long json_size = 0, param_size = 0;
+  char* json = read_file(argv[1], &json_size);
+  char* params = read_file(argv[2], &param_size);
+  if (!json || !params) {
+    fprintf(stderr, "cannot read model files\n");
+    return 2;
+  }
+
+  PredictorHandle h = NULL;
+  const char* keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {2, 4};
+  if (MXPredCreate(json, params, (int)param_size, /*cpu*/ 1, 0, 1, keys,
+                   indptr, shape, &h) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  float x[8] = {1.0f, 2.0f, 3.0f, 4.0f, -1.0f, 0.5f, 0.0f, 2.0f};
+  if (MXPredSetInput(h, "data", x, 8) != 0 || MXPredForward(h) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint* oshape = NULL;
+  mx_uint ondim = 0;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("shape");
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) {
+    printf(" %u", oshape[i]);
+    total *= oshape[i];
+  }
+  printf("\n");
+
+  float* out = (float*)malloc(sizeof(float) * total);
+  if (MXPredGetOutput(h, 0, out, total) != 0) {
+    fprintf(stderr, "output: %s\n", MXGetLastError());
+    return 1;
+  }
+  for (mx_uint i = 0; i < total; ++i) {
+    printf("%.6f%c", (double)out[i], i + 1 == total ? '\n' : ' ');
+  }
+
+  free(out);
+  free(json);
+  free(params);
+  MXPredFree(h);
+  return 0;
+}

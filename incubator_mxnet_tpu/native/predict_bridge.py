@@ -1,0 +1,100 @@
+"""Python half of the C predict API (reference:
+include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc — the
+deployment surface C/C++ applications link against).
+
+The native layer (``c_predict_api.cc``) embeds CPython and calls the
+functions here; this module owns everything above the marshaling line:
+parse the nnvm -symbol.json, decode the ``arg:``/``aux:`` ``.params``
+bytes, bind an Executor, run forwards.  The compute still lowers through
+jax/XLA — the C caller gets the same compiled program a Python caller
+would, which is the TPU-native answer to the reference's C++ engine
+behind its predict API."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as _np
+
+
+def _pin_device(dev_type: int) -> None:
+    """dev_type follows the reference enum: 1 = cpu, 2 = gpu (here: the
+    accelerator).  cpu pins the jax platform BEFORE the framework import
+    so a deployment box never touches (or hangs on) an accelerator
+    runtime it doesn't want."""
+    if dev_type == 1:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass      # backend already initialized; placement still cpu
+
+
+class Predictor:
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, dev_id: int,
+                 inputs: Sequence[Tuple[str, Tuple[int, ...]]]):
+        _pin_device(dev_type)
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.ndarray.utils import load_frombuffer
+        from incubator_mxnet_tpu.symbol import symbol as sym_mod
+
+        self._mx = mx
+        sym = sym_mod.load_json(symbol_json)
+        loaded = load_frombuffer(param_bytes)
+        if not isinstance(loaded, dict):
+            raise ValueError(".params bytes hold a bare list, not the "
+                             "arg:/aux: dict a checkpoint carries")
+        arg_params = {k[4:]: v for k, v in loaded.items()
+                      if k.startswith("arg:")}
+        aux_params = {k[4:]: v for k, v in loaded.items()
+                      if k.startswith("aux:")}
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
+
+        self._input_names = [k for k, _ in inputs]
+        args = {}
+        for name, shape in inputs:
+            args[name] = mx.nd.zeros(shape, ctx=ctx)
+        for name in sym.list_arguments():
+            if name in args:
+                continue
+            if name not in arg_params:
+                raise ValueError(f"parameter {name!r} missing from the "
+                                 ".params bytes and not a declared input")
+            args[name] = arg_params[name]
+        self._exec = sym.bind(ctx=ctx, args=args,
+                              aux_states=aux_params or None,
+                              grad_req="null")
+        self._pending: Dict[str, object] = {}
+        self._outputs: List[_np.ndarray] = []
+        self.forward()        # reference semantics: predictor is runnable
+        #                       (and output shapes queryable) on create
+
+    def set_input(self, key: str, data: bytes) -> None:
+        if key not in self._input_names:
+            raise ValueError(f"unknown input {key!r}; declared inputs: "
+                             f"{self._input_names}")
+        arr = _np.frombuffer(data, dtype=_np.float32).reshape(
+            self._exec.arg_dict[key].shape)
+        self._pending[key] = self._mx.nd.array(arr, dtype=_np.float32)
+
+    def forward(self) -> None:
+        outs = self._exec.forward(is_train=False, **self._pending)
+        self._pending = {}
+        self._outputs = [_np.ascontiguousarray(
+            o.asnumpy().astype(_np.float32)) for o in outs]
+
+    def num_outputs(self) -> int:
+        return len(self._outputs)
+
+    def get_output_shape(self, index: int) -> Tuple[int, ...]:
+        return tuple(int(d) for d in self._outputs[index].shape)
+
+    def get_output(self, index: int) -> bytes:
+        return self._outputs[index].tobytes()
+
+
+def create(symbol_json: str, param_bytes: bytes, dev_type: int,
+           dev_id: int, inputs) -> Predictor:
+    return Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                     [(str(k), tuple(int(d) for d in s))
+                      for k, s in inputs])

@@ -1,0 +1,126 @@
+"""C predict ABI (reference: include/mxnet/c_predict_api.h +
+src/c_api/c_predict_api.cc): a C application runs a checkpoint through
+the flat ABI with no Python of its own.  Two tiers here:
+
+1. ctypes in-process — the ABI functions driven exactly as a C caller
+   would (ctypes IS the C ABI), against the golden module checkpoint;
+2. a REAL pure-C program (native/example_c_predict.c) compiled with gcc
+   and executed as a subprocess — the embedded-interpreter path end to
+   end, Python nowhere on the caller's stack."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_GOLD = os.path.join(_REPO, "tests", "golden")
+_NATIVE = os.path.join(_REPO, "incubator_mxnet_tpu", "native")
+
+
+def _build_so():
+    from incubator_mxnet_tpu import native
+    so = native.build_predict_api()
+    if so is None:
+        pytest.skip("predict-ABI build unavailable (toolchain or "
+                    "libpython embed flags missing)")
+    return so
+
+
+def _expected(x):
+    """The golden checkpoint is FullyConnected(num_hidden=2) with
+    fc_weight = linspace(-1, 1, 8).reshape(2, 4), fc_bias = [.1, -.2]."""
+    W = np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4)
+    b = np.array([0.1, -0.2], np.float32)
+    return x @ W.T + b
+
+
+def test_predict_abi_ctypes():
+    so = _build_so()
+    lib = ctypes.CDLL(so)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    u = ctypes.c_uint32
+
+    with open(os.path.join(_GOLD, "ckpt-symbol.json")) as f:
+        sym_json = f.read().encode()
+    with open(os.path.join(_GOLD, "ckpt-0007.params"), "rb") as f:
+        params = f.read()
+
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    shape = (u * 2)(2, 4)
+    rc = lib.MXPredCreate(sym_json, params, len(params), 1, 0, 1, keys,
+                          indptr, shape, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+
+    x = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
+    buf = (ctypes.c_float * 8)(*x.ravel())
+    assert lib.MXPredSetInput(handle, b"data", buf, 8) == 0, \
+        lib.MXGetLastError().decode()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError().decode()
+
+    sdata = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == 0
+    oshape = tuple(sdata[i] for i in range(ndim.value))
+    assert oshape == (2, 2)
+
+    out = (ctypes.c_float * 4)()
+    assert lib.MXPredGetOutput(handle, 0, out, 4) == 0, \
+        lib.MXGetLastError().decode()
+    np.testing.assert_allclose(
+        np.array(out[:]).reshape(2, 2), _expected(x), rtol=1e-5,
+        atol=1e-6)
+
+    # wrong-size output buffer reports instead of corrupting memory
+    bad = (ctypes.c_float * 3)()
+    assert lib.MXPredGetOutput(handle, 0, bad, 3) != 0
+    assert b"floats" in lib.MXGetLastError()
+    assert lib.MXPredFree(handle) == 0
+
+
+def test_predict_abi_bad_model_reports():
+    so = _build_so()
+    lib = ctypes.CDLL(so)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    u = ctypes.c_uint32
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    shape = (u * 2)(2, 4)
+    rc = lib.MXPredCreate(b"{not json", b"xx", 2, 1, 0, 1, keys, indptr,
+                          shape, ctypes.byref(handle))
+    assert rc != 0
+    assert lib.MXGetLastError()   # non-empty message
+
+
+@pytest.mark.timeout(600)
+def test_predict_pure_c_program(tmp_path):
+    so = _build_so()
+    from incubator_mxnet_tpu.native import _python_embed_flags
+    _, ldflags = _python_embed_flags()
+    exe = str(tmp_path / "c_predict_demo")
+    cmd = (["gcc", "-O2", f"-I{_NATIVE}",
+            os.path.join(_NATIVE, "example_c_predict.c"), so,
+            f"-Wl,-rpath,{_NATIVE}", "-o", exe] + ldflags)
+    build = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"C driver build failed: {build.stderr[-400:]}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [exe, os.path.join(_GOLD, "ckpt-symbol.json"),
+         os.path.join(_GOLD, "ckpt-0007.params")],
+        capture_output=True, text=True, timeout=480, env=env)
+    assert run.returncode == 0, (run.stdout[-500:], run.stderr[-1500:])
+    lines = run.stdout.strip().splitlines()
+    assert lines[0].split() == ["shape", "2", "2"]
+    got = np.array([float(v) for v in lines[1].split()]).reshape(2, 2)
+    x = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
+    np.testing.assert_allclose(got, _expected(x), rtol=1e-5, atol=1e-6)
