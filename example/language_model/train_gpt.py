@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Causal language-model training + generation (reference workload:
+GluonNLP scripts/language_model — the GPT-2/AWD-LSTM family scripts).
+
+Trains models.gpt on a synthetic corpus (zero-egress environment: a
+deterministic integer grammar stands in for text), reports perplexity,
+then generates continuations both greedily and with top-k sampling
+through the KV-cached lax.scan decoder.
+
+    python example/language_model/train_gpt.py --steps 60 --cpu
+    python example/language_model/train_gpt.py --arch 124m  # GPT-2 small
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+
+def make_batch(rng, batch_size, seq_len, vocab):
+    """Synthetic 'language': arithmetic sequences mod vocab, stride 1-3
+    — enough structure that a causal LM can beat uniform entropy fast."""
+    start = rng.randint(0, vocab, (batch_size, 1))
+    stride = rng.randint(1, 4, (batch_size, 1))
+    seq = (start + stride * np.arange(seq_len + 1)[None]) % vocab
+    return seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", choices=["tiny", "124m"], default="tiny")
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=24)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--cpu", action="store_true",
+                    help="run on CPU (testing)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu.models import gpt
+
+    mx.random.seed(0)
+    if args.arch == "tiny":
+        net = gpt.gpt_tiny(vocab_size=args.vocab, dropout=0.1)
+    else:
+        net = gpt.gpt2_124m(vocab_size=args.vocab)
+    net.initialize(init=mx.init.Normal(0.02))
+    net.hybridize()
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": args.lr})
+
+    rng = np.random.RandomState(0)
+    tic = time.time()
+    for step in range(1, args.steps + 1):
+        x, y = make_batch(rng, args.batch_size, args.seq_len, args.vocab)
+        with ag.record():
+            logits = net(mx.nd.array(x, dtype="int32"))
+            L = loss_fn(logits.reshape((-1, args.vocab)),
+                        mx.nd.array(y.reshape(-1).astype(np.float32))
+                        ).mean()
+        L.backward()
+        trainer.step(1)
+        if step % 10 == 0 or step == 1:
+            ppl = float(np.exp(min(float(L.asnumpy()), 20.0)))
+            toks_per_s = (step * args.batch_size * args.seq_len
+                          / (time.time() - tic))
+            print(f"step {step:4d}  loss {float(L.asnumpy()):.4f}  "
+                  f"ppl {ppl:8.2f}  {toks_per_s:,.0f} tok/s")
+
+    # continuation accuracy on held-out sequences: the grammar is
+    # deterministic given two tokens, so a trained LM should ace it
+    x, y = make_batch(rng, 8, 8, args.vocab)
+    out = net.generate(mx.nd.array(x, dtype="int32"), max_new_tokens=6,
+                       temperature=0.0)
+    cont = out.asnumpy()[:, 8:]
+    stride = (x[:, 1] - x[:, 0]) % args.vocab
+    want = (x[:, -1:] + stride[:, None] * np.arange(1, 7)[None]) \
+        % args.vocab
+    acc = (cont == want).mean()
+    print(f"greedy continuation accuracy: {acc:.2%}")
+    sampled = net.generate(mx.nd.array(x[:2], dtype="int32"),
+                           max_new_tokens=6, temperature=0.8, top_k=8,
+                           seed=1)
+    print("top-k sample:", sampled.asnumpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

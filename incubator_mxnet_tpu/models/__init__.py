@@ -10,10 +10,13 @@ from . import ssd  # noqa: F401
 from .ssd import SSD, ssd_512, ssd_300, ssd_tiny
 from . import yolo  # noqa: F401
 from .yolo import YOLOv3, yolo3_darknet53, yolo3_tiny
+from . import gpt  # noqa: F401
+from .gpt import GPTModel, gpt_tiny, gpt2_124m
 
 __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
            "bert_base", "bert_large", "bert_tiny",
            "transformer", "TransformerModel", "transformer_base",
            "transformer_big",
            "ssd", "SSD", "ssd_512", "ssd_300", "ssd_tiny",
-           "yolo", "YOLOv3", "yolo3_darknet53", "yolo3_tiny"]
+           "yolo", "YOLOv3", "yolo3_darknet53", "yolo3_tiny",
+           "gpt", "GPTModel", "gpt_tiny", "gpt2_124m"]

@@ -159,6 +159,29 @@ class MultiHeadAttention(HybridBlock):
         return self.key(mem), self.value(mem)
 
 
+def cached_step_attn(qv, kn, vn, ck, cv, tv, num_heads):
+    """jax-level single-position attention over a KV cache, shared by the
+    incremental decoders (transformer._DecoderCell.step, gpt.GPTCell.step):
+    write this position's K/V at index ``tv``, attend causally over
+    positions <= tv.  qv/kn/vn (B, 1, C); ck/cv (B, Tmax, C); returns
+    (out (B, 1, C), ck', cv')."""
+    import jax.numpy as jnp
+    B, _, C = qv.shape
+    hd = C // num_heads
+    Tm = ck.shape[1]
+    ck = ck.at[:, tv].set(kn[:, 0])
+    cv = cv.at[:, tv].set(vn[:, 0])
+    qh = qv.reshape(B, 1, num_heads, hd).transpose(0, 2, 1, 3)
+    kh = ck.reshape(B, Tm, num_heads, hd).transpose(0, 2, 1, 3)
+    vh = cv.reshape(B, Tm, num_heads, hd).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(hd)
+    s = jnp.where(jnp.arange(Tm)[None, None, None, :] <= tv, s, -1e30)
+    p = jnp.exp(s - jnp.max(s, -1, keepdims=True))
+    p = p / jnp.sum(p, -1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
+    return out.transpose(0, 2, 1, 3).reshape(B, 1, C), ck, cv
+
+
 @_contextlib.contextmanager
 def dense_attention(net):
     """Temporarily run every attention cell of ``net`` on the dense

@@ -1,0 +1,334 @@
+"""Decoder-only causal language model (GPT-2 style; reference workload:
+GluonNLP ``scripts/language_model`` + ``model.train.GPT2Model``, built —
+like every model here — from this repo's op surface:
+gluon.nn.Dense/LayerNorm/Embedding, python/mxnet/gluon/nn/basic_layers.py).
+
+TPU-first design (mirrors models/bert.py and models/transformer.py):
+  * pre-LN blocks; self-attention is the ONE fused SDPA op from bert.py,
+    causal mask baked in statically — the whole stack is a single XLA
+    program under hybridize/SPMDTrainer;
+  * generation is a ``lax.scan`` over decode steps with per-layer KV
+    caches in the carry (O(T) per step); ``use_cache=False`` re-runs the
+    full prefix each step and is the tested oracle;
+  * sampling (temperature / top-k) uses a threaded PRNG key in the scan
+    carry — one compiled program, reproducible from mx.random.seed;
+  * Megatron ``tp_rules`` + optional ``seq_axis`` ring/Ulysses attention
+    make the same model the long-context/multichip workload.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray, _invoke
+from .bert import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["GPTCell", "GPTModel", "gpt_tiny", "gpt2_124m", "tp_rules"]
+
+
+class GPTCell(HybridBlock):
+    """Pre-LN decoder block: x + attn(ln1(x)), then x + ffn(ln2(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 seq_axis=None, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.attention = MultiHeadAttention(
+                units, num_heads, dropout, causal=True,
+                seq_axis=seq_axis, mesh=mesh)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation="gelu")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attention(self.ln1(x))
+        return x + self.ffn(self.ln2(x))
+
+    def prime(self, x):
+        """Full-prefix forward that ALSO returns this layer's K/V
+        projections — fills the generation cache in one pass, projecting
+        each of Q/K/V exactly once (the plain forward would recompute
+        K/V inside the attention block)."""
+        from .bert import _sdpa
+        at = self.attention
+        h = self.ln1(x)
+        q, k, v = at.query(h), at.key(h), at.value(h)
+        out = _sdpa(q, k, v, at._num_heads, causal=True)
+        x = x + at.dropout(at.proj(out))
+        return x + self.ffn(self.ln2(x)), k, v
+
+    def step(self, x, cache_k, cache_v, t):
+        """One-position incremental step: x (B, 1, C) at position ``t``,
+        cache_k/v (B, Tmax, C) holding positions < t.  Returns
+        (y (B, 1, C), cache_k', cache_v')."""
+        import functools
+        from .bert import cached_step_attn
+        at = self.attention
+        h = self.ln1(x)
+        q, k_new, v_new = at.query(h), at.key(h), at.value(h)
+        out, ck, cv = _invoke(
+            functools.partial(cached_step_attn, num_heads=at._num_heads),
+            [q, k_new, v_new, cache_k, cache_v, t], name="gpt_step_attn")
+        out = x + at.dropout(at.proj(out))
+        return out + self.ffn(self.ln2(out)), ck, cv
+
+
+class GPTModel(HybridBlock):
+    """Token + LEARNED position embeddings -> N GPTCells -> final LN ->
+    tied LM head (logits through the embedding matrix, GPT-2's tying)."""
+
+    def __init__(self, vocab_size, units=128, hidden_size=512,
+                 num_layers=2, num_heads=2, max_length=256, dropout=0.1,
+                 seq_axis=None, mesh=None, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab_size = vocab_size
+        self._units = units
+        self._max_length = max_length
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units)
+            self.pos_embed = nn.Embedding(max_length, units)
+            self.drop = nn.Dropout(dropout)
+            self.cells = nn.HybridSequential()
+            for _ in range(num_layers):
+                self.cells.add(GPTCell(units, hidden_size, num_heads,
+                                       dropout, seq_axis=seq_axis,
+                                       mesh=mesh))
+            self.ln_f = nn.LayerNorm(in_channels=units)
+
+    # -- helpers -------------------------------------------------------
+    def _positions(self, ids, offset=0):
+        def fn(iv):
+            import jax.numpy as jnp
+            T = iv.shape[1]
+            return jnp.broadcast_to(
+                jnp.arange(offset, offset + T, dtype=jnp.int32)[None],
+                iv.shape)
+        return _invoke(fn, [ids], name="gpt_positions")
+
+    def _embed_at(self, ids, offset=0):
+        x = self.embed(ids) + self.pos_embed(self._positions(ids, offset))
+        return self.drop(x)
+
+    def _project(self, x):
+        """Tied LM head: logits = x @ E^T.  The embedding Parameter's own
+        NDArray goes into the op, so the eager autograd tape reaches it —
+        a fresh wrapper would silently drop the head's gradient."""
+        w = self.embed.weight.data()
+        return _invoke(_lm_logits, [x, w], name="gpt_lm_head")
+
+    def hybrid_forward(self, F, ids):
+        if ids.shape[1] > self._max_length:
+            raise MXNetError(
+                f"sequence length {ids.shape[1]} exceeds max_length "
+                f"{self._max_length}")
+        x = self._embed_at(ids)
+        x = self.cells(x)
+        return self._project(self.ln_f(x))
+
+    # -- generation ----------------------------------------------------
+    def generate(self, ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, use_cache=True, seed=None):
+        """Autoregressive continuation of prompt ``ids`` (B, Tp) int32.
+
+        temperature == 0 -> greedy; otherwise softmax sampling at that
+        temperature, restricted to the ``top_k`` highest logits when
+        top_k > 0.  One ``lax.scan`` program either way; ``use_cache``
+        False re-runs the full prefix per step (the oracle).  Returns
+        (B, Tp + max_new_tokens) int32 tokens."""
+        B, Tp = ids.shape
+        total = Tp + max_new_tokens
+        if total > self._max_length:
+            raise MXNetError(
+                f"prompt {Tp} + {max_new_tokens} new tokens exceeds "
+                f"max_length {self._max_length}")
+        from .. import random as _random
+        key = _random.new_key() if seed is None else None
+        if seed is not None:
+            import jax
+            key = jax.random.PRNGKey(seed)
+        if use_cache:
+            return self._generate_cached(ids, max_new_tokens, temperature,
+                                         top_k, key)
+        return self._generate_full(ids, max_new_tokens, temperature,
+                                   top_k, key)
+
+    def _sample_fn(self, temperature, top_k):
+        def pick(logits, key):
+            import jax
+            import jax.numpy as jnp
+            lf = logits.astype(jnp.float32)
+            if temperature <= 0.0:
+                return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+            lf = lf / temperature
+            k = min(int(top_k), lf.shape[-1]) if top_k else 0
+            if k > 0 and k < lf.shape[-1]:
+                # top_k >= vocab degenerates to plain sampling (GPT-2
+                # convention) rather than an out-of-bounds sort index
+                kth = jnp.sort(lf, axis=-1)[..., -k][..., None]
+                lf = jnp.where(lf >= kth, lf, -jnp.inf)
+            return jax.random.categorical(key, lf, axis=-1).astype(
+                jnp.int32)
+        return pick
+
+    def _generate_full(self, ids, n_new, temperature, top_k, key):
+        """Oracle: whole prefix re-run per step, lax.scan outside."""
+        pick = self._sample_fn(temperature, top_k)
+        B, Tp = ids.shape
+        total = Tp + n_new
+
+        # pad to the full length once; scan carries (tokens, t, key)
+        def fn(iv):
+            import jax
+            import jax.numpy as jnp
+
+            toks0 = jnp.zeros((B, total), jnp.int32)
+            toks0 = jax.lax.dynamic_update_slice(toks0, iv, (0, 0))
+
+            def body(carry, _):
+                toks, t, k = carry
+                logits = self._fwd_tokens(toks)      # (B, total, V)
+                last = jnp.take_along_axis(
+                    logits, (t - 1)[None, None, None].astype(jnp.int32)
+                    .repeat(B, 0), axis=1)[:, 0]
+                k, sub = jax.random.split(k)
+                nxt = pick(last, sub)
+                toks = toks.at[:, t].set(nxt)
+                return (toks, t + 1, k), None
+
+            (toks, _, _), _ = jax.lax.scan(
+                body, (toks0, jnp.int32(Tp), key), None, length=n_new)
+            return toks
+        return _invoke(fn, [ids], name="gpt_generate_full")
+
+    def _fwd_tokens(self, toks):
+        """jax-level forward over already-jax tokens (inside scan)."""
+        import jax.numpy as jnp
+        x = self.embed.weight.data()._data[toks]
+        pos = self.pos_embed.weight.data()._data[
+            jnp.arange(toks.shape[1])]
+        x = (x + pos[None].astype(x.dtype))
+        xn = NDArray(x)
+        for cell in self.cells._children.values():
+            xn = cell(xn)
+        out = self.ln_f(xn)
+        return _lm_logits(out._data, self.embed.weight.data()._data)
+
+    def _generate_cached(self, ids, n_new, temperature, top_k, key):
+        pick = self._sample_fn(temperature, top_k)
+        B, Tp = ids.shape
+        total = Tp + n_new
+        C = self._units
+        cells = list(self.cells._children.values())
+
+        # prime: one full-prefix pass filling each layer's cache
+        x = self._embed_at(ids)
+        caches = []
+        for cell in cells:
+            x, k_proj, v_proj = cell.prime(x)
+            ck = _invoke(
+                lambda kv: _pad_cache(kv, total), [k_proj],
+                name="gpt_cache_pad")
+            cv = _invoke(
+                lambda kv: _pad_cache(kv, total), [v_proj],
+                name="gpt_cache_pad")
+            caches.append((ck, cv))
+        logits_p = self._project(self.ln_f(x))
+
+        def fn(iv, lp, *flat):
+            import jax
+            import jax.numpy as jnp
+            cks = flat[0::2]
+            cvs = flat[1::2]
+
+            toks0 = jnp.zeros((B, total), jnp.int32)
+            toks0 = jax.lax.dynamic_update_slice(toks0, iv, (0, 0))
+            k0, sub0 = jax.random.split(key)
+            first = pick(lp[:, -1], sub0)
+            toks0 = toks0.at[:, Tp].set(first)
+
+            def body(carry, _):
+                toks, t, k, caches_c = carry
+                # the token at position t is the newest one; its logits
+                # produce position t+1
+                cur = jnp.take_along_axis(
+                    toks, jnp.broadcast_to(
+                        t.reshape(1, 1), (B, 1)).astype(jnp.int32),
+                    axis=1)
+                xn = NDArray(
+                    self.embed.weight.data()._data[cur]
+                    + self.pos_embed.weight.data()._data[t][None, None])
+                new_caches = []
+                for cell, (ck, cv) in zip(cells, caches_c):
+                    xn, ck2, cv2 = cell.step(
+                        xn, NDArray(ck), NDArray(cv), NDArray(t))
+                    new_caches.append((ck2._data, cv2._data))
+                out = self.ln_f(xn)
+                logits = _lm_logits(
+                    out._data, self.embed.weight.data()._data)[:, 0]
+                k, sub = jax.random.split(k)
+                nxt = pick(logits, sub)
+                toks = toks.at[:, t + 1].set(nxt)
+                return (toks, t + 1, k, tuple(new_caches)), None
+
+            caches_c = tuple((ck, cv) for ck, cv in zip(cks, cvs))
+            (toks, _, _, _), _ = jax.lax.scan(
+                body, (toks0, jnp.int32(Tp), k0, caches_c), None,
+                length=max(n_new - 1, 0))
+            return toks
+
+        flat = []
+        for ck, cv in caches:
+            flat += [ck, cv]
+        return _invoke(fn, [ids, logits_p] + flat, name="gpt_generate")
+
+
+def _lm_logits(xv, wv):
+    """The tied-head einsum, jax-level — the ONE definition every logits
+    site (training forward, full-prefix oracle, cached scan body) uses."""
+    import jax.numpy as jnp
+    return jnp.einsum("btc,vc->btv", xv, wv.astype(xv.dtype))
+
+
+def _pad_cache(kv, total):
+    import jax.numpy as jnp
+    B, Tp, C = kv.shape
+    pad = jnp.zeros((B, total - Tp, C), kv.dtype)
+    return jnp.concatenate([kv, pad], axis=1)
+
+
+def tp_rules(model_axis="model"):
+    """Megatron sharding for SPMDTrainer (same spirit as bert.tp_rules):
+    attention QKV + first FFN matmul column-parallel, attention proj +
+    second FFN matmul row-parallel, embeddings row-sharded over vocab."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"multiheadattention\d+_dense[012]_weight", P(model_axis, None)),
+        (r"multiheadattention\d+_dense3_weight", P(None, model_axis)),
+        (r"positionwiseffn\d+_dense0_weight", P(model_axis, None)),
+        (r"positionwiseffn\d+_dense1_weight", P(None, model_axis)),
+        (r"gptmodel\d+_embedding0_weight", P(model_axis, None)),
+    ]
+
+
+def gpt_tiny(vocab_size=512, **kwargs):
+    kwargs.setdefault("units", 64)
+    kwargs.setdefault("hidden_size", 128)
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("num_heads", 2)
+    kwargs.setdefault("max_length", 128)
+    return GPTModel(vocab_size, **kwargs)
+
+
+def gpt2_124m(vocab_size=50257, **kwargs):
+    """GPT-2 small (124M): 12 layers, 768 units, 12 heads, ctx 1024."""
+    kwargs.setdefault("units", 768)
+    kwargs.setdefault("hidden_size", 3072)
+    kwargs.setdefault("num_layers", 12)
+    kwargs.setdefault("num_heads", 12)
+    kwargs.setdefault("max_length", 1024)
+    return GPTModel(vocab_size, **kwargs)

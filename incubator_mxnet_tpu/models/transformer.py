@@ -92,32 +92,16 @@ class _DecoderCell(HybridBlock):
         the cross-attention query axis.  Returns (y (B*K, 1, C),
         cache_k', cache_v').  O(Tmax) per step instead of re-running the
         full prefix."""
+        import functools
+        from .bert import cached_step_attn
         sa = self.self_attention
-        nh = sa._num_heads
         q = sa.query(x)
         k_new = sa.key(x)
         v_new = sa.value(x)
-
-        def self_attn(qv, kn, vn, ck, cv, tv):
-            import jax.numpy as jnp
-            B, _, C = qv.shape
-            hd = C // nh
-            Tm = ck.shape[1]
-            ck = ck.at[:, tv].set(kn[:, 0])
-            cv = cv.at[:, tv].set(vn[:, 0])
-            qh = qv.reshape(B, 1, nh, hd).transpose(0, 2, 1, 3)
-            kh = ck.reshape(B, Tm, nh, hd).transpose(0, 2, 1, 3)
-            vh = cv.reshape(B, Tm, nh, hd).transpose(0, 2, 1, 3)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(hd)
-            s = jnp.where(jnp.arange(Tm)[None, None, None, :] <= tv,
-                          s, -1e30)
-            p = jnp.exp(s - jnp.max(s, -1, keepdims=True))
-            p = p / jnp.sum(p, -1, keepdims=True)
-            out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
-            return out.transpose(0, 2, 1, 3).reshape(B, 1, C), ck, cv
-        out, ck, cv = _invoke(self_attn,
-                              [q, k_new, v_new, cache_k, cache_v, t],
-                              name="decode_self_attn")
+        out, ck, cv = _invoke(
+            functools.partial(cached_step_attn, num_heads=sa._num_heads),
+            [q, k_new, v_new, cache_k, cache_v, t],
+            name="decode_self_attn")
         x = self.ln1(x + sa.dropout(sa.proj(out)))
 
         ca = self.cross_attention

@@ -37,3 +37,11 @@ def test_example_transformer_short():
                "--cpu", "--steps", "6", "--seq-len", "8",
                "--batch-size", "8")
     assert "greedy reversal accuracy" in out
+
+
+def test_example_gpt_short():
+    out = _run("example/language_model/train_gpt.py",
+               "--cpu", "--steps", "6", "--seq-len", "12",
+               "--batch-size", "8", timeout=360)
+    assert "greedy continuation accuracy" in out
+    assert "top-k sample:" in out
