@@ -32,7 +32,8 @@ from ..ndarray.ndarray import NDArray, _invoke
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "BERTEncoder", "BERTModel", "BERTForPretrain", "MLMPretrainLoss",
            "BERTMLMOnly", "bert_tiny", "bert_base", "bert_large",
-           "tp_rules", "dense_attention"]
+           "tp_rules", "dense_attention", "cached_step_attn",
+           "maybe_remat_cell"]
 
 
 def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
@@ -241,6 +242,26 @@ class TransformerEncoderCell(HybridBlock):
         return x
 
 
+def maybe_remat_cell(cell, x, *rest):
+    """Run one layer, optionally under ``jax.checkpoint``
+    (``MXNET_BACKWARD_DO_MIRROR`` — the reference's mirror/memonger knob,
+    docs/faq/env_var.md: trade recompute for activation memory).  Under
+    the compiled paths (SPMDTrainer/hybridize via functional_call) the
+    layer's internal activations are then rematerialized in the backward
+    instead of saved — the standard seq-512/large-batch enabler on HBM.
+    The eager-tape path records per-op, where a checkpoint boundary can't
+    apply — plain call there."""
+    from ..base import getenv_bool
+    from .. import autograd as _ag
+    if not getenv_bool("MXNET_BACKWARD_DO_MIRROR") or _ag.is_recording():
+        return cell(x, *rest)
+    import jax
+
+    def f(xv):
+        return cell(NDArray(xv), *rest)._data
+    return NDArray(jax.checkpoint(f)(x._data))
+
+
 class BERTEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  dropout=0.0, seq_axis=None, mesh=None, **kwargs):
@@ -254,7 +275,7 @@ class BERTEncoder(HybridBlock):
 
     def hybrid_forward(self, F, x, mask=None):
         for cell in self._children.values():
-            x = cell(x, mask)
+            x = maybe_remat_cell(cell, x, mask)
         return x
 
 

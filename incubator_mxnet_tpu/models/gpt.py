@@ -25,7 +25,7 @@ from ..base import MXNetError
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import NDArray, _invoke
-from .bert import MultiHeadAttention, PositionwiseFFN
+from .bert import MultiHeadAttention, PositionwiseFFN, maybe_remat_cell
 
 __all__ = ["GPTCell", "GPTModel", "gpt_tiny", "gpt2_124m", "tp_rules"]
 
@@ -127,7 +127,8 @@ class GPTModel(HybridBlock):
                 f"sequence length {ids.shape[1]} exceeds max_length "
                 f"{self._max_length}")
         x = self._embed_at(ids)
-        x = self.cells(x)
+        for cell in self.cells._children.values():
+            x = maybe_remat_cell(cell, x)
         return self._project(self.ln_f(x))
 
     # -- generation ----------------------------------------------------

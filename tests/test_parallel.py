@@ -372,3 +372,131 @@ def test_sp_impl_env_routes_model_attention(monkeypatch):
     monkeypatch.setenv("MXNET_SP_IMPL", "bogus")
     with pytest.raises(mx.MXNetError, match="MXNET_SP_IMPL"):
         _sdpa(q, k, v, H, seq_axis="seq", mesh=mesh)
+
+
+def test_gpt_spmd_dp_tp_sp_matches_single_device():
+    """The GPT family trains under a 3-axis data x model x seq mesh with
+    CAUSAL ring attention inside the compiled step, matching the 1-device
+    dense loss over two steps (update-dependent oracle, like the judged
+    BERT dryrun)."""
+    from incubator_mxnet_tpu.models import gpt
+    from incubator_mxnet_tpu.models.bert import dense_attention
+    mesh = parallel.make_mesh({"data": 2, "model": 2, "seq": 2})
+    V, B, T = 64, 4, 16
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, V, (B, T)).astype(np.int32)
+    y = rng.randint(0, V, (B, T)).astype(np.float32)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class _Wrap(mx.gluon.HybridBlock):
+        def __init__(self, net, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.net = net
+
+        def hybrid_forward(self, F, ids):
+            out = self.net(ids)
+            return out.reshape((-1, V))
+
+    def run(step_mesh, seq_axis, rules):
+        mx.random.seed(3)
+        net = gpt.gpt_tiny(vocab_size=V, dropout=0.0,
+                           seq_axis=seq_axis,
+                           mesh=step_mesh if seq_axis else None)
+        net.initialize(init=mx.init.Normal(0.02))
+        with dense_attention(net), mx.autograd.pause():
+            net(mx.nd.array(x, dtype="int32"))
+        tr = parallel.SPMDTrainer(
+            _Wrap(net), loss_fn, "adam", {"learning_rate": 1e-3},
+            mesh=step_mesh, data_axis="data", sharding_rules=rules,
+            shard_optimizer_state=True, donate=False)
+        tr.step(x, y.reshape(-1))
+        return float(tr.step(x, y.reshape(-1)))
+
+    loss = run(mesh, "seq", gpt.tp_rules("model"))
+    mesh1 = parallel.make_mesh({"data": 1, "model": 1},
+                               devices=__import__("jax").devices()[:1])
+    loss1 = run(mesh1, None, None)
+    assert np.isfinite(loss)
+    assert abs(loss - loss1) <= 1e-3 * max(1.0, abs(loss1)), (loss, loss1)
+
+
+def test_backward_do_mirror_equivalence(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR (layer remat under jax.checkpoint) must
+    not change the numbers: two SPMD training steps with mirror on == off
+    (reference: the mirror knob trades memory for recompute only)."""
+    from incubator_mxnet_tpu.models import bert as bert_mod
+    mesh = parallel.make_mesh({"data": 2})
+    V, B, T = 128, 4, 16
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, V, (B, T)).astype(np.int32)
+    types = np.zeros((B, T), np.int32)
+    labels = np.concatenate(
+        [rng.randint(0, V, (B, T)), rng.randint(0, 2, (B, 1))],
+        axis=1).astype(np.float32)
+
+    def make_trainer(mirror):
+        if mirror:
+            monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+        else:
+            monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+        mx.random.seed(5)
+        net = bert_mod.BERTForPretrain(
+            bert_mod.bert_tiny(vocab_size=V, max_length=T, dropout=0.0),
+            vocab_size=V)
+        net.initialize(init=mx.init.Normal(0.02))
+        with mx.autograd.pause():
+            net(mx.nd.array(ids, dtype="int32"),
+                mx.nd.array(types, dtype="int32"))
+        return parallel.SPMDTrainer(
+            net, bert_mod.BERTPretrainLoss(V), "adam",
+            {"learning_rate": 1e-3}, mesh=mesh, donate=False)
+
+    def two_steps(tr):
+        tr.step(ids, types, labels)
+        return float(tr.step(ids, types, labels))
+
+    base = two_steps(make_trainer(False))
+    tr_m = make_trainer(True)
+    # the remat must engage on the TRAINER's compiled path, not only in
+    # a hand-rolled trace: the step function's jaxpr carries the
+    # checkpoint primitive
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import random as mxrand
+    jx = jax.make_jaxpr(tr_m._build_step())(
+        tr_m._tr_vals, tr_m._aux_vals, tr_m._opt_state,
+        jnp.int32(1), mxrand.new_key(), ids, types, labels)
+    assert "remat" in str(jx)
+    remat = two_steps(tr_m)
+    assert np.isfinite(base)
+    np.testing.assert_allclose(remat, base, rtol=1e-6, atol=1e-7)
+
+
+def test_mirror_actually_inserts_remat(monkeypatch):
+    """The jaxpr of a mirrored layer must contain the checkpoint/remat
+    primitive — guards against the env gate silently never engaging."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.models import bert as bert_mod
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    mx.random.seed(0)
+    cell = bert_mod.TransformerEncoderCell(16, 32, 2, dropout=0.0)
+    cell.initialize(init=mx.init.Normal(0.02))
+    with mx.autograd.pause():
+        cell(mx.nd.ones((1, 4, 16)))
+
+    def make_f():
+        # distinct function objects per trace: jax caches traces by
+        # function identity + avals, and the env gate is (by design)
+        # read at trace time — a trainer builds a fresh step function,
+        # so each trainer construction re-reads the env
+        def f(xv):
+            return bert_mod.maybe_remat_cell(cell, NDArray(xv))._data
+        return f
+
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert "remat" in str(jax.make_jaxpr(make_f())(jnp.ones((1, 4, 16))))
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
+    assert "remat" not in str(
+        jax.make_jaxpr(make_f())(jnp.ones((1, 4, 16))))
