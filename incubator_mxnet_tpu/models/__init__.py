@@ -12,6 +12,8 @@ from . import yolo  # noqa: F401
 from .yolo import YOLOv3, yolo3_darknet53, yolo3_tiny
 from . import gpt  # noqa: F401
 from .gpt import GPTModel, gpt_tiny, gpt2_124m
+from . import moe  # noqa: F401
+from .moe import MoEFFN
 
 __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
            "bert_base", "bert_large", "bert_tiny",
@@ -19,4 +21,5 @@ __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
            "transformer_big",
            "ssd", "SSD", "ssd_512", "ssd_300", "ssd_tiny",
            "yolo", "YOLOv3", "yolo3_darknet53", "yolo3_tiny",
-           "gpt", "GPTModel", "gpt_tiny", "gpt2_124m"]
+           "gpt", "GPTModel", "gpt_tiny", "gpt2_124m",
+           "moe", "MoEFFN"]

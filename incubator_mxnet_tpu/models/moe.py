@@ -1,0 +1,180 @@
+"""Mixture-of-Experts FFN with expert parallelism (reference lineage:
+the Switch/GShard MoE layer — the reference repo itself predates MoE, so
+this is a beyond-parity capability like ring attention, SURVEY §5.7;
+built from the same op surface as every model here).
+
+TPU-first design:
+  * experts are STACKED parameters — w1 (E, C, H), w2 (E, H, C) — so
+    expert parallelism is nothing but a sharding rule
+    (``ep_rules('expert')``: PartitionSpec('expert', ...) on the stacked
+    axis).  GSPMD then inserts the dispatch all-to-alls over ICI by
+    itself; there is no hand-written collective (the scaling-book
+    recipe: annotate, let XLA place the communication);
+  * routing is the capacity-based GShard dispatch: one-hot
+    dispatch/combine tensors and three einsums — dense, static-shaped,
+    MXU-friendly; no sorts or dynamic shapes inside the program;
+  * top-k (k=1 Switch, k=2 GShard default) with renormalized gates and
+    rank-ordered capacity claims; overflowing tokens are DROPPED
+    (combine weight 0) exactly like the reference implementations — the
+    load-balancing auxiliary loss keeps that rare;
+  * the auxiliary load-balancing loss (Switch eq. 4) is returned
+    alongside the output so the training loss can add it.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import _invoke
+
+__all__ = ["MoEFFN", "ep_rules"]
+
+
+def _moe_dispatch(logits, k, capacity):
+    """GShard routing over one GROUP of g tokens: returns (dispatch
+    (g, E, Cap) f32, combine (g, E, Cap) f32, aux scalar).  Rank r
+    claims capacity after ranks < r; tokens keep arrival order within a
+    rank.  Vmapped over groups — capacity is per group, so the
+    dispatch/combine tensors stay linear in total token count."""
+    import jax
+    import jax.numpy as jnp
+    g, E = logits.shape
+    raw = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(raw, k)                  # (g, k)
+    w = vals / jnp.maximum(jnp.sum(vals, -1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((g, E, capacity), jnp.float32)
+    combine = jnp.zeros((g, E, capacity), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)
+    for r in range(k):
+        onehot = jax.nn.one_hot(idx[:, r], E, dtype=jnp.int32)  # (g, E)
+        # this token's slot in its expert's buffer: earlier tokens of
+        # the same rank + everything claimed by lower ranks
+        pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)                # (g,)
+        keep = pos_tok < capacity
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)
+        d_r = (onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+               * keep.astype(jnp.float32)[:, None, None])
+        dispatch = dispatch + d_r
+        combine = combine + d_r * w[:, r][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+
+    # Switch aux loss: E * sum_e mean_gate_e * fraction_top1_e
+    me = jnp.mean(raw, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32),
+                  axis=0)
+    aux = E * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+class MoEFFN(HybridBlock):
+    """Drop-in positionwise FFN with E experts.
+
+    Forward returns ``(out (B, T, C), aux_loss scalar)``; add
+    ``aux_weight * aux_loss`` to the training loss (Switch uses 1e-2).
+    ``capacity_factor`` scales each expert's token buffer
+    (ceil(cf * S * k / E)); overflow is dropped like the reference
+    implementations."""
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25, group_size=256, activation="gelu",
+                 dtype=_np.float32, **kwargs):
+        super().__init__(**kwargs)
+        if top_k < 1 or top_k > num_experts:
+            raise MXNetError(f"top_k={top_k} must be in [1, num_experts]")
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._k = top_k
+        self._cf = capacity_factor
+        self._group = group_size
+        self._act = activation
+        with self.name_scope():
+            self.router = nn.Dense(num_experts, flatten=False,
+                                   use_bias=False, in_units=units)
+            self.w1 = self.params.get(
+                "w1", shape=(num_experts, units, hidden_size), dtype=dtype)
+            self.b1 = self.params.get(
+                "b1", shape=(num_experts, hidden_size), dtype=dtype,
+                init="zeros")
+            self.w2 = self.params.get(
+                "w2", shape=(num_experts, hidden_size, units), dtype=dtype)
+            self.b2 = self.params.get(
+                "b2", shape=(num_experts, units), dtype=dtype,
+                init="zeros")
+
+    def hybrid_forward(self, F, x, w1, b1, w2, b2):
+        logits = self.router(x)                       # (B, T, E)
+        E, k, cf, act = self._E, self._k, self._cf, self._act
+        group = self._group
+
+        def run(xv, lg, w1v, b1v, w2v, b2v):
+            import functools
+            import jax
+            import jax.numpy as jnp
+            B, T, C = xv.shape
+            S = B * T
+            # route within fixed-size groups (GShard): capacity is per
+            # group, so dispatch/combine memory is O(S * g), linear in
+            # token count — never O(S^2)
+            g = min(group or S, S)
+            while S % g:              # largest divisor <= requested size
+                g -= 1
+            G = S // g
+            capacity = max(1, int(math.ceil(cf * g * k / E)))
+            dispatch, combine, aux = jax.vmap(
+                functools.partial(_moe_dispatch, k=k, capacity=capacity))(
+                    lg.reshape(G, g, E))
+            aux = jnp.mean(aux)       # equal groups: mean == global
+            xs = xv.reshape(G, g, C)
+            # dispatch -> per-expert buffers -> FFN -> combine back
+            ein = dispatch.astype(xv.dtype)
+            expert_in = jnp.einsum("gsec,gsm->gecm", ein, xs)
+            h = jnp.einsum("gecm,emh->gech", expert_in, w1v) \
+                + b1v[None, :, None, :]
+            h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+            y = jnp.einsum("gech,ehm->gecm", h, w2v) \
+                + b2v[None, :, None, :]
+            out = jnp.einsum("gsec,gecm->gsm",
+                             combine.astype(xv.dtype), y)
+            return out.reshape(B, T, C), aux
+
+        out, aux = _invoke(run, [x, logits, w1, b1, w2, b2], name="moe_ffn")
+        return out, aux
+
+
+def ep_rules(expert_axis="expert", block=None):
+    """Expert-parallel sharding: the stacked expert axis of every expert
+    parameter shards over the mesh's expert axis; GSPMD inserts the
+    token all-to-alls.  Compose with tp/dp rules by concatenation.
+
+    With ``block`` (a MoEFFN, or any Block containing them) the rules
+    are derived from the ACTUAL parameter names — use this whenever the
+    layers were built with a custom ``prefix=``, which the default
+    auto-prefix regexes cannot see (they would silently replicate the
+    experts)."""
+    import re
+    from jax.sharding import PartitionSpec as P
+    specs = {"w1": P(expert_axis, None, None),
+             "b1": P(expert_axis, None),
+             "w2": P(expert_axis, None, None),
+             "b2": P(expert_axis, None)}
+    if block is not None:
+        rules = []
+        blocks = []
+        block.apply(lambda b: blocks.append(b)
+                    if isinstance(b, MoEFFN) else None)
+        if not blocks:
+            raise MXNetError("ep_rules(block=...): no MoEFFN found")
+        for b in blocks:
+            for short, spec in specs.items():
+                rules.append(
+                    (f"^{re.escape(getattr(b, short).name)}$", spec))
+        return rules
+    return [(rf"moeffn\d+_{short}$", spec)
+            for short, spec in specs.items()]
