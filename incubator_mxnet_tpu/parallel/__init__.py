@@ -19,6 +19,8 @@ from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
 from .spmd import SPMDTrainer, shard_params, data_sharding
 from .ring import ring_attention, local_flash_attention
 from .ulysses import ulysses_attention
+from .pipeline import (gpipe, stack_stage_params, pipe_specs,
+                       stack_block_stages)
 from . import optim
 from . import distributed
 
@@ -26,4 +28,5 @@ __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "replicated", "shard_spec", "named_sharding",
            "device_put_sharded", "SPMDTrainer", "shard_params",
            "data_sharding", "ring_attention", "local_flash_attention",
-           "ulysses_attention", "optim", "distributed"]
+           "ulysses_attention", "gpipe", "stack_stage_params",
+           "pipe_specs", "stack_block_stages", "optim", "distributed"]

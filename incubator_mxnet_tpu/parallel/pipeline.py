@@ -1,0 +1,146 @@
+"""Pipeline parallelism: a GPipe schedule as ONE SPMD program
+(reference analog: the reference had no pipeline engine — its
+distributed story was data parallelism over kvstore; this is the
+beyond-parity axis completing dp/tp/sp/ep/pp.  Pattern: the
+pipelined-scan recipe of the TPU scaling playbook — stack homogeneous
+stage parameters, shard the stack over a mesh axis, stream microbatches
+around the ring with ppermute inside lax.scan).
+
+Design:
+  * stage parameters are STACKED pytrees — every leaf (S, ...) — and
+    sharded over the ``pipe`` mesh axis, so placement is a
+    PartitionSpec, exactly like tensor/expert parallelism here;
+  * the schedule runs M + S - 1 ticks; every device runs the SAME
+    program each tick (SPMD — idle bubble ticks compute on garbage and
+    are masked), activations hop stage->stage+1 via ppermute over ICI;
+  * differentiable end to end: lax.scan + ppermute transpose cleanly,
+    so jax.grad/SPMDTrainer-style training through the pipeline needs
+    nothing special;
+  * microbatches enter replicated; outputs are collected on the last
+    stage and replicated back with a psum — callers see a plain
+    (M, ...) array.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..base import MXNetError
+
+__all__ = ["gpipe", "stack_stage_params", "pipe_specs",
+           "stack_block_stages"]
+
+
+def stack_block_stages(blocks, rng_key=None):
+    """Turn a list of same-architecture (initialized, shape-settled)
+    Blocks into pipeline stages: returns ``(stage_fn, stacked_params)``
+    for :func:`gpipe`.  The first block is the template whose forward
+    runs functionally with each stage's parameter values substituted —
+    the ONE place the cell-as-stage recipe lives (used by the driver
+    dryrun and the tests alike)."""
+    import jax
+    from ..gluon.block import functional_call
+    from ..ndarray.ndarray import NDArray
+    if not blocks:
+        raise MXNetError("stack_block_stages needs >= 1 block")
+    template = blocks[0]
+    trainable = list(template.collect_params().values())
+    # strip each param's block-prefix so the SAME key maps the matching
+    # param across stages (collect_params order is construction order,
+    # identical for same-architecture blocks)
+    names = [p.name.split("_", 1)[1] for p in trainable]
+    trees = []
+    for b in blocks:
+        ps = list(b.collect_params().values())
+        if len(ps) != len(names):
+            raise MXNetError("stage blocks differ in parameter count")
+        trees.append({n: p.data()._data for n, p in zip(names, ps)})
+    stacked = stack_stage_params(trees)
+    key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+
+    def stage_fn(p, x):
+        outs, _ = functional_call(template, trainable,
+                                  [p[n] for n in names], [], [],
+                                  [NDArray(x)], False, key)
+        return outs[0]
+
+    return stage_fn, stacked
+
+
+def stack_stage_params(param_trees):
+    """Stack per-stage parameter pytrees (a list of S same-structure
+    trees) into one tree whose leaves carry a leading stage axis."""
+    import jax
+    import jax.numpy as jnp
+    if not param_trees:
+        raise MXNetError("stack_stage_params needs >= 1 stage tree")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def pipe_specs(stacked_params, axis="pipe"):
+    """PartitionSpecs sharding every leaf's leading (stage) axis."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def leaf(v):
+        return P(axis, *([None] * (v.ndim - 1)))
+    return jax.tree.map(leaf, stacked_params)
+
+
+def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs,
+          mesh, axis: str = "pipe"):
+    """Apply S pipeline stages to M microbatches.
+
+    stage_fn(params, x) -> y : one stage's computation (same shape in
+    and out — the transformer-layer contract); ``stacked_params``:
+    pytree with leading stage dim S == mesh.shape[axis];
+    ``xs``: (M, ...) microbatched activations.  Returns (M, ...) — the
+    composition stage_{S-1}(...stage_0(x)) per microbatch, replicated.
+
+    Wall-clock is (M + S - 1)/M of the ideal — the GPipe bubble; raise
+    M to amortize.  Gradients flow through (scan + ppermute transpose).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if axis not in mesh.shape:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    S = mesh.shape[axis]
+    M = xs.shape[0]
+    leading = {v.shape[0] for v in jax.tree.leaves(stacked_params)}
+    if leading != {S}:
+        raise MXNetError(
+            f"stacked_params leading dims {sorted(leading)} != pipe "
+            f"axis size {S}")
+
+    def body(params_local, xs_rep):
+        stage = jax.lax.axis_index(axis)
+        p = jax.tree.map(lambda a: a[0], params_local)  # this stage's
+        buf = jnp.zeros_like(xs_rep[0])
+        ys0 = jnp.zeros_like(xs_rep)
+
+        def tick(carry, t):
+            buf, ys = carry
+            # stage 0 ingests microbatch t (clipped reads during the
+            # drain phase are masked out downstream)
+            inp = jnp.where(stage == 0,
+                            xs_rep[jnp.clip(t, 0, M - 1)], buf)
+            out = stage_fn(p, inp)
+            # the last stage owns microbatch t - stage at this tick
+            idx = jnp.clip(t - stage, 0, M - 1)
+            valid = (stage == S - 1) & (t >= stage) & (t < stage + M)
+            ys = ys.at[idx].set(jnp.where(valid, out, ys[idx]))
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % S) for i in range(S)])
+            return (nxt, ys), None
+
+        (_, ys), _ = jax.lax.scan(tick, (buf, ys0),
+                                  jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; psum replicates them
+        ys = jnp.where(stage == S - 1, ys, jnp.zeros_like(ys))
+        return jax.lax.psum(ys, axis)
+
+    in_specs = (pipe_specs(stacked_params, axis), P())
+    return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                     check_vma=False)(stacked_params, xs)
