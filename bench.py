@@ -159,22 +159,35 @@ def _bench_bert(on_accel, kind, dev, seq_len=None, batch_ladder=None,
         jax.block_until_ready(loss)
         return steps * B / (time.perf_counter() - t0)
 
-    samples_per_sec, B_used = None, None
-    for B in batch_ladder:
+    # ladder: on OOM, first retry the SAME batch with layer remat
+    # (MXNET_BACKWARD_DO_MIRROR — activations recomputed in the
+    # backward), since a remat'd large batch usually beats a saved-
+    # activation small one on MFU; only then step the batch down
+    samples_per_sec, B_used, remat_used = None, None, False
+    attempts = [(B, m) for B in batch_ladder
+                for m in ((False, True) if on_accel else (False,))]
+    for i, (B, mirror) in enumerate(attempts):
         try:
-            samples_per_sec, B_used = _attempt(B), B
+            if mirror:
+                os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+            else:
+                os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+            samples_per_sec, B_used, remat_used = _attempt(B), B, mirror
             break
-        except Exception as e:  # OOM on this batch size -> step down
-            if "RESOURCE_EXHAUSTED" not in str(e) or B == batch_ladder[-1]:
+        except Exception as e:  # OOM on this config -> next rung
+            if "RESOURCE_EXHAUSTED" not in str(e) \
+                    or i == len(attempts) - 1:
                 raise
             import gc
             gc.collect()
+        finally:
+            os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
     assert samples_per_sec is not None  # loop breaks or re-raises
 
     flops = _model_flops_per_step(cfg, B_used, T)
     peak = _peak_flops(kind) if on_accel else None
     mfu = (samples_per_sec / B_used) * flops / peak if peak else None
-    return samples_per_sec, B_used, T, mfu
+    return samples_per_sec, B_used, T, mfu, remat_used
 
 
 def _bench_resnet50(on_accel, kind, dev):
@@ -339,13 +352,15 @@ def _scaling_dryrun(timeout=900):
 
 
 def main():
-    # The anchor must measure the DEFAULT config: a pre-set fusion flag
-    # (either spelling — base.getenv gives MXTPU_* precedence) would
-    # silently fuse the anchor run and turn the fusion_on delta into
-    # fused/fused ~1.0.  Force-unset both; the explicit fusion_on
-    # sub-record below measures the fused config.
+    # The anchor must measure the DEFAULT config: a pre-set fusion or
+    # mirror flag (either spelling — base.getenv gives MXTPU_*
+    # precedence) would silently change what the anchor measures (and a
+    # preset MXTPU_BACKWARD_DO_MIRROR=0 would veto the ladder's own
+    # remat retry).  Force-unset all; fusion_on measures the fused
+    # config explicitly and the ladder owns the remat knob.
     _preset = {k: os.environ.pop(k) for k in
-               ("MXNET_USE_FUSION", "MXTPU_USE_FUSION")
+               ("MXNET_USE_FUSION", "MXTPU_USE_FUSION",
+                "MXNET_BACKWARD_DO_MIRROR", "MXTPU_BACKWARD_DO_MIRROR")
                if k in os.environ}
     preset_fusion = ", ".join(f"{k}={v}" for k, v in _preset.items()) \
         or None
@@ -365,7 +380,8 @@ def main():
     dev = jax.devices()[0]
     accel_error = None
     try:
-        samples_per_sec, B_used, T, mfu = _bench_bert(on_accel, kind, dev)
+        samples_per_sec, B_used, T, mfu, remat = _bench_bert(
+            on_accel, kind, dev)
     except Exception as e:
         if not on_accel:
             raise
@@ -397,17 +413,17 @@ def main():
         # phase-2 (seq 512) + fusion-on delta at the phase-1 batch: these
         # are secondary records — a failure must not cost the anchor
         try:
-            s2, b2, t2, mfu2 = _bench_bert(
+            s2, b2, t2, mfu2, remat2 = _bench_bert(
                 on_accel, kind, dev, seq_len=512,
                 batch_ladder=[16, 8, 4], steps=10)
             phase2 = {"samples_per_sec": round(s2, 2), "batch_size": b2,
-                      "seq_len": t2,
+                      "seq_len": t2, "remat": remat2,
                       "mfu": round(mfu2, 4) if mfu2 is not None else None}
         except Exception as e:
             phase2 = {"error": str(e)[:200]}
         try:
             os.environ["MXNET_USE_FUSION"] = "1"
-            sf, bf, _, mfuf = _bench_bert(
+            sf, bf, _, mfuf, _rm = _bench_bert(
                 on_accel, kind, dev, batch_ladder=[B_used], steps=10)
             fusion = {
                 "samples_per_sec": round(sf, 2), "batch_size": bf,
@@ -439,6 +455,7 @@ def main():
         "objective": "MLM+NSP",
         "device": f"{platform or 'cpu'}:{kind or ''}",
         "dtype": "bfloat16" if on_accel else "float32",
+        "remat": remat,
         "resnet50": resnet,
         "dp_scaling": scaling,
     }
@@ -449,9 +466,10 @@ def main():
     if fusion is not None:
         out["fusion_on"] = fusion
     if preset_fusion is not None:
-        out["note"] = (f"pre-set fusion flag ignored ({preset_fusion}): "
-                       "the anchor always measures the default XLA path; "
-                       "see fusion_on for the fused config")
+        out["note"] = (f"pre-set flags ignored ({preset_fusion}): the "
+                       "anchor measures the default config; fusion_on "
+                       "covers the fused path and the OOM ladder decides "
+                       "remat itself (recorded per measurement)")
     print(json.dumps(out))
 
 

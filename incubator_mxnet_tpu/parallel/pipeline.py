@@ -30,24 +30,44 @@ __all__ = ["gpipe", "stack_stage_params", "pipe_specs",
            "stack_block_stages"]
 
 
-def stack_block_stages(blocks, rng_key=None):
+def stack_block_stages(blocks, training=False, rng_key=None):
     """Turn a list of same-architecture (initialized, shape-settled)
     Blocks into pipeline stages: returns ``(stage_fn, stacked_params)``
     for :func:`gpipe`.  The first block is the template whose forward
     runs functionally with each stage's parameter values substituted —
     the ONE place the cell-as-stage recipe lives (used by the driver
-    dryrun and the tests alike)."""
+    dryrun and the tests alike).
+
+    ``training`` selects the train-mode forward (BatchNorm batch stats
+    etc.).  Stage calls are pure fn(params, x), so STOCHASTIC layers get
+    the one ``rng_key`` on every call — identical dropout masks across
+    stages/microbatches.  Build pipeline stages with dropout disabled
+    (the standard pipeline practice); a block with active Dropout under
+    training=True is refused rather than silently mis-sampled."""
     import jax
     from ..gluon.block import functional_call
     from ..ndarray.ndarray import NDArray
     if not blocks:
         raise MXNetError("stack_block_stages needs >= 1 block")
     template = blocks[0]
+    if training:
+        from ..gluon import nn as _nn
+        drops = []
+        template.apply(lambda b: drops.append(b)
+                       if isinstance(b, _nn.Dropout)
+                       and getattr(b, "_rate", 0) else None)
+        if drops:
+            raise MXNetError(
+                "stack_block_stages(training=True) with active Dropout: "
+                "the pure stage contract would reuse one RNG key for "
+                "every stage/microbatch — build the stages with "
+                "dropout=0 instead")
     trainable = list(template.collect_params().values())
     # strip each param's block-prefix so the SAME key maps the matching
     # param across stages (collect_params order is construction order,
-    # identical for same-architecture blocks)
-    names = [p.name.split("_", 1)[1] for p in trainable]
+    # identical for same-architecture blocks); prefix='' blocks have no
+    # underscore to strip — [-1] keeps the whole name
+    names = [p.name.split("_", 1)[-1] for p in trainable]
     trees = []
     for b in blocks:
         ps = list(b.collect_params().values())
@@ -60,7 +80,7 @@ def stack_block_stages(blocks, rng_key=None):
     def stage_fn(p, x):
         outs, _ = functional_call(template, trainable,
                                   [p[n] for n in names], [], [],
-                                  [NDArray(x)], False, key)
+                                  [NDArray(x)], training, key)
         return outs[0]
 
     return stage_fn, stacked
