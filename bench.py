@@ -427,6 +427,7 @@ def main():
                 on_accel, kind, dev, batch_ladder=[B_used], steps=10)
             fusion = {
                 "samples_per_sec": round(sf, 2), "batch_size": bf,
+                "remat": _rm,
                 "mfu": round(mfuf, 4) if mfuf is not None else None,
                 "speedup_vs_xla": round(sf / samples_per_sec, 3)}
         except Exception as e:

@@ -103,7 +103,88 @@ def _default_suite():
     suite.append(("depth_to_space", (16, 64, 32, 32),
                   lambda: (lambda x: _nd().depth_to_space(x, 2),
                            [_mk((16, 64, 32, 32))]), None))
+    # round-4 families: flash attention kernel, legacy tail, MoE dispatch
+    suite.append(("flash_attention", (4, 8, 256, 64),
+                  lambda: (lambda q: _flash()(q, q, q),
+                           [_mk((4, 8, 256, 64))]),
+                  2 * 2 * 4 * 8 * 256 * 256 * 64))
+    suite.append(("count_sketch", (256, 4096),
+                  lambda: (lambda x: _contrib().count_sketch(
+                      x, _hash_idx(4096, 512), _signs(4096),
+                      out_dim=512), [_mk((256, 4096))]), None))
+    suite.append(("PSROIPooling", (1, 98, 64, 64),
+                  lambda: (lambda x: _contrib().PSROIPooling(
+                      x, _rois(16, 64), spatial_scale=1.0, output_dim=2,
+                      pooled_size=7), [_mk((1, 2 * 49, 64, 64))]), None))
+    suite.append(("SVMOutput", (4096, 1000),
+                  lambda: (lambda x: _nd().SVMOutput(
+                      x, _labels(4096, 1000)), [_mk((4096, 1000))]),
+                  None))
+    suite.append(("moe_ffn", (8, 128, 256),
+                  lambda: (lambda x: _moe()(x)[0], [_mk((8, 128, 256))]),
+                  # ~k/E of tokens hit each expert: 2 matmuls x top-2
+                  2 * 2 * 2 * 8 * 128 * 256 * 512))
     return suite
+
+
+_MOE_NET = None
+
+
+def _moe():
+    """One shared MoEFFN so its params build once per process."""
+    global _MOE_NET
+    if _MOE_NET is None:
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu.models import moe as moe_mod
+        mx.random.seed(0)
+        _MOE_NET = moe_mod.MoEFFN(256, 512, 8, top_k=2)
+        _MOE_NET.initialize(init=mx.init.Normal(0.05))
+    return _MOE_NET
+
+
+def _flash():
+    from incubator_mxnet_tpu.kernels import flash_attention
+
+    def run(q, k, v):
+        import jax
+        out = flash_attention(q._data, k._data, v._data)
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+        return NDArray(out)
+    return run
+
+
+def _hash_idx(d, k, seed=7):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    return mx.nd.array(rng.integers(0, k, (1, d)).astype(np.int32),
+                       dtype=np.int32)
+
+
+def _signs(d, seed=8):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    return mx.nd.array(rng.choice([-1.0, 1.0], (1, d)).astype(np.float32))
+
+
+def _rois(n, hw, seed=9):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    x0 = rng.integers(0, hw // 2, (n,))
+    y0 = rng.integers(0, hw // 2, (n,))
+    x1 = x0 + rng.integers(8, hw // 2, (n,))
+    y1 = y0 + rng.integers(8, hw // 2, (n,))
+    return mx.nd.array(np.stack(
+        [np.zeros(n), x0, y0, x1, y1], 1).astype(np.float32))
+
+
+def _labels(n, k, seed=10):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(seed)
+    return mx.nd.array(rng.integers(0, k, (n,)).astype(np.float32))
 
 
 def _contrib():

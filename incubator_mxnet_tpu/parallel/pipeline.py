@@ -38,12 +38,13 @@ def stack_block_stages(blocks, training=False, rng_key=None):
     the ONE place the cell-as-stage recipe lives (used by the driver
     dryrun and the tests alike).
 
-    ``training`` selects the train-mode forward (BatchNorm batch stats
-    etc.).  Stage calls are pure fn(params, x), so STOCHASTIC layers get
-    the one ``rng_key`` on every call — identical dropout masks across
-    stages/microbatches.  Build pipeline stages with dropout disabled
-    (the standard pipeline practice); a block with active Dropout under
-    training=True is refused rather than silently mis-sampled."""
+    ``training`` selects the train-mode forward.  Stage calls are pure
+    fn(params, x): STOCHASTIC layers would get the one ``rng_key`` on
+    every call and AUXILIARY state (BatchNorm running stats) has no way
+    out of the schedule — so training=True REFUSES blocks with active
+    Dropout or aux state rather than silently mis-sampling/stale-ing
+    them.  Build pipelined stages from deterministic, stateless layers
+    (LayerNorm etc.), the standard pipeline practice."""
     import jax
     from ..gluon.block import functional_call
     from ..ndarray.ndarray import NDArray
@@ -63,11 +64,22 @@ def stack_block_stages(blocks, training=False, rng_key=None):
                 "every stage/microbatch — build the stages with "
                 "dropout=0 instead")
     trainable = list(template.collect_params().values())
-    # strip each param's block-prefix so the SAME key maps the matching
-    # param across stages (collect_params order is construction order,
-    # identical for same-architecture blocks); prefix='' blocks have no
-    # underscore to strip — [-1] keeps the whole name
-    names = [p.name.split("_", 1)[-1] for p in trainable]
+    if any(p.grad_req == "null" for p in trainable) and training:
+        raise MXNetError(
+            "stack_block_stages(training=True) with auxiliary state "
+            "(BatchNorm running stats): the pure stage contract cannot "
+            "carry aux updates out of the schedule — use stateless "
+            "normalization (LayerNorm/GroupNorm) in pipelined stages")
+    # readable keys: strip the template's own prefix; stages align by
+    # POSITION (collect_params order is construction order, identical
+    # for same-architecture blocks), so a key collision — possible with
+    # prefix='' where child names carry no shared block prefix — falls
+    # back to enumerated keys rather than silently merging params
+    pfx = getattr(template, "prefix", "") or ""
+    names = [p.name[len(pfx):] if pfx and p.name.startswith(pfx)
+             else p.name for p in trainable]
+    if len(set(names)) != len(names):
+        names = [f"p{i}_{n}" for i, n in enumerate(names)]
     trees = []
     for b in blocks:
         ps = list(b.collect_params().values())

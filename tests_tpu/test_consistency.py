@@ -328,3 +328,33 @@ def test_legacy_tail_consistency():
         lambda d: mx.nd.SVMOutput(
             d, mx.nd.array(labels, ctx=d.context)), [scores],
         ctx_list=_ctx_list(), rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_generate_consistency():
+    """The LM forward logits must agree CPU vs chip (MXU-tolerance like
+    every matmul test here — bf16 operand rounding forbids exact token
+    claims), and the KV-cached lax.scan generator must RUN on the chip:
+    right shape, prompt preserved, tokens in-vocab.  Token-exact
+    equality across backends is not asserted: one near-tie argmax under
+    bf16 matmul rounding would legitimately diverge."""
+    from incubator_mxnet_tpu.models import gpt
+    rng = onp.random.default_rng(43)
+    prompt = rng.integers(1, 60, (2, 5)).astype(onp.int32)
+    logits, toks = [], []
+    for ctx in _ctx_list():
+        with ctx:
+            mx.random.seed(21)
+            net = gpt.gpt_tiny(vocab_size=60, dropout=0.0)
+            net.initialize(init=mx.init.Normal(0.02))
+            logits.append(net(mx.nd.array(prompt,
+                                          dtype="int32")).asnumpy())
+            out = net.generate(mx.nd.array(prompt, dtype="int32"),
+                               max_new_tokens=8, temperature=0.0,
+                               use_cache=True)
+            toks.append(out.asnumpy())
+    tu.assert_almost_equal(logits[0], logits[1], rtol=2e-2, atol=2e-3,
+                           names=("logits@cpu", "logits@accel"))
+    for t in toks:
+        assert t.shape == (2, 13)
+        onp.testing.assert_array_equal(t[:, :5], prompt)
+        assert ((t >= 0) & (t < 60)).all()
