@@ -272,6 +272,50 @@ def _bench_resnet50(on_accel, kind, dev):
     }
 
 
+def _bench_int8(on_accel, kind, dev):
+    """int8 vs fp32 inference throughput on a matmul-heavy MLP — the
+    fork's headline focus area (reference: docs faq/perf.md MKL-DNN
+    section, int8 ~3-4x fp32 on CPU; here the question is what XLA's
+    int8 matmul path yields on the MXU)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.contrib import quantization as q
+    from incubator_mxnet_tpu.gluon import nn
+
+    D, B = (4096, 256) if on_accel else (256, 32)
+    steps, warmup = (20, 3) if on_accel else (5, 2)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(3):
+        net.add(nn.Dense(D, in_units=D, activation="relu"))
+    net.initialize(init=mx.init.Xavier())
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (B, D)).astype(np.float32))
+    net(x)
+
+    def rate(f):
+        for _ in range(warmup):
+            out = f(x)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(x)
+        out.wait_to_read()
+        return steps * B / (time.perf_counter() - t0)
+
+    # fp32 FIRST: quantize_net rewrites the network IN PLACE (and
+    # returns it), so measuring after would time int8 twice
+    net.hybridize()
+    fp32 = rate(net)
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    qnet.hybridize()
+    int8 = rate(qnet)
+    return {"fp32_samples_per_sec": round(fp32, 1),
+            "int8_samples_per_sec": round(int8, 1),
+            "int8_speedup": round(int8 / fp32, 3),
+            "layers": "3x Dense(4096)" if on_accel else "3x Dense(256)",
+            "batch_size": B}
+
+
 _SCALING_SCRIPT = r"""
 import json, time
 import numpy as np
@@ -439,6 +483,10 @@ def main():
         resnet = _bench_resnet50(on_accel, kind, dev)
     except Exception as e:
         resnet = {"error": str(e)[:200]}
+    try:
+        int8 = _bench_int8(on_accel, kind, dev)
+    except Exception as e:
+        int8 = {"error": str(e)[:200]}
     scaling = _scaling_dryrun()
 
     out = {
@@ -458,6 +506,7 @@ def main():
         "dtype": "bfloat16" if on_accel else "float32",
         "remat": remat,
         "resnet50": resnet,
+        "int8_inference": int8,
         "dp_scaling": scaling,
     }
     if probe_error:
