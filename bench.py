@@ -283,14 +283,22 @@ def _bench_int8(on_accel, kind, dev):
 
     D, B = (4096, 256) if on_accel else (256, 32)
     steps, warmup = (20, 3) if on_accel else (5, 2)
-    mx.random.seed(0)
-    net = nn.HybridSequential()
-    for _ in range(3):
-        net.add(nn.Dense(D, in_units=D, activation="relu"))
-    net.initialize(init=mx.init.Xavier())
+
+    def build():
+        # same seed => identical weights for the fp32 and to-be-quantized
+        # copies.  TWO nets because (a) quantize_net rewrites its input
+        # IN PLACE and (b) calibration hooks only fire on a net that has
+        # never compiled a _CachedGraph for the calibration batch's key
+        # (a hybridized cache hit bypasses child __call__ entirely)
+        mx.random.seed(0)
+        n = nn.HybridSequential()
+        for _ in range(3):
+            n.add(nn.Dense(D, in_units=D, activation="relu"))
+        n.initialize(init=mx.init.Xavier())
+        return n
+
     x = mx.nd.array(np.random.default_rng(0).standard_normal(
         (B, D)).astype(np.float32))
-    net(x)
 
     def rate(f):
         for _ in range(warmup):
@@ -302,16 +310,24 @@ def _bench_int8(on_accel, kind, dev):
         out.wait_to_read()
         return steps * B / (time.perf_counter() - t0)
 
-    # fp32 FIRST: quantize_net rewrites the network IN PLACE (and
-    # returns it), so measuring after would time int8 twice
+    net = build()
+    net(x)
+    ref_out = net(x).asnumpy()
     net.hybridize()
     fp32 = rate(net)
-    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+
+    qnet = q.quantize_net(build(), calib_data=[x], calib_mode="naive")
+    q_out = qnet(x).asnumpy()
     qnet.hybridize()
     int8 = rate(qnet)
+    # record output agreement so a silently mis-calibrated int8 net can
+    # never masquerade as a valid speedup
+    rel = float(np.max(np.abs(q_out - ref_out))
+                / (np.max(np.abs(ref_out)) + 1e-9))
     return {"fp32_samples_per_sec": round(fp32, 1),
             "int8_samples_per_sec": round(int8, 1),
             "int8_speedup": round(int8 / fp32, 3),
+            "int8_vs_fp32_max_rel_dev": round(rel, 5),
             "layers": "3x Dense(4096)" if on_accel else "3x Dense(256)",
             "batch_size": B}
 

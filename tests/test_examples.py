@@ -45,3 +45,10 @@ def test_example_gpt_short():
                "--batch-size", "8", timeout=360)
     assert "greedy continuation accuracy" in out
     assert "top-k sample:" in out
+
+
+def test_example_moe_short():
+    out = _run("example/moe/train_moe.py", "--cpu", "--steps", "8",
+               timeout=360)
+    assert "expert shards:" in out
+    assert "final loss" in out
