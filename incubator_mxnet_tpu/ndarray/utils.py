@@ -56,30 +56,96 @@ def _flag_dtype(flag: int):
         raise MXNetError(f"unknown mshadow type flag {flag}")
 
 
+# storage-type enum (reference: include/mxnet/ndarray.h NDArrayStorageType)
+_STYPE_DENSE = 0
+_STYPE_ROW_SPARSE = 1
+_STYPE_CSR = 2
+_INT64_FLAG = 6
+
+
+def _shape_pack(shape):
+    return struct.pack("<i", len(shape)) \
+        + struct.pack(f"<{len(shape)}q", *shape)
+
+
+def _shape_unpack(mv, off):
+    (ndim,) = struct.unpack_from("<i", mv, off); off += 4
+    shape = struct.unpack_from(f"<{ndim}q", mv, off); off += 8 * ndim
+    return shape, off
+
+
+def _blob(a):
+    return _np.ascontiguousarray(a).tobytes()
+
+
 def _save_ndarray(buf: bytearray, arr):
-    np_data = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+    """One chunk.  Sparse layout follows the reference save sequence
+    (src/ndarray/ndarray.cc NDArray::Save sparse branch): V2 magic,
+    int32 stype, the STORAGE shape (the packed values buffer's TShape),
+    the logical shape, ctx, values dtype, then per aux array an int32
+    dtype flag + TShape, then the VALUES blob, then the aux blobs.
+    CSR aux order is (indptr, indices) — CSRAuxType kIndPtr=0, kIdx=1;
+    RowSparse has one aux (row indices), both int64.  Re-verify byte
+    order against genuine reference artifacts when the mount populates
+    (it has been empty every round)."""
+    from .sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        stype = _STYPE_ROW_SPARSE
+        auxes = [_np.asarray(arr.indices.asnumpy(), _np.int64)]
+        values = _np.asarray(arr.data.asnumpy())
+        shape = tuple(arr.shape)
+    elif isinstance(arr, CSRNDArray):
+        stype = _STYPE_CSR
+        auxes = [_np.asarray(arr.indptr.asnumpy(), _np.int64),
+                 _np.asarray(arr.indices.asnumpy(), _np.int64)]
+        values = _np.asarray(arr.data.asnumpy())
+        shape = tuple(arr.shape)
+    else:
+        np_data = (arr.asnumpy() if isinstance(arr, NDArray)
+                   else _np.asarray(arr))
+        buf += struct.pack("<I", _V2_MAGIC)
+        buf += struct.pack("<i", _STYPE_DENSE)
+        buf += _shape_pack(np_data.shape)
+        buf += struct.pack("<ii", 1, 0)              # Context: cpu(0)
+        buf += struct.pack("<i", _dtype_flag(np_data.dtype))
+        buf += np_data.tobytes()
+        return
     buf += struct.pack("<I", _V2_MAGIC)
-    buf += struct.pack("<i", 0)                      # stype: dense
-    buf += struct.pack("<i", np_data.ndim)           # TShape ndim
-    buf += struct.pack(f"<{np_data.ndim}q", *np_data.shape)
-    buf += struct.pack("<ii", 1, 0)                  # Context: cpu(0)
-    buf += struct.pack("<i", _dtype_flag(np_data.dtype))
-    buf += np_data.tobytes()
+    buf += struct.pack("<i", stype)
+    buf += _shape_pack(values.shape)                 # storage shape
+    buf += _shape_pack(shape)                        # logical shape
+    buf += struct.pack("<ii", 1, 0)
+    buf += struct.pack("<i", _dtype_flag(values.dtype))
+    for a in auxes:
+        buf += struct.pack("<i", _INT64_FLAG)
+        buf += _shape_pack(a.shape)
+    buf += _blob(values)                             # data blob first
+    for a in auxes:
+        buf += _blob(a)
+
+
+def _read_blob(mv, off, shape, dt):
+    n = int(_np.prod(shape)) if len(shape) else 1
+    data = _np.frombuffer(mv, dtype=dt, count=n,
+                          offset=off).reshape(shape)
+    return data, off + n * dt.itemsize
 
 
 def _load_ndarray(mv: memoryview, off: int):
     (magic,) = struct.unpack_from("<I", mv, off); off += 4
+    stype = _STYPE_DENSE
+    storage_shape = None
     if magic in (_V2_MAGIC, _V3_MAGIC):
         (stype,) = struct.unpack_from("<i", mv, off); off += 4
-        if stype != 0:
-            raise MXNetError(
-                "loading sparse NDArray is not supported yet (stype="
-                f"{stype})")
-        (ndim,) = struct.unpack_from("<i", mv, off); off += 4
-        shape = struct.unpack_from(f"<{ndim}q", mv, off); off += 8 * ndim
+        if stype not in (_STYPE_DENSE, _STYPE_ROW_SPARSE, _STYPE_CSR):
+            raise MXNetError(f"unknown storage type {stype} in file")
+        if stype != _STYPE_DENSE:
+            storage_shape, off = _shape_unpack(mv, off)
+        shape, off = _shape_unpack(mv, off)
+        ndim = len(shape)
     elif magic == _V1_MAGIC:
-        (ndim,) = struct.unpack_from("<i", mv, off); off += 4
-        shape = struct.unpack_from(f"<{ndim}q", mv, off); off += 8 * ndim
+        shape, off = _shape_unpack(mv, off)
+        ndim = len(shape)
     else:
         # legacy V0: the "magic" was actually ndim (uint32 dims)
         ndim = magic
@@ -87,11 +153,33 @@ def _load_ndarray(mv: memoryview, off: int):
     _dev_type, _dev_id = struct.unpack_from("<ii", mv, off); off += 8
     (flag,) = struct.unpack_from("<i", mv, off); off += 4
     dt = _flag_dtype(flag)
-    n = int(_np.prod(shape)) if ndim else 1
-    nbytes = n * dt.itemsize
-    data = _np.frombuffer(mv, dtype=dt, count=n, offset=off).reshape(shape)
-    off += nbytes
-    return _array(_np.array(data), dtype=dt), off
+    if stype == _STYPE_DENSE:
+        n = int(_np.prod(shape)) if ndim else 1
+        data = _np.frombuffer(mv, dtype=dt, count=n,
+                              offset=off).reshape(shape)
+        off += n * dt.itemsize
+        return _array(_np.array(data), dtype=dt), off
+    # sparse: aux descriptors, then the VALUES blob (its shape is the
+    # stored storage_shape), then the aux blobs — the reference's order
+    nad = 1 if stype == _STYPE_ROW_SPARSE else 2
+    aux_dts, aux_shapes = [], []
+    for _ in range(nad):
+        (aflag,) = struct.unpack_from("<i", mv, off); off += 4
+        aux_dts.append(_flag_dtype(aflag))
+        ashape, off = _shape_unpack(mv, off)
+        aux_shapes.append(ashape)
+    values, off = _read_blob(mv, off, storage_shape, dt)
+    values = _np.array(values)
+    auxes = []
+    for adt, ashape in zip(aux_dts, aux_shapes):
+        a, off = _read_blob(mv, off, ashape, adt)
+        auxes.append(_np.array(a))
+    from . import sparse as _sp
+    if stype == _STYPE_ROW_SPARSE:
+        return _sp.row_sparse_array(
+            (values, auxes[0]), shape=tuple(shape)), off
+    return _sp.csr_matrix(
+        (values, auxes[1], auxes[0]), shape=tuple(shape)), off
 
 
 def save(fname: str, data):

@@ -58,6 +58,44 @@ def v0_chunk(a):
     return b + a.tobytes()
 
 
+def shape_bytes(*dims):
+    return struct.pack("<i", len(dims)) + struct.pack(f"<{len(dims)}q",
+                                                      *dims)
+
+
+def sparse_chunks():
+    """RowSparse + CSR chunks per the reference save sequence
+    (src/ndarray/ndarray.cc NDArray::Save sparse branch): V2 magic,
+    stype (1 rsp / 2 csr), STORAGE shape (packed values buffer), logical
+    shape, ctx, value dtype, per-aux (int64 dtype flag + shape), the
+    VALUES blob, then the aux blobs.  CSR aux order is (indptr,
+    indices)."""
+    out = []
+    # RowSparse (6, 3): rows 1 and 4 occupied
+    vals = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+    idx = np.array([1, 4], np.int64)
+    b = struct.pack("<I", V2) + struct.pack("<i", 1)
+    b += shape_bytes(2, 3)                            # storage shape
+    b += shape_bytes(6, 3)                            # logical shape
+    b += struct.pack("<ii", 1, 0) + struct.pack("<i", FLAG[vals.dtype])
+    b += struct.pack("<i", 6) + shape_bytes(2)        # aux: int64, (2,)
+    b += vals.tobytes() + idx.tobytes()
+    out.append(("rsp", b, (vals, idx)))
+    # CSR (3, 4): [[0,7,0,0],[0,0,0,8],[9,0,0,0]]
+    data = np.array([7., 8., 9.], np.float32)
+    indices = np.array([1, 3, 0], np.int64)
+    indptr = np.array([0, 1, 2, 3], np.int64)
+    b = struct.pack("<I", V2) + struct.pack("<i", 2)
+    b += shape_bytes(3)                               # storage shape
+    b += shape_bytes(3, 4)                            # logical shape
+    b += struct.pack("<ii", 1, 0) + struct.pack("<i", FLAG[data.dtype])
+    b += struct.pack("<i", 6) + shape_bytes(4)        # indptr: (4,)
+    b += struct.pack("<i", 6) + shape_bytes(3)        # indices: (3,)
+    b += data.tobytes() + indptr.tobytes() + indices.tobytes()
+    out.append(("csr", b, (data, indices, indptr)))
+    return out
+
+
 def file_bytes(chunks, names):
     b = struct.pack("<QQ", LIST_MAGIC, 0)
     b += struct.pack("<Q", len(chunks))
@@ -98,6 +136,11 @@ def main():
         f.write(file_bytes([v0_chunk(np.array([[1.25, -2.5],
                                                [3.75, 4.0]],
                                               np.float64))], []))
+
+    sp = sparse_chunks()
+    with open("list_sparse.params", "wb") as f:
+        f.write(file_bytes([c for _, c, _ in sp],
+                           [n for n, _, _ in sp]))
 
     # module-style checkpoint: arg:/aux: prefixes (reference:
     # python/mxnet/model.py save_checkpoint naming)

@@ -132,3 +132,53 @@ def test_import_params_collision_refused(tmp_path):
     with pytest.raises(SystemExit, match="collision"):
         import_params.convert({"arg:a": 1, "aux:b": 2},
                               maps=[("a", "x"), ("b", "x")])
+
+
+def test_golden_sparse_loads_and_writer_byte_exact(tmp_path):
+    """Sparse chunks (RowSparse stype 1, CSR stype 2 with (indptr,
+    indices) aux order): golden bytes load into the sparse classes, and
+    mx.nd.save reproduces the independent assembly byte-exactly."""
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    d = mx.nd.load(os.path.join(_GOLD, "list_sparse.params"))
+    assert list(d.keys()) == ["rsp", "csr"]
+    rsp, csr = d["rsp"], d["csr"]
+    assert isinstance(rsp, sp.RowSparseNDArray)
+    assert isinstance(csr, sp.CSRNDArray)
+    dense_rsp = np.zeros((6, 3), np.float32)
+    dense_rsp[1] = [1, 2, 3]
+    dense_rsp[4] = [4, 5, 6]
+    np.testing.assert_array_equal(rsp.asnumpy(), dense_rsp)
+    dense_csr = np.zeros((3, 4), np.float32)
+    dense_csr[0, 1], dense_csr[1, 3], dense_csr[2, 0] = 7, 8, 9
+    np.testing.assert_array_equal(csr.asnumpy(), dense_csr)
+
+    out = tmp_path / "sparse_roundtrip.params"
+    mx.nd.save(str(out), {"rsp": rsp, "csr": csr})
+    with open(os.path.join(_GOLD, "list_sparse.params"), "rb") as f:
+        golden = f.read()
+    assert out.read_bytes() == golden
+
+
+def test_sparse_dense_mixed_roundtrip(tmp_path):
+    """A dict mixing dense, RowSparse and CSR arrays round-trips with
+    classes and values preserved (reference: mx.nd.save of sparse
+    gradients/embeddings)."""
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    rng = np.random.default_rng(0)
+    dense = mx.nd.array(rng.standard_normal((3, 3)).astype(np.float32))
+    rsp = sp.row_sparse_array(
+        (rng.standard_normal((2, 4)).astype(np.float32),
+         np.array([0, 7])), shape=(9, 4))
+    csr = sp.csr_matrix(
+        (np.array([1.5, -2.5], np.float32), np.array([2, 0]),
+         np.array([0, 1, 1, 2])), shape=(3, 5))
+    f = tmp_path / "mixed.params"
+    mx.nd.save(str(f), {"d": dense, "r": rsp, "c": csr})
+    back = mx.nd.load(str(f))
+    np.testing.assert_array_equal(back["d"].asnumpy(), dense.asnumpy())
+    assert isinstance(back["r"], sp.RowSparseNDArray)
+    np.testing.assert_array_equal(back["r"].asnumpy(), rsp.asnumpy())
+    np.testing.assert_array_equal(back["r"].indices.asnumpy(),
+                                  rsp.indices.asnumpy())
+    assert isinstance(back["c"], sp.CSRNDArray)
+    np.testing.assert_array_equal(back["c"].asnumpy(), csr.asnumpy())
