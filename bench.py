@@ -424,6 +424,13 @@ def main():
                if k in os.environ}
     preset_fusion = ", ".join(f"{k}={v}" for k, v in _preset.items()) \
         or None
+    try:
+        _main(preset_fusion)
+    finally:
+        os.environ.update(_preset)   # in-process callers keep their env
+
+
+def _main(preset_fusion):
     probe_error = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         platform, kind = "cpu", ""

@@ -32,7 +32,8 @@ from ..ndarray.ndarray import NDArray, _invoke
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "BERTEncoder", "BERTModel", "BERTForPretrain", "MLMPretrainLoss",
            "BERTMLMOnly", "bert_tiny", "bert_base", "bert_large",
-           "tp_rules", "dense_attention", "cached_step_attn",
+           "tp_rules", "derive_tp_rules", "dense_attention",
+           "cached_step_attn",
            "maybe_remat_cell"]
 
 
@@ -395,17 +396,81 @@ class BERTMLMOnly(HybridBlock):
         return mlm_scores
 
 
-def tp_rules(model_axis="model"):
-    """Megatron-style tensor-parallel sharding rules for SPMDTrainer:
-    FFN first matmul + QKV column-sharded, second matmul row-sharded."""
+from ..parallel.spmd import exact_rule  # noqa: E402  (shared rule builder)
+
+
+def derive_tp_rules(block, model_axis="model", extra=None):
+    """Megatron TP rules derived from a BUILT model's ACTUAL parameter
+    names: every MultiHeadAttention gets QKV column- / proj row-parallel,
+    every PositionwiseFFN first-matmul column- / second-matmul
+    row-parallel.  Name-exact, so custom ``prefix=`` models shard
+    correctly (the regex fallbacks in each family's ``tp_rules`` key on
+    the default auto-prefix names and would silently replicate a
+    custom-prefixed model — SPMDTrainer warns when that happens).
+    ``extra``: optional callable(block) -> list of rules appended per
+    visited block (model-family hooks for embeddings/heads)."""
+    from jax.sharding import PartitionSpec as P
+    rules = []
+
+    def visit(b):
+        if isinstance(b, MultiHeadAttention):
+            rules.extend(exact_rule(d.weight, P(model_axis, None))
+                         for d in (b.query, b.key, b.value))
+            rules.append(exact_rule(b.proj.weight, P(None, model_axis)))
+        elif isinstance(b, PositionwiseFFN):
+            rules.append(exact_rule(b.ffn_1.weight, P(model_axis, None)))
+            rules.append(exact_rule(b.ffn_2.weight, P(None, model_axis)))
+        elif isinstance(b, BERTForPretrain):
+            rules.append(exact_rule(b.mlm_decoder.weight,
+                                     P(model_axis, None)))
+        elif isinstance(b, BERTModel):
+            rules.append(exact_rule(b.word_embed.weight,
+                                     P(None, model_axis)))
+        if extra is not None:
+            rules.extend(extra(b))
+
+    block.apply(visit)
+    if not rules:
+        raise MXNetError("derive_tp_rules: no shardable layers under "
+                         f"{type(block).__name__}")
+    return rules
+
+
+def core_tp_regex_rules(model_axis="model"):
+    """The attention/FFN Megatron rules every transformer family shares
+    (regexes over the DEFAULT auto-prefix names: dense0..2 =
+    query/key/value, dense3 = proj — construction order; ffn dense0/1 =
+    first/second matmul).  Each family's ``tp_rules`` appends its own
+    embedding/head rules."""
     from jax.sharding import PartitionSpec as P
     return [
-        (r"ffn_1.*weight", P(model_axis, None)),   # (hidden, units)
-        (r"ffn_2.*weight", P(None, model_axis)),   # (units, hidden)
-        (r"(query|key|value).*weight", P(model_axis, None)),
-        (r"proj.*weight", P(None, model_axis)),
-        (r"mlm_decoder.*weight", P(model_axis, None)),
-        (r"word_embed.*weight", P(None, model_axis)),
+        (r"multiheadattention\d+_dense[012]_weight", P(model_axis, None)),
+        (r"multiheadattention\d+_dense3_weight", P(None, model_axis)),
+        (r"positionwiseffn\d+_dense0_weight", P(model_axis, None)),
+        (r"positionwiseffn\d+_dense1_weight", P(None, model_axis)),
+    ]
+
+
+def tp_rules(model_axis="model", block=None):
+    """Megatron-style tensor-parallel sharding rules for SPMDTrainer:
+    attention QKV + FFN first matmul column-parallel (axis 0 of the
+    (out, in) Dense weight), attention proj + FFN second matmul
+    row-parallel, MLM decoder column-parallel, word embedding sharded
+    over the units axis.  The regexes target DEFAULT auto-prefix names;
+    pass ``block=`` (the built net) to derive exact-name rules instead,
+    required whenever any layer was built with a custom ``prefix=``
+    (shard_params warns when a required rule goes dead)."""
+    from jax.sharding import PartitionSpec as P
+    if block is not None:
+        return derive_tp_rules(block, model_axis)
+    return core_tp_regex_rules(model_axis) + [
+        # BERTForPretrain heads: dense0 = mlm_dense, dense1 = mlm_decoder
+        # ((?#optional): a plain BERTModel has no pretrain head — exempt
+        # from shard_params' dead-rule warning, invisible to re.search)
+        (r"(?#optional)bertforpretrain\d+_dense1_weight",
+         P(model_axis, None)),
+        # BERTModel embeddings: embedding0 = word, embedding1 = token type
+        (r"bertmodel\d+_embedding0_weight", P(None, model_axis)),
     ]
 
 

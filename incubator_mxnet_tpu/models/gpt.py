@@ -147,6 +147,12 @@ class GPTModel(HybridBlock):
             raise MXNetError(
                 f"prompt {Tp} + {max_new_tokens} new tokens exceeds "
                 f"max_length {self._max_length}")
+        if max_new_tokens < 0:
+            raise MXNetError(
+                f"max_new_tokens={max_new_tokens} is negative (a "
+                "miscomputed budget?); use 0 for no-op generation")
+        if max_new_tokens == 0:
+            return ids
         from .. import random as _random
         key = _random.new_key() if seed is None else None
         if seed is not None:
@@ -302,16 +308,24 @@ def _pad_cache(kv, total):
     return jnp.concatenate([kv, pad], axis=1)
 
 
-def tp_rules(model_axis="model"):
+def tp_rules(model_axis="model", block=None):
     """Megatron sharding for SPMDTrainer (same spirit as bert.tp_rules):
     attention QKV + first FFN matmul column-parallel, attention proj +
-    second FFN matmul row-parallel, embeddings row-sharded over vocab."""
+    second FFN matmul row-parallel, embeddings row-sharded over vocab.
+    Pass ``block=`` (the built net) for exact-name rules — required with
+    custom ``prefix=`` models, where the auto-prefix regexes below would
+    silently replicate the weights (SPMDTrainer warns on dead rules)."""
     from jax.sharding import PartitionSpec as P
-    return [
-        (r"multiheadattention\d+_dense[012]_weight", P(model_axis, None)),
-        (r"multiheadattention\d+_dense3_weight", P(None, model_axis)),
-        (r"positionwiseffn\d+_dense0_weight", P(model_axis, None)),
-        (r"positionwiseffn\d+_dense1_weight", P(None, model_axis)),
+    if block is not None:
+        from .bert import derive_tp_rules, exact_rule
+
+        def gpt_extra(b):
+            if isinstance(b, GPTModel):
+                return [exact_rule(b.embed.weight, P(model_axis, None))]
+            return []
+        return derive_tp_rules(block, model_axis, extra=gpt_extra)
+    from .bert import core_tp_regex_rules
+    return core_tp_regex_rules(model_axis) + [
         (r"gptmodel\d+_embedding0_weight", P(model_axis, None)),
     ]
 

@@ -158,8 +158,8 @@ def ep_rules(expert_axis="expert", block=None):
     layers were built with a custom ``prefix=``, which the default
     auto-prefix regexes cannot see (they would silently replicate the
     experts)."""
-    import re
     from jax.sharding import PartitionSpec as P
+    from ..parallel.spmd import exact_rule
     specs = {"w1": P(expert_axis, None, None),
              "b1": P(expert_axis, None),
              "w2": P(expert_axis, None, None),
@@ -172,9 +172,8 @@ def ep_rules(expert_axis="expert", block=None):
         if not blocks:
             raise MXNetError("ep_rules(block=...): no MoEFFN found")
         for b in blocks:
-            for short, spec in specs.items():
-                rules.append(
-                    (f"^{re.escape(getattr(b, short).name)}$", spec))
+            rules.extend(exact_rule(getattr(b, short), spec)
+                         for short, spec in specs.items())
         return rules
     return [(rf"moeffn\d+_{short}$", spec)
             for short, spec in specs.items()]

@@ -549,16 +549,30 @@ class LabelSmoothingCELoss(HybridBlock):
         return _invoke(fn, [logits, labels], name="label_smoothing_ce")
 
 
-def tp_rules(model_axis="model"):
+def tp_rules(model_axis="model", block=None):
     """Megatron-style TP sharding rules for SPMDTrainer (see
-    bert.tp_rules)."""
+    bert.tp_rules; regexes target default auto-prefix names — pass
+    ``block=`` for exact-name rules with custom ``prefix=`` models)."""
     from jax.sharding import PartitionSpec as P
-    return [
-        (r"ffn_1.*weight", P(model_axis, None)),
-        (r"ffn_2.*weight", P(None, model_axis)),
-        (r"(query|key|value).*weight", P(model_axis, None)),
-        (r"proj.*weight", P(None, model_axis)),
-        (r"embed.*weight", P(None, model_axis)),
+    if block is not None:
+        from .bert import derive_tp_rules, exact_rule
+
+        def tf_extra(b):
+            rules = []
+            if isinstance(b, TransformerModel):
+                rules.append(exact_rule(b.embed.weight,
+                                         P(None, model_axis)))
+                if not b._tie:
+                    rules.append(exact_rule(b.out_proj.weight,
+                                             P(model_axis, None)))
+            return rules
+        return derive_tp_rules(block, model_axis, extra=tf_extra)
+    from .bert import core_tp_regex_rules
+    return core_tp_regex_rules(model_axis) + [
+        (r"embedding\d+_weight", P(None, model_axis)),
+        # untied output projection ((?#optional): absent when tied)
+        (r"(?#optional)transformermodel\d+_dense0_weight",
+         P(model_axis, None)),
     ]
 
 

@@ -26,7 +26,17 @@ def _pin_device(dev_type: int) -> None:
         try:
             jax.config.update("jax_platforms", "cpu")
         except RuntimeError:
-            pass      # backend already initialized; placement still cpu
+            # backend already initialized; if it settled on an
+            # accelerator, a cpu-ctx predictor would silently compute
+            # there (ops follow input placement) — surface it
+            if jax.default_backend() != "cpu":
+                import warnings
+                warnings.warn(
+                    "predictor requested dev_type=cpu but the jax "
+                    f"backend is already {jax.default_backend()!r}; "
+                    "cpu placement rides the ctx device, but create "
+                    "the predictor before any accelerator use to pin "
+                    "the platform", stacklevel=3)
 
 
 class Predictor:

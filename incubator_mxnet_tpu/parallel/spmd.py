@@ -32,7 +32,15 @@ from ..gluon.block import functional_call
 from . import mesh as mesh_mod
 from . import optim as fopt
 
-__all__ = ["SPMDTrainer", "shard_params", "data_sharding"]
+__all__ = ["SPMDTrainer", "shard_params", "data_sharding", "exact_rule"]
+
+
+def exact_rule(param, spec):
+    """One exact-name sharding rule ``("^<name>$", spec)`` for a
+    Parameter (or anything with ``.name``) — the building block every
+    ``*_rules(block=...)`` derivation uses; immune to custom prefixes,
+    unlike the auto-prefix regex rule lists."""
+    return (f"^{re.escape(param.name)}$", spec)
 
 
 def data_sharding(mesh, data_axis="data"):
@@ -42,18 +50,43 @@ def data_sharding(mesh, data_axis="data"):
 
 def shard_params(params: Dict[str, object], mesh, rules=None):
     """Apply (regex, PartitionSpec) rules to a name→array dict; first match
-    wins, default replicated.  Returns name→NamedSharding."""
+    wins, default replicated.  Returns name→NamedSharding.
+
+    Warns on DEAD rules (patterns matching no parameter): a sharding rule
+    that silently matches nothing replicates the weights it was meant to
+    shard — the failure mode of auto-prefix regexes applied to a
+    custom-``prefix=`` model (use the family's ``tp_rules(block=net)``).
+    Patterns carrying a ``(?#optional)`` regex comment (a model-variant
+    rule, e.g. an untied-head rule on a tied model) are exempt."""
     from jax.sharding import NamedSharding, PartitionSpec
     out = {}
-    rules = rules or []
+    rules = list(rules or [])
+    hit = [False] * len(rules)
     for name in params:
-        spec = PartitionSpec()
-        for pat, s in rules:
+        spec = None
+        for i, (pat, s) in enumerate(rules):
             if re.search(pat, name):
-                spec = s if isinstance(s, PartitionSpec) \
-                    else PartitionSpec(*s)
-                break
-        out[name] = NamedSharding(mesh, spec)
+                # FIRST match decides the spec, but every matching rule
+                # counts as live — a rule shadowed by an earlier one is
+                # not dead (its weights are sharded, just by the earlier
+                # rule)
+                hit[i] = True
+                if spec is None:
+                    spec = s if isinstance(s, PartitionSpec) \
+                        else PartitionSpec(*s)
+        out[name] = NamedSharding(mesh, spec or PartitionSpec())
+    # a "(?#optional)" regex comment inside the pattern marks the rule
+    # as covering a model VARIANT (e.g. an untied-head rule on a tied
+    # model) — exempt from the dead warning; any other dead rule means
+    # the weights it targets silently replicate
+    dead = [rules[i][0] for i in range(len(rules))
+            if not hit[i] and "(?#optional)" not in rules[i][0]]
+    if dead:
+        import warnings
+        warnings.warn(
+            "sharding rules matched no parameter (their weights stay "
+            f"REPLICATED): {dead}; with custom prefix= models derive "
+            "exact-name rules via tp_rules(block=net)", stacklevel=2)
     return out
 
 

@@ -1,0 +1,162 @@
+"""Tensor-parallel sharding rules must MATCH the models' real parameter
+names (round-4 advisor finding: bert/transformer regexes keyed on
+attribute names — ``query``/``ffn_1`` — that never appear in the
+auto-prefix parameter names, so the "Megatron TP" mesh axis silently
+replicated every weight; the loss oracle can't catch it because
+replication is numerically identical).  These tests pin:
+
+  * every matmul-shaped weight of each family is covered by the default
+    regex rules on a default-prefix model;
+  * ``tp_rules(block=net)`` derives exact-name rules that survive a
+    custom ``prefix=``;
+  * ``shard_params`` warns on dead rules (the catch-all for both).
+
+Reference analog: the placement assertions of
+tests/python/unittest/test_gluon.py::test_sparse_hybrid_block (device
+placement is asserted, not just values)."""
+import re
+import warnings
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import parallel
+from incubator_mxnet_tpu.models import bert, gpt, transformer
+
+
+def _matmul_weights(net, exclude=()):
+    names = [n for n in net.collect_params()
+             if n.endswith("_weight") and not any(e in n for e in exclude)]
+    assert names
+    return names
+
+
+def _covered(names, rules):
+    return [n for n in names
+            if any(re.search(rule[0], n) for rule in rules)]
+
+
+def _assert_shards(net, rules, must_shard):
+    """Every name in must_shard gets a non-replicated PartitionSpec."""
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    params = {n: p.data()._data for n, p in net.collect_params().items()}
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")       # dead rules would raise
+        shardings = parallel.shard_params(params, mesh, rules)
+    for n in must_shard:
+        spec = shardings[n].spec
+        assert any(ax is not None for ax in spec), \
+            f"{n} stayed replicated: {spec}"
+
+
+def _built_bert(prefix=None):
+    mx.random.seed(0)
+    kw = {"prefix": prefix} if prefix else {}
+    net = bert.BERTForPretrain(
+        bert.bert_tiny(vocab_size=64, dropout=0.0), vocab_size=64, **kw)
+    net.initialize()
+    ids = mx.nd.array(np.zeros((1, 8)), dtype="int32")
+    with mx.autograd.pause():
+        net(ids, ids)
+    return net
+
+
+def test_bert_default_rules_cover_attention_ffn_head_embed():
+    net = _built_bert()
+    rules = bert.tp_rules("model")
+    names = list(net.collect_params())
+    att = [n for n in names
+           if re.search(r"multiheadattention\d+_dense\d+_weight", n)]
+    ffn = [n for n in names
+           if re.search(r"positionwiseffn\d+_dense\d+_weight", n)]
+    assert att and ffn
+    covered = set(_covered(names, rules))
+    for n in att + ffn:
+        assert n in covered, n
+    assert any("embedding0_weight" in n for n in covered)       # word
+    assert any(re.search(r"bertforpretrain\d+_dense1_weight", n)
+               for n in covered)                                # decoder
+    _assert_shards(net, rules, att + ffn)
+
+
+def test_bert_derived_rules_survive_custom_prefix():
+    net = _built_bert(prefix="my_bert_")
+    # the regex embedding/head rules key on 'bertforpretrain0_' which a
+    # custom prefix erases; block= derivation must still cover them
+    rules = bert.tp_rules("model", block=net)
+    names = list(net.collect_params())
+    att_ffn = [n for n in names
+               if re.search(r"(multiheadattention|positionwiseffn)"
+                            r"\d+_dense\d+_weight", n)]
+    head = [n for n in names if n == net.mlm_decoder.weight.name]
+    embed = [n for n in names
+             if n == net.bert.word_embed.weight.name]
+    assert head and embed
+    _assert_shards(net, rules, att_ffn + head + embed)
+
+
+def test_transformer_default_rules_cover_matmuls():
+    mx.random.seed(0)
+    net = transformer.TransformerModel(
+        vocab_size=64, units=16, hidden_size=32, num_layers=1,
+        num_heads=2, dropout=0.0)
+    net.initialize()
+    src = mx.nd.array(np.zeros((1, 6)), dtype="int32")
+    with mx.autograd.pause():
+        net(src, src)
+    rules = transformer.tp_rules("model")
+    names = list(net.collect_params())
+    dense = [n for n in names
+             if re.search(r"(multiheadattention|positionwiseffn)"
+                          r"\d+_dense\d+_weight", n)]
+    assert dense
+    _assert_shards(net, rules, dense)
+
+
+def test_gpt_derived_rules_survive_custom_prefix():
+    mx.random.seed(0)
+    net = gpt.gpt_tiny(vocab_size=64, dropout=0.0, prefix="my_gpt_")
+    net.initialize()
+    with mx.autograd.pause():
+        net(mx.nd.array(np.zeros((1, 8)), dtype="int32"))
+    rules = gpt.tp_rules("model", block=net)
+    names = list(net.collect_params())
+    dense = [n for n in names
+             if re.search(r"(multiheadattention|positionwiseffn)"
+                          r"\d+_dense\d+_weight", n)]
+    embed = [net.embed.weight.name]
+    _assert_shards(net, rules, dense + embed)
+    # and the default embedding regex is indeed dead on this net —
+    # exactly the case block= exists for
+    dead_embed = [n for n in names
+                  if re.search(r"gptmodel\d+_embedding0_weight", n)]
+    assert not dead_embed
+
+
+def test_default_gpt_rules_on_custom_prefix_warn_dead_embedding():
+    # the exact advertised failure: inner auto-names keep matching but
+    # the model-level embedding rule dies under a custom prefix — the
+    # PARTIAL deadness must warn (the embedding is the largest weight)
+    mx.random.seed(0)
+    net = gpt.gpt_tiny(vocab_size=64, dropout=0.0, prefix="my_gpt_")
+    net.initialize()
+    with mx.autograd.pause():
+        net(mx.nd.array(np.zeros((1, 8)), dtype="int32"))
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    params = {n: p.data()._data for n, p in net.collect_params().items()}
+    with pytest.warns(UserWarning, match="embedding0"):
+        parallel.shard_params(params, mesh, gpt.tp_rules("model"))
+
+
+def test_shard_params_warns_on_dead_rules():
+    from jax.sharding import PartitionSpec as P
+    mesh = parallel.make_mesh({"data": 4, "model": 2})
+    params = {"net0_dense0_weight": np.zeros((4, 4), np.float32)}
+    with pytest.warns(UserWarning, match="matched no parameter"):
+        parallel.shard_params(params, mesh,
+                              [(r"query.*weight", P("model", None))])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        parallel.shard_params(params, mesh,
+                              [(r"dense0_weight", P("model", None))])
