@@ -131,6 +131,47 @@ class GPTModel(HybridBlock):
             x = maybe_remat_cell(cell, x)
         return self._project(self.ln_f(x))
 
+    # -- pipeline parallelism ------------------------------------------
+    def pipeline_split(self):
+        """Stage protocol for ``parallel.PipelineTrainer`` (reached via
+        ``SPMDTrainer(..., pipeline_axis=...)``): returns
+        ``(first_params, first_fn, cells, last_params, last_fn)``.
+        Stage 0 owns the embeddings (``first_fn`` embeds a microbatch of
+        ids into (b, T, C)); every stage runs its contiguous slice of
+        ``cells``; the last stage applies the final LayerNorm and the
+        TIED LM head — the embedding matrix arrives back via
+        ``first_vals`` so the tying (and both gradient contributions,
+        summed by the pipe-axis psum) is preserved.  Requires
+        dropout=0 (the trainer enforces the pure-stage contract)."""
+        import jax
+
+        first_params = [self.embed.weight, self.pos_embed.weight]
+        max_length = self._max_length
+
+        def first_fn(vals, ids):
+            import jax.numpy as jnp
+            E, Ppos = vals
+            T = ids.shape[-1]
+            if T > max_length:       # static shape — trace-time guard,
+                raise MXNetError(    # same contract as hybrid_forward
+                    f"sequence length {T} exceeds max_length "
+                    f"{max_length}")
+            pos = Ppos[jnp.arange(T)][None]
+            return E[ids] + pos.astype(E.dtype)
+
+        cells = list(self.cells._children.values())
+        ln = self.ln_f
+        last_params = [ln.gamma, ln.beta]
+        key = jax.random.PRNGKey(0)     # LN consumes no randomness
+
+        def last_fn(vals, first_vals, xv):
+            from ..gluon.block import functional_call
+            outs, _ = functional_call(ln, last_params, list(vals),
+                                      [], [], [NDArray(xv)], False, key)
+            return _lm_logits(outs[0], first_vals[0])
+
+        return first_params, first_fn, cells, last_params, last_fn
+
     # -- generation ----------------------------------------------------
     def generate(self, ids, max_new_tokens=32, temperature=0.0,
                  top_k=0, use_cache=True, seed=None):

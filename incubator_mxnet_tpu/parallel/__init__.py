@@ -16,17 +16,18 @@ shardings + one compiled step:
 from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
                    replicated, shard_spec, named_sharding,
                    device_put_sharded)
-from .spmd import SPMDTrainer, shard_params, data_sharding
+from .spmd import SPMDTrainer, shard_params, data_sharding, exact_rule
 from .ring import ring_attention, local_flash_attention
 from .ulysses import ulysses_attention
 from .pipeline import (gpipe, stack_stage_params, pipe_specs,
-                       stack_block_stages)
+                       stack_block_stages, PipelineTrainer)
 from . import optim
 from . import distributed
 
 __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "replicated", "shard_spec", "named_sharding",
            "device_put_sharded", "SPMDTrainer", "shard_params",
-           "data_sharding", "ring_attention", "local_flash_attention",
-           "ulysses_attention", "gpipe", "stack_stage_params",
-           "pipe_specs", "stack_block_stages", "optim", "distributed"]
+           "data_sharding", "exact_rule", "ring_attention",
+           "local_flash_attention", "ulysses_attention", "gpipe",
+           "stack_stage_params", "pipe_specs", "stack_block_stages",
+           "PipelineTrainer", "optim", "distributed"]

@@ -24,10 +24,12 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import numpy as _np
+
 from ..base import MXNetError
 
 __all__ = ["gpipe", "stack_stage_params", "pipe_specs",
-           "stack_block_stages"]
+           "stack_block_stages", "PipelineTrainer"]
 
 
 def stack_block_stages(blocks, training=False, rng_key=None):
@@ -52,17 +54,7 @@ def stack_block_stages(blocks, training=False, rng_key=None):
         raise MXNetError("stack_block_stages needs >= 1 block")
     template = blocks[0]
     if training:
-        from ..gluon import nn as _nn
-        drops = []
-        template.apply(lambda b: drops.append(b)
-                       if isinstance(b, _nn.Dropout)
-                       and getattr(b, "_rate", 0) else None)
-        if drops:
-            raise MXNetError(
-                "stack_block_stages(training=True) with active Dropout: "
-                "the pure stage contract would reuse one RNG key for "
-                "every stage/microbatch — build the stages with "
-                "dropout=0 instead")
+        _refuse_impure(template, "stack_block_stages(training=True)")
     trainable = list(template.collect_params().values())
     if any(p.grad_req == "null" for p in trainable) and training:
         raise MXNetError(
@@ -176,3 +168,332 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs,
     in_specs = (pipe_specs(stacked_params, axis), P())
     return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
                      check_vma=False)(stacked_params, xs)
+
+
+def _refuse_impure(net, what):
+    """The pure-stage contract shared with stack_block_stages: stochastic
+    layers would reuse one RNG key across stages/microbatches and aux
+    state (BatchNorm stats) has no way out of the schedule."""
+    from ..gluon import nn as _nn
+    drops = []
+    net.apply(lambda b: drops.append(b) if isinstance(b, _nn.Dropout)
+              and getattr(b, "_rate", 0) else None)
+    if drops:
+        raise MXNetError(
+            f"{what} with active Dropout: build the net with dropout=0 "
+            "(the pure stage contract cannot thread per-stage RNG)")
+
+
+from .spmd import SPMDTrainer as _SPMDTrainer  # noqa: E402
+
+
+class PipelineTrainer(_SPMDTrainer):
+    """GPipe pipeline-parallel TRAINING as one compiled SPMD program over
+    a ``data`` x ``pipe`` mesh (typically reached via
+    ``SPMDTrainer(..., pipeline_axis="pipe")``).
+
+    Stage assignment is Megatron's: every stage runs an equal contiguous
+    slice of the model's transformer cells; stage 0 additionally runs
+    the embedding ("first") work and the LAST stage the final-norm +
+    head ("last") work plus the loss, so activations crossing stages are
+    uniformly (b, T, C) and the collected per-microbatch output is a
+    scalar loss.  The model describes that split via
+    ``pipeline_split() -> (first_params, first_fn, cells, last_params,
+    last_fn)`` where ``first_fn(first_vals, ids) -> x`` embeds a
+    microbatch and ``last_fn(last_vals, first_vals, x) -> outputs``
+    produces what the loss block consumes (``first_vals`` is passed back
+    so tied heads — GPT's logits through the embedding matrix — stay
+    tied; both gradient contributions sum via the pipe-axis psum the
+    shard_map transpose inserts).
+
+    Parameter placement is pure sharding, like every other axis here:
+    cell parameters are STACKED (S, ...) pytrees sharded over ``pipe``
+    (each device holds only its stages' weights — the memory win
+    pipeline parallelism exists for); first/last parameters ride
+    replicated.  The optimizer state inherits each leaf's sharding, so
+    cell-state memory also scales 1/S.  The batch axis shards over
+    ``data`` exactly as in SPMDTrainer; grad all-reduce is the compiled
+    psum.
+
+    Schedule: plain GPipe — M microbatches, M + S - 1 ticks, bubble
+    fraction (S-1)/(M+S-1); raise ``pipeline_microbatches`` to amortize.
+    Every tick every device runs the same program (SPMD): non-owning
+    stages compute first/last work into a discarded ``where`` branch —
+    wasted FLOPs linear in (first+last)/stage cost, the price of
+    single-program form (a 1F1B interleave is a schedule change inside
+    ``_build_step``, not an API change).
+
+    Restrictions (all raise): dropout > 0 anywhere in the net, aux state
+    (BatchNorm) in cells, ``lamb`` (its per-TENSOR trust ratio sees the
+    stacked (S, ...) tensor, changing the math vs the unstacked oracle),
+    len(cells) % S != 0, and local batch % microbatches != 0.
+
+    Reference analog: none — the reference's distributed story stops at
+    data parallelism over kvstore (SURVEY §2.4); this is the pp axis of
+    the beyond-parity dp/tp/sp/ep/pp set, trained end to end.
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, data_axis="data", sharding_rules=None,
+                 extra_input_shardings=None, donate=True,
+                 shard_optimizer_state=False, pipeline_axis="pipe",
+                 pipeline_microbatches=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import mesh as mesh_mod
+        from . import optim as fopt
+
+        if sharding_rules or extra_input_shardings or shard_optimizer_state:
+            raise MXNetError(
+                "pipeline_axis does not compose with sharding_rules / "
+                "extra_input_shardings / shard_optimizer_state yet — "
+                "cell params are already sharded over the pipe axis "
+                "(their optimizer state with them)")
+        self._net = net
+        self._loss = loss_fn
+        self._mesh = mesh or mesh_mod.current_mesh()
+        if self._mesh is None:
+            raise MXNetError("PipelineTrainer needs a mesh")
+        for ax in (data_axis, pipeline_axis):
+            if ax not in self._mesh.shape:
+                raise MXNetError(f"mesh has no axis {ax!r}")
+        self._data_axis = data_axis
+        self._pipe_axis = pipeline_axis
+        self._S = S = self._mesh.shape[pipeline_axis]
+        self._donate = donate
+        if optimizer == "lamb":
+            raise MXNetError(
+                "lamb is not stage-stacking-safe (per-tensor trust "
+                "ratio over the stacked (S, ...) tensor differs from "
+                "per-stage); use sgd/adam")
+        self._opt = fopt.create(optimizer, **(optimizer_params or {}))
+
+        if not hasattr(net, "pipeline_split"):
+            raise MXNetError(
+                f"{type(net).__name__} does not implement "
+                "pipeline_split(); see models/gpt.py for the protocol")
+        (self._first_params, self._first_fn, cells,
+         self._last_params, self._last_fn) = net.pipeline_split()
+        _refuse_impure(net, "PipelineTrainer")
+        if len(cells) % S:
+            raise MXNetError(
+                f"{len(cells)} cells do not split over pipe axis {S}")
+        self._L = L = len(cells) // S
+        self._cells = cells
+        self._cell_trainables = []
+        n_per_cell = None
+        for c in cells:
+            ps = list(c.collect_params().values())
+            if any(p.grad_req == "null" for p in ps):
+                raise MXNetError(
+                    "pipelined cells with auxiliary state (BatchNorm "
+                    "running stats) are unsupported — use stateless "
+                    "normalization (LayerNorm)")
+            if n_per_cell is None:
+                n_per_cell = len(ps)
+            elif len(ps) != n_per_cell:
+                raise MXNetError("cells differ in parameter count")
+            self._cell_trainables.append(ps)
+        for p in (list(self._first_params) + list(self._last_params)
+                  + [q for ps in self._cell_trainables for q in ps]):
+            if p._data is None:
+                raise MXNetError(
+                    "initialize the net and run one forward before "
+                    "building a PipelineTrainer")
+
+        repl = NamedSharding(self._mesh, P())
+
+        def pipe_sh(v):
+            return NamedSharding(
+                self._mesh, P(pipeline_axis, *([None] * (v.ndim - 1))))
+
+        # placed COPIES (same donation-safety reasoning as SPMDTrainer)
+        self._first_vals = tuple(
+            jnp.copy(jax.device_put(p.data()._data, repl))
+            for p in self._first_params)
+        self._last_vals = tuple(
+            jnp.copy(jax.device_put(p.data()._data, repl))
+            for p in self._last_params)
+        stacked = {}
+        for j in range(L):
+            for i in range(n_per_cell):
+                vals = [self._cell_trainables[s * L + j][i].data()._data
+                        for s in range(S)]
+                v = jnp.stack(vals)
+                stacked[f"c{j}_p{i}"] = jnp.copy(
+                    jax.device_put(v, pipe_sh(v)))
+        self._stacked = stacked
+        self._opt_state = self._opt.init(
+            (self._first_vals, self._stacked, self._last_vals))
+        self._M = S if pipeline_microbatches is None \
+            else int(pipeline_microbatches)
+        if self._M < 1:
+            raise MXNetError("pipeline_microbatches must be >= 1")
+        self._step_count = 0
+        self._jit_cache = {}
+
+    # _shard_batch / mesh come from SPMDTrainer (whose __init__ this
+    # class REPLACES rather than extends — the parameter storage is
+    # stacked-by-stage, not per-Parameter)
+
+    @property
+    def params(self):
+        out = {p.name: v for p, v in
+               zip(self._first_params, self._first_vals)}
+        out.update({p.name: v for p, v in
+                    zip(self._last_params, self._last_vals)})
+        L, S = self._L, self._S
+        for j in range(L):
+            for i in range(len(self._cell_trainables[0])):
+                leaf = self._stacked[f"c{j}_p{i}"]
+                for s in range(S):
+                    out[self._cell_trainables[s * L + j][i].name] = \
+                        leaf[s]
+        return out
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from ..gluon.block import functional_call
+        from ..ndarray.ndarray import NDArray
+        from .. import autograd as _ag
+
+        mesh, S, L, M = self._mesh, self._S, self._L, self._M
+        pipe, data = self._pipe_axis, self._data_axis
+        templates = self._cells[:L]
+        tmpl_params = self._cell_trainables[:L]
+        n_per_cell = len(tmpl_params[0])
+        first_fn, last_fn, loss_blk = (self._first_fn, self._last_fn,
+                                       self._loss)
+        key = jax.random.PRNGKey(0)   # dropout refused: never consumed
+
+        def stage_fn(tree, x):
+            for j in range(L):
+                vals = [tree[f"c{j}_p{i}"] for i in range(n_per_cell)]
+                outs, _ = functional_call(
+                    templates[j], tmpl_params[j], vals, [], [],
+                    [NDArray(x)], True, key)
+                x = outs[0]
+            return x
+
+        def mb_loss(lv, fv, out, labels):
+            outs = last_fn(lv, fv, out)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            with _ag.pause(train_mode=True):
+                l_nd = loss_blk(*[NDArray(o) for o in outs],
+                                NDArray(labels))
+            return jnp.mean(l_nd._data)
+
+        def body(fv, sv, lv, ids_l, labels_l):
+            stage = jax.lax.axis_index(pipe)
+            p_stage = jax.tree.map(lambda a: a[0], sv)
+            b_l = ids_l.shape[0]
+            ids_mb = ids_l.reshape(M, b_l // M, *ids_l.shape[1:])
+            labels_mb = labels_l.reshape(M, b_l // M,
+                                         *labels_l.shape[1:])
+            x0_shape = jax.eval_shape(first_fn, fv, ids_mb[0])
+            buf = jnp.zeros(x0_shape.shape, x0_shape.dtype)
+            losses0 = jnp.zeros((M,), jnp.float32)
+
+            def tick(carry, t):
+                buf, losses = carry
+                mb_in = jnp.clip(t, 0, M - 1)
+                # non-0 stages compute-and-discard the embed (the price
+                # of single-program SPMD form; see class docstring)
+                x0 = first_fn(fv, ids_mb[mb_in])
+                inp = jnp.where(stage == 0, x0, buf)
+                out = stage_fn(p_stage, inp)
+                idx = jnp.clip(t - stage, 0, M - 1)
+                loss_t = mb_loss(lv, fv, out, labels_mb[idx])
+                valid = ((stage == S - 1) & (t >= stage)
+                         & (t < stage + M))
+                losses = losses.at[idx].set(
+                    jnp.where(valid, loss_t, losses[idx]))
+                nxt = jax.lax.ppermute(
+                    out, pipe, [(i, (i + 1) % S) for i in range(S)])
+                return (nxt, losses), None
+
+            (_, losses), _ = jax.lax.scan(
+                tick, (buf, losses0), jnp.arange(M + S - 1))
+            # only the last stage wrote real losses; psum replicates
+            loss = jax.lax.psum(jnp.sum(losses) / M, pipe)
+            return jax.lax.pmean(loss, data)
+
+        fv_specs = jax.tree.map(lambda _: P(), self._first_vals)
+        lv_specs = jax.tree.map(lambda _: P(), self._last_vals)
+        sv_specs = pipe_specs(self._stacked, pipe)
+
+        def batch_spec(x):
+            return P(data, *([None] * (x.ndim - 1)))
+
+        opt = self._opt
+
+        def pure_step(fv, sv, lv, opt_state, step, ids, labels):
+            sharded = shard_map(
+                body, mesh=mesh,
+                in_specs=(fv_specs, sv_specs, lv_specs,
+                          batch_spec(ids), batch_spec(labels)),
+                out_specs=P(), check_vma=False)
+
+            def loss_of(tr):
+                f, s, l = tr
+                return sharded(f, s, l, ids, labels)
+
+            loss, grads = jax.value_and_grad(loss_of)((fv, sv, lv))
+            (nf, ns, nl), nstate = opt.update((fv, sv, lv), grads,
+                                              opt_state, step)
+            return loss, nf, ns, nl, nstate
+
+        donate = (0, 1, 2, 3) if self._donate else ()
+        fv_sh = tuple(v.sharding for v in self._first_vals)
+        lv_sh = tuple(v.sharding for v in self._last_vals)
+        sv_sh = {k: v.sharding for k, v in self._stacked.items()}
+        return jax.jit(pure_step,
+                       out_shardings=(None, fv_sh, sv_sh, lv_sh, None),
+                       donate_argnums=donate)
+
+    def step(self, *batch):
+        """One pipelined train step (ids, labels); returns the scalar
+        loss (replicated, async)."""
+        import jax.numpy as jnp
+        ids, labels = batch
+        sharded = tuple(self._shard_batch(b) for b in batch)
+        dp = self._mesh.shape[self._data_axis]
+        b_local = sharded[0].shape[0] // dp
+        if sharded[0].shape[0] % dp or b_local % self._M:
+            raise MXNetError(
+                f"global batch {sharded[0].shape[0]} must split over "
+                f"data axis {dp} x microbatches {self._M}")
+        cache_key = tuple((a.shape, str(a.dtype)) for a in sharded)
+        if cache_key not in self._jit_cache:
+            self._jit_cache[cache_key] = self._build_step()
+        self._step_count += 1
+        step_arr = jnp.asarray(self._step_count, jnp.int32)
+        (loss, self._first_vals, self._stacked, self._last_vals,
+         self._opt_state) = self._jit_cache[cache_key](
+            self._first_vals, self._stacked, self._last_vals,
+            self._opt_state, step_arr, *sharded)
+        return loss
+
+    def sync_to_block(self):
+        """Write trained values back into the net's Parameters (cell
+        leaves unstacked to their per-stage owners; multi-host shards
+        allgathered first, like SPMDTrainer.sync_to_block)."""
+        import jax
+        from .spmd import _fetch_full
+        for p, v in zip(
+                list(self._first_params) + list(self._last_params),
+                list(self._first_vals) + list(self._last_vals)):
+            dev = p.data().ctx.jax_device()
+            p._data._set_data(jax.device_put(_fetch_full(v), dev))
+        L, S = self._L, self._S
+        for j in range(L):
+            for i in range(len(self._cell_trainables[0])):
+                leaf = _fetch_full(self._stacked[f"c{j}_p{i}"])
+                for s in range(S):
+                    p = self._cell_trainables[s * L + j][i]
+                    dev = p.data().ctx.jax_device()
+                    p._data._set_data(jax.device_put(leaf[s], dev))

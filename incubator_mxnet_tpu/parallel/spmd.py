@@ -35,6 +35,16 @@ from . import optim as fopt
 __all__ = ["SPMDTrainer", "shard_params", "data_sharding", "exact_rule"]
 
 
+def _fetch_full(v):
+    """Materialize a (possibly sharded) jax array as full numpy.
+    Multi-host: shards on other processes are not addressable; allgather
+    over DCN first (single-host path is a plain copy)."""
+    if getattr(v, "is_fully_addressable", True):
+        return _np.asarray(v)
+    from jax.experimental import multihost_utils
+    return _np.asarray(multihost_utils.process_allgather(v, tiled=True))
+
+
 def exact_rule(param, spec):
     """One exact-name sharding rule ``("^<name>$", spec)`` for a
     Parameter (or anything with ``.name``) — the building block every
@@ -103,11 +113,30 @@ class SPMDTrainer:
         trainer.sync_to_block()         # write params back to net
     """
 
+    def __new__(cls, *args, **kwargs):
+        # pipeline_axis= switches to the GPipe trainer (stacked-stage
+        # parameter storage over a data x pipe mesh) — one entry point
+        # for every parallel axis; see parallel/pipeline.py
+        if cls is SPMDTrainer and kwargs.get("pipeline_axis"):
+            from .pipeline import PipelineTrainer
+            return object.__new__(PipelineTrainer)
+        return object.__new__(cls)
+
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
-                 shard_optimizer_state=False):
+                 shard_optimizer_state=False, pipeline_axis=None,
+                 pipeline_microbatches=None):
         import jax
+        if pipeline_axis is not None:
+            # only reachable from a subclass that didn't override
+            # __init__ — SPMDTrainer itself dispatches in __new__
+            raise MXNetError(
+                "pipeline_axis is handled by parallel.PipelineTrainer")
+        if pipeline_microbatches is not None:
+            raise MXNetError(
+                "pipeline_microbatches without pipeline_axis — pass "
+                "pipeline_axis=<mesh axis> to request pipelining")
         self._net = net
         self._loss = loss_fn
         self._mesh = mesh or mesh_mod.current_mesh()
@@ -284,16 +313,7 @@ class SPMDTrainer:
         Parameters, gathered onto each Parameter's own device so eager
         execution keeps working."""
         import jax
-
-        def fetch(v):
-            # multi-host: shards on other processes are not addressable;
-            # allgather over DCN first (single-host path is a plain copy)
-            if getattr(v, "is_fully_addressable", True):
-                return _np.asarray(v)
-            from jax.experimental import multihost_utils
-            return _np.asarray(
-                multihost_utils.process_allgather(v, tiled=True))
-
+        fetch = _fetch_full
         for p, v in zip(self._trainable, self._tr_vals):
             dev = p.data().ctx.jax_device()
             p._data._set_data(jax.device_put(fetch(v), dev))

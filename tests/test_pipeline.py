@@ -137,3 +137,152 @@ def test_gpipe_validation():
         parallel.gpipe(_mlp_stage, stacked, xs, mesh, axis="pipe")
     with pytest.raises(mx.MXNetError, match="no axis"):
         parallel.gpipe(_mlp_stage, stacked, xs, mesh, axis="bogus")
+
+
+# ---------------------------------------------------------------------------
+# PipelineTrainer: GPipe TRAINING end to end (VERDICT r04 item 2)
+# ---------------------------------------------------------------------------
+
+def _gpt_and_batch(seed=11, B=8, T=16, V=64):
+    import jax
+    from incubator_mxnet_tpu.models import gpt
+    mx.random.seed(seed)
+    net = gpt.gpt_tiny(vocab_size=V, dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.05))
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, (B, T)).astype(np.int32)
+    labels = rng.integers(0, V, (B, T)).astype(np.float32)
+    with mx.autograd.pause():
+        net(mx.nd.array(ids, dtype="int32"))
+    return net, ids, labels
+
+
+def test_pipeline_trainer_trains_and_matches_1dev():
+    """Two optimizer steps through a dp2 x pipe2 GPipe schedule must
+    reproduce the 1-device losses (sync-SPMD semantics) AND genuinely
+    shard the cell parameters over the pipe axis."""
+    import jax
+    from incubator_mxnet_tpu.models import bert
+    net, ids, labels = _gpt_and_batch()
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2},
+                              devices=jax.devices()[:4])
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh,
+                              pipeline_axis="pipe",
+                              pipeline_microbatches=2)
+    assert isinstance(tr, parallel.PipelineTrainer)
+    l1 = float(tr.step(ids, labels))
+    l2 = float(tr.step(ids, labels))
+    assert l2 < l1          # the optimizer actually stepped
+
+    # cell params sharded over pipe; embeddings replicated
+    leaf = tr._stacked["c0_p0"]
+    assert leaf.sharding.spec[0] == "pipe"
+    assert all(ax is None for ax in tr._first_vals[0].sharding.spec)
+
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(net, loss_blk, "adam",
+                               {"learning_rate": 1e-3}, mesh=mesh1)
+    o1 = float(tr1.step(ids, labels))
+    o2 = float(tr1.step(ids, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+    assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
+
+    # sync_to_block unstacks: net params == the 1-device trainer's
+    tr.sync_to_block()
+    p1 = tr1.params
+    for name, p in net.collect_params().items():
+        np.testing.assert_allclose(
+            p.data().asnumpy(), np.asarray(p1[name]),
+            rtol=2e-5, atol=2e-6, err_msg=name)
+
+
+def test_pipeline_trainer_one_microbatch_degenerates():
+    """M=1 is sequential layer-parallelism (pure bubble) but must still
+    be numerically exact."""
+    import jax
+    from incubator_mxnet_tpu.models import bert
+    net, ids, labels = _gpt_and_batch(seed=5, B=4)
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2},
+                              devices=jax.devices()[:4])
+    tr = parallel.SPMDTrainer(net, loss_blk, "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9},
+                              mesh=mesh, pipeline_axis="pipe",
+                              pipeline_microbatches=1)
+    l1 = float(tr.step(ids, labels))
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(net, loss_blk, "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               mesh=mesh1)
+    o1 = float(tr1.step(ids, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+
+
+def test_pipeline_trainer_validation():
+    import jax
+    from incubator_mxnet_tpu.models import bert, gpt
+    net, ids, labels = _gpt_and_batch(seed=7, B=4)
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2},
+                              devices=jax.devices()[:4])
+    with pytest.raises(mx.MXNetError, match="lamb"):
+        parallel.SPMDTrainer(net, loss_blk, "lamb", mesh=mesh,
+                             pipeline_axis="pipe")
+    with pytest.raises(mx.MXNetError, match="sharding_rules"):
+        parallel.SPMDTrainer(net, loss_blk, "adam", mesh=mesh,
+                             pipeline_axis="pipe",
+                             sharding_rules=gpt.tp_rules("model"))
+    # 2 cells cannot split over 4 stages
+    mesh4 = parallel.make_mesh({"data": 1, "pipe": 4},
+                               devices=jax.devices()[:4])
+    with pytest.raises(mx.MXNetError, match="split over pipe"):
+        parallel.SPMDTrainer(net, loss_blk, "adam", mesh=mesh4,
+                             pipeline_axis="pipe")
+    # batch 4 over dp2 -> local 2, M=4 does not divide
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam", mesh=mesh,
+                              pipeline_axis="pipe",
+                              pipeline_microbatches=4)
+    with pytest.raises(mx.MXNetError, match="microbatches"):
+        tr.step(ids, labels)
+    # dropout > 0 refused up front
+    mx.random.seed(9)
+    netd = gpt.gpt_tiny(vocab_size=64, dropout=0.2)
+    netd.initialize()
+    with mx.autograd.pause():
+        netd(mx.nd.array(ids, dtype="int32"))
+    with pytest.raises(mx.MXNetError, match="[Dd]ropout"):
+        parallel.SPMDTrainer(netd, loss_blk, "adam", mesh=mesh,
+                             pipeline_axis="pipe")
+
+
+def test_pipeline_trainer_four_stages_middle_stage_logic():
+    """S=4 exercises pure middle stages (neither embed owner nor loss
+    owner) — the tick masking unique to 0 < stage < S-1."""
+    import jax
+    from incubator_mxnet_tpu.models import bert, gpt
+    mx.random.seed(21)
+    net = gpt.gpt_tiny(vocab_size=64, dropout=0.0, num_layers=4)
+    net.initialize(init=mx.init.Normal(0.05))
+    rng = np.random.default_rng(21)
+    ids = rng.integers(0, 64, (4, 12)).astype(np.int32)
+    labels = rng.integers(0, 64, (4, 12)).astype(np.float32)
+    with mx.autograd.pause():
+        net(mx.nd.array(ids, dtype="int32"))
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 1, "pipe": 4},
+                              devices=jax.devices()[:4])
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh,
+                              pipeline_axis="pipe",
+                              pipeline_microbatches=4)
+    l1 = float(tr.step(ids, labels))
+    l2 = float(tr.step(ids, labels))
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(net, loss_blk, "adam",
+                               {"learning_rate": 1e-3}, mesh=mesh1)
+    o1 = float(tr1.step(ids, labels))
+    o2 = float(tr1.step(ids, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+    assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
