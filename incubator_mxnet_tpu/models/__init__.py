@@ -13,7 +13,7 @@ from .yolo import YOLOv3, yolo3_darknet53, yolo3_tiny
 from . import gpt  # noqa: F401
 from .gpt import GPTModel, gpt_tiny, gpt2_124m
 from . import moe  # noqa: F401
-from .moe import MoEFFN
+from .moe import MoEFFN, MoELoss
 
 __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
            "bert_base", "bert_large", "bert_tiny",
@@ -22,4 +22,4 @@ __all__ = ["bert", "BERTModel", "BERTEncoder", "BERTForPretrain",
            "ssd", "SSD", "ssd_512", "ssd_300", "ssd_tiny",
            "yolo", "YOLOv3", "yolo3_darknet53", "yolo3_tiny",
            "gpt", "GPTModel", "gpt_tiny", "gpt2_124m",
-           "moe", "MoEFFN"]
+           "moe", "MoEFFN", "MoELoss"]

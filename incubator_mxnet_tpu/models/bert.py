@@ -259,8 +259,14 @@ def maybe_remat_cell(cell, x, *rest):
     import jax
 
     def f(xv):
-        return cell(NDArray(xv), *rest)._data
-    return NDArray(jax.checkpoint(f)(x._data))
+        out = cell(NDArray(xv), *rest)
+        if isinstance(out, tuple):      # e.g. MoE cells: (y, aux_loss)
+            return tuple(o._data for o in out)
+        return out._data
+    out = jax.checkpoint(f)(x._data)
+    if isinstance(out, tuple):
+        return tuple(NDArray(o) for o in out)
+    return NDArray(out)
 
 
 class BERTEncoder(HybridBlock):

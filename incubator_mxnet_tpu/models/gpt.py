@@ -34,19 +34,36 @@ class GPTCell(HybridBlock):
     """Pre-LN decoder block: x + attn(ln1(x)), then x + ffn(ln2(x))."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 seq_axis=None, mesh=None, **kwargs):
+                 seq_axis=None, mesh=None, moe_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
+        self._moe = int(moe_experts) > 0
+        if self._moe and dropout > 0:
+            raise MXNetError(
+                "moe_experts>0 with dropout>0: MoEFFN carries no FFN "
+                "dropout, so the regularization would silently differ "
+                "from the dense configuration — use dropout=0.0 with "
+                "MoE models")
         with self.name_scope():
             self.ln1 = nn.LayerNorm(in_channels=units)
             self.attention = MultiHeadAttention(
                 units, num_heads, dropout, causal=True,
                 seq_axis=seq_axis, mesh=mesh)
             self.ln2 = nn.LayerNorm(in_channels=units)
-            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
-                                       activation="gelu")
+            if self._moe:
+                from .moe import MoEFFN
+                self.ffn = MoEFFN(units, hidden_size, moe_experts,
+                                  top_k=moe_top_k,
+                                  capacity_factor=moe_capacity_factor)
+            else:
+                self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                           activation="gelu")
 
     def hybrid_forward(self, F, x):
         x = x + self.attention(self.ln1(x))
+        if self._moe:
+            y, aux = self.ffn(self.ln2(x))
+            return x + y, aux
         return x + self.ffn(self.ln2(x))
 
     def prime(self, x):
@@ -60,7 +77,14 @@ class GPTCell(HybridBlock):
         q, k, v = at.query(h), at.key(h), at.value(h)
         out = _sdpa(q, k, v, at._num_heads, causal=True)
         x = x + at.dropout(at.proj(out))
-        return x + self.ffn(self.ln2(x)), k, v
+        return x + self._ffn_out(self.ln2(x)), k, v
+
+    def _ffn_out(self, h):
+        """FFN output with the MoE aux loss discarded — the generation
+        paths are inference-only, where only the activations matter."""
+        if self._moe:
+            return self.ffn(h)[0]
+        return self.ffn(h)
 
     def step(self, x, cache_k, cache_v, t):
         """One-position incremental step: x (B, 1, C) at position ``t``,
@@ -75,7 +99,7 @@ class GPTCell(HybridBlock):
             functools.partial(cached_step_attn, num_heads=at._num_heads),
             [q, k_new, v_new, cache_k, cache_v, t], name="gpt_step_attn")
         out = x + at.dropout(at.proj(out))
-        return out + self.ffn(self.ln2(out)), ck, cv
+        return out + self._ffn_out(self.ln2(out)), ck, cv
 
 
 class GPTModel(HybridBlock):
@@ -84,20 +108,24 @@ class GPTModel(HybridBlock):
 
     def __init__(self, vocab_size, units=128, hidden_size=512,
                  num_layers=2, num_heads=2, max_length=256, dropout=0.1,
-                 seq_axis=None, mesh=None, **kwargs):
+                 seq_axis=None, mesh=None, moe_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
         self._vocab_size = vocab_size
         self._units = units
         self._max_length = max_length
+        self._moe = int(moe_experts) > 0
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units)
             self.pos_embed = nn.Embedding(max_length, units)
             self.drop = nn.Dropout(dropout)
             self.cells = nn.HybridSequential()
             for _ in range(num_layers):
-                self.cells.add(GPTCell(units, hidden_size, num_heads,
-                                       dropout, seq_axis=seq_axis,
-                                       mesh=mesh))
+                self.cells.add(GPTCell(
+                    units, hidden_size, num_heads, dropout,
+                    seq_axis=seq_axis, mesh=mesh, moe_experts=moe_experts,
+                    moe_top_k=moe_top_k,
+                    moe_capacity_factor=moe_capacity_factor))
             self.ln_f = nn.LayerNorm(in_channels=units)
 
     # -- helpers -------------------------------------------------------
@@ -127,9 +155,20 @@ class GPTModel(HybridBlock):
                 f"sequence length {ids.shape[1]} exceeds max_length "
                 f"{self._max_length}")
         x = self._embed_at(ids)
+        aux_total = None
         for cell in self.cells._children.values():
-            x = maybe_remat_cell(cell, x)
-        return self._project(self.ln_f(x))
+            out = maybe_remat_cell(cell, x)
+            if cell._moe:
+                x, aux = out
+                aux_total = aux if aux_total is None else aux_total + aux
+            else:
+                x = out
+        logits = self._project(self.ln_f(x))
+        if self._moe:
+            # SUM over MoE layers (the Switch recipe): loss adds
+            # aux_weight * aux once, regardless of depth
+            return logits, aux_total
+        return logits
 
     # -- pipeline parallelism ------------------------------------------
     def pipeline_split(self):
@@ -144,6 +183,12 @@ class GPTModel(HybridBlock):
         summed by the pipe-axis psum) is preserved.  Requires
         dropout=0 (the trainer enforces the pure-stage contract)."""
         import jax
+
+        if self._moe:
+            raise MXNetError(
+                "pipeline_split does not yet support MoE cells (the "
+                "stage protocol carries one activation tensor, not the "
+                "aux loss); use expert parallelism (ep_rules) instead")
 
         first_params = [self.embed.weight, self.pos_embed.weight]
         max_length = self._max_length
@@ -262,7 +307,7 @@ class GPTModel(HybridBlock):
         x = (x + pos[None].astype(x.dtype))
         xn = NDArray(x)
         for cell in self.cells._children.values():
-            xn = cell(xn)
+            xn = cell(xn)[0] if cell._moe else cell(xn)
         out = self.ln_f(xn)
         return _lm_logits(out._data, self.embed.weight.data()._data)
 

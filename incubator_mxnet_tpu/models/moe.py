@@ -31,7 +31,7 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import _invoke
 
-__all__ = ["MoEFFN", "ep_rules"]
+__all__ = ["MoEFFN", "MoELoss", "ep_rules"]
 
 
 def _moe_dispatch(logits, k, capacity):
@@ -146,6 +146,23 @@ class MoEFFN(HybridBlock):
 
         out, aux = _invoke(run, [x, logits, w1, b1, w2, b2], name="moe_ffn")
         return out, aux
+
+
+class MoELoss(HybridBlock):
+    """Wrap a base loss to add the router's load-balancing term: takes
+    ``(out, aux, *labels)`` — the output signature of any MoE model
+    (e.g. ``GPTModel(moe_experts=E)``) — and returns
+    ``mean(base(out, *labels)) + aux_weight * aux`` (Switch uses
+    aux_weight 1e-2).  Drop-in loss block for Trainer/SPMDTrainer."""
+
+    def __init__(self, base, aux_weight=1e-2, **kwargs):
+        super().__init__(**kwargs)
+        self._aux_weight = aux_weight
+        with self.name_scope():
+            self.base = base
+
+    def hybrid_forward(self, F, out, aux, *labels):
+        return self.base(out, *labels).mean() + self._aux_weight * aux
 
 
 def ep_rules(expert_axis="expert", block=None):

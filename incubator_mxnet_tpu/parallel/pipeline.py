@@ -24,8 +24,6 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-import numpy as _np
-
 from ..base import MXNetError
 
 __all__ = ["gpipe", "stack_stage_params", "pipe_specs",
@@ -85,6 +83,12 @@ def stack_block_stages(blocks, training=False, rng_key=None):
         outs, _ = functional_call(template, trainable,
                                   [p[n] for n in names], [], [],
                                   [NDArray(x)], training, key)
+        if len(outs) != 1:
+            raise MXNetError(
+                "pipeline stages must return exactly one activation "
+                f"tensor, got {len(outs)} outputs — multi-output cells "
+                "(e.g. MoE's (y, aux)) cannot ride the stage protocol; "
+                "use expert parallelism (moe.ep_rules) instead")
         return outs[0]
 
     return stage_fn, stacked
@@ -343,10 +347,14 @@ class PipelineTrainer(_SPMDTrainer):
                zip(self._first_params, self._first_vals)}
         out.update({p.name: v for p, v in
                     zip(self._last_params, self._last_vals)})
+        from .spmd import _fetch_full
         L, S = self._L, self._S
         for j in range(L):
             for i in range(len(self._cell_trainables[0])):
-                leaf = self._stacked[f"c{j}_p{i}"]
+                # allgather first: pipe-sharded stacked leaves are not
+                # fully addressable on a multi-host mesh (same routing
+                # sync_to_block uses)
+                leaf = _fetch_full(self._stacked[f"c{j}_p{i}"])
                 for s in range(S):
                     out[self._cell_trainables[s * L + j][i].name] = \
                         leaf[s]
@@ -376,6 +384,13 @@ class PipelineTrainer(_SPMDTrainer):
                 outs, _ = functional_call(
                     templates[j], tmpl_params[j], vals, [], [],
                     [NDArray(x)], True, key)
+                if len(outs) != 1:
+                    raise MXNetError(
+                        "pipeline stages must return exactly one "
+                        f"activation tensor, got {len(outs)} — "
+                        "multi-output cells (e.g. MoE's (y, aux)) "
+                        "cannot ride the stage protocol; use expert "
+                        "parallelism (moe.ep_rules) instead")
                 x = outs[0]
             return x
 
