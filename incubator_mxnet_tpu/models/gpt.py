@@ -38,12 +38,6 @@ class GPTCell(HybridBlock):
                  moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
         self._moe = int(moe_experts) > 0
-        if self._moe and dropout > 0:
-            raise MXNetError(
-                "moe_experts>0 with dropout>0: MoEFFN carries no FFN "
-                "dropout, so the regularization would silently differ "
-                "from the dense configuration — use dropout=0.0 with "
-                "MoE models")
         with self.name_scope():
             self.ln1 = nn.LayerNorm(in_channels=units)
             self.attention = MultiHeadAttention(
@@ -55,15 +49,22 @@ class GPTCell(HybridBlock):
                 self.ffn = MoEFFN(units, hidden_size, moe_experts,
                                   top_k=moe_top_k,
                                   capacity_factor=moe_capacity_factor)
+                # MoEFFN is dropout-free inside (the routed einsums are
+                # pure); regularize the combined output instead — the
+                # Megatron-MoE placement
+                self.moe_drop = nn.Dropout(dropout)
             else:
                 self.ffn = PositionwiseFFN(units, hidden_size, dropout,
                                            activation="gelu")
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, valid=None):
         x = x + self.attention(self.ln1(x))
         if self._moe:
-            y, aux = self.ffn(self.ln2(x))
-            return x + y, aux
+            if valid is None:
+                y, aux = self.ffn(self.ln2(x))
+            else:
+                y, aux = self.ffn(self.ln2(x), valid)
+            return x + self.moe_drop(y), aux
         return x + self.ffn(self.ln2(x))
 
     def prime(self, x):
@@ -226,7 +227,16 @@ class GPTModel(HybridBlock):
         temperature, restricted to the ``top_k`` highest logits when
         top_k > 0.  One ``lax.scan`` program either way; ``use_cache``
         False re-runs the full prefix per step (the oracle).  Returns
-        (B, Tp + max_new_tokens) int32 tokens."""
+        (B, Tp + max_new_tokens) int32 tokens.
+
+        MoE models: padding positions are masked out of the router (they
+        claim no expert capacity), so cached == full-prefix holds in the
+        no-drop regime (ample ``moe_capacity_factor``).  Under capacity
+        pressure the two paths form different routing groups (prefill
+        routes B*Tp tokens at once, a decode step routes B) and may drop
+        different tokens — inherent to capacity-based GShard routing,
+        exactly as train-time vs incremental-serve routing differs in
+        the public Switch/GShard implementations."""
         B, Tp = ids.shape
         total = Tp + max_new_tokens
         if total > self._max_length:
@@ -284,7 +294,7 @@ class GPTModel(HybridBlock):
 
             def body(carry, _):
                 toks, t, k = carry
-                logits = self._fwd_tokens(toks)      # (B, total, V)
+                logits = self._fwd_tokens(toks, n_valid=t)  # (B, total, V)
                 last = jnp.take_along_axis(
                     logits, (t - 1)[None, None, None].astype(jnp.int32)
                     .repeat(B, 0), axis=1)[:, 0]
@@ -298,16 +308,28 @@ class GPTModel(HybridBlock):
             return toks
         return _invoke(fn, [ids], name="gpt_generate_full")
 
-    def _fwd_tokens(self, toks):
-        """jax-level forward over already-jax tokens (inside scan)."""
+    def _fwd_tokens(self, toks, n_valid=None):
+        """jax-level forward over already-jax tokens (inside scan).
+        ``n_valid`` (scalar, may be traced) marks how many leading
+        positions hold real tokens: causal attention already ignores
+        the zero-padded tail, but MoE routing would otherwise let
+        padding claim expert capacity away from real tokens."""
         import jax.numpy as jnp
         x = self.embed.weight.data()._data[toks]
         pos = self.pos_embed.weight.data()._data[
             jnp.arange(toks.shape[1])]
         x = (x + pos[None].astype(x.dtype))
         xn = NDArray(x)
+        valid = None
+        if n_valid is not None and self._moe:
+            valid = NDArray(jnp.broadcast_to(
+                (jnp.arange(toks.shape[1]) < n_valid)[None], toks.shape)
+                .astype(jnp.float32))
         for cell in self.cells._children.values():
-            xn = cell(xn)[0] if cell._moe else cell(xn)
+            if cell._moe:
+                xn = (cell(xn) if valid is None else cell(xn, valid))[0]
+            else:
+                xn = cell(xn)
         out = self.ln_f(xn)
         return _lm_logits(out._data, self.embed.weight.data()._data)
 

@@ -34,24 +34,35 @@ from ..ndarray.ndarray import _invoke
 __all__ = ["MoEFFN", "MoELoss", "ep_rules"]
 
 
-def _moe_dispatch(logits, k, capacity):
+def _moe_dispatch(logits, k, capacity, valid=None):
     """GShard routing over one GROUP of g tokens: returns (dispatch
     (g, E, Cap) f32, combine (g, E, Cap) f32, aux scalar).  Rank r
     claims capacity after ranks < r; tokens keep arrival order within a
     rank.  Vmapped over groups — capacity is per group, so the
-    dispatch/combine tensors stay linear in total token count."""
+    dispatch/combine tensors stay linear in total token count.
+
+    ``valid`` (g,) 0/1 marks real tokens: invalid (padding) tokens
+    claim NO expert capacity, produce zero output, and are excluded
+    from the aux-loss statistics — without it, padded positions compete
+    real tokens out of their expert buffers."""
     import jax
     import jax.numpy as jnp
     g, E = logits.shape
     raw = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     vals, idx = jax.lax.top_k(raw, k)                  # (g, k)
     w = vals / jnp.maximum(jnp.sum(vals, -1, keepdims=True), 1e-9)
+    vfl = None if valid is None else valid.astype(jnp.float32)
 
     dispatch = jnp.zeros((g, E, capacity), jnp.float32)
     combine = jnp.zeros((g, E, capacity), jnp.float32)
     counts = jnp.zeros((E,), jnp.int32)
+    top1 = None
     for r in range(k):
         onehot = jax.nn.one_hot(idx[:, r], E, dtype=jnp.int32)  # (g, E)
+        if vfl is not None:     # padding claims nothing, routes nowhere
+            onehot = onehot * vfl.astype(jnp.int32)[:, None]
+        if r == 0:
+            top1 = onehot
         # this token's slot in its expert's buffer: earlier tokens of
         # the same rank + everything claimed by lower ranks
         pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None]
@@ -64,10 +75,15 @@ def _moe_dispatch(logits, k, capacity):
         combine = combine + d_r * w[:, r][:, None, None]
         counts = counts + jnp.sum(onehot, axis=0)
 
-    # Switch aux loss: E * sum_e mean_gate_e * fraction_top1_e
-    me = jnp.mean(raw, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32),
-                  axis=0)
+    # Switch aux loss: E * sum_e mean_gate_e * fraction_top1_e,
+    # statistics over VALID tokens only
+    if vfl is None:
+        me = jnp.mean(raw, axis=0)
+        ce = jnp.mean(top1.astype(jnp.float32), axis=0)
+    else:
+        n = jnp.maximum(jnp.sum(vfl), 1.0)
+        me = jnp.sum(raw * vfl[:, None], axis=0) / n
+        ce = jnp.sum(top1.astype(jnp.float32), axis=0) / n
     aux = E * jnp.sum(me * ce)
     return dispatch, combine, aux
 
@@ -108,12 +124,13 @@ class MoEFFN(HybridBlock):
                 "b2", shape=(num_experts, units), dtype=dtype,
                 init="zeros")
 
-    def hybrid_forward(self, F, x, w1, b1, w2, b2):
+    def hybrid_forward(self, F, x, valid=None, w1=None, b1=None,
+                       w2=None, b2=None):
         logits = self.router(x)                       # (B, T, E)
         E, k, cf, act = self._E, self._k, self._cf, self._act
         group = self._group
 
-        def run(xv, lg, w1v, b1v, w2v, b2v):
+        def run(xv, lg, w1v, b1v, w2v, b2v, vv=None):
             import functools
             import jax
             import jax.numpy as jnp
@@ -127,9 +144,13 @@ class MoEFFN(HybridBlock):
                 g -= 1
             G = S // g
             capacity = max(1, int(math.ceil(cf * g * k / E)))
-            dispatch, combine, aux = jax.vmap(
-                functools.partial(_moe_dispatch, k=k, capacity=capacity))(
-                    lg.reshape(G, g, E))
+            fn = functools.partial(_moe_dispatch, k=k, capacity=capacity)
+            if vv is None:
+                dispatch, combine, aux = jax.vmap(fn)(lg.reshape(G, g, E))
+            else:
+                dispatch, combine, aux = jax.vmap(fn)(
+                    lg.reshape(G, g, E),
+                    valid=vv.reshape(G, g).astype(jnp.float32))
             aux = jnp.mean(aux)       # equal groups: mean == global
             xs = xv.reshape(G, g, C)
             # dispatch -> per-expert buffers -> FFN -> combine back
@@ -144,7 +165,14 @@ class MoEFFN(HybridBlock):
                              combine.astype(xv.dtype), y)
             return out.reshape(B, T, C), aux
 
-        out, aux = _invoke(run, [x, logits, w1, b1, w2, b2], name="moe_ffn")
+        if valid is None:
+            out, aux = _invoke(run, [x, logits, w1, b1, w2, b2],
+                               name="moe_ffn")
+        else:
+            out, aux = _invoke(
+                lambda xv, lg, w1v, b1v, w2v, b2v, vv:
+                    run(xv, lg, w1v, b1v, w2v, b2v, vv),
+                [x, logits, w1, b1, w2, b2, valid], name="moe_ffn")
         return out, aux
 
 

@@ -26,6 +26,19 @@ from typing import Any, Callable
 
 from ..base import MXNetError
 
+
+def _require_single_output(outs):
+    """The stage protocol carries ONE activation tensor between pipe
+    ranks; anything else (e.g. MoE's (y, aux)) would be silently
+    truncated at outs[0]."""
+    if len(outs) != 1:
+        raise MXNetError(
+            "pipeline stages must return exactly one activation "
+            f"tensor, got {len(outs)} outputs — multi-output cells "
+            "(e.g. MoE's (y, aux)) cannot ride the stage protocol; "
+            "use expert parallelism (moe.ep_rules) instead")
+    return outs[0]
+
 __all__ = ["gpipe", "stack_stage_params", "pipe_specs",
            "stack_block_stages", "PipelineTrainer"]
 
@@ -83,13 +96,7 @@ def stack_block_stages(blocks, training=False, rng_key=None):
         outs, _ = functional_call(template, trainable,
                                   [p[n] for n in names], [], [],
                                   [NDArray(x)], training, key)
-        if len(outs) != 1:
-            raise MXNetError(
-                "pipeline stages must return exactly one activation "
-                f"tensor, got {len(outs)} outputs — multi-output cells "
-                "(e.g. MoE's (y, aux)) cannot ride the stage protocol; "
-                "use expert parallelism (moe.ep_rules) instead")
-        return outs[0]
+        return _require_single_output(outs)
 
     return stage_fn, stacked
 
@@ -384,14 +391,7 @@ class PipelineTrainer(_SPMDTrainer):
                 outs, _ = functional_call(
                     templates[j], tmpl_params[j], vals, [], [],
                     [NDArray(x)], True, key)
-                if len(outs) != 1:
-                    raise MXNetError(
-                        "pipeline stages must return exactly one "
-                        f"activation tensor, got {len(outs)} — "
-                        "multi-output cells (e.g. MoE's (y, aux)) "
-                        "cannot ride the stage protocol; use expert "
-                        "parallelism (moe.ep_rules) instead")
-                x = outs[0]
+                x = _require_single_output(outs)
             return x
 
         def mb_loss(lv, fv, out, labels):

@@ -268,3 +268,39 @@ def test_gpt_moe_refuses_pipeline_split():
     net.initialize()
     with pytest.raises(mx.MXNetError, match="MoE"):
         net.pipeline_split()
+
+
+def test_valid_mask_blocks_padding_from_capacity():
+    """MoEFFN(x, valid): masked (padding) positions claim no expert
+    capacity, produce zero output, and are excluded from aux stats —
+    so garbage content beyond the valid prefix cannot influence real
+    tokens.  Without the mask it can (the displacement bug)."""
+    C, H, E = 8, 16, 4
+    mx.random.seed(30)
+    net = moe.MoEFFN(C, H, E, top_k=1, capacity_factor=1.0,
+                     group_size=None)
+    net.initialize(init=mx.init.Normal(0.5))
+    rng = np.random.default_rng(31)
+    xv = rng.standard_normal((1, 8, C)).astype(np.float32)
+    pad_a = np.zeros((1, 8, C), np.float32)
+    pad_b = (rng.standard_normal((1, 8, C)) * 3).astype(np.float32)
+    # padding FIRST: arrival order would hand it the expert slots
+    valid = np.concatenate(
+        [np.zeros((1, 8)), np.ones((1, 8))], axis=1).astype(np.float32)
+
+    outs = []
+    for pad in (pad_a, pad_b):
+        x = np.concatenate([pad, xv], axis=1)
+        out, aux = net(mx.nd.array(x), mx.nd.array(valid))
+        outs.append((out.asnumpy(), float(aux.asnumpy())))
+    # masked garbage has no influence on output or aux ...
+    np.testing.assert_allclose(outs[0][0], outs[1][0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-6)
+    # ... masked rows produce exactly zero (residual passes x through)
+    assert np.allclose(outs[0][0][:, :8], 0.0)
+    # and WITHOUT the mask, garbage claims the slots first and competes
+    # real tokens out of their buffers (capacity 1.0*16*1/4 = 4/expert)
+    out_nomask, _ = net(mx.nd.array(np.concatenate([pad_b, xv], 1)))
+    assert not np.allclose(out_nomask.asnumpy()[:, 8:], outs[0][0][:, 8:],
+                           atol=1e-6)
