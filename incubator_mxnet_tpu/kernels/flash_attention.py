@@ -8,9 +8,14 @@ score matrix in HBM.  The MXU does the two matmuls per block; running
 max/sum rescaling happens on the VPU.
 
 Scope/contract:
-* forward-only Pallas; the backward recomputes attention under XLA via a
-  ``jax.custom_vjp`` (correct gradients, standard-memory backward — the
-  usual first deployment step for custom kernels);
+* forward AND backward are Pallas online-softmax kernels
+  (FlashAttention-2): the forward also emits the per-row logsumexp, the
+  backward recomputes P = exp(S - LSE) blockwise — dQ in a
+  query-parallel kernel, dK/dV (+ the key-bias cotangent) in a
+  key-parallel kernel — so the (T, T) score matrix exists in neither
+  direction.  ``MXNET_FLASH_BWD=xla`` switches the backward to the
+  XLA-recompute path, kept as the numerics oracle
+  (tests/test_flash_attention.py grad-checks pallas vs xla);
 * dense (non-causal or causal) attention, with an optional (B, Tk) 0/1
   key-validity mask (the shape every padded BERT batch carries as
   ``valid_length``) applied as an additive -1e30 bias streamed through
@@ -41,12 +46,30 @@ _BLOCK_Q = 128
 _BLOCK_K = 128
 
 
+def _causal_mask(s, q0, k0):
+    """-inf the strictly-upper-triangular scores of one (BQ, BK) block;
+    ``q0``/``k0`` are the absolute positions of the block's first
+    row/column.  Shared by the forward and both backward kernels."""
+    bq, bk = s.shape
+    iq = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    ik = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(iq >= ik, s, -jnp.inf)
+
+
+def _n_diag_blocks(qi, block_q, block_k, n_kb):
+    """How many leading K blocks a causal query block (index ``qi``) can
+    see: blocks past the diagonal contribute nothing."""
+    return jnp.minimum(
+        (qi * block_q + block_q + block_k - 1) // block_k, n_kb)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
-                seq_len, has_bias):
+                seq_len, has_bias, with_lse):
     from jax.experimental import pallas as pl
 
     b_ref = rest[0] if has_bias else None
-    o_ref = rest[-1]
+    lse_ref = rest[-1] if with_lse else None
+    o_ref = rest[-2] if with_lse else rest[-1]
     q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
     block_q = q.shape[0]
     qi = pl.program_id(1)
@@ -63,11 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
             # broadcast over the query rows
             s = s + b_ref[0, :, pl.ds(j * block_k, block_k)]
         if causal:
-            iq = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            ik = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(iq >= ik, s, -jnp.inf)
+            s = _causal_mask(s, qi * block_q, j * block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # fully-masked rows (causal upper blocks) keep m=-inf: exp(-inf
         # - -inf) would be nan — pin those rows' correction to 0
@@ -85,13 +104,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     if causal:
-        # only blocks at or below the diagonal contribute
-        n_needed = jnp.minimum(
-            (qi * block_q + block_q + block_k - 1) // block_k, n_kb)
+        n_needed = _n_diag_blocks(qi, block_q, block_k, n_kb)
         m, l, acc = jax.lax.fori_loop(0, n_needed, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if with_lse:
+        # per-row logsumexp of the (scaled, biased, masked) scores — the
+        # one residual the FA2 backward needs to recompute P blockwise
+        safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+        lse_ref[0] = (safe_m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _xla_attention(q, k, v, scale, causal, bias=None):
@@ -111,10 +133,12 @@ def _xla_attention(q, k, v, scale, causal, bias=None):
         q.dtype)
 
 
-def _flash_fwd_impl(q, k, v, bias, scale, causal, interpret, n_heads):
+def _flash_fwd_impl(q, k, v, bias, scale, causal, interpret, n_heads,
+                    with_lse=False):
     """``bias``: None, or a (B, 1, Tk) float32 additive key bias shared by
     the batch's ``n_heads`` grid rows (indexed bh -> bh // n_heads, so the
-    per-head copies never materialize in HBM)."""
+    per-head copies never materialize in HBM).  ``with_lse`` additionally
+    returns the per-row logsumexp (BH, T) float32 for the backward."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -123,7 +147,8 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, interpret, n_heads):
     block_k = min(_BLOCK_K, T)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_len=T,
-                               has_bias=bias is not None)
+                               has_bias=bias is not None,
+                               with_lse=with_lse)
     grid = (BH, T // block_q)
     spec_q = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
                           memory_space=pltpu.VMEM)
@@ -136,14 +161,193 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, interpret, n_heads):
             (1, 1, T), lambda bh, qi: (bh // n_heads, 0, 0),
             memory_space=pltpu.VMEM))
         operands.append(bias)
+    out_shape = jax.ShapeDtypeStruct((BH, T, D), q.dtype)
+    out_specs = spec_q
+    if with_lse:
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((BH, T), jnp.float32)]
+        out_specs = [spec_q,
+                     pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi),
+                                  memory_space=pltpu.VMEM)]
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=in_specs,
-        out_specs=spec_q,
+        out_specs=out_specs,
         interpret=interpret,
     )(*operands)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref, *rest,
+                   scale, causal, block_k, seq_len, has_bias):
+    """Query-parallel dQ: stream K/V blocks, recompute P from the saved
+    logsumexp, accumulate dQ = sum_j (P * (dP - D)) @ K * scale."""
+    from jax.experimental import pallas as pl
+
+    b_ref = rest[0] if has_bias else None
+    dq_ref = rest[-1]
+    q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)              # (BQ,)
+    dd = dd_ref[0].astype(jnp.float32)                # (BQ,)
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    n_kb = seq_len // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * scale
+        if has_bias:
+            s = s + b_ref[0, :, pl.ds(j * block_k, block_k)]
+        if causal:
+            s = _causal_mask(s, qi * block_q, j * block_k)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None])                   # (BQ, BK)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    if causal:
+        n_needed = _n_diag_blocks(qi, block_q, block_k, n_kb)
+        dq = jax.lax.fori_loop(0, n_needed, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, n_kb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref, *rest,
+                    scale, causal, block_q, seq_len, has_bias):
+    """Key-parallel dK/dV (+ key-bias cotangent rows): stream Q/dO
+    blocks over one K/V block, recomputing P from the logsumexp.
+    dV = P^T dO;  dK = (P * (dP - D))^T Q * scale;
+    dbias_rows = sum_rows(P * (dP - D))."""
+    from jax.experimental import pallas as pl
+
+    b_ref = rest[0] if has_bias else None
+    dk_ref, dv_ref, dbs_ref = rest[-3], rest[-2], rest[-1]
+    k = k_ref[0].astype(jnp.float32)                  # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    kj = pl.program_id(1)
+    n_qb = seq_len // block_q
+
+    def body(i, carry):
+        dk, dv, dbs = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        dd = dd_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * scale
+        if has_bias:
+            s = s + b_ref[0]                          # (1, BK) broadcast
+        if causal:
+            s = _causal_mask(s, i * block_q, kj * block_k)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (BK, D)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None])                   # (BQ, BK)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        dbs = dbs + jnp.sum(ds, axis=0)               # (BK,)
+        return dk, dv, dbs
+
+    z = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    carry0 = (z, z, jnp.zeros((block_k,), jnp.float32))
+    if causal:
+        # q blocks strictly above the diagonal see this k block masked out
+        start = (kj * block_k) // block_q
+        dk, dv, dbs = jax.lax.fori_loop(start, n_qb, body, carry0)
+    else:
+        dk, dv, dbs = jax.lax.fori_loop(0, n_qb, body, carry0)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dbs_ref[0] = dbs
+
+
+def _flash_bwd_impl(q, k, v, bias, out, lse, g, scale, causal, interpret,
+                    n_heads):
+    """FA2 backward as two Pallas kernels; returns (dq, dk, dv, dbias)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    block_q = min(_BLOCK_Q, T)
+    block_k = min(_BLOCK_K, T)
+    has_bias = bias is not None
+    # D_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
+    dd = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), -1)
+
+    spec_row_q = pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0),
+                              memory_space=pltpu.VMEM)
+    spec_full = pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0),
+                             memory_space=pltpu.VMEM)
+    spec_vec_q = pl.BlockSpec((1, block_q), lambda bh, i: (bh, i),
+                              memory_space=pltpu.VMEM)
+    spec_vec_full = pl.BlockSpec((1, T), lambda bh, i: (bh, 0),
+                                 memory_space=pltpu.VMEM)
+
+    # dQ: grid over query blocks
+    in_specs = [spec_row_q, spec_row_q, spec_vec_q, spec_vec_q,
+                spec_full, spec_full]
+    operands = [q, g, lse, dd, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, T), lambda bh, i: (bh // n_heads, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(bias)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=T, has_bias=has_bias),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        grid=(BH, T // block_q),
+        in_specs=in_specs,
+        out_specs=spec_row_q,
+        interpret=interpret,
+    )(*operands)
+
+    # dK/dV (+ bias-cotangent rows): grid over key blocks
+    spec_row_k = pl.BlockSpec((1, block_k, D), lambda bh, j: (bh, j, 0),
+                              memory_space=pltpu.VMEM)
+    spec_vec_k = pl.BlockSpec((1, block_k), lambda bh, j: (bh, j),
+                              memory_space=pltpu.VMEM)
+    in_specs = [spec_full, spec_full, spec_vec_full, spec_vec_full,
+                spec_row_k, spec_row_k]
+    operands = [q, g, lse, dd, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, j: (bh // n_heads, 0, j),
+            memory_space=pltpu.VMEM))
+        operands.append(bias)
+    dk, dv, dbs = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=T, has_bias=has_bias),
+        out_shape=[jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+                   jax.ShapeDtypeStruct((BH, T), jnp.float32)],
+        grid=(BH, T // block_k),
+        in_specs=in_specs,
+        out_specs=[spec_row_k, spec_row_k, spec_vec_k],
+        interpret=interpret,
+    )(*operands)
+
+    dbias = None
+    if has_bias:
+        # (BH, Tk) rows -> the (B, 1, Tk) bias: sum the head axis out
+        dbias = dbs.reshape(-1, n_heads, T).sum(1)[:, None, :]
+    return dq, dk, dv, dbias
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -156,13 +360,22 @@ def _flash(q, k, v, bias, scale, causal, interpret, n_heads):
 
 
 def _flash_fwd(q, k, v, bias, scale, causal, interpret, n_heads):
-    out = _flash(q, k, v, bias, scale, causal, interpret, n_heads)
-    return out, (q, k, v, bias)
+    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, interpret,
+                               n_heads, with_lse=True)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, n_heads, res, g):
-    # backward by recomputation under XLA: same math, standard memory
-    q, k, v, bias = res
+    q, k, v, bias, out, lse = res
+    from ..base import getenv
+    # read at TRACE time: an already-jitted step keeps whichever backward
+    # it was traced with (docs/env_var.md) — set before the first trace
+    if (getenv("MXNET_FLASH_BWD") or "pallas").lower() != "xla":
+        dq, dk, dv, dbias = _flash_bwd_impl(
+            q, k, v, bias, out, lse, g, scale, causal, interpret, n_heads)
+        return dq, dk, dv, dbias
+    # MXNET_FLASH_BWD=xla — the recompute oracle: same math, standard
+    # memory, autodiffed under XLA
     BH = q.shape[0]
     if bias is None:
         _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(
