@@ -9,9 +9,12 @@
 // still sees only this ABI (handles + float buffers + MXGetLastError),
 // and the heavy lifting stays in the compiled XLA program.
 //
-// ABI subset implemented (signatures match the reference):
-//   MXGetLastError, MXPredCreate, MXPredSetInput, MXPredForward,
-//   MXPredGetOutputShape, MXPredGetOutput, MXPredFree
+// ABI implemented (signatures match the reference):
+//   MXGetLastError, MXPredCreate, MXPredCreatePartialOut, MXPredReshape,
+//   MXPredSetInput, MXPredForward, MXPredPartialForward,
+//   MXPredGetOutputShape, MXPredGetOutput, MXPredFree,
+//   MXPredCreateMultiThread (GIL contract documented in the header),
+//   MXNDListCreate, MXNDListGet, MXNDListFree
 //
 // Build (the test does this; python3-config supplies the embed flags):
 //   g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc \
@@ -21,12 +24,15 @@
 
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
 
-using mx_uint = uint32_t;
-using PredictorHandle = void*;
+// the public header declares every extern-C signature below, so a
+// drifting declaration becomes a compile error here, not a consumer's
+// stack corruption at runtime
+#include "c_predict_api.h"
 
 namespace {
 
@@ -98,9 +104,60 @@ class Gil {
   PyGILState_STATE state_;
 };
 
+// [(key, (d0, d1, ...)), ...] from the CSR-style shape triplet.
+// Returns a new reference, or nullptr with a Python error set (every
+// inner allocation checked: the ABI's contract is rc=-1 +
+// MXGetLastError, never a segfault in the host process).
+PyObject* build_inputs_list(mx_uint num_input_nodes,
+                            const char** input_keys,
+                            const mx_uint* input_shape_indptr,
+                            const mx_uint* input_shape_data) {
+  PyObject* inputs = PyList_New(num_input_nodes);
+  if (inputs == nullptr) return nullptr;
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    const mx_uint begin = input_shape_indptr[i];
+    const mx_uint end = input_shape_indptr[i + 1];
+    PyObject* shape = PyTuple_New(end - begin);
+    if (shape == nullptr) { Py_DECREF(inputs); return nullptr; }
+    for (mx_uint d = begin; d < end; ++d) {
+      PyObject* dim = PyLong_FromUnsignedLong(input_shape_data[d]);
+      if (dim == nullptr) {
+        Py_DECREF(shape);
+        Py_DECREF(inputs);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(shape, d - begin, dim);
+    }
+    PyObject* key = PyUnicode_FromString(input_keys[i]);
+    PyObject* pair = key != nullptr ? PyTuple_New(2) : nullptr;
+    if (pair == nullptr) {
+      Py_XDECREF(key);
+      Py_DECREF(shape);
+      Py_DECREF(inputs);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(pair, 0, key);
+    PyTuple_SET_ITEM(pair, 1, shape);
+    PyList_SET_ITEM(inputs, i, pair);
+  }
+  return inputs;
+}
+
+// Decoded .nd file, copied into C++-owned storage at create so
+// MXNDListGet never needs the GIL and pointers stay stable.
+struct NDList {
+  std::vector<std::string> keys;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<std::vector<float>> data;
+};
+
 }  // namespace
 
 extern "C" {
+
+// defined below; used by MXPredPartialForward / MXPredCreateMultiThread
+int MXPredForward(PredictorHandle handle);
+int MXPredFree(PredictorHandle handle);
 
 const char* MXGetLastError() { return g_last_error.c_str(); }
 
@@ -120,28 +177,16 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
     take_py_error("MXPredCreate: import predict_bridge");
     return -1;
   }
-  // inputs: [(key, (d0, d1, ...)), ...]
-  PyObject* inputs = PyList_New(num_input_nodes);
-  for (mx_uint i = 0; i < num_input_nodes; ++i) {
-    const mx_uint begin = input_shape_indptr[i];
-    const mx_uint end = input_shape_indptr[i + 1];
-    PyObject* shape = PyTuple_New(end - begin);
-    for (mx_uint d = begin; d < end; ++d) {
-      PyTuple_SET_ITEM(shape, d - begin,
-                       PyLong_FromUnsignedLong(input_shape_data[d]));
-    }
-    PyObject* pair = PyTuple_New(2);
-    PyTuple_SET_ITEM(pair, 0, PyUnicode_FromString(input_keys[i]));
-    PyTuple_SET_ITEM(pair, 1, shape);
-    PyList_SET_ITEM(inputs, i, pair);
-  }
+  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
+                                       input_shape_indptr,
+                                       input_shape_data);
   PyObject* params = PyBytes_FromStringAndSize(
       static_cast<const char*>(param_bytes), param_size);
   PyObject* res = PyObject_CallMethod(
       mod, "create", "sOiiO", symbol_json_str, params, dev_type, dev_id,
       inputs);
-  Py_DECREF(params);
-  Py_DECREF(inputs);
+  Py_XDECREF(params);
+  Py_XDECREF(inputs);
   Py_DECREF(mod);
   if (res == nullptr) {
     take_py_error("MXPredCreate");
@@ -150,6 +195,148 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
   auto* pred = new Predictor();
   pred->obj = res;
   *out = pred;
+  return 0;
+}
+
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char** output_keys,
+                           PredictorHandle* out) {
+  if (out == nullptr || symbol_json_str == nullptr) {
+    g_last_error = "MXPredCreatePartialOut: null argument";
+    return -1;
+  }
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    take_py_error("MXPredCreatePartialOut: import predict_bridge");
+    return -1;
+  }
+  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
+                                       input_shape_indptr,
+                                       input_shape_data);
+  PyObject* outputs = PyList_New(num_output_nodes);
+  for (mx_uint i = 0; i < num_output_nodes; ++i) {
+    PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* res = PyObject_CallMethod(
+      mod, "create", "sOiiOO", symbol_json_str, params, dev_type,
+      dev_id, inputs, outputs);
+  Py_XDECREF(params);
+  Py_XDECREF(outputs);
+  Py_XDECREF(inputs);
+  Py_DECREF(mod);
+  if (res == nullptr) {
+    take_py_error("MXPredCreatePartialOut");
+    return -1;
+  }
+  auto* pred = new Predictor();
+  pred->obj = res;
+  *out = pred;
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char** input_keys,
+                  const mx_uint* input_shape_indptr,
+                  const mx_uint* input_shape_data,
+                  PredictorHandle handle, PredictorHandle* out) {
+  auto* pred = static_cast<Predictor*>(handle);
+  if (pred == nullptr || out == nullptr) {
+    g_last_error = "MXPredReshape: null argument";
+    return -1;
+  }
+  Gil gil;
+  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
+                                       input_shape_indptr,
+                                       input_shape_data);
+  PyObject* res =
+      PyObject_CallMethod(pred->obj, "reshape", "O", inputs);
+  Py_XDECREF(inputs);
+  if (res == nullptr) {
+    take_py_error("MXPredReshape");
+    return -1;
+  }
+  auto* fresh = new Predictor();
+  fresh->obj = res;
+  *out = fresh;
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step,
+                         int* step_left) {
+  if (step != 0) {
+    // one compiled XLA program — no node-level stepping to expose
+    g_last_error = "MXPredPartialForward: the executor is a single "
+                   "compiled XLA program; only step 0 (full forward) "
+                   "exists";
+    return -1;
+  }
+  const int rc = MXPredForward(handle);
+  if (rc == 0 && step_left != nullptr) *step_left = 0;
+  return rc;
+}
+
+int MXPredCreateMultiThread(const char* symbol_json_str,
+                            const void* param_bytes, int param_size,
+                            int dev_type, int dev_id,
+                            mx_uint num_input_nodes,
+                            const char** input_keys,
+                            const mx_uint* input_shape_indptr,
+                            const mx_uint* input_shape_data,
+                            int num_threads, PredictorHandle* out) {
+  if (out == nullptr || symbol_json_str == nullptr || num_threads < 1) {
+    g_last_error = "MXPredCreateMultiThread: null argument or "
+                   "num_threads < 1";
+    return -1;
+  }
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    take_py_error("MXPredCreateMultiThread: import predict_bridge");
+    return -1;
+  }
+  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
+                                       input_shape_indptr,
+                                       input_shape_data);
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* res = PyObject_CallMethod(
+      mod, "create_multi_thread", "sOiiOi", symbol_json_str, params,
+      dev_type, dev_id, inputs, num_threads);
+  Py_XDECREF(params);
+  Py_XDECREF(inputs);
+  Py_DECREF(mod);
+  if (res == nullptr) {
+    take_py_error("MXPredCreateMultiThread");
+    return -1;
+  }
+  for (int i = 0; i < num_threads; ++i) {
+    PyObject* item = PyList_GetItem(res, i);  // borrowed
+    if (item == nullptr) {
+      take_py_error("MXPredCreateMultiThread: handle list");
+      for (int j = 0; j < i; ++j) {
+        MXPredFree(out[j]);
+        out[j] = nullptr;
+      }
+      Py_DECREF(res);
+      return -1;
+    }
+    Py_INCREF(item);
+    auto* pred = new Predictor();
+    pred->obj = item;
+    out[i] = pred;
+  }
+  Py_DECREF(res);
   return 0;
 }
 
@@ -258,6 +445,94 @@ int MXPredFree(PredictorHandle handle) {
     Py_XDECREF(pred->obj);
   }
   delete pred;
+  return 0;
+}
+
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, mx_uint* out_length) {
+  if (nd_file_bytes == nullptr || out == nullptr ||
+      out_length == nullptr) {
+    g_last_error = "MXNDListCreate: null argument";
+    return -1;
+  }
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    take_py_error("MXNDListCreate: import predict_bridge");
+    return -1;
+  }
+  PyObject* raw = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject* obj = PyObject_CallMethod(mod, "ndlist_create", "O", raw);
+  Py_XDECREF(raw);
+  Py_DECREF(mod);
+  if (obj == nullptr) {
+    take_py_error("MXNDListCreate");
+    return -1;
+  }
+  // copy everything into C++-owned storage: MXNDListGet then needs no
+  // GIL and the returned pointers stay stable until MXNDListFree
+  auto list = std::make_unique<NDList>();
+  const Py_ssize_t n = PyObject_Length(obj);
+  bool ok = n >= 0;
+  for (Py_ssize_t i = 0; ok && i < n; ++i) {
+    PyObject* key = PyObject_CallMethod(obj, "key", "n", i);
+    PyObject* shape = PyObject_CallMethod(obj, "shape", "n", i);
+    PyObject* data = PyObject_CallMethod(obj, "data", "n", i);
+    ok = key != nullptr && shape != nullptr && data != nullptr;
+    if (ok) {
+      list->keys.emplace_back(PyUnicode_AsUTF8(key));
+      std::vector<mx_uint> dims;
+      for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
+        dims.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d))));
+      }
+      list->shapes.push_back(std::move(dims));
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      ok = PyBytes_AsStringAndSize(data, &buf, &len) == 0;
+      if (ok) {
+        const float* f = reinterpret_cast<const float*>(buf);
+        list->data.emplace_back(f, f + len / sizeof(float));
+      }
+    }
+    Py_XDECREF(key);
+    Py_XDECREF(shape);
+    Py_XDECREF(data);
+  }
+  Py_DECREF(obj);
+  if (!ok) {
+    take_py_error("MXNDListCreate: decode");
+    return -1;
+  }
+  *out_length = static_cast<mx_uint>(n);
+  *out = list.release();
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char** out_key,
+                const float** out_data, const mx_uint** out_shape,
+                mx_uint* out_ndim) {
+  auto* list = static_cast<NDList*>(handle);
+  if (list == nullptr || out_key == nullptr || out_data == nullptr ||
+      out_shape == nullptr || out_ndim == nullptr) {
+    g_last_error = "MXNDListGet: null argument";
+    return -1;
+  }
+  if (index >= list->keys.size()) {
+    g_last_error = "MXNDListGet: index " + std::to_string(index) +
+                   " >= length " + std::to_string(list->keys.size());
+    return -1;
+  }
+  *out_key = list->keys[index].c_str();
+  *out_data = list->data[index].data();
+  *out_shape = list->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(list->shapes[index].size());
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList*>(handle);
   return 0;
 }
 

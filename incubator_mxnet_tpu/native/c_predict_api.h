@@ -29,6 +29,7 @@ extern "C" {
 
 typedef uint32_t mx_uint;
 typedef void* PredictorHandle;
+typedef void* NDListHandle;
 
 /* Last error message of the calling thread (empty string if none). */
 const char* MXGetLastError(void);
@@ -64,6 +65,63 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
 
 /* Release the predictor. */
 int MXPredFree(PredictorHandle handle);
+
+/* Like MXPredCreate, but predict INTERNAL outputs: output_keys names
+ * graph nodes ("fc" or "fc_output") whose values become the predictor's
+ * outputs — the feature-extraction entry point. */
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char** output_keys,
+                           PredictorHandle* out);
+
+/* New predictor over the SAME weights with new input shapes (batch or
+ * sequence-length change without re-decoding the checkpoint).  The old
+ * handle stays valid; free both. */
+int MXPredReshape(mx_uint num_input_nodes, const char** input_keys,
+                  const mx_uint* input_shape_indptr,
+                  const mx_uint* input_shape_data,
+                  PredictorHandle handle, PredictorHandle* out);
+
+/* Partial forward (reference: step through the graph for debugging).
+ * The executor here is ONE compiled XLA program — there is no node-level
+ * stepping to expose — so step 0 runs the whole forward and *step_left
+ * is always 0; step > 0 is an error. */
+int MXPredPartialForward(PredictorHandle handle, int step,
+                         int* step_left);
+
+/* num_threads predictors over ONE decoded checkpoint, for one C host
+ * thread each.  CONCURRENCY CONTRACT: each handle owns its executor and
+ * the compiled XLA computation runs outside the GIL, but every entry
+ * point marshals through the embedded interpreter, so ABI calls from
+ * different threads serialize on the GIL for the marshaling portion.
+ * out must have room for num_threads handles. */
+int MXPredCreateMultiThread(const char* symbol_json_str,
+                            const void* param_bytes, int param_size,
+                            int dev_type, int dev_id,
+                            mx_uint num_input_nodes,
+                            const char** input_keys,
+                            const mx_uint* input_shape_indptr,
+                            const mx_uint* input_shape_data,
+                            int num_threads, PredictorHandle* out);
+
+/* Decode a .nd file's bytes (the mean-image convention): a list of
+ * arrays, optionally keyed.  All arrays are exported as float32. */
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, mx_uint* out_length);
+
+/* Borrowed views of entry `index`; pointers stay valid until
+ * MXNDListFree.  Bare (unkeyed) lists return "" keys. */
+int MXNDListGet(NDListHandle handle, mx_uint index, const char** out_key,
+                const float** out_data, const mx_uint** out_shape,
+                mx_uint* out_ndim);
+
+int MXNDListFree(NDListHandle handle);
 
 #ifdef __cplusplus
 }

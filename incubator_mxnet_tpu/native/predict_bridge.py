@@ -42,25 +42,44 @@ def _pin_device(dev_type: int) -> None:
 class Predictor:
     def __init__(self, symbol_json: str, param_bytes: bytes,
                  dev_type: int, dev_id: int,
-                 inputs: Sequence[Tuple[str, Tuple[int, ...]]]):
+                 inputs: Sequence[Tuple[str, Tuple[int, ...]]],
+                 output_names: Sequence[str] = (),
+                 _shared=None):
+        """``output_names`` selects INTERNAL outputs by name (the
+        reference's MXPredCreatePartialOut contract, e.g. "fc_output" or
+        "fc"); empty means the symbol's own outputs.  ``_shared`` is the
+        (sym, arg_params, aux_params) triple an existing predictor hands
+        to MXPredReshape/MXPredCreateMultiThread so the checkpoint is
+        decoded once per process, not once per handle."""
         _pin_device(dev_type)
         import incubator_mxnet_tpu as mx
-        from incubator_mxnet_tpu.ndarray.utils import load_frombuffer
         from incubator_mxnet_tpu.symbol import symbol as sym_mod
 
         self._mx = mx
-        sym = sym_mod.load_json(symbol_json)
-        loaded = load_frombuffer(param_bytes)
-        if not isinstance(loaded, dict):
-            raise ValueError(".params bytes hold a bare list, not the "
-                             "arg:/aux: dict a checkpoint carries")
-        arg_params = {k[4:]: v for k, v in loaded.items()
-                      if k.startswith("arg:")}
-        aux_params = {k[4:]: v for k, v in loaded.items()
-                      if k.startswith("aux:")}
+        if _shared is not None:
+            sym, arg_params, aux_params = _shared
+        else:
+            from incubator_mxnet_tpu.ndarray.utils import load_frombuffer
+            sym = sym_mod.load_json(symbol_json)
+            loaded = load_frombuffer(param_bytes)
+            if not isinstance(loaded, dict):
+                raise ValueError(".params bytes hold a bare list, not "
+                                 "the arg:/aux: dict a checkpoint "
+                                 "carries")
+            arg_params = {k[4:]: v for k, v in loaded.items()
+                          if k.startswith("arg:")}
+            aux_params = {k[4:]: v for k, v in loaded.items()
+                          if k.startswith("aux:")}
+        self._shared = (sym, arg_params, aux_params)
+        self._dev = (dev_type, dev_id)
+        if output_names:
+            internals = sym.get_internals()
+            sym = sym_mod.Group([internals[str(n)]
+                                 for n in output_names])
         ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
 
         self._input_names = [k for k, _ in inputs]
+        self._output_names = list(output_names)
         args = {}
         for name, shape in inputs:
             args[name] = mx.nd.zeros(shape, ctx=ctx)
@@ -78,6 +97,14 @@ class Predictor:
         self._outputs: List[_np.ndarray] = []
         self.forward()        # reference semantics: predictor is runnable
         #                       (and output shapes queryable) on create
+
+    def reshape(self, inputs) -> "Predictor":
+        """New predictor over the SAME weights with new input shapes
+        (reference: MXPredReshape).  The old handle stays valid."""
+        return Predictor("", b"", self._dev[0], self._dev[1],
+                         _norm_inputs(inputs),
+                         output_names=self._output_names,
+                         _shared=self._shared)
 
     def set_input(self, key: str, data: bytes) -> None:
         if key not in self._input_names:
@@ -103,8 +130,59 @@ class Predictor:
         return self._outputs[index].tobytes()
 
 
+class NDList:
+    """Decoded .nd file (reference: MXNDListCreate — the mean-image /
+    aux-blob loader of the predict ABI).  Bare lists get empty keys,
+    dicts keep their save() keys; every array is exported float32."""
+
+    def __init__(self, raw: bytes):
+        from incubator_mxnet_tpu.ndarray.utils import load_frombuffer
+        loaded = load_frombuffer(raw)
+        if isinstance(loaded, dict):
+            items = list(loaded.items())
+        else:
+            items = [("", a) for a in loaded]
+        self._keys = [str(k) for k, _ in items]
+        self._arrays = [_np.ascontiguousarray(
+            a.asnumpy().astype(_np.float32)) for _, a in items]
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def key(self, index: int) -> str:
+        return self._keys[index]
+
+    def shape(self, index: int) -> Tuple[int, ...]:
+        return tuple(int(d) for d in self._arrays[index].shape)
+
+    def data(self, index: int) -> bytes:
+        return self._arrays[index].tobytes()
+
+
+def _norm_inputs(inputs):
+    return [(str(k), tuple(int(d) for d in s)) for k, s in inputs]
+
+
 def create(symbol_json: str, param_bytes: bytes, dev_type: int,
-           dev_id: int, inputs) -> Predictor:
+           dev_id: int, inputs, output_names=()) -> Predictor:
     return Predictor(symbol_json, param_bytes, dev_type, dev_id,
-                     [(str(k), tuple(int(d) for d in s))
-                      for k, s in inputs])
+                     _norm_inputs(inputs),
+                     output_names=[str(n) for n in output_names])
+
+
+def create_multi_thread(symbol_json: str, param_bytes: bytes,
+                        dev_type: int, dev_id: int, inputs,
+                        num_threads: int):
+    """N predictors over ONE decoded checkpoint (reference:
+    MXPredCreateMultiThread).  Each handle owns its executor, so C host
+    threads can drive them concurrently; entry into the embedded
+    interpreter still serializes on the GIL (documented in the
+    header) — the compiled XLA computation itself runs outside it."""
+    first = Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                      _norm_inputs(inputs))
+    rest = [first.reshape(inputs) for _ in range(int(num_threads) - 1)]
+    return [first] + rest
+
+
+def ndlist_create(raw: bytes) -> NDList:
+    return NDList(raw)

@@ -124,3 +124,160 @@ def test_predict_pure_c_program(tmp_path):
     got = np.array([float(v) for v in lines[1].split()]).reshape(2, 2)
     x = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
     np.testing.assert_allclose(got, _expected(x), rtol=1e-5, atol=1e-6)
+
+
+def _load_lib():
+    so = _build_so()
+    lib = ctypes.CDLL(so)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _create(lib, batch=2, fn="MXPredCreate", extra=()):
+    u = ctypes.c_uint32
+    with open(os.path.join(_GOLD, "ckpt-symbol.json")) as f:
+        sym_json = f.read().encode()
+    with open(os.path.join(_GOLD, "ckpt-0007.params"), "rb") as f:
+        params = f.read()
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    shape = (u * 2)(batch, 4)
+    rc = getattr(lib, fn)(sym_json, params, len(params), 1, 0, 1, keys,
+                          indptr, shape, *extra, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    return handle
+
+
+def _run(lib, handle, x):
+    u = ctypes.c_uint32
+    n = x.size
+    buf = (ctypes.c_float * n)(*x.ravel())
+    assert lib.MXPredSetInput(handle, b"data", buf, n) == 0, \
+        lib.MXGetLastError().decode()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError().decode()
+    sdata = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == 0
+    oshape = tuple(sdata[i] for i in range(ndim.value))
+    cnt = int(np.prod(oshape))
+    out = (ctypes.c_float * cnt)()
+    assert lib.MXPredGetOutput(handle, 0, out, cnt) == 0, \
+        lib.MXGetLastError().decode()
+    return np.array(out[:]).reshape(oshape)
+
+
+def test_predict_reshape():
+    """MXPredReshape: a batch-4 predictor derived from a batch-2 handle
+    shares the checkpoint and computes the same function; the old handle
+    stays usable."""
+    lib = _load_lib()
+    h2 = _create(lib, batch=2)
+    u = ctypes.c_uint32
+    h4 = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    shape = (u * 2)(4, 4)
+    rc = lib.MXPredReshape(1, keys, indptr, shape, h2, ctypes.byref(h4))
+    assert rc == 0, lib.MXGetLastError().decode()
+
+    x4 = np.arange(16, dtype=np.float32).reshape(4, 4) / 7 - 1
+    np.testing.assert_allclose(_run(lib, h4, x4), _expected(x4),
+                               rtol=1e-5, atol=1e-6)
+    x2 = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
+    np.testing.assert_allclose(_run(lib, h2, x2), _expected(x2),
+                               rtol=1e-5, atol=1e-6)
+    assert lib.MXPredFree(h4) == 0
+    assert lib.MXPredFree(h2) == 0
+
+
+def test_predict_partial_out_and_partial_forward():
+    """MXPredCreatePartialOut selects an internal output by node name;
+    MXPredPartialForward runs the whole compiled program at step 0 and
+    refuses step > 0 (no node-level stepping in one XLA program)."""
+    lib = _load_lib()
+    okeys = (ctypes.c_char_p * 1)(b"fc")
+    h = _create(lib, batch=2, fn="MXPredCreatePartialOut",
+                extra=(ctypes.c_uint32(1), okeys))
+    x = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
+    n = x.size
+    buf = (ctypes.c_float * n)(*x.ravel())
+    assert lib.MXPredSetInput(h, b"data", buf, n) == 0
+    left = ctypes.c_int(-1)
+    assert lib.MXPredPartialForward(h, 0, ctypes.byref(left)) == 0
+    assert left.value == 0
+    assert lib.MXPredPartialForward(h, 1, ctypes.byref(left)) != 0
+    assert b"XLA" in lib.MXGetLastError()
+    u = ctypes.c_uint32
+    sdata = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXPredGetOutputShape(h, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == 0
+    oshape = tuple(sdata[i] for i in range(ndim.value))
+    out = (ctypes.c_float * 4)()
+    assert lib.MXPredGetOutput(h, 0, out, 4) == 0
+    np.testing.assert_allclose(np.array(out[:]).reshape(oshape),
+                               _expected(x), rtol=1e-5, atol=1e-6)
+    assert lib.MXPredFree(h) == 0
+
+
+def test_predict_multi_thread_handles():
+    """MXPredCreateMultiThread: N handles over one decoded checkpoint,
+    each independently usable (GIL serialization documented)."""
+    lib = _load_lib()
+    u = ctypes.c_uint32
+    with open(os.path.join(_GOLD, "ckpt-symbol.json")) as f:
+        sym_json = f.read().encode()
+    with open(os.path.join(_GOLD, "ckpt-0007.params"), "rb") as f:
+        params = f.read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    shape = (u * 2)(2, 4)
+    handles = (ctypes.c_void_p * 3)()
+    rc = lib.MXPredCreateMultiThread(sym_json, params, len(params), 1, 0,
+                                     1, keys, indptr, shape, 3, handles)
+    assert rc == 0, lib.MXGetLastError().decode()
+    x = np.array([[1, 2, 3, 4], [-1, 0.5, 0, 2]], np.float32)
+    for i in range(3):
+        # c_void_p-array getitem yields a bare int; re-wrap it so ctypes
+        # passes a full 64-bit pointer (ints truncate to c_int)
+        h = ctypes.c_void_p(handles[i])
+        np.testing.assert_allclose(_run(lib, h, x),
+                                   _expected(x), rtol=1e-5, atol=1e-6)
+        assert lib.MXPredFree(h) == 0
+
+
+def test_ndlist_roundtrip():
+    """MXNDListCreate/Get/Free: decode golden .nd fixtures — a bare
+    (unkeyed) v1 list and the keyed v2 dict — through the C ABI."""
+    lib = _load_lib()
+    u = ctypes.c_uint32
+    for fname, want_first_key in [("list_v1.params", b""),
+                                  ("list_v2.params", None)]:
+        with open(os.path.join(_GOLD, fname), "rb") as f:
+            raw = f.read()
+        handle = ctypes.c_void_p()
+        length = u()
+        rc = lib.MXNDListCreate(raw, len(raw), ctypes.byref(handle),
+                                ctypes.byref(length))
+        assert rc == 0, lib.MXGetLastError().decode()
+        assert length.value >= 1
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shp = ctypes.POINTER(u)()
+        ndim = u()
+        assert lib.MXNDListGet(handle, 0, ctypes.byref(key),
+                               ctypes.byref(data), ctypes.byref(shp),
+                               ctypes.byref(ndim)) == 0
+        if want_first_key is not None:
+            assert key.value == want_first_key
+        n = int(np.prod([shp[i] for i in range(ndim.value)]))
+        vals = np.array([data[i] for i in range(n)], np.float32)
+        if fname == "list_v1.params":
+            np.testing.assert_allclose(vals, [1.0, 2.0, 3.0])
+        # out-of-range index reports cleanly
+        assert lib.MXNDListGet(handle, length.value, ctypes.byref(key),
+                               ctypes.byref(data), ctypes.byref(shp),
+                               ctypes.byref(ndim)) != 0
+        assert lib.MXNDListFree(handle) == 0
