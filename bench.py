@@ -475,6 +475,75 @@ def main():
         os.environ.update(_preset)   # in-process callers keep their env
 
 
+def _run_sub(name, platform, kind, timeout, extra_env=None):
+    """One measurement in a FRESH process: each accel sub-bench gets the
+    whole HBM (observed on-chip: the anchor's BERT-large params + Adam
+    state stay resident in-process, and every follow-on model then dies
+    with RESOURCE_EXHAUSTED).  A shared persistent compilation cache
+    keeps the per-process XLA recompiles cheap."""
+    env = {**os.environ,
+           "BENCH_SUB_PLATFORM": platform or "",
+           "BENCH_SUB_KIND": kind or "",
+           "JAX_COMPILATION_CACHE_DIR":
+               os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                              "/tmp/jax_bench_cache"),
+           **(extra_env or {})}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sub", name],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        return {"error": (tail[-1][:200] if tail
+                          else f"rc={out.returncode}, no output")}
+    except subprocess.TimeoutExpired:
+        return {"error": f"sub-bench {name} hung >{timeout}s"}
+    except Exception as e:
+        return {"error": str(e)[:200]}
+
+
+def _sub_main(name):
+    """Entry for --sub NAME: trust the parent's probe verdict (env), run
+    exactly one measurement, print one JSON line."""
+    platform = os.environ.get("BENCH_SUB_PLATFORM") or "cpu"
+    kind = os.environ.get("BENCH_SUB_KIND", "")
+    on_accel = platform not in ("", "cpu")
+    import jax
+    dev = jax.devices()[0]
+    if name == "anchor":
+        s, B, T, mfu, remat = _bench_bert(on_accel, kind, dev)
+        rec = {"samples_per_sec": round(s, 2), "batch_size": B,
+               "seq_len": T,
+               "mfu": round(mfu, 4) if mfu is not None else None,
+               "remat": remat}
+    elif name == "phase2":
+        s, B, T, mfu, remat = _bench_bert(
+            on_accel, kind, dev, seq_len=512,
+            batch_ladder=[16, 8, 4], steps=10)
+        rec = {"samples_per_sec": round(s, 2), "batch_size": B,
+               "seq_len": T, "remat": remat,
+               "mfu": round(mfu, 4) if mfu is not None else None}
+    elif name == "fusion":
+        os.environ["MXNET_USE_FUSION"] = "1"
+        b_used = int(os.environ.get("BENCH_B_USED", "0"))
+        s, B, _, mfu, remat = _bench_bert(
+            on_accel, kind, dev,
+            batch_ladder=[b_used] if b_used else None, steps=10)
+        rec = {"samples_per_sec": round(s, 2), "batch_size": B,
+               "remat": remat,
+               "mfu": round(mfu, 4) if mfu is not None else None}
+    elif name == "resnet50":
+        rec = _bench_resnet50(on_accel, kind, dev)
+    elif name == "int8":
+        rec = _bench_int8(on_accel, kind, dev)
+    elif name == "int8_conv":
+        rec = _bench_int8_conv(on_accel, kind, dev)
+    else:
+        raise SystemExit(f"unknown sub-bench {name!r}")
+    print(json.dumps(rec))
+
+
 def _main(preset_fusion):
     probe_error = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -484,82 +553,66 @@ def _main(preset_fusion):
         platform, kind, probe_error = _probe_backend()
     on_accel = platform not in (None, "cpu")
 
-    import jax
-    if not on_accel:
+    if on_accel:
+        # accel path: NO jax client in this process — every measurement
+        # runs in its own subprocess with a clean HBM (see _run_sub)
+        anchor = _run_sub("anchor", platform, kind, timeout=3600)
+        if "error" in anchor:
+            accel_error = anchor["error"]
+            try:
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True, text=True, timeout=1800,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu",
+                         "BENCH_FORCE_CPU": "1",
+                         "BENCH_PROBE_ERROR":
+                             "accel reached then died mid-run: "
+                             + accel_error})
+                line = out.stdout.strip().splitlines()[-1] \
+                    if out.stdout.strip() else "{}"
+                rec = json.loads(line)
+            except Exception as salvage_err:  # never lose the artifact
+                rec = {"metric": "bench_degraded", "value": 0.0,
+                       "unit": "samples/s", "vs_baseline": 0.0,
+                       "salvage_error": str(salvage_err)[:200]}
+            rec["accel_error"] = accel_error
+            print(json.dumps(rec))
+            return
+        samples_per_sec = anchor["samples_per_sec"]
+        B_used, T = anchor["batch_size"], anchor["seq_len"]
+        mfu, remat = anchor["mfu"], anchor["remat"]
+
+        phase2 = _run_sub("phase2", platform, kind, timeout=2700)
+        fusion = _run_sub("fusion", platform, kind, timeout=2700,
+                          extra_env={"BENCH_B_USED": str(B_used)})
+        if "samples_per_sec" in fusion:
+            fusion["speedup_vs_xla"] = round(
+                fusion["samples_per_sec"] / samples_per_sec, 3)
+        resnet = _run_sub("resnet50", platform, kind, timeout=2700)
+        int8 = _run_sub("int8", platform, kind, timeout=1800)
+        int8["conv"] = _run_sub("int8_conv", platform, kind, timeout=2700)
+        scaling = _scaling_dryrun()
+    else:
+        import jax
         # never touch the broken/hung backend again in-process
         jax.config.update("jax_platforms", "cpu")
-
-    dev = jax.devices()[0]
-    accel_error = None
-    try:
+        dev = jax.devices()[0]
         samples_per_sec, B_used, T, mfu, remat = _bench_bert(
-            on_accel, kind, dev)
-    except Exception as e:
-        if not on_accel:
-            raise
-        # the tunnel can die mid-run (observed: remote_compile stream
-        # errors); salvage a CPU-smoke record in a FRESH process rather
-        # than emitting bench_degraded with no measurement
-        accel_error = str(e)[:200]
+            False, kind, dev)
+        phase2 = fusion = None
         try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, timeout=1800,
-                env={**os.environ, "JAX_PLATFORMS": "cpu",
-                     "BENCH_FORCE_CPU": "1",
-                     "BENCH_PROBE_ERROR":
-                         f"accel reached then died mid-run: {accel_error}"})
-            line = out.stdout.strip().splitlines()[-1] \
-                if out.stdout.strip() else "{}"
-            rec = json.loads(line)
-        except Exception as salvage_err:  # never lose the artifact
-            rec = {"metric": "bench_degraded", "value": 0.0,
-                   "unit": "samples/s", "vs_baseline": 0.0,
-                   "salvage_error": str(salvage_err)[:200]}
-        rec["accel_error"] = accel_error
-        print(json.dumps(rec))
-        return
-
-    phase2 = fusion = None
-    if on_accel:
-        # phase-2 (seq 512) + fusion-on delta at the phase-1 batch: these
-        # are secondary records — a failure must not cost the anchor
-        try:
-            s2, b2, t2, mfu2, remat2 = _bench_bert(
-                on_accel, kind, dev, seq_len=512,
-                batch_ladder=[16, 8, 4], steps=10)
-            phase2 = {"samples_per_sec": round(s2, 2), "batch_size": b2,
-                      "seq_len": t2, "remat": remat2,
-                      "mfu": round(mfu2, 4) if mfu2 is not None else None}
+            resnet = _bench_resnet50(False, kind, dev)
         except Exception as e:
-            phase2 = {"error": str(e)[:200]}
+            resnet = {"error": str(e)[:200]}
         try:
-            os.environ["MXNET_USE_FUSION"] = "1"
-            sf, bf, _, mfuf, _rm = _bench_bert(
-                on_accel, kind, dev, batch_ladder=[B_used], steps=10)
-            fusion = {
-                "samples_per_sec": round(sf, 2), "batch_size": bf,
-                "remat": _rm,
-                "mfu": round(mfuf, 4) if mfuf is not None else None,
-                "speedup_vs_xla": round(sf / samples_per_sec, 3)}
+            int8 = _bench_int8(False, kind, dev)
         except Exception as e:
-            fusion = {"error": str(e)[:200]}
-        finally:
-            os.environ.pop("MXNET_USE_FUSION", None)
-
-    try:
-        resnet = _bench_resnet50(on_accel, kind, dev)
-    except Exception as e:
-        resnet = {"error": str(e)[:200]}
-    try:
-        int8 = _bench_int8(on_accel, kind, dev)
-    except Exception as e:
-        int8 = {"error": str(e)[:200]}
-    try:
-        int8["conv"] = _bench_int8_conv(on_accel, kind, dev)
-    except Exception as e:
-        int8["conv"] = {"error": str(e)[:200]}
-    scaling = _scaling_dryrun()
+            int8 = {"error": str(e)[:200]}
+        try:
+            int8["conv"] = _bench_int8_conv(False, kind, dev)
+        except Exception as e:
+            int8["conv"] = {"error": str(e)[:200]}
+        scaling = _scaling_dryrun()
 
     out = {
         "metric": ("bert_large_pretrain_samples_per_sec_per_chip"
@@ -596,6 +649,9 @@ def _main(preset_fusion):
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--sub":
+        _sub_main(sys.argv[2])   # let failures propagate: the parent
+        sys.exit(0)              # records stderr as the sub's error
     try:
         main()
     except Exception as e:  # degrade, never lose the artifact

@@ -155,9 +155,8 @@ struct NDList {
 
 extern "C" {
 
-// defined below; used by MXPredPartialForward / MXPredCreateMultiThread
-int MXPredForward(PredictorHandle handle);
-int MXPredFree(PredictorHandle handle);
+// (MXPredForward / MXPredFree used below are declared by the included
+// public header — no in-file re-declaration, one signature source)
 
 const char* MXGetLastError() { return g_last_error.c_str(); }
 
@@ -222,9 +221,18 @@ int MXPredCreatePartialOut(const char* symbol_json_str,
   PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
                                        input_shape_indptr,
                                        input_shape_data);
-  PyObject* outputs = PyList_New(num_output_nodes);
-  for (mx_uint i = 0; i < num_output_nodes; ++i) {
-    PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  PyObject* outputs =
+      inputs != nullptr ? PyList_New(num_output_nodes) : nullptr;
+  for (mx_uint i = 0; outputs != nullptr && i < num_output_nodes; ++i) {
+    PyObject* name = PyUnicode_FromString(output_keys[i]);
+    if (name == nullptr) { Py_CLEAR(outputs); break; }
+    PyList_SET_ITEM(outputs, i, name);
+  }
+  if (outputs == nullptr) {
+    Py_XDECREF(inputs);
+    Py_DECREF(mod);
+    take_py_error("MXPredCreatePartialOut: marshal arguments");
+    return -1;
   }
   PyObject* params = PyBytes_FromStringAndSize(
       static_cast<const char*>(param_bytes), param_size);
@@ -395,8 +403,14 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
   const Py_ssize_t n = PyTuple_Size(res);
   pred->shape_buf.resize(static_cast<size_t>(n));
   for (Py_ssize_t i = 0; i < n; ++i) {
-    pred->shape_buf[static_cast<size_t>(i)] = static_cast<mx_uint>(
-        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i)));
+    const unsigned long v =
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i));
+    if (v == static_cast<unsigned long>(-1) && PyErr_Occurred()) {
+      Py_DECREF(res);
+      take_py_error("MXPredGetOutputShape: non-integer dim");
+      return -1;
+    }
+    pred->shape_buf[static_cast<size_t>(i)] = static_cast<mx_uint>(v);
   }
   Py_DECREF(res);
   *shape_data = pred->shape_buf.data();
@@ -480,12 +494,16 @@ int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
     PyObject* shape = PyObject_CallMethod(obj, "shape", "n", i);
     PyObject* data = PyObject_CallMethod(obj, "data", "n", i);
     ok = key != nullptr && shape != nullptr && data != nullptr;
-    if (ok) {
-      list->keys.emplace_back(PyUnicode_AsUTF8(key));
+    const char* key_c = ok ? PyUnicode_AsUTF8(key) : nullptr;
+    ok = ok && key_c != nullptr;   // surrogate-escaped names decode to
+    if (ok) {                      // nullptr: rc=-1, never a segfault
+      list->keys.emplace_back(key_c);
       std::vector<mx_uint> dims;
-      for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
-        dims.push_back(static_cast<mx_uint>(
-            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d))));
+      for (Py_ssize_t d = 0; ok && d < PyTuple_Size(shape); ++d) {
+        const unsigned long v =
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d));
+        ok = !(v == static_cast<unsigned long>(-1) && PyErr_Occurred());
+        dims.push_back(static_cast<mx_uint>(v));
       }
       list->shapes.push_back(std::move(dims));
       char* buf = nullptr;

@@ -75,6 +75,7 @@ def main():
             env={**os.environ, "MXNET_TPU_TIER_REACHABLE": "1"})
         rec["wall_seconds"] = round(time.time() - t0, 1)
         counts = {}
+        bad_names = []
         try:
             root = ET.parse(xml_path).getroot()
             suite = root if root.tag == "testsuite" else root[0]
@@ -84,9 +85,19 @@ def main():
             s = int(suite.get("skipped", 0))
             counts = {"tests": n, "passed": n - f_ - e - s,
                       "failed": f_, "errors": e, "skipped": s}
+            for case in suite.iter("testcase"):
+                for kind in ("failure", "error"):
+                    node = case.find(kind)
+                    if node is not None:
+                        bad_names.append(
+                            f"{case.get('classname', '')}::"
+                            f"{case.get('name', '')} [{kind}] "
+                            + (node.get("message") or "")[:90])
         except (OSError, ET.ParseError, IndexError) as pe:
             counts = {"junit_parse_error": str(pe)[:200]}
         rec.update(counts)
+        if bad_names:
+            rec["failing_tests"] = bad_names[:40]
         # honest status: 'ok' needs BOTH rc==0 and parsed counts;
         # 'ran_with_failures' needs parsed counts showing real test
         # failures (pytest rc==1); anything else (rc>=2 internal/usage
@@ -98,6 +109,13 @@ def main():
             rec["status"] = "ok"
         elif out.returncode == 1 and has_failures:
             rec["status"] = "ran_with_failures"
+            # the axon relay can die MID-tier: every chip op after the
+            # death errors with JaxRuntimeError and the counts describe
+            # the tunnel, not the code.  Re-probe and say so.
+            post_platform, _, post_err = probe()
+            if post_platform in (None, "cpu"):
+                rec["status"] = "tunnel_died_mid_run"
+                rec["post_probe_error"] = post_err or "cpu backend"
         else:
             rec["status"] = "pytest_error"
             rec["returncode"] = out.returncode
