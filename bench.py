@@ -517,7 +517,11 @@ def _sub_main(name):
                "seq_len": T,
                "mfu": round(mfu, 4) if mfu is not None else None,
                "remat": remat}
-    elif name == "phase2":
+    elif name in ("phase2", "fusion512"):
+        # ONE seq-512 config for both the XLA baseline and the fused
+        # run, so the f512/phase2 ratio always compares like for like
+        if name == "fusion512":
+            os.environ["MXNET_USE_FUSION"] = "1"
         s, B, T, mfu, remat = _bench_bert(
             on_accel, kind, dev, seq_len=512,
             batch_ladder=[16, 8, 4], steps=10)
@@ -588,6 +592,21 @@ def _main(preset_fusion):
         if "samples_per_sec" in fusion:
             fusion["speedup_vs_xla"] = round(
                 fusion["samples_per_sec"] / samples_per_sec, 3)
+        f512 = _run_sub("fusion512", platform, kind, timeout=2700)
+        if "samples_per_sec" in f512 and isinstance(phase2, dict) \
+                and phase2.get("samples_per_sec"):
+            if f512.get("batch_size") == phase2.get("batch_size"):
+                f512["speedup_vs_xla"] = round(
+                    f512["samples_per_sec"] / phase2["samples_per_sec"],
+                    3)
+            else:
+                # the OOM ladder settled differently (fused attention
+                # has a smaller footprint): a throughput ratio would
+                # conflate fusion with batch-size gains
+                f512["speedup_note"] = (
+                    f"batch sizes differ (fused {f512.get('batch_size')}"
+                    f" vs xla {phase2.get('batch_size')}); no ratio")
+        fusion["seq512"] = f512
         resnet = _run_sub("resnet50", platform, kind, timeout=2700)
         int8 = _run_sub("int8", platform, kind, timeout=1800)
         int8["conv"] = _run_sub("int8_conv", platform, kind, timeout=2700)

@@ -126,7 +126,7 @@ class SPMDTrainer:
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
                  shard_optimizer_state=False, pipeline_axis=None,
-                 pipeline_microbatches=None):
+                 pipeline_microbatches=None, pipeline_schedule=None):
         import jax
         if pipeline_axis is not None:
             # only reachable from a subclass that didn't override
@@ -136,6 +136,10 @@ class SPMDTrainer:
         if pipeline_microbatches is not None:
             raise MXNetError(
                 "pipeline_microbatches without pipeline_axis — pass "
+                "pipeline_axis=<mesh axis> to request pipelining")
+        if pipeline_schedule is not None:
+            raise MXNetError(
+                "pipeline_schedule without pipeline_axis — pass "
                 "pipeline_axis=<mesh axis> to request pipelining")
         self._net = net
         self._loss = loss_fn
