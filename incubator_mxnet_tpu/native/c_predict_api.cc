@@ -151,6 +151,64 @@ struct NDList {
   std::vector<std::vector<float>> data;
 };
 
+// Shared creator scaffold (MXPredCreate / CreatePartialOut /
+// CreateMultiThread differ only in one trailing argument): init the
+// interpreter, marshal (inputs[, outputs], params), call `method` on
+// the bridge, and return the new-reference result — or nullptr with
+// g_last_error set.  Refcount-sensitive code lives HERE once.
+PyObject* call_create(const char* who, const char* method,
+                      const char* symbol_json, const void* param_bytes,
+                      int param_size, int dev_type, int dev_id,
+                      mx_uint n_in, const char** in_keys,
+                      const mx_uint* indptr, const mx_uint* shp,
+                      mx_uint n_out, const char** out_keys,
+                      int num_threads) {
+  if (!ensure_python()) return nullptr;
+  Gil gil;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    take_py_error(who);
+    return nullptr;
+  }
+  PyObject* inputs = build_inputs_list(n_in, in_keys, indptr, shp);
+  bool ok = inputs != nullptr;
+  PyObject* outputs = nullptr;
+  if (ok && out_keys != nullptr) {
+    outputs = PyList_New(n_out);
+    ok = outputs != nullptr;
+    for (mx_uint i = 0; ok && i < n_out; ++i) {
+      PyObject* name = PyUnicode_FromString(out_keys[i]);
+      ok = name != nullptr;
+      if (ok) PyList_SET_ITEM(outputs, i, name);
+    }
+  }
+  PyObject* params =
+      ok ? PyBytes_FromStringAndSize(
+               static_cast<const char*>(param_bytes), param_size)
+         : nullptr;
+  PyObject* res = nullptr;
+  if (params != nullptr) {
+    if (out_keys != nullptr) {
+      res = PyObject_CallMethod(mod, method, "sOiiOO", symbol_json,
+                                params, dev_type, dev_id, inputs,
+                                outputs);
+    } else if (num_threads >= 1) {
+      res = PyObject_CallMethod(mod, method, "sOiiOi", symbol_json,
+                                params, dev_type, dev_id, inputs,
+                                num_threads);
+    } else {
+      res = PyObject_CallMethod(mod, method, "sOiiO", symbol_json,
+                                params, dev_type, dev_id, inputs);
+    }
+  }
+  Py_XDECREF(params);
+  Py_XDECREF(outputs);
+  Py_XDECREF(inputs);
+  Py_DECREF(mod);
+  if (res == nullptr) take_py_error(who);
+  return res;
+}
+
 }  // namespace
 
 extern "C" {
@@ -169,28 +227,11 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
     g_last_error = "MXPredCreate: null argument";
     return -1;
   }
-  if (!ensure_python()) return -1;
-  Gil gil;
-  PyObject* mod = bridge();
-  if (mod == nullptr) {
-    take_py_error("MXPredCreate: import predict_bridge");
-    return -1;
-  }
-  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
-                                       input_shape_indptr,
-                                       input_shape_data);
-  PyObject* params = PyBytes_FromStringAndSize(
-      static_cast<const char*>(param_bytes), param_size);
-  PyObject* res = PyObject_CallMethod(
-      mod, "create", "sOiiO", symbol_json_str, params, dev_type, dev_id,
-      inputs);
-  Py_XDECREF(params);
-  Py_XDECREF(inputs);
-  Py_DECREF(mod);
-  if (res == nullptr) {
-    take_py_error("MXPredCreate");
-    return -1;
-  }
+  PyObject* res = call_create(
+      "MXPredCreate", "create", symbol_json_str, param_bytes,
+      param_size, dev_type, dev_id, num_input_nodes, input_keys,
+      input_shape_indptr, input_shape_data, 0, nullptr, 0);
+  if (res == nullptr) return -1;
   auto* pred = new Predictor();
   pred->obj = res;
   *out = pred;
@@ -211,42 +252,12 @@ int MXPredCreatePartialOut(const char* symbol_json_str,
     g_last_error = "MXPredCreatePartialOut: null argument";
     return -1;
   }
-  if (!ensure_python()) return -1;
-  Gil gil;
-  PyObject* mod = bridge();
-  if (mod == nullptr) {
-    take_py_error("MXPredCreatePartialOut: import predict_bridge");
-    return -1;
-  }
-  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
-                                       input_shape_indptr,
-                                       input_shape_data);
-  PyObject* outputs =
-      inputs != nullptr ? PyList_New(num_output_nodes) : nullptr;
-  for (mx_uint i = 0; outputs != nullptr && i < num_output_nodes; ++i) {
-    PyObject* name = PyUnicode_FromString(output_keys[i]);
-    if (name == nullptr) { Py_CLEAR(outputs); break; }
-    PyList_SET_ITEM(outputs, i, name);
-  }
-  if (outputs == nullptr) {
-    Py_XDECREF(inputs);
-    Py_DECREF(mod);
-    take_py_error("MXPredCreatePartialOut: marshal arguments");
-    return -1;
-  }
-  PyObject* params = PyBytes_FromStringAndSize(
-      static_cast<const char*>(param_bytes), param_size);
-  PyObject* res = PyObject_CallMethod(
-      mod, "create", "sOiiOO", symbol_json_str, params, dev_type,
-      dev_id, inputs, outputs);
-  Py_XDECREF(params);
-  Py_XDECREF(outputs);
-  Py_XDECREF(inputs);
-  Py_DECREF(mod);
-  if (res == nullptr) {
-    take_py_error("MXPredCreatePartialOut");
-    return -1;
-  }
+  PyObject* res = call_create(
+      "MXPredCreatePartialOut", "create", symbol_json_str, param_bytes,
+      param_size, dev_type, dev_id, num_input_nodes, input_keys,
+      input_shape_indptr, input_shape_data, num_output_nodes,
+      output_keys, 0);
+  if (res == nullptr) return -1;
   auto* pred = new Predictor();
   pred->obj = res;
   *out = pred;
@@ -306,28 +317,13 @@ int MXPredCreateMultiThread(const char* symbol_json_str,
                    "num_threads < 1";
     return -1;
   }
-  if (!ensure_python()) return -1;
+  PyObject* res = call_create(
+      "MXPredCreateMultiThread", "create_multi_thread", symbol_json_str,
+      param_bytes, param_size, dev_type, dev_id, num_input_nodes,
+      input_keys, input_shape_indptr, input_shape_data, 0, nullptr,
+      num_threads);
+  if (res == nullptr) return -1;
   Gil gil;
-  PyObject* mod = bridge();
-  if (mod == nullptr) {
-    take_py_error("MXPredCreateMultiThread: import predict_bridge");
-    return -1;
-  }
-  PyObject* inputs = build_inputs_list(num_input_nodes, input_keys,
-                                       input_shape_indptr,
-                                       input_shape_data);
-  PyObject* params = PyBytes_FromStringAndSize(
-      static_cast<const char*>(param_bytes), param_size);
-  PyObject* res = PyObject_CallMethod(
-      mod, "create_multi_thread", "sOiiOi", symbol_json_str, params,
-      dev_type, dev_id, inputs, num_threads);
-  Py_XDECREF(params);
-  Py_XDECREF(inputs);
-  Py_DECREF(mod);
-  if (res == nullptr) {
-    take_py_error("MXPredCreateMultiThread");
-    return -1;
-  }
   for (int i = 0; i < num_threads; ++i) {
     PyObject* item = PyList_GetItem(res, i);  // borrowed
     if (item == nullptr) {
