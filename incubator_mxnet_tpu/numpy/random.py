@@ -18,7 +18,14 @@ from .multiarray import _reclass
 __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
            "choice", "shuffle", "permutation", "beta", "gamma",
            "exponential", "poisson", "multinomial", "binomial",
-           "lognormal", "laplace", "standard_normal"]
+           "lognormal", "laplace", "standard_normal",
+           # round-5 distribution tail (jax.random-backed)
+           "chisquare", "dirichlet", "f", "geometric", "gumbel",
+           "logistic", "multivariate_normal", "pareto", "rayleigh",
+           "standard_cauchy", "standard_t", "standard_exponential",
+           "standard_gamma", "triangular", "wald", "weibull",
+           "negative_binomial", "random", "random_sample", "ranf",
+           "sample", "bytes"]
 
 
 def seed(s):
@@ -158,3 +165,157 @@ def shuffle(x):
     if not isinstance(x, NDArray):
         raise TypeError("shuffle expects an ndarray")
     x._set_data(permutation(x)._data)
+
+
+# ---------------------------------------------------------------------------
+# round-5 distribution tail: the rest of the numpy.random function
+# surface that jax.random backs directly (reference:
+# python/mxnet/numpy/random.py; RandomState/Generator OBJECT machinery is
+# out of scope — this framework's RNG is the per-context key stream, see
+# docs/np_coverage.md)
+# ---------------------------------------------------------------------------
+def _draw(sample, size=None, ctx=None):
+    """Common tail: new key from the context stream, sample, place.
+    ``size=None`` hands jax ``shape=None`` — NumPy semantics: the result
+    broadcasts to the distribution parameters' shape, one INDEPENDENT
+    draw per element (not one scalar broadcast over them)."""
+    ctx = ctx or current_context()
+    key = _random.new_key(ctx)
+    return _reclass(_place(
+        sample(key, None if size is None else _size(size)), ctx))
+
+
+def _param_shape(s, *params):
+    """Draw shape for transform-style samplers: the requested size, else
+    the broadcast of the parameter shapes (numpy's size=None rule)."""
+    import jax.numpy as jnp
+    if s is not None:
+        return s
+    return jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+
+
+def chisquare(df, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.chisquare(k, df, shape=s),
+                 size, device or ctx)
+
+
+def dirichlet(alpha, size=None, ctx=None, device=None):
+    import jax
+    import jax.numpy as jnp
+    a = jnp.asarray(alpha, dtype="float32")
+    return _draw(lambda k, s: jax.random.dirichlet(k, a, shape=s),
+                 size, device or ctx)
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.f(k, dfnum, dfden, shape=s),
+                 size, device or ctx)
+
+
+def geometric(p, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.geometric(k, p, shape=s),
+                 size, device or ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: loc + scale * jax.random.gumbel(
+        k, _param_shape(s, loc, scale)), size, device or ctx)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: loc + scale * jax.random.logistic(
+        k, _param_shape(s, loc, scale)), size, device or ctx)
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None, device=None):
+    import jax
+    import jax.numpy as jnp
+    m = jnp.asarray(mean, dtype="float32")
+    c = jnp.asarray(cov, dtype="float32")
+    return _draw(lambda k, s: jax.random.multivariate_normal(
+        k, m, c, shape=s), size, device or ctx)
+
+
+def pareto(a, size=None, ctx=None, device=None):
+    import jax
+    # numpy draws the Lomax (shifted Pareto): classical Pareto - 1
+    return _draw(lambda k, s: jax.random.pareto(k, a, shape=s) - 1.0,
+                 size, device or ctx)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.rayleigh(k, scale, shape=s),
+                 size, device or ctx)
+
+
+def standard_cauchy(size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.cauchy(k, s),
+                 size, device or ctx)
+
+
+def standard_t(df, size=None, ctx=None, device=None):
+    import jax
+    # shape made explicit: jax.random.t does not accept shape=None
+    return _draw(lambda k, s: jax.random.t(
+        k, df, shape=_param_shape(s, df)), size, device or ctx)
+
+
+def standard_exponential(size=None, ctx=None, device=None):
+    return exponential(1.0, size=size, ctx=device or ctx)
+
+
+def standard_gamma(shape, size=None, ctx=None, device=None):
+    return gamma(shape, 1.0, size=size, ctx=device or ctx)
+
+
+def triangular(left, mode, right, size=None, ctx=None, device=None):
+    import jax
+    return _draw(lambda k, s: jax.random.triangular(
+        k, left, mode, right, shape=s), size, device or ctx)
+
+
+def wald(mean, scale, size=None, ctx=None, device=None):
+    import jax
+    # jax.random.wald samples IG(mu, lambda=1); the inverse-Gaussian
+    # scaling law cX ~ IG(c*mu, c*lambda) gives
+    # IG(mean, scale) = scale * IG(mean/scale, 1)
+    return _draw(lambda k, s: scale * jax.random.wald(
+        k, mean / scale, shape=s), size, device or ctx)
+
+
+def weibull(a, size=None, ctx=None, device=None):
+    import jax
+    # numpy's standard Weibull: scale 1, concentration a (draw shape
+    # made explicit: jax's weibull_min does not broadcast shape=None
+    # against the concentration)
+    return _draw(lambda k, s: jax.random.weibull_min(
+        k, 1.0, a, shape=_param_shape(s, a)), size, device or ctx)
+
+
+def negative_binomial(n, p, size=None, ctx=None, device=None):
+    # numpy counts FAILURES before the n-th success with success prob p;
+    # the nd.random sampler uses the same (k, p) convention
+    return _reclass(_nd_random.negative_binomial(
+        k=n, p=p, shape=_size(size), ctx=device or ctx))
+
+
+def random(size=None, ctx=None, device=None):
+    return uniform(0.0, 1.0, size=size, ctx=device or ctx)
+
+
+random_sample = random
+ranf = random
+sample = random
+
+
+def bytes(length):
+    """``length`` random bytes (reference: np.random.bytes)."""
+    out = randint(0, 256, size=(int(length),), dtype="int32")
+    return _onp.asarray(out.asnumpy(), dtype=_onp.uint8).tobytes()

@@ -462,3 +462,81 @@ def test_np_audit_clean():
     _, _, unaccounted, _ = mod.audit()
     assert not unaccounted, f"np names neither implemented nor " \
                             f"justified: {unaccounted}"
+
+
+# ---------------------------------------------------------------------------
+# mx.np.random distribution tail (round 5): every sampler runs, shapes
+# are numpy's, and first moments match theory under a fixed seed
+# ---------------------------------------------------------------------------
+
+def test_np_random_distribution_tail():
+    r = np.random
+    mx.random.seed(123)
+    n = 4000
+    cases = [
+        ("chisquare", (3.0,), 3.0, 0.3),
+        ("f", (4.0, 8.0), 8.0 / 6.0, 0.3),          # dfden/(dfden-2)
+        ("geometric", (0.25,), 4.0, 0.3),
+        ("gumbel", (1.0, 2.0), 1.0 + 2.0 * 0.5772, 0.3),
+        ("logistic", (0.5, 1.0), 0.5, 0.2),
+        ("pareto", (3.0,), 0.5, 0.2),               # Lomax mean 1/(a-1)
+        ("rayleigh", (2.0,), 2.0 * 1.2533, 0.2),
+        ("standard_t", (5.0,), 0.0, 0.2),
+        ("standard_exponential", (), 1.0, 0.2),
+        ("standard_gamma", (2.0,), 2.0, 0.3),
+        ("triangular", (0.0, 1.0, 2.0), 1.0, 0.2),
+        ("wald", (2.0, 8.0), 2.0, 0.3),
+        ("weibull", (2.0,), 0.8862, 0.15),
+        ("random", (), 0.5, 0.1),
+    ]
+    for name, args, expect, tol in cases:
+        out = getattr(r, name)(*args, size=(n,))
+        v = out.asnumpy()
+        assert v.shape == (n,), name
+        assert onp.isfinite(v).all(), name
+        assert abs(float(v.mean()) - expect) <= tol, \
+            (name, float(v.mean()), expect)
+    c = r.standard_cauchy(size=(n,))
+    v = c.asnumpy()
+    assert v.shape == (n,) and onp.isfinite(v).all()
+    assert abs(float(onp.median(v))) < 0.1          # median 0; mean undefined
+    d = r.dirichlet([1.0, 2.0, 3.0], size=(64,))
+    assert d.shape == (64, 3)
+    onp.testing.assert_allclose(d.asnumpy().sum(-1), 1.0, rtol=1e-5)
+    mv = r.multivariate_normal([0.0, 1.0], [[1.0, 0.0], [0.0, 4.0]],
+                               size=(n,))
+    assert mv.shape == (n, 2)
+    assert abs(float(mv.asnumpy()[:, 1].mean()) - 1.0) < 0.2
+    nb = r.negative_binomial(5, 0.5, size=(n,))
+    assert abs(float(nb.asnumpy().mean()) - 5.0) < 0.4
+    assert len(r.bytes(32)) == 32 and isinstance(r.bytes(1), bytes)
+    for alias in ("random_sample", "ranf", "sample"):
+        assert getattr(r, alias) is r.random
+
+
+def test_np_random_param_broadcast():
+    """size=None broadcasts to the distribution parameters with one
+    INDEPENDENT draw per element (numpy semantics), for both native-jax
+    samplers and the loc/scale-transform ones."""
+    mx.random.seed(5)
+    df = onp.array([1.0, 2.0, 3.0])
+    out = np.random.chisquare(df)
+    assert out.shape == (3,)
+    g = np.random.gumbel(onp.zeros(64))
+    vals = g.asnumpy()
+    assert vals.shape == (64,)
+    assert onp.unique(vals).size > 1      # independent draws, not one
+    w = np.random.weibull(onp.array([1.0, 2.0]))
+    assert w.shape == (2,) and onp.unique(w.asnumpy()).size == 2
+    lg = np.random.logistic(onp.zeros(8), 1.0)
+    assert onp.unique(lg.asnumpy()).size > 1
+    t = np.random.standard_t(onp.array([3.0, 4.0]))
+    assert t.shape == (2,)
+
+
+def test_np_random_seed_determinism_tail():
+    mx.random.seed(7)
+    a = np.random.gumbel(size=(16,)).asnumpy()
+    mx.random.seed(7)
+    b = np.random.gumbel(size=(16,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)

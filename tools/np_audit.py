@@ -129,6 +129,25 @@ def audit():
     return implemented, justified, unaccounted, extra
 
 
+def _submodule_section():
+    import incubator_mxnet_tpu as mx
+    rnd = sorted(set(getattr(mx.np.random, "__all__", None)
+                     or [n for n in dir(mx.np.random)
+                         if not n.startswith("_")]))
+    lin = sorted(set(getattr(mx.np.linalg, "__all__", None)
+                     or [n for n in dir(mx.np.linalg)
+                         if not n.startswith("_")]))
+    return "\n".join([
+        f"`np.random` ({len(rnd)} names — per-context key streams; the "
+        "stateful `RandomState`/`Generator`/`get_state` object machinery "
+        "is excluded by design, `mx.random.seed` governs the stream):",
+        "", ", ".join(f"`{n}`" for n in rnd), "",
+        f"`np.linalg` ({len(lin)} names, generated from jax.numpy.linalg"
+        " — XLA-native decompositions):", "",
+        ", ".join(f"`{n}`" for n in lin),
+    ])
+
+
 def write_doc(path):
     implemented, justified, unaccounted, extra = audit()
     import numpy as np
@@ -187,6 +206,8 @@ def write_doc(path):
         "Framework-side names exposed by `mx.np` that the plain NumPy "
         "namespace does not carry (device placement, framework bridge):",
         "", ", ".join(f"`{n}`" for n in extra), "",
+        "## Submodules: np.random / np.linalg", "",
+        _submodule_section(), "",
         "## npx (numpy_extension)", "",
         "The reference's `mx.npx` is MXNet-specific (accelerated nn ops, "
         "device helpers, np-semantics switches), not a NumPy mirror; its "
