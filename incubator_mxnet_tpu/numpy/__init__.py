@@ -10,7 +10,7 @@ import importlib as _importlib
 from . import multiarray as _ma
 from .multiarray import ndarray  # noqa: F401 — the array type, always eager
 
-_SUBMODULES = ("linalg", "random")
+_SUBMODULES = ("linalg", "random", "fft")
 
 
 def __getattr__(name):

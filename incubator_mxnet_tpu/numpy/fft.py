@@ -1,0 +1,32 @@
+"""``mx.np.fft`` — NumPy-compatible FFT family (reference: the upstream
+``mx.np`` stops at ``contrib`` FFT ops; exposed here as the standard
+``np.fft`` namespace because XLA lowers FFTs natively — on TPU via the
+accelerated convolution/FFT path, on CPU via Ducc/Eigen).
+
+Complex results come back as complex64 ndarrays (complex IS an XLA
+dtype); gradients flow through every transform (jnp.fft is
+differentiable), and the wrappers record on the autograd tape like any
+other mx.np function.
+"""
+from __future__ import annotations
+
+from .multiarray import _np_op
+
+_NAMES = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _gen():
+    import jax.numpy.fft as jfft
+    # hard getattr: every name below exists in supported jax versions,
+    # and a silent shrink of __all__ would be invisible to the audit —
+    # fail at import instead of as a user-facing AttributeError
+    return {n: _np_op(getattr(jfft, n), f"fft.{n}") for n in _NAMES}
+
+
+globals().update(_gen())
+
+__all__ = list(_NAMES)

@@ -540,3 +540,40 @@ def test_np_random_seed_determinism_tail():
     mx.random.seed(7)
     b = np.random.gumbel(size=(16,)).asnumpy()
     onp.testing.assert_array_equal(a, b)
+
+
+def test_np_fft_family():
+    """mx.np.fft vs numpy.fft on every exported transform (round 5)."""
+    x = onp.array([1., 2., 3., 4.], onp.float32)
+    im = onp.arange(16, dtype=onp.float32).reshape(4, 4)
+    cases = {
+        "fft": x, "ifft": x, "rfft": x, "ihfft": x, "hfft": x[:3],
+        "fft2": im, "ifft2": im, "fftn": im, "ifftn": im,
+        "rfft2": im, "rfftn": im,
+        "fftshift": x, "ifftshift": x,
+    }
+    for name, arg in cases.items():
+        got = getattr(np.fft, name)(np.array(arg)).asnumpy()
+        want = getattr(onp.fft, name)(arg)
+        onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                    err_msg=name)
+    onp.testing.assert_allclose(
+        np.fft.irfft(np.fft.rfft(np.array(x))).asnumpy(), x,
+        rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(
+        np.fft.irfft2(np.fft.rfft2(np.array(im))).asnumpy(), im,
+        rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        np.fft.irfftn(np.fft.rfftn(np.array(im))).asnumpy(), im,
+        rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.fft.fftfreq(5).asnumpy(),
+                                onp.fft.fftfreq(5), rtol=1e-6)
+    onp.testing.assert_allclose(np.fft.rfftfreq(5).asnumpy(),
+                                onp.fft.rfftfreq(5), rtol=1e-6)
+    # differentiable: d/da sum(|FFT(a)|^2) = 2*N*a (Parseval)
+    a = np.array(x)
+    a.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.abs(np.fft.fft(a)) ** 2)
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * 4 * x, rtol=1e-4)

@@ -137,6 +137,7 @@ def _submodule_section():
     lin = sorted(set(getattr(mx.np.linalg, "__all__", None)
                      or [n for n in dir(mx.np.linalg)
                          if not n.startswith("_")]))
+    fftn = sorted(set(mx.np.fft.__all__))
     return "\n".join([
         f"`np.random` ({len(rnd)} names — per-context key streams; the "
         "stateful `RandomState`/`Generator`/`get_state` object machinery "
@@ -144,7 +145,10 @@ def _submodule_section():
         "", ", ".join(f"`{n}`" for n in rnd), "",
         f"`np.linalg` ({len(lin)} names, generated from jax.numpy.linalg"
         " — XLA-native decompositions):", "",
-        ", ".join(f"`{n}`" for n in lin),
+        ", ".join(f"`{n}`" for n in lin), "",
+        f"`np.fft` ({len(fftn)} names, generated from jax.numpy.fft — "
+        "XLA-native transforms, differentiable):", "",
+        ", ".join(f"`{n}`" for n in fftn),
     ])
 
 
