@@ -112,7 +112,10 @@ def _bench_bert(on_accel, kind, dev, seq_len=None, batch_ladder=None,
         cfg = dict(vocab_size=30522, units=1024, hidden_size=4096,
                    num_layers=24, num_heads=16, max_length=512)
         T = seq_len or 128
-        batch_ladder = batch_ladder or [64, 32, 16, 8]
+        # 128 first: B=64 fit WITHOUT remat in the r05 window (HBM
+        # headroom observed), so a bigger batch may lift MFU; the OOM
+        # ladder (remat retry, then halve) makes the attempt safe
+        batch_ladder = batch_ladder or [128, 64, 32, 16, 8]
         steps, warmup = steps or 20, 3
     else:
         cfg = dict(vocab_size=1024, units=128, hidden_size=256,

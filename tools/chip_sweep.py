@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""One-shot chip sweep for a live relay window: run the TPU tier, then
+the full bench, writing both judged artifacts.  Designed to be fired
+automatically by a relay watcher the moment listeners appear — relay
+windows have been ~30 minutes, so the tier (fast, correctness evidence)
+goes first and the bench (long, perf evidence) second.
+
+    python tools/chip_sweep.py --round 5
+
+Artifacts: TPU_TIER_r{N}.json (tier), BENCH_r{N}_midround.json (bench
+record + context), /tmp/chip_sweep.log (progress).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK = "/tmp/chip_sweep.lock"
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%SZ', time.gmtime())}] {msg}"
+    print(line, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--round", type=int, default=5)
+    ap.add_argument("--skip-tier", action="store_true")
+    args = ap.parse_args()
+
+    if os.path.exists(LOCK):
+        # SIGTERM skips the finally-unlink: honor the lock only while
+        # its holder is actually alive
+        try:
+            holder = int(open(LOCK).read().strip() or 0)
+        except (OSError, ValueError):
+            holder = 0
+        if holder and os.path.exists(f"/proc/{holder}"):
+            log(f"lock {LOCK} held by live pid {holder}; exiting")
+            return
+        log(f"stale lock (pid {holder} gone) — taking over")
+    open(LOCK, "w").write(str(os.getpid()))
+    try:
+        _run(args)
+    finally:
+        os.unlink(LOCK)
+
+
+def _run(args):
+    n = args.round
+    t0 = time.time()
+    if not args.skip_tier:
+        log("tier starting")
+        tmp = f".tpu_tier_sweep_r{n:02d}.json"
+        rc = subprocess.run(
+            [sys.executable, "tools/run_tpu_tier.py",
+             "--out", tmp, "--timeout", "5400"],
+            cwd=_REPO).returncode
+        final = os.path.join(_REPO, f"TPU_TIER_r{n:02d}.json")
+        try:
+            fresh = json.load(open(os.path.join(_REPO, tmp)))
+            # promote unless this run never reached the chip while a
+            # previous artifact carries real chip executions
+            prior_ran = os.path.exists(final) and \
+                json.load(open(final)).get("passed", 0) > 0
+            if fresh.get("status") != "tpu_down" or not prior_ran:
+                os.replace(os.path.join(_REPO, tmp), final)
+                log(f"tier artifact promoted (status="
+                    f"{fresh.get('status')})")
+            else:
+                os.unlink(os.path.join(_REPO, tmp))
+                log("tier probe found relay dead again; kept the prior "
+                    "chip-run artifact")
+        except (OSError, ValueError) as e:
+            log(f"tier artifact handling failed: {e}")
+        log(f"tier done rc={rc} ({time.time() - t0:.0f}s)")
+
+    log("bench starting")
+    t1 = time.time()
+    out = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd=_REPO, timeout=4 * 3600)
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() \
+        else "{}"
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        rec = {"parse_error": line[:300]}
+    sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         capture_output=True, text=True,
+                         cwd=_REPO).stdout.strip()
+    wrapped = {
+        "source": "relay-window chip sweep (tools/chip_sweep.py); the "
+                  "judged BENCH_r{} .json is the driver's end-of-round "
+                  "run".format(n),
+        "git_sha": sha,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "bench_wall_seconds": round(time.time() - t1, 1),
+        "record": rec,
+    }
+    dst = os.path.join(_REPO, f"BENCH_r{n:02d}_midround.json")
+    on_accel = str(rec.get("device", "")).startswith(
+        ("tpu", "axon")) if isinstance(rec, dict) else False
+    if not on_accel and os.path.exists(dst):
+        try:
+            old = json.load(open(dst)).get("record", {})
+            if str(old.get("device", "")).startswith(("tpu", "axon")):
+                # never clobber a real chip record with a CPU-degraded
+                # one (the relay died between watcher-fire and bench)
+                dst = dst.replace(".json", "_degraded.json")
+                log("existing record is on-chip; writing degraded "
+                    f"record to {os.path.basename(dst)} instead")
+        except (OSError, ValueError):
+            pass
+    with open(dst, "w") as f:
+        json.dump(wrapped, f, indent=1)
+    log(f"bench done ({time.time() - t1:.0f}s): {line[:200]}")
+    log(f"sweep complete in {time.time() - t0:.0f}s")
+
+
+if __name__ == "__main__":
+    main()
