@@ -52,3 +52,15 @@ def test_example_moe_short():
                timeout=360)
     assert "expert shards:" in out
     assert "final loss" in out
+
+
+def test_example_pipeline_short():
+    out = _run("example/distributed/train_pipeline.py",
+               "--schedule", "1f1b", "--dp", "2", "--stages", "2",
+               "--layers", "4", "--microbatches", "4", "--steps", "6",
+               "--batch-size", "8", "--seq-len", "16", "--fixed-batch",
+               timeout=600)
+    assert "schedule=1f1b" in out and "done: final loss" in out
+    losses = [float(l.rsplit(" ", 1)[1]) for l in out.splitlines()
+              if l.startswith("step ")]
+    assert losses[-1] < losses[0]
