@@ -7,6 +7,7 @@ shardings + one compiled step:
   make_mesh / mesh_scope      device mesh with named axes
   SPMDTrainer                 whole train step (fwd+bwd+psum+opt) in one jit
   shard_params                regex→PartitionSpec tensor parallelism
+  fsdp_rules                  ZeRO-3-class full parameter sharding over data
   ring_attention              sequence parallelism over the mesh (beyond
                               reference parity)
   ulysses_attention           all-to-all sequence parallelism (DeepSpeed-
@@ -16,7 +17,8 @@ shardings + one compiled step:
 from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
                    replicated, shard_spec, named_sharding,
                    device_put_sharded)
-from .spmd import SPMDTrainer, shard_params, data_sharding, exact_rule
+from .spmd import (SPMDTrainer, shard_params, data_sharding,
+                   exact_rule, fsdp_rules)
 from .ring import ring_attention, local_flash_attention
 from .ulysses import ulysses_attention
 from .pipeline import (gpipe, stack_stage_params, pipe_specs,
@@ -26,7 +28,7 @@ from . import distributed
 
 __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "replicated", "shard_spec", "named_sharding",
-           "device_put_sharded", "SPMDTrainer", "shard_params",
+           "device_put_sharded", "SPMDTrainer", "shard_params", "fsdp_rules",
            "data_sharding", "exact_rule", "ring_attention",
            "local_flash_attention", "ulysses_attention", "gpipe",
            "stack_stage_params", "pipe_specs", "stack_block_stages",

@@ -32,7 +32,8 @@ from ..gluon.block import functional_call
 from . import mesh as mesh_mod
 from . import optim as fopt
 
-__all__ = ["SPMDTrainer", "shard_params", "data_sharding", "exact_rule"]
+__all__ = ["SPMDTrainer", "shard_params", "data_sharding",
+           "exact_rule", "fsdp_rules"]
 
 
 def _fetch_full(v):
@@ -98,6 +99,54 @@ def shard_params(params: Dict[str, object], mesh, rules=None):
             f"REPLICATED): {dead}; with custom prefix= models derive "
             "exact-name rules via tp_rules(block=net)", stacklevel=2)
     return out
+
+
+def fsdp_rules(block, axis="data", min_size=1 << 16, mesh=None):
+    """Fully-sharded data parallelism (ZeRO-3 class) as sharding rules.
+
+    Every parameter of at least ``min_size`` elements gets its largest
+    (mesh-divisible, when ``mesh`` is given) axis sharded over the DATA
+    axis, so each device stores 1/N of the big weights; GSPMD then
+    compiles the FSDP communication schedule automatically — all-gather
+    of each layer's weights before its compute, reduce-scatter of its
+    gradients in the backward — while the batch stays sharded over the
+    same axis.  Small parameters (biases, norms) remain replicated,
+    standard FSDP practice: their all-gather latency would exceed the
+    memory saved.
+
+    Compose with ``shard_optimizer_state=True``: optimizer-state leaves
+    inherit each param's sharding, so moments for FSDP-sharded weights
+    are already distributed and ZeRO-1 covers the replicated remainder
+    (see ``_make_state_shardings``).
+
+    Reference analog: none — the reference's kvstore replicates all
+    weights per device (SURVEY §2.4); beyond-parity with
+    dp/tp/sp/ep/pp.  Pattern: GSPMD ("automatic sharding propagation")
+    + the ZeRO paper's stage-3 partitioning, expressed as
+    PartitionSpecs instead of a runtime."""
+    from jax.sharding import PartitionSpec as P
+    rules = []
+    n = mesh.shape[axis] if mesh is not None else None
+    for p in block.collect_params().values():
+        if p._data is None:
+            raise MXNetError(
+                "initialize the net and run one forward before deriving "
+                "fsdp_rules (deferred shapes must be settled)")
+        v = p.data()
+        if v.size < min_size:
+            continue
+        shape = tuple(v.shape)
+        pick = None
+        for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if n is None or (shape[d] > 0 and shape[d] % n == 0):
+                pick = d
+                break
+        if pick is None:
+            continue           # no divisible axis: stays replicated
+        spec = [None] * len(shape)
+        spec[pick] = axis
+        rules.append(exact_rule(p, P(*spec)))
+    return rules
 
 
 class SPMDTrainer:

@@ -500,3 +500,80 @@ def test_mirror_actually_inserts_remat(monkeypatch):
     monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
     assert "remat" not in str(
         jax.make_jaxpr(make_f())(jnp.ones((1, 4, 16))))
+
+
+# ---------------------------------------------------------------------------
+# FSDP (ZeRO-3-class) parameter sharding over the data axis (round 5)
+# ---------------------------------------------------------------------------
+
+def test_fsdp_rules_shard_and_match_1dev():
+    """fsdp_rules shards every big weight over the data axis (each
+    device stores 1/N), GSPMD compiles the all-gather/reduce-scatter
+    schedule, and two optimizer steps match the 1-device oracle —
+    composed with ZeRO-1 on the replicated remainder."""
+    import jax
+    from incubator_mxnet_tpu.models import bert
+
+    def build():
+        mx.random.seed(17)
+        net = bert.BERTForPretrain(
+            bert.BERTModel(vocab_size=512, units=64, hidden_size=128,
+                           num_layers=2, num_heads=4, max_length=32,
+                           dropout=0.0), vocab_size=512)
+        net.initialize(init=mx.init.Normal(0.02))
+        with mx.autograd.pause():
+            net(mx.nd.array(np.zeros((2, 16), np.int32), dtype="int32"),
+                mx.nd.array(np.zeros((2, 16), np.int32), dtype="int32"))
+        return net
+
+    rng = np.random.default_rng(17)
+    ids = rng.integers(0, 512, (16, 16)).astype(np.int32)
+    types = np.zeros((16, 16), np.int32)
+    labels = np.concatenate(      # packed: T MLM targets + 1 NSP class
+        [rng.integers(0, 512, (16, 16)),
+         rng.integers(0, 2, (16, 1))], axis=1).astype(np.float32)
+    loss_blk = bert.BERTPretrainLoss(512)
+
+    mesh = parallel.make_mesh({"data": 8})
+    net = build()
+    rules = parallel.fsdp_rules(net, mesh=mesh, min_size=1 << 10)
+    assert rules, "expected big params to produce rules"
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh,
+                              sharding_rules=rules,
+                              shard_optimizer_state=True)
+    # the big weights are genuinely distributed: data appears in the
+    # value sharding, and the per-device shard is 1/8 of the weight
+    sharded = [v for v in tr._tr_vals
+               if any("data" in str(ax) for ax in v.sharding.spec)]
+    assert len(sharded) == len(rules)   # every rule landed
+    v = max(sharded, key=lambda a: a.size)
+    shard_elems = v.addressable_shards[0].data.size
+    assert shard_elems * 8 == v.size
+
+    l1 = float(tr.step(ids, types, labels))
+    l2 = float(tr.step(ids, types, labels))
+    assert l2 < l1
+
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(build(), loss_blk, "adam",
+                               {"learning_rate": 1e-3}, mesh=mesh1)
+    o1 = float(tr1.step(ids, types, labels))
+    o2 = float(tr1.step(ids, types, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+    assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
+
+
+def test_fsdp_rules_small_params_replicated():
+    from incubator_mxnet_tpu.models import bert
+    mx.random.seed(18)
+    net = bert.BERTModel(vocab_size=128, units=32, hidden_size=64,
+                         num_layers=1, num_heads=2, max_length=16,
+                         dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.02))
+    with mx.autograd.pause():
+        net(mx.nd.array(np.zeros((1, 8), np.int32), dtype="int32"),
+            mx.nd.array(np.zeros((1, 8), np.int32), dtype="int32"))
+    mesh = parallel.make_mesh({"data": 8})
+    rules = parallel.fsdp_rules(net, mesh=mesh, min_size=1 << 30)
+    assert rules == []     # everything under min_size stays replicated
