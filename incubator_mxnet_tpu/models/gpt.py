@@ -220,12 +220,14 @@ class GPTModel(HybridBlock):
 
     # -- generation ----------------------------------------------------
     def generate(self, ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, use_cache=True, seed=None):
+                 top_k=0, top_p=0.0, use_cache=True, seed=None):
         """Autoregressive continuation of prompt ``ids`` (B, Tp) int32.
 
         temperature == 0 -> greedy; otherwise softmax sampling at that
         temperature, restricted to the ``top_k`` highest logits when
-        top_k > 0.  One ``lax.scan`` program either way; ``use_cache``
+        top_k > 0 and/or to the nucleus of smallest cumulative
+        probability mass >= ``top_p`` when 0 < top_p < 1 (the top-1
+        token always survives; both filters compose, top-k first).  One ``lax.scan`` program either way; ``use_cache``
         False re-runs the full prefix per step (the oracle).  Returns
         (B, Tp + max_new_tokens) int32 tokens.
 
@@ -256,11 +258,14 @@ class GPTModel(HybridBlock):
             key = jax.random.PRNGKey(seed)
         if use_cache:
             return self._generate_cached(ids, max_new_tokens, temperature,
-                                         top_k, key)
+                                         top_k, top_p, key)
         return self._generate_full(ids, max_new_tokens, temperature,
-                                   top_k, key)
+                                   top_k, top_p, key)
 
-    def _sample_fn(self, temperature, top_k):
+    def _sample_fn(self, temperature, top_k, top_p=0.0):
+        if not 0.0 <= float(top_p) <= 1.0:
+            raise MXNetError(f"top_p={top_p} outside [0, 1]")
+
         def pick(logits, key):
             import jax
             import jax.numpy as jnp
@@ -269,18 +274,38 @@ class GPTModel(HybridBlock):
                 return jnp.argmax(lf, axis=-1).astype(jnp.int32)
             lf = lf / temperature
             k = min(int(top_k), lf.shape[-1]) if top_k else 0
+            need_sort = (k > 0 and k < lf.shape[-1]) or 0.0 < top_p < 1.0
+            if need_sort:
+                # ONE descending sort feeds both filters (the nucleus
+                # runs on the already-top-k-masked order: -inf entries
+                # carry zero probability mass, so they can never be
+                # kept or become the cutoff)
+                srt = -jnp.sort(-lf, axis=-1)
             if k > 0 and k < lf.shape[-1]:
                 # top_k >= vocab degenerates to plain sampling (GPT-2
                 # convention) rather than an out-of-bounds sort index
-                kth = jnp.sort(lf, axis=-1)[..., -k][..., None]
+                kth = srt[..., k - 1][..., None]
                 lf = jnp.where(lf >= kth, lf, -jnp.inf)
+                srt = jnp.where(jnp.arange(srt.shape[-1]) < k, srt,
+                                -jnp.inf)
+            if 0.0 < top_p < 1.0:
+                # nucleus filter: keep the smallest prefix of the
+                # descending-prob sort whose mass reaches top_p; the
+                # exclusive cumsum keeps the top-1 token unconditionally
+                probs = jax.nn.softmax(srt, axis=-1)
+                before = jnp.cumsum(probs, axis=-1) - probs
+                keep = before < top_p
+                cutoff = jnp.min(jnp.where(keep, srt, jnp.inf),
+                                 axis=-1, keepdims=True)
+                lf = jnp.where(lf >= cutoff, lf, -jnp.inf)
             return jax.random.categorical(key, lf, axis=-1).astype(
                 jnp.int32)
         return pick
 
-    def _generate_full(self, ids, n_new, temperature, top_k, key):
+    def _generate_full(self, ids, n_new, temperature, top_k, top_p,
+                       key):
         """Oracle: whole prefix re-run per step, lax.scan outside."""
-        pick = self._sample_fn(temperature, top_k)
+        pick = self._sample_fn(temperature, top_k, top_p)
         B, Tp = ids.shape
         total = Tp + n_new
 
@@ -333,8 +358,9 @@ class GPTModel(HybridBlock):
         out = self.ln_f(xn)
         return _lm_logits(out._data, self.embed.weight.data()._data)
 
-    def _generate_cached(self, ids, n_new, temperature, top_k, key):
-        pick = self._sample_fn(temperature, top_k)
+    def _generate_cached(self, ids, n_new, temperature, top_k, top_p,
+                         key):
+        pick = self._sample_fn(temperature, top_k, top_p)
         B, Tp = ids.shape
         total = Tp + n_new
         C = self._units

@@ -125,6 +125,10 @@ def fsdp_rules(block, axis="data", min_size=1 << 16, mesh=None):
     + the ZeRO paper's stage-3 partitioning, expressed as
     PartitionSpecs instead of a runtime."""
     from jax.sharding import PartitionSpec as P
+    if mesh is not None and axis not in mesh.shape:
+        raise MXNetError(
+            f"fsdp_rules: mesh has no axis {axis!r} "
+            f"(axes: {tuple(mesh.shape)})")
     rules = []
     n = mesh.shape[axis] if mesh is not None else None
     for p in block.collect_params().values():

@@ -172,3 +172,36 @@ class TestTraining:
         assert matmul_weights
         for n in matmul_weights:
             assert any(re.search(pat, n) for pat, _ in rules), n
+
+
+def test_generate_top_p_nucleus():
+    """Nucleus sampling: with top_p covering only the single dominant
+    token, sampling degenerates to greedy; cached == full-prefix; and
+    the filter composes with top_k."""
+    net = _tiny()
+    ids = mx.nd.array(np.array([[1, 2, 3]], np.int32), dtype="int32")
+
+    # tight nucleus -> only the argmax token survives -> equals greedy
+    greedy = net.generate(ids, max_new_tokens=6, temperature=0.0)
+    tight = net.generate(ids, max_new_tokens=6, temperature=1.0,
+                         top_p=1e-6, seed=3)
+    np.testing.assert_array_equal(np.asarray(greedy.asnumpy()),
+                                  np.asarray(tight.asnumpy()))
+
+    # cached and full-prefix paths agree under the same seed
+    a = net.generate(ids, max_new_tokens=5, temperature=0.8, top_p=0.9,
+                     seed=11, use_cache=True)
+    b = net.generate(ids, max_new_tokens=5, temperature=0.8, top_p=0.9,
+                     seed=11, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a.asnumpy()),
+                                  np.asarray(b.asnumpy()))
+
+    # composes with top_k and stays in-vocab
+    c = net.generate(ids, max_new_tokens=5, temperature=1.0, top_k=8,
+                     top_p=0.7, seed=5)
+    v = np.asarray(c.asnumpy())
+    assert v.shape == (1, 8) and (v >= 0).all() and (v < 64).all()
+
+    # validation
+    with pytest.raises(mx.base.MXNetError, match="top_p"):
+        net.generate(ids, max_new_tokens=2, temperature=1.0, top_p=1.5)
