@@ -1,6 +1,7 @@
 """Pallas TPU kernels for the hot ops (SURVEY §7 stance: XLA fuses most
 of the graph; hand-written kernels only where the compiler's schedule
 leaves HBM bandwidth on the table — attention being the canonical case)."""
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import (flash_attention,  # noqa: F401
+                              flash_attention_lse)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]

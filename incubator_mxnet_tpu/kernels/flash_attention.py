@@ -40,7 +40,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -278,8 +278,14 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref, *rest,
 
 
 def _flash_bwd_impl(q, k, v, bias, out, lse, g, scale, causal, interpret,
-                    n_heads):
-    """FA2 backward as two Pallas kernels; returns (dq, dk, dv, dbias)."""
+                    n_heads, g_lse=None):
+    """FA2 backward as two Pallas kernels; returns (dq, dk, dv, dbias).
+
+    ``g_lse``: optional cotangent of the logsumexp output (the
+    with-lse variant used by blockwise ring attention).  It folds into
+    the existing kernels with NO kernel change: ds = p*(dp - dd) and
+    d(lse_i)/d(s_ij) = p_ij, so the lse term is exactly dd -> dd - g_lse
+    (dv = p^T dO is lse-independent and untouched)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -289,6 +295,8 @@ def _flash_bwd_impl(q, k, v, bias, out, lse, g, scale, causal, interpret,
     has_bias = bias is not None
     # D_i = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
     dd = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), -1)
+    if g_lse is not None:
+        dd = dd - g_lse.astype(jnp.float32)
 
     spec_row_q = pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0),
                               memory_space=pltpu.VMEM)
@@ -395,13 +403,84 @@ def _flash_bwd(scale, causal, interpret, n_heads, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False, mask=None):
-    """Online-softmax attention over (B, H, T, D) jax arrays.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse(q, k, v, bias, scale, causal, interpret, n_heads):
+    """Variant exposing (out, lse) as OUTPUTS — the building block of
+    blockwise ring attention, whose cross-shard merge needs each
+    block's logsumexp (and gradients through it)."""
+    return _flash_fwd_impl(q, k, v, bias, scale, causal, interpret,
+                           n_heads, with_lse=True)
 
-    ``mask``: optional (B, Tk) key-validity array (nonzero = attend), the
-    ``valid_length``-derived mask every padded batch carries; rows must
-    keep >= 1 valid key.  Falls back to the XLA implementation when shapes
-    don't fit the kernel contract (T not divisible by the block size)."""
+
+def _flash_lse_fwd(q, k, v, bias, scale, causal, interpret, n_heads):
+    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, interpret,
+                               n_heads, with_lse=True)
+    return (out, lse), (q, k, v, bias, out, lse)
+
+
+def _flash_lse_bwd(scale, causal, interpret, n_heads, res, g):
+    q, k, v, bias, out, lse = res
+    g_out, g_lse = g
+    from ..base import getenv
+    if (getenv("MXNET_FLASH_BWD") or "pallas").lower() != "xla":
+        return _flash_bwd_impl(q, k, v, bias, out, lse, g_out, scale,
+                               causal, interpret, n_heads, g_lse=g_lse)
+    # MXNET_FLASH_BWD=xla — the recompute oracle (same switch as the
+    # no-lse path; AD produces the g_lse term naturally here)
+    BH = q.shape[0]
+
+    def ref(q_, k_, v_, b_):
+        bb = None
+        if b_ is not None:
+            bb = jnp.broadcast_to(
+                b_[:, None], (b_.shape[0], n_heads) + b_.shape[1:]
+            ).reshape((BH,) + b_.shape[1:])
+        return _xla_attention_lse(q_, k_, v_, scale, causal, bias=bb)
+
+    if bias is None:
+        _, vjp = jax.vjp(lambda q_, k_, v_: ref(q_, k_, v_, None),
+                         q, k, v)
+        return vjp((g_out, g_lse)) + (None,)
+    _, vjp = jax.vjp(ref, q, k, v, bias)
+    return vjp((g_out, g_lse))
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _xla_attention_lse(q, k, v, scale, causal, bias=None):
+    """(BH, T, D) reference path returning (out, lse) — differentiable
+    by plain AD; the odd-shape fallback of flash_attention_lse."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        T = q.shape[1]
+        iq = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        ik = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        s = jnp.where(iq[None] >= ik[None], s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+    return out, lse
+
+
+def flash_attention_lse(q, k, v, scale=None, causal=False, mask=None):
+    """Like :func:`flash_attention` but ALSO returns the per-row
+    logsumexp: (out (B, H, T, D), lse (B, H, T)).  Gradients flow
+    through both outputs (the lse cotangent folds into the kernels'
+    dd term).  Used by blockwise ring attention to merge per-shard
+    blocks exactly; same tile-alignment gate and XLA fallback as
+    flash_attention (one dispatcher)."""
+    return _dispatch(q, k, v, scale, causal, mask, with_lse=True)
+
+
+def _dispatch(q, k, v, scale, causal, mask, with_lse):
+    """ONE dispatcher for both public entry points: mask→bias encoding,
+    the tile-alignment + VMEM gate, and platform/interpret detection
+    live here once (they had already drifted when duplicated)."""
     B, H, T, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
@@ -409,15 +488,18 @@ def flash_attention(q, k, v, scale=None, causal=False, mask=None):
     if mask is not None:
         bias = jnp.where(mask > 0, 0.0, -1e30).astype(
             jnp.float32).reshape(B, 1, T)
+    qf, kf, vf = (x.reshape(B * H, T, D) for x in (q, k, v))
     kv_bytes = 2 * T * D * q.dtype.itemsize
     if T % _BLOCK_Q or kv_bytes > 8 * 2 ** 20:
         # not tile-aligned, or K+V would blow the VMEM budget: XLA path
         bb = None if bias is None else jnp.broadcast_to(
             bias[:, None], (B, H, 1, T)).reshape(B * H, 1, T)
-        return _xla_attention(
-            q.reshape(B * H, T, D), k.reshape(B * H, T, D),
-            v.reshape(B * H, T, D), scale, causal,
-            bias=bb).reshape(B, H, T, D)
+        if with_lse:
+            out, lse = _xla_attention_lse(qf, kf, vf, scale, causal,
+                                          bias=bb)
+            return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+        return _xla_attention(qf, kf, vf, scale, causal,
+                              bias=bb).reshape(B, H, T, D)
     # interpret on CPU: decide from where the DATA lives (a concrete
     # array on the CPU backend of a TPU-default process must interpret);
     # tracers have no devices — fall back to the default backend
@@ -426,6 +508,19 @@ def flash_attention(q, k, v, scale=None, causal=False, mask=None):
     except Exception:
         platform = jax.default_backend()
     interpret = platform == "cpu"
-    qf, kf, vf = (x.reshape(B * H, T, D) for x in (q, k, v))
+    if with_lse:
+        out, lse = _flash_lse(qf, kf, vf, bias, scale, causal,
+                              interpret, H)
+        return out.reshape(B, H, T, D), lse.reshape(B, H, T)
     out = _flash(qf, kf, vf, bias, scale, causal, interpret, H)
     return out.reshape(B, H, T, D)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, mask=None):
+    """Online-softmax attention over (B, H, T, D) jax arrays.
+
+    ``mask``: optional (B, Tk) key-validity array (nonzero = attend), the
+    ``valid_length``-derived mask every padded batch carries; rows must
+    keep >= 1 valid key.  Falls back to the XLA implementation when shapes
+    don't fit the kernel contract (T not divisible by the block size)."""
+    return _dispatch(q, k, v, scale, causal, mask, with_lse=False)

@@ -77,8 +77,13 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
                 from jax.sharding import PartitionSpec as P
                 from jax import shard_map
                 spec = P(None, None, seq_axis, None)
+                from ..base import getenv_bool as _gb
                 body = partial(_ring_body, axis_name=seq_axis,
-                               scale=scale, causal=causal)
+                               scale=scale, causal=causal,
+                               # blockwise (flash) local compute rides
+                               # the same fusion gate as dense SDPA
+                               use_flash=fuse_ok
+                               and _gb("MXNET_USE_FUSION"))
                 if rest:
                     # valid_length mask is sequence-sharded like K/V and
                     # rotates around the ring with them

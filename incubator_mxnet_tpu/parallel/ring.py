@@ -15,7 +15,8 @@ from functools import partial
 
 from ..base import MXNetError
 
-__all__ = ["ring_attention", "local_flash_attention", "ring_attention_nd"]
+__all__ = ["ring_attention", "local_flash_attention",
+           "ring_attention_nd"]
 
 
 def local_flash_attention(q, k, v, scale=None, causal=False,
@@ -46,7 +47,87 @@ def local_flash_attention(q, k, v, scale=None, causal=False,
     return o / jnp.maximum(l, 1e-30)
 
 
-def _ring_body(q, k, v, kv_mask=None, *, axis_name, scale, causal):
+def _ring_body_flash(q, k, v, kv_mask=None, *, axis_name, scale, causal):
+    """Blockwise ring attention (Liu et al.'s full recipe): each ring
+    step's LOCAL block runs through the Pallas flash kernel — the
+    (T_local, T_local) score tile never materializes either — and
+    blocks merge EXACTLY via their logsumexp:
+    ``o <- w*o + w_b*o_b`` with ``w = exp(lse - logaddexp(lse, lse_b))``.
+    Gradients flow through the merge because flash_attention_lse's
+    custom_vjp accepts the lse cotangent (it folds into the kernels'
+    dd term).  Requires (B, H, T_local, D) inputs.
+
+    Causal cross-shard structure is data-dependent inside the loop
+    (src vs my): handled with lax.switch over {full block, diagonal
+    (causal) block, empty block} — all three branches trace the same
+    shapes, SPMD-uniform."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from ..kernels.flash_attention import flash_attention_lse
+
+    if q.ndim != 4:
+        raise MXNetError(
+            "blockwise ring attention needs (B, H, T_local, D) inputs")
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    lse0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+
+    def blk(k_cur, v_cur, mask_cur, src):
+        def run(causal_blk):
+            o, l = flash_attention_lse(q, k_cur, v_cur, scale=scale,
+                                       causal=causal_blk, mask=mask_cur)
+            return o.astype(jnp.float32), l
+
+        if not causal:
+            return run(False)
+
+        def full_blk():
+            return run(False)
+
+        def diag_blk():
+            return run(True)
+
+        def empty_blk():          # src > my: entirely in the future
+            return jnp.zeros_like(o0), jnp.full(lse0.shape, -jnp.inf,
+                                                jnp.float32)
+
+        idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+        return lax.switch(idx, [full_blk, diag_blk, empty_blk])
+
+    def body(step, carry):
+        o, lse, k_cur, v_cur, mask_cur = carry
+        src = (my - step) % n
+        o_b, lse_b = blk(k_cur, v_cur, mask_cur, src)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        w_o = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - safe))
+        w_b = jnp.where(jnp.isneginf(lse_b), 0.0, jnp.exp(lse_b - safe))
+        o = o * w_o[..., None] + o_b * w_b[..., None]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        mask_next = (None if mask_cur is None
+                     else lax.ppermute(mask_cur, axis_name, perm))
+        return o, lse_new, k_next, v_next, mask_next
+
+    o, _, *_ = lax.fori_loop(0, n, body, (o0, lse0, k, v, kv_mask),
+                             unroll=True)
+    return o.astype(q.dtype)
+
+
+def _ring_body(q, k, v, kv_mask=None, *, axis_name, scale, causal,
+               use_flash=False):
+    if use_flash:
+        return _ring_body_flash(q, k, v, kv_mask, axis_name=axis_name,
+                                scale=scale, causal=causal)
+    return _ring_body_einsum(q, k, v, kv_mask, axis_name=axis_name,
+                             scale=scale, causal=causal)
+
+
+def _ring_body_einsum(q, k, v, kv_mask=None, *, axis_name, scale, causal):
     """Per-shard ring schedule (runs inside shard_map).
 
     ``kv_mask``: optional (B, T_local) key-validity indicator (>0 = valid),
@@ -96,12 +177,15 @@ def _ring_body(q, k, v, kv_mask=None, *, axis_name, scale, causal):
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="seq", scale=None,
-                   causal=False):
+                   causal=False, use_flash=False):
     """Exact attention with Q/K/V sequence-sharded over ``axis_name``.
 
     q/k/v: (batch, heads, T, D) with T sharded over the mesh axis.
     Returns attention output with the same sharding.  Accepts jax arrays or
     NDArrays; batch/head dims may additionally be sharded over other axes.
+    ``use_flash=True`` runs each ring step's local block through the
+    Pallas flash kernel (blockwise ring attention — O(T_local) memory
+    within the block as well); results are numerically the same path.
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -123,7 +207,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="seq", scale=None,
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         partial(_ring_body, axis_name=axis_name, scale=scale,
-                causal=causal),
+                causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     out = fn(q, k, v)
