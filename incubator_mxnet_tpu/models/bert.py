@@ -67,10 +67,12 @@ def _sdpa(q, k, v, num_heads, mask=None, seq_axis=None, mesh=None,
                 # all-to-all schedule (docs/parallelism.md: constant
                 # collective count, needs heads % axis_size == 0)
                 from ..parallel.ulysses import ulysses_attention
+                from ..base import getenv_bool as _gb
                 out = ulysses_attention(
                     qh, kh, vh, mesh=mesh, axis_name=seq_axis,
                     scale=scale, causal=causal,
-                    mask=rest[0] if rest else None)
+                    mask=rest[0] if rest else None,
+                    use_flash=fuse_ok and _gb("MXNET_USE_FUSION"))
             elif sp_impl == "ring":
                 from ..parallel.ring import _ring_body
                 from functools import partial

@@ -21,12 +21,16 @@ from ..base import MXNetError
 __all__ = ["ulysses_attention"]
 
 
-def _ulysses_body(q, k, v, mask=None, *, axis_name, scale, causal):
+def _ulysses_body(q, k, v, mask=None, *, axis_name, scale, causal,
+                  use_flash=False):
     """Per-shard body (runs inside shard_map).
 
     q/k/v: (B, H, T_local, D) sequence shards; optional ``mask``
     (B, T_local) key-validity shard.  Returns the (B, H, T_local, D)
-    attention output shard."""
+    attention output shard.  ``use_flash`` runs the post-all-to-all
+    full-sequence attention through the Pallas flash kernel (the
+    (B, H/n, T, D) gathered shape is exactly the kernel's contract; the
+    dispatcher still falls back to XLA for non-tile-aligned T)."""
     from jax import lax
     from .ring import local_flash_attention
 
@@ -41,15 +45,20 @@ def _ulysses_body(q, k, v, mask=None, *, axis_name, scale, causal):
     full_mask = (None if mask is None else
                  lax.all_gather(mask, axis_name, axis=1,
                                 tiled=True))         # (B, T)
-    oh = local_flash_attention(qh, kh, vh, scale=scale, causal=causal,
-                               key_mask=full_mask)
+    if use_flash:
+        from ..kernels import flash_attention
+        oh = flash_attention(qh, kh, vh, scale=scale, causal=causal,
+                             mask=full_mask)
+    else:
+        oh = local_flash_attention(qh, kh, vh, scale=scale,
+                                   causal=causal, key_mask=full_mask)
     # head-sharded -> seq-sharded
     return lax.all_to_all(oh, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
 
 
 def ulysses_attention(q, k, v, mesh=None, axis_name="seq", scale=None,
-                      causal=False, mask=None):
+                      causal=False, mask=None, use_flash=False):
     """Exact attention with Q/K/V sequence-sharded over ``axis_name``,
     computed with the DeepSpeed-Ulysses all-to-all schedule.
 
@@ -85,14 +94,14 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="seq", scale=None,
     if mask is not None:
         fn = shard_map(
             partial(_ulysses_body, axis_name=axis_name, scale=scale,
-                    causal=causal),
+                    causal=causal, use_flash=use_flash),
             mesh=mesh, in_specs=(spec, spec, spec, P(None, axis_name)),
             out_specs=spec, check_vma=False)
         out = fn(q, k, v, mask)
     else:
         fn = shard_map(
             partial(_ulysses_body, axis_name=axis_name, scale=scale,
-                    causal=causal),
+                    causal=causal, use_flash=use_flash),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
         out = fn(q, k, v)
