@@ -358,3 +358,62 @@ def test_gpt_generate_consistency():
         assert t.shape == (2, 13)
         onp.testing.assert_array_equal(t[:, :5], prompt)
         assert ((t >= 0) & (t < 60)).all()
+
+
+def test_flash_lse_and_backward_consistency():
+    """Round-5 chip proof: the with-lse kernel variant (out AND
+    logsumexp) and its Pallas BACKWARD (incl. the lse cotangent that
+    blockwise ring attention exercises) agree CPU-interpret vs the real
+    Mosaic kernels."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.kernels import flash_attention_lse
+    rng = onp.random.default_rng(51)
+    B, H, T, D = 2, 2, 128, 64
+    q_ = rng.standard_normal((B, H, T, D)).astype(onp.float32)
+    k_ = rng.standard_normal((B, H, T, D)).astype(onp.float32)
+    v_ = rng.standard_normal((B, H, T, D)).astype(onp.float32)
+
+    def run(ctx, causal):
+        with ctx:
+            qj = mx.nd.array(q_)._data
+            kj = mx.nd.array(k_)._data
+            vj = mx.nd.array(v_)._data
+
+            def loss(q, k, v):
+                o, lse = flash_attention_lse(q, k, v, causal=causal)
+                return ((o.astype(jnp.float32) ** 2).sum()
+                        + (1.3 * lse).sum())
+
+            val, grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2))(qj, kj, vj)
+            return float(val), [onp.asarray(g) for g in grads]
+
+    for causal in (False, True):
+        (v0, g0), (v1, g1) = (run(c, causal) for c in _ctx_list())
+        assert abs(v0 - v1) <= 2e-2 * max(1.0, abs(v0)), (causal, v0, v1)
+        for a, b, nm in zip(g0, g1, "qkv"):
+            tu.assert_almost_equal(a, b, rtol=2e-2, atol=2e-3,
+                                   names=(f"cpu d{nm}", f"tpu d{nm}"))
+
+
+def test_np_fft_consistency():
+    """np.fft round-5 namespace: XLA's CPU (Ducc) and TPU FFT
+    implementations must agree on values, not just shapes."""
+    rng = onp.random.default_rng(52)
+    x = rng.standard_normal((4, 64)).astype(onp.float32)
+    outs = {}
+    for ctx in _ctx_list():
+        with ctx:
+            a = mx.np.array(x)
+            outs[str(ctx)] = {
+                "fft": mx.np.fft.fft(a).asnumpy(),
+                "rfft": mx.np.fft.rfft(a).asnumpy(),
+                "irfft": mx.np.fft.irfft(mx.np.fft.rfft(a)).asnumpy(),
+                "fft2": mx.np.fft.fft2(a).asnumpy(),
+            }
+    (k0, o0), (k1, o1) = outs.items()
+    for name in o0:
+        tu.assert_almost_equal(onp.abs(o0[name]), onp.abs(o1[name]),
+                               rtol=2e-3, atol=2e-3,
+                               names=(f"{name}@{k0}", f"{name}@{k1}"))
