@@ -179,7 +179,10 @@ def write_doc(path):
         "",
         "Every implemented name is executed at least once by the "
         "generated sweep in `tests/test_np_sweep.py` (value-compared "
-        "against real NumPy where the name exists there).",
+        "against real NumPy where the name exists there), and a seeded "
+        "fuzz-parity pass re-runs the elementwise/binary/reduction "
+        "buckets under randomized shapes, dtypes (f32/f16/i32/bool), "
+        "and broadcasting pairs.",
         "",
         "**Intentional semantic divergence**: dtype promotion follows "
         "JAX, not NumPy — `promote_types(float32, int32)` is `float32` "
