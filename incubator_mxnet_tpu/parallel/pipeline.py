@@ -263,12 +263,15 @@ class PipelineTrainer(_SPMDTrainer):
         from . import mesh as mesh_mod
         from . import optim as fopt
 
-        if sharding_rules or extra_input_shardings or shard_optimizer_state:
+        if extra_input_shardings or shard_optimizer_state:
             raise MXNetError(
-                "pipeline_axis does not compose with sharding_rules / "
+                "pipeline_axis does not compose with "
                 "extra_input_shardings / shard_optimizer_state yet — "
                 "cell params are already sharded over the pipe axis "
-                "(their optimizer state with them)")
+                "(their optimizer state with them).  sharding_rules DO "
+                "compose: tensor-parallel specs apply on top of the "
+                "stage stacking (3D dp x pipe x model parallelism)")
+        self._rules = list(sharding_rules or [])
         self._net = net
         self._loss = loss_fn
         self._mesh = mesh or mesh_mod.current_mesh()
@@ -277,6 +280,30 @@ class PipelineTrainer(_SPMDTrainer):
         for ax in (data_axis, pipeline_axis):
             if ax not in self._mesh.shape:
                 raise MXNetError(f"mesh has no axis {ax!r}")
+        from jax.sharding import PartitionSpec as _P
+        for _pat, _sp in self._rules:
+            entries = tuple(_sp) if isinstance(_sp, (list, tuple, _P)) \
+                else (_sp,)
+            for entry in entries:
+                for ax in (entry if isinstance(entry, tuple)
+                           else (entry,)):
+                    if ax is None:
+                        continue
+                    if ax not in self._mesh.shape:
+                        raise MXNetError(
+                            f"sharding_rules: axis {ax!r} (rule {_pat!r})"
+                            f" not in the mesh {tuple(self._mesh.shape)} "
+                            "— a 3D pipeline needs the tensor axis in "
+                            "the mesh, e.g. make_mesh({'data': d, "
+                            "'pipe': s, 'model': t})")
+                    if ax in (data_axis, pipeline_axis):
+                        raise MXNetError(
+                            f"sharding_rules: axis {ax!r} (rule {_pat!r})"
+                            " is a schedule-owned (manual) axis — the "
+                            "pipeline already shards stages over "
+                            f"{pipeline_axis!r} and the batch over "
+                            f"{data_axis!r}; tensor rules may only use "
+                            "other mesh axes (e.g. 'model')")
         self._data_axis = data_axis
         self._pipe_axis = pipeline_axis
         self._S = S = self._mesh.shape[pipeline_axis]
@@ -321,18 +348,37 @@ class PipelineTrainer(_SPMDTrainer):
                     "initialize the net and run one forward before "
                     "building a PipelineTrainer")
 
-        repl = NamedSharding(self._mesh, P())
+        # one matcher for the whole trainer: shard_params gives
+        # first-match resolution AND the dead-rule warning the tp_rules
+        # docstrings promise (a rule matching nothing silently
+        # replicates the weights it meant to shard).  EVERY trainable
+        # name participates so per-stage exact-name rules count as live.
+        from .spmd import shard_params as _shard_params
+        all_named = {p.name: p.data()._data
+                     for p in (list(self._first_params)
+                               + list(self._last_params)
+                               + [q for ps in self._cell_trainables
+                                  for q in ps])}
+        rule_sh = _shard_params(all_named, self._mesh, self._rules)
 
-        def pipe_sh(v):
-            return NamedSharding(
-                self._mesh, P(pipeline_axis, *([None] * (v.ndim - 1))))
+        def _tp_spec(name, ndim):
+            """The matched rule's spec, None-padded to ndim (all-None =
+            replicated on the tensor axes)."""
+            entries = list(rule_sh[name].spec)
+            entries += [None] * (ndim - len(entries))
+            return tuple(entries)
+
+        def pipe_sh(tp_spec):
+            # stage axis first, then the cell param's own TP spec —
+            # 3D parallelism is just this composition of PartitionSpecs
+            return NamedSharding(self._mesh, P(pipeline_axis, *tp_spec))
 
         # placed COPIES (same donation-safety reasoning as SPMDTrainer)
         self._first_vals = tuple(
-            jnp.copy(jax.device_put(p.data()._data, repl))
+            jnp.copy(jax.device_put(p.data()._data, rule_sh[p.name]))
             for p in self._first_params)
         self._last_vals = tuple(
-            jnp.copy(jax.device_put(p.data()._data, repl))
+            jnp.copy(jax.device_put(p.data()._data, rule_sh[p.name]))
             for p in self._last_params)
         stacked = {}
         for j in range(L):
@@ -340,8 +386,14 @@ class PipelineTrainer(_SPMDTrainer):
                 vals = [self._cell_trainables[s * L + j][i].data()._data
                         for s in range(S)]
                 v = jnp.stack(vals)
+                # the TP spec comes from the TEMPLATE cell's param name;
+                # same-architecture stages shard identically (rules from
+                # tp_rules(block=net) carry exact per-cell names — the
+                # template's is the canonical one for its position)
+                tp = _tp_spec(self._cell_trainables[j][i].name,
+                              v.ndim - 1)
                 stacked[f"c{j}_p{i}"] = jnp.copy(
-                    jax.device_put(v, pipe_sh(v)))
+                    jax.device_put(v, pipe_sh(tp)))
         self._stacked = stacked
         self._opt_state = self._opt.init(
             (self._first_vals, self._stacked, self._last_vals))
@@ -553,7 +605,12 @@ class PipelineTrainer(_SPMDTrainer):
                 in_specs=(fv_specs, sv_specs, lv_specs,
                           batch_spec(ids), batch_spec(labels)),
                 out_specs=(P(), fv_specs, sv_specs, lv_specs),
-                check_vma=False)
+                check_vma=False,
+                # data/pipe are MANUAL (the schedule psums over them);
+                # every other mesh axis (e.g. a tensor-parallel 'model')
+                # stays AUTO — GSPMD shards the stage matmuls over it
+                # from the parameter shardings alone (3D parallelism)
+                axis_names=frozenset({data, pipe}))
             loss, g_fv, g_sv, g_lv = sharded(fv, sv, lv, ids, labels)
             (nf, ns, nl), nstate = opt.update(
                 (fv, sv, lv), (g_fv, g_sv, g_lv), opt_state, step)
@@ -627,7 +684,9 @@ class PipelineTrainer(_SPMDTrainer):
                 body, mesh=mesh,
                 in_specs=(fv_specs, sv_specs, lv_specs,
                           batch_spec(ids), batch_spec(labels)),
-                out_specs=P(), check_vma=False)
+                out_specs=P(), check_vma=False,
+                # see _build_step_1f1b: non-data/pipe axes stay auto
+                axis_names=frozenset({data, pipe}))
 
             def loss_of(tr):
                 f, s, l = tr

@@ -672,4 +672,18 @@ def test_np_fuzz_parity(seed):
             got = getattr(np, name)(np.array(ai), np.array(bi))
             _cmp(_to_host(got), want, f"{name}{shape}int32")
             n_cases += 1
+        ab = _fuzz_array(rng, onp.bool_, shape)
+        bb = _fuzz_array(rng, onp.bool_, partner)
+        for name in ("logical_and", "logical_or", "logical_xor",
+                     "maximum"):
+            try:
+                want = getattr(onp, name)(ab, bb)
+            except ValueError:
+                continue
+            got = getattr(np, name)(np.array(ab), np.array(bb))
+            _cmp(_to_host(got), want, f"{name}{shape}bool")
+            n_cases += 1
+        got = np.logical_not(np.array(ab))
+        _cmp(_to_host(got), onp.logical_not(ab), f"logical_not{shape}")
+        n_cases += 1
     assert n_cases >= 30       # the slice genuinely exercised cases

@@ -230,7 +230,9 @@ def test_pipeline_trainer_validation():
     with pytest.raises(mx.MXNetError, match="lamb"):
         parallel.SPMDTrainer(net, loss_blk, "lamb", mesh=mesh,
                              pipeline_axis="pipe")
-    with pytest.raises(mx.MXNetError, match="sharding_rules"):
+    # TP rules now COMPOSE with the pipeline (3D) — but the tensor
+    # axis must exist in the mesh; a descriptive error otherwise
+    with pytest.raises(mx.MXNetError, match="not in the mesh"):
         parallel.SPMDTrainer(net, loss_blk, "adam", mesh=mesh,
                              pipeline_axis="pipe",
                              sharding_rules=gpt.tp_rules("model"))
@@ -376,3 +378,68 @@ def test_pipeline_schedule_validation():
         parallel.SPMDTrainer(net, bert.MLMPretrainLoss(64), "adam", {},
                              mesh=mesh, pipeline_axis="pipe",
                              pipeline_schedule="zigzag")
+
+
+def test_pipeline_3d_dp_pipe_tensor_matches_1dev():
+    """3D parallelism: dp2 x pipe2 x model2 — cells stacked over pipe,
+    their matmuls ALSO tensor-sharded over 'model' via tp_rules
+    (GSPMD auto axes inside the pipe-explicit schedule), batch over
+    data.  Two Adam steps must match the 1-device oracle, and the
+    stacked leaves must genuinely carry both axes."""
+    import jax
+    from incubator_mxnet_tpu.models import bert, gpt
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    net, ids, labels = _gpt_and_batch(seed=77)
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2, "model": 2},
+                              devices=jax.devices()[:8])
+    rules = gpt.tp_rules("model", block=net)
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh,
+                              pipeline_axis="pipe",
+                              pipeline_microbatches=2,
+                              sharding_rules=rules)
+    # at least one stacked leaf carries BOTH pipe and a model axis
+    specs = [tuple(v.sharding.spec) for v in tr._stacked.values()]
+    assert any(s[0] == "pipe" and "model" in s for s in specs), specs
+    l1 = float(tr.step(ids, labels))
+    l2 = float(tr.step(ids, labels))
+    assert l2 < l1
+
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(net, loss_blk, "adam",
+                               {"learning_rate": 1e-3}, mesh=mesh1)
+    o1 = float(tr1.step(ids, labels))
+    o2 = float(tr1.step(ids, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+    assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
+
+
+def test_pipeline_3d_1f1b_matches_1dev():
+    """The 1F1B schedule under the same 3D mesh (its hand-written
+    backward must coexist with GSPMD's auto tensor axis)."""
+    import jax
+    from incubator_mxnet_tpu.models import bert, gpt
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    net, ids, labels = _gpt_and_batch(seed=78)
+    loss_blk = bert.MLMPretrainLoss(64)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2, "model": 2},
+                              devices=jax.devices()[:8])
+    tr = parallel.SPMDTrainer(net, loss_blk, "adam",
+                              {"learning_rate": 1e-3}, mesh=mesh,
+                              pipeline_axis="pipe",
+                              pipeline_microbatches=4,
+                              pipeline_schedule="1f1b",
+                              sharding_rules=gpt.tp_rules("model",
+                                                          block=net))
+    l1 = float(tr.step(ids, labels))
+    l2 = float(tr.step(ids, labels))
+    mesh1 = parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr1 = parallel.SPMDTrainer(net, loss_blk, "adam",
+                               {"learning_rate": 1e-3}, mesh=mesh1)
+    o1 = float(tr1.step(ids, labels))
+    o2 = float(tr1.step(ids, labels))
+    assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
+    assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
