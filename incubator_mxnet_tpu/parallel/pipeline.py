@@ -322,6 +322,17 @@ class PipelineTrainer(_SPMDTrainer):
         (self._first_params, self._first_fn, cells,
          self._last_params, self._last_fn) = net.pipeline_split()
         _refuse_impure(net, "PipelineTrainer")
+        sp_axes = set()
+        net.apply(lambda b: sp_axes.add(getattr(b, "_seq_axis", None)))
+        if sp_axes - {None}:
+            raise MXNetError(
+                "pipeline does not compose with sequence parallelism "
+                f"(net carries seq_axis={sorted(sp_axes - {None})}): "
+                "ring/ulysses build their own shard_map inside the "
+                "stage body — nested manual collectives; build the net "
+                "without seq_axis and use tensor parallelism "
+                "(sharding_rules=tp_rules(block=net)) for the "
+                "attention instead")
         if len(cells) % S:
             raise MXNetError(
                 f"{len(cells)} cells do not split over pipe axis {S}")

@@ -443,3 +443,22 @@ def test_pipeline_3d_1f1b_matches_1dev():
     o2 = float(tr1.step(ids, labels))
     assert abs(l1 - o1) <= 1e-4 * max(1.0, abs(o1)), (l1, o1)
     assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
+
+
+def test_pipeline_refuses_sequence_parallel_net():
+    """pp x sp: ring/ulysses build their own shard_map inside the stage
+    body — the trainer must refuse descriptively, naming tp as the
+    alternative (docs/parallelism.md composition matrix)."""
+    import jax
+    from incubator_mxnet_tpu.models import bert, gpt
+    mx.random.seed(3)
+    mesh = parallel.make_mesh({"data": 2, "pipe": 2, "seq": 2},
+                              devices=jax.devices()[:8])
+    net = gpt.gpt_tiny(vocab_size=64, dropout=0.0, num_layers=2,
+                       seq_axis="seq", mesh=mesh)
+    net.initialize(init=mx.init.Normal(0.05))
+    with pytest.raises(mx.base.MXNetError,
+                       match="sequence parallelism"):
+        parallel.SPMDTrainer(net, bert.MLMPretrainLoss(64), "adam", {},
+                             mesh=mesh, pipeline_axis="pipe",
+                             pipeline_microbatches=2)
