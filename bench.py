@@ -663,12 +663,18 @@ def _main(preset_fusion):
         # exists: a dead-relay CPU smoke does not erase the mid-round
         # hardware measurement
         import glob
-        chip_recs = sorted(glob.glob(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_r*_midround.json")))
-        for rec_path in reversed(chip_recs):
+        # newest first by mtime — lexicographic filename order breaks
+        # when the round number outgrows its zero padding (r100 < r99)
+        chip_recs = sorted(
+            glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_r*_midround.json")),
+            key=lambda p: os.path.getmtime(p), reverse=True)
+        for rec_path in chip_recs:
             try:
-                rec_r = json.load(open(rec_path)).get("record", {})
+                loaded = json.load(open(rec_path))
+                rec_r = loaded.get("record", {}) \
+                    if isinstance(loaded, dict) else {}
             except (OSError, ValueError):
                 continue
             if str(rec_r.get("device", "")).startswith(("tpu", "axon")):

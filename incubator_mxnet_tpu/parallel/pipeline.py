@@ -256,13 +256,19 @@ class PipelineTrainer(_SPMDTrainer):
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
                  shard_optimizer_state=False, pipeline_axis="pipe",
-                 pipeline_microbatches=None, pipeline_schedule=None):
+                 pipeline_microbatches=None, pipeline_schedule=None,
+                 accum_steps=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
         from . import mesh as mesh_mod
         from . import optim as fopt
 
+        if accum_steps not in (None, 1):
+            raise MXNetError(
+                "accum_steps does not apply to the pipeline trainer — "
+                "pipeline_microbatches already streams the batch in "
+                "microbatches (raise it for the same memory effect)")
         if extra_input_shardings or shard_optimizer_state:
             raise MXNetError(
                 "pipeline_axis does not compose with "

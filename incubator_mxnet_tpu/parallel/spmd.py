@@ -179,7 +179,8 @@ class SPMDTrainer:
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
                  shard_optimizer_state=False, pipeline_axis=None,
-                 pipeline_microbatches=None, pipeline_schedule=None):
+                 pipeline_microbatches=None, pipeline_schedule=None,
+                 accum_steps=None):
         import jax
         if pipeline_axis is not None:
             # only reachable from a subclass that didn't override
@@ -252,6 +253,9 @@ class SPMDTrainer:
             self._opt_state = jax.tree.map(
                 lambda v, s: jax.device_put(v, s),
                 self._opt_state, self._opt_state_shardings)
+        self._accum = 1 if accum_steps is None else int(accum_steps)
+        if self._accum < 1:
+            raise MXNetError(f"accum_steps={accum_steps} must be >= 1")
         self._step_count = 0
         self._jit_cache = {}
 
@@ -301,25 +305,60 @@ class SPMDTrainer:
         net, loss_blk, opt = self._net, self._loss, self._opt
         trainable, aux = self._trainable, self._aux
 
+        k = self._accum
+
+        def loss_of(tr, aux_cur, rng_i, xs, label):
+            nds = [NDArray(b) for b in xs]
+            out_vals, new_aux = functional_call(
+                net, trainable, tr, aux, aux_cur, nds, True, rng_i)
+            # multi-output nets (e.g. MLM+NSP heads) pass every output
+            # to the loss block: loss(out0, out1, ..., label)
+            out_nds = [NDArray(v) for v in out_vals]
+            with_label = NDArray(label)
+            from .. import autograd as _ag
+            with _ag.pause(train_mode=True):
+                loss_nd = loss_blk(*out_nds, with_label)
+            loss = jnp.mean(loss_nd._data)
+            return loss, tuple(new_aux)
+
         def pure_step(tr_vals, aux_vals, opt_state, step, rng, *batch):
             *xs, label = batch
+            if k == 1:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(tr_vals, aux_vals, rng, xs,
+                                           label)
+            else:
+                # gradient accumulation: grads computed and consumed
+                # PER microbatch inside the scan body, so activation
+                # memory is one microbatch's, not the whole batch's —
+                # the point of accumulation.  Microbatches interleave
+                # (reshape + leading-axis swap) so each one spans every
+                # data shard evenly.
+                def mb_split(a):
+                    rest = a.shape[1:]
+                    return a.reshape(a.shape[0] // k, k, *rest).swapaxes(
+                        0, 1)
 
-            def loss_of(tr):
-                nds = [NDArray(b) for b in xs]
-                out_vals, new_aux = functional_call(
-                    net, trainable, tr, aux, aux_vals, nds, True, rng)
-                # multi-output nets (e.g. MLM+NSP heads) pass every output
-                # to the loss block: loss(out0, out1, ..., label)
-                out_nds = [NDArray(v) for v in out_vals]
-                with_label = NDArray(label)
-                from .. import autograd as _ag
-                with _ag.pause(train_mode=True):
-                    loss_nd = loss_blk(*out_nds, with_label)
-                loss = jnp.mean(loss_nd._data)
-                return loss, tuple(new_aux)
+                xs_mb = [mb_split(x) for x in xs]
+                label_mb = mb_split(label)
+                g0 = jax.tree.map(jnp.zeros_like, tr_vals)
 
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(tr_vals)
+                def micro(carry, mb):
+                    g_acc, aux_cur, loss_acc, rng_cur = carry
+                    *mb_xs, mb_label = mb
+                    rng_i, rng_next = jax.random.split(rng_cur)
+                    (l, new_aux), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(tr_vals, aux_cur, rng_i,
+                                               mb_xs, mb_label)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, new_aux, loss_acc + l, rng_next), None
+
+                (g_sum, new_aux, loss_sum, _), _ = jax.lax.scan(
+                    micro, (g0, aux_vals, jnp.zeros((), jnp.float32),
+                            rng),
+                    tuple(xs_mb) + (label_mb,))
+                grads = jax.tree.map(lambda g: g / k, g_sum)
+                loss = loss_sum / k
             new_tr, new_opt = opt.update(tr_vals, grads, opt_state, step)
             return loss, new_tr, new_aux, new_opt
 
@@ -351,6 +390,14 @@ class SPMDTrainer:
         from .. import random as _random
         import jax.numpy as jnp
         sharded = tuple(self._shard_batch(b) for b in batch)
+        if self._accum > 1:
+            B = sharded[0].shape[0]
+            dp = self._mesh.shape[self._data_axis]
+            if B % (self._accum * dp):
+                raise MXNetError(
+                    f"global batch {B} must divide by accum_steps "
+                    f"{self._accum} x data axis {dp} for even "
+                    "microbatch sharding")
         key = self._build_key(sharded)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_step()
