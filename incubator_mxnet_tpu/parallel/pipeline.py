@@ -1,10 +1,13 @@
-"""Pipeline parallelism: a GPipe schedule as ONE SPMD program
+"""Pipeline parallelism as ONE SPMD program — GPipe and 1F1B schedules,
+composing with tensor parallelism into 3D (data x pipe x model)
 (reference analog: the reference had no pipeline engine — its
 distributed story was data parallelism over kvstore; this is the
-beyond-parity axis completing dp/tp/sp/ep/pp.  Pattern: the
+beyond-parity axis completing dp/tp/sp/ep/pp/fsdp.  Pattern: the
 pipelined-scan recipe of the TPU scaling playbook — stack homogeneous
 stage parameters, shard the stack over a mesh axis, stream microbatches
-around the ring with ppermute inside lax.scan).
+around the ring with ppermute inside lax.scan; 1F1B writes the backward
+out explicitly for O(S) activation memory; tensor axes ride GSPMD auto
+mode inside the pipe-explicit schedule).
 
 Design:
   * stage parameters are STACKED pytrees — every leaf (S, ...) — and
