@@ -8,7 +8,7 @@ cd "$(dirname "$0")/.."
 usage() {
     cat <<EOF
 usage: ci/run_tests.sh <function>
-  unittest_cpu          full CPU suite (single run; ~12 min on 1 core)
+  unittest_cpu          full CPU suite (single run; ~30 min on 1 core)
   unittest_cpu_chunked  CPU suite in two halves (for constrained runners)
   unittest_tpu          TPU tier (tests_tpu/: op sweep on the live chip
                         + CPU-vs-TPU consistency; self-skips without one)
