@@ -64,3 +64,14 @@ def test_example_pipeline_short():
     losses = [float(l.rsplit(" ", 1)[1]) for l in out.splitlines()
               if l.startswith("step ")]
     assert losses[-1] < losses[0]
+
+
+def test_example_long_context_short():
+    out = _run("example/distributed/train_long_context.py",
+               "--dp", "2", "--sp", "4", "--seq-len", "64",
+               "--layers", "2", "--steps", "5", "--batch-size", "8",
+               "--fixed-batch", timeout=600)
+    assert "sp_impl=ring" in out and "done: final loss" in out
+    losses = [float(l.rsplit(" ", 1)[1]) for l in out.splitlines()
+              if l.startswith("step ")]
+    assert losses[-1] < losses[0]
