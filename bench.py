@@ -54,6 +54,22 @@ def _peak_flops(kind):
     return best[1] if best else 197e12  # unknown TPU kind: v5e-class
 
 
+def _telemetry_snapshot():
+    """Telemetry snapshot when MXNET_TELEMETRY is on, else None.  Env is
+    checked first so the accel parent path never imports the framework
+    (and with it a jax client) just to discover telemetry is off."""
+    if not any(os.environ.get(k) not in (None, "", "0")
+               for k in ("MXNET_TELEMETRY", "MXTPU_TELEMETRY")):
+        return None
+    try:
+        from incubator_mxnet_tpu import telemetry
+        if telemetry.enabled():
+            return telemetry.snapshot()
+    except Exception:
+        pass
+    return None
+
+
 def _probe_backend(timeout=90):
     """Probe the default (axon TPU tunnel) backend in a SUBPROCESS so a
     hung PJRT init cannot take the bench down with it (round-1 failure
@@ -548,6 +564,9 @@ def _sub_main(name):
         rec = _bench_int8_conv(on_accel, kind, dev)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
+    tel = _telemetry_snapshot()
+    if tel is not None:
+        rec["telemetry"] = tel
     print(json.dumps(rec))
 
 
@@ -689,6 +708,9 @@ def _main(preset_fusion):
         out["phase2_seq512"] = phase2
     if fusion is not None:
         out["fusion_on"] = fusion
+    tel = _telemetry_snapshot()
+    if tel is not None:
+        out["telemetry"] = tel
     if preset_fusion is not None:
         out["note"] = (f"pre-set flags ignored ({preset_fusion}): the "
                        "anchor measures the default config; fusion_on "

@@ -13,7 +13,10 @@ usage: ci/run_tests.sh <function>
   unittest_tpu          TPU tier (tests_tpu/: op sweep on the live chip
                         + CPU-vs-TPU consistency; self-skips without one)
   smoke                 60-second end-to-end slice (gluon MNIST)
-  bench                 judged benchmark (prints one JSON line)
+  telemetry_smoke       MNIST slice under MXNET_TELEMETRY=1; asserts the
+                        Prometheus dump has nonzero op/step/compile counters
+  bench                 judged benchmark (prints one JSON line; includes a
+                        telemetry snapshot when MXNET_TELEMETRY=1)
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -36,6 +39,36 @@ unittest_tpu() {
 
 smoke() {
     python example/gluon/mnist.py --cpu --epochs 1
+}
+
+telemetry_smoke() {
+    local dump=/tmp/mxtpu_telemetry_smoke.prom
+    rm -f "$dump"
+    MXNET_TELEMETRY=1 MXNET_TELEMETRY_DUMP="$dump" \
+        python example/gluon/mnist.py --cpu --epochs 1 --hybridize
+    python - "$dump" <<'EOF'
+import sys
+
+vals = {}
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line or line.startswith("#"):
+        continue
+    name, _, val = line.rpartition(" ")
+    base = name.split("{")[0]
+    try:
+        vals[base] = vals.get(base, 0.0) + float(val)
+    except ValueError:
+        pass
+
+for metric in ("mx_op_dispatch_total", "mx_trainer_steps_total",
+               "mx_compile_total", "mx_trainer_step_seconds_count"):
+    assert vals.get(metric, 0) > 0, \
+        f"telemetry_smoke: {metric} is zero/absent; got {sorted(vals)}"
+print("telemetry_smoke ok:",
+      {k: vals[k] for k in ("mx_op_dispatch_total",
+                            "mx_trainer_steps_total", "mx_compile_total")})
+EOF
 }
 
 bench() {

@@ -58,6 +58,7 @@ from . import module as mod
 from . import numpy as np
 from . import numpy_extension as npx
 from . import engine
+from . import telemetry
 from . import profiler
 from . import test_utils
 from . import library
@@ -72,4 +73,4 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
            "parallel", "symbol", "sym", "Executor", "io", "recordio",
            "image", "metric", "callback", "model", "module", "mod", "np",
-           "npx", "engine", "profiler", "runtime", "contrib"]
+           "npx", "engine", "telemetry", "profiler", "runtime", "contrib"]

@@ -34,3 +34,58 @@ def im2rec_main():
 def launch_main():
     """Spawn a multi-process training job (tools/launch.py)."""
     sys.exit(_load_tool("launch").main())
+
+
+def stats_main():
+    """``mxtpu-stats`` — run a script under runtime telemetry and print
+    the metrics afterwards::
+
+        mxtpu-stats [--format prometheus|json] [--out PATH] script.py [args...]
+
+    The script runs in-process (as ``__main__``) with the telemetry
+    collector started, so every layer (op dispatch, compile cache,
+    kvstore, trainer, dataloader) is observed without touching the
+    script.  Metrics go to --out (or stdout) when the script finishes —
+    including when it raises."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mxtpu-stats",
+        description="run a python script with MXNET_TELEMETRY collection "
+                    "and print the metrics dump")
+    ap.add_argument("--format", choices=("prometheus", "json"),
+                    default="prometheus")
+    ap.add_argument("--out", default=None,
+                    help="write the dump here instead of stdout")
+    ap.add_argument("script", help="python script to run")
+    ap.add_argument("args", nargs=argparse.REMAINDER,
+                    help="arguments passed to the script")
+    ns = ap.parse_args()
+
+    from . import telemetry
+    telemetry.start()
+
+    import runpy
+    sys.argv = [ns.script] + ns.args
+    status = 0
+    try:
+        runpy.run_path(ns.script, run_name="__main__")
+    except SystemExit as e:
+        status = e.code if isinstance(e.code, int) else (0 if e.code is None
+                                                         else 1)
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        status = 1
+
+    if ns.format == "prometheus":
+        text = telemetry.render_prometheus()
+    else:
+        import json
+        text = json.dumps(telemetry.snapshot(), indent=2, default=str) + "\n"
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    sys.exit(status)

@@ -19,6 +19,7 @@ import numpy as _np
 from .base import MXNetError
 from .context import Context, current_context
 from . import ndarray as nd
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 
 
@@ -173,7 +174,8 @@ class Executor:
     def _fwd(self, is_train: bool):
         if is_train not in self._fwd_cache:
             import jax
-            self._fwd_cache[is_train] = jax.jit(self._pure_fn(is_train))
+            self._fwd_cache[is_train] = _telemetry.instrument_jit(
+                "executor", jax.jit(self._pure_fn(is_train)))
         return self._fwd_cache[is_train]
 
     def _bwd(self):
@@ -193,7 +195,8 @@ class Executor:
                 diff_vals = [arg_vals[k] for k in diff_idx]
                 _, vjp_fn = jax.vjp(f, *diff_vals)
                 return vjp_fn(tuple(cotangents))
-            self._bwd_cache = (jax.jit(bwd), diff_idx)
+            self._bwd_cache = (_telemetry.instrument_jit(
+                "executor", jax.jit(bwd)), diff_idx)
         return self._bwd_cache
 
     # ------------------------------------------------------------------

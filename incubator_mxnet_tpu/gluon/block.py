@@ -30,6 +30,7 @@ from ..base import MXNetError
 from ..context import Context, current_context
 from .. import autograd as _ag
 from .. import random as _random
+from .. import telemetry as _telemetry
 from ..ndarray import ndarray as _ndmod
 from ..ndarray.ndarray import NDArray, _invoke
 from .parameter import (Parameter, ParameterDict,
@@ -369,7 +370,8 @@ class _CachedGraph:
                     training, rng_key)
                 return tuple(out_vals), tuple(new_aux)
 
-            self._cache[key] = jax.jit(pure)
+            self._cache[key] = _telemetry.instrument_jit(
+                "cached_op", jax.jit(pure))
         jitted = self._cache[key]
 
         aux_vals = tuple(p.data()._data for p in aux)

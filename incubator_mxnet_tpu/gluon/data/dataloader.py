@@ -24,11 +24,13 @@ if a platform makes fork unsafe.
 from __future__ import annotations
 
 import multiprocessing
+import time as _time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
 
 from ...base import MXNetError
+from ... import telemetry as _telemetry
 from ...ndarray import ndarray as _ndmod
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
@@ -137,6 +139,23 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        it = self._iter_impl()
+        if not _telemetry.DATALOADER.subscribers:
+            yield from it
+            return
+        # fetch-wait plane: time the consumer spends blocked obtaining the
+        # next batch (worker stalls surface here, compute does not)
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _telemetry.DATALOADER.publish(
+                seconds=_time.perf_counter() - t0)
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

@@ -9,10 +9,12 @@ update_on_kvstore contract.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Optional
 
 from ..base import MXNetError
 from .. import optimizer as opt_mod
+from .. import telemetry as _telemetry
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -110,12 +112,19 @@ class Trainer:
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """One optimization step; grads are rescaled by 1/batch_size
-        (reference: Trainer.step)."""
+        (reference: Trainer.step).  Timing is dispatch time: the update
+        itself is async, so blocking waits show up in the op/sync planes,
+        not here."""
+        observe = bool(_telemetry.TRAINER.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if observe:
+            _telemetry.TRAINER.publish(
+                phase="step", seconds=_time.perf_counter() - t0)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -143,10 +152,15 @@ class Trainer:
                     self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
+        observe = bool(_telemetry.TRAINER.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
+        if observe:
+            _telemetry.TRAINER.publish(
+                phase="update", seconds=_time.perf_counter() - t0)
 
     def _update(self, ignore_stale_grad=False):
         if self._kvstore is not None and self._update_on_kvstore:

@@ -25,13 +25,27 @@ cross-process sum.
 from __future__ import annotations
 
 import pickle
+import time as _time
 from typing import Dict, List, Optional
 
 from .base import MXNetError
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 from .ndarray import ndarray as _ndmod
 
 __all__ = ["KVStore", "create"]
+
+
+def _nd_nbytes(v) -> int:
+    """Logical payload size of an NDArray-ish value (shape x itemsize)."""
+    try:
+        import numpy as _np
+        n = 1
+        for d in v.shape:
+            n *= int(d)
+        return n * _np.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
 
 _mesh_sum_cache: Dict = {}   # device-id tuple -> jitted replicated sum
 
@@ -171,9 +185,11 @@ class KVStore:
         fn = _mesh_sum_cache.get(key)
         if fn is None:
             import jax.numpy as jnp
-            fn = jax.jit(lambda x: jnp.sum(x, axis=0),
-                         out_shardings=NamedSharding(flat_mesh,
-                                                     PartitionSpec()))
+            fn = _telemetry.instrument_jit(
+                "kvstore",
+                jax.jit(lambda x: jnp.sum(x, axis=0),
+                        out_shardings=NamedSharding(flat_mesh,
+                                                    PartitionSpec())))
             _mesh_sum_cache[key] = fn
         return NDArray(fn(stacked), ctx=vlist[0].ctx)
 
@@ -212,11 +228,16 @@ class KVStore:
         """Push value(s); multiple values per key are summed; dist types
         also sum across processes.  With an updater set, the update is
         applied here — the 'update_on_kvstore' path."""
+        observe = bool(_telemetry.KVSTORE.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
+        nbytes = 0
         _, keys, values = self._norm_keys(key, value)
         for k, v in zip(keys, values):
             agg = self._aggregate(v)
             if k not in self._store:
                 raise MXNetError(f"key {k!r} was not init()-ed")
+            if observe:
+                nbytes += _nd_nbytes(agg)
             if self._is_dist():
                 if self._compression_params and \
                         self._compression_params.get("type") == "2bit":
@@ -226,14 +247,23 @@ class KVStore:
                 self._updater(_key_int(k), agg, self._store[k])
             else:
                 self._store[k] = agg.copy()
+        if observe:
+            _telemetry.KVSTORE.publish(
+                op="push", nbytes=nbytes,
+                seconds=_time.perf_counter() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        observe = bool(_telemetry.KVSTORE.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
+        nbytes = 0
         _, keys, outs = self._norm_keys(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} was not init()-ed")
             src = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
+            if observe:
+                nbytes += _nd_nbytes(src) * len(targets)
             from .ndarray import sparse as _sp
             for t in targets:
                 if isinstance(t, _sp.BaseSparseNDArray):
@@ -243,12 +273,24 @@ class KVStore:
                     src.tostype("default").copyto(t)
                 else:
                     src.copyto(t)
+        if observe:
+            _telemetry.KVSTORE.publish(
+                op="pull", nbytes=nbytes,
+                seconds=_time.perf_counter() - t0)
 
     def pushpull(self, key, value, out=None, priority=0):
-        """Fused push+pull (reference: KVStorePushPullEx)."""
+        """Fused push+pull (reference: KVStorePushPullEx).  The nested
+        push/pull publish their own byte counts; this event adds the
+        fused-call count and end-to-end latency."""
+        observe = bool(_telemetry.KVSTORE.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
         self.push(key, value, priority)
         if out is not None:
             self.pull(key, out, priority)
+        if observe:
+            _telemetry.KVSTORE.publish(
+                op="pushpull", nbytes=0,
+                seconds=_time.perf_counter() - t0)
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)

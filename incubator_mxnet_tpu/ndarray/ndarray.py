@@ -31,6 +31,14 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..context import Context, current_context
+from .. import telemetry as _telemetry
+
+# runtime event topics (multi-subscriber; see telemetry.py).  Bound once at
+# import so the hot path pays one attribute load per check.
+_OP_DISPATCH = _telemetry.OP_DISPATCH
+_OP_TIMED = _telemetry.OP_TIMED
+_SYNC = _telemetry.SYNC
+_TRANSFER = _telemetry.TRANSFER
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
            "eye", "linspace", "from_jax", "concatenate", "waitall"]
@@ -134,7 +142,12 @@ class NDArray:
     # ------------------------------------------------------------------
     def asnumpy(self) -> _np.ndarray:
         """Block and copy to host (reference: NDArray::SyncCopyToCPU)."""
-        return _np.asarray(self._data)
+        if _SYNC.subscribers:
+            _SYNC.publish("asnumpy")
+        out = _np.asarray(self._data)
+        if _TRANSFER.subscribers:
+            _TRANSFER.publish("d2h", out.nbytes)
+        return out
 
     def asscalar(self):
         if self.size != 1:
@@ -147,6 +160,8 @@ class NDArray:
     def wait_to_read(self):
         """Block until the async computation producing this array finishes
         (reference: NDArray::WaitToRead via engine WaitForVar)."""
+        if _SYNC.subscribers:
+            _SYNC.publish("wait_to_read")
         self._data.block_until_ready()
         return self
 
@@ -562,17 +577,42 @@ def _expand_reshape(old: Sequence[int], new: Sequence[int]):
 # Dispatch instrumentation (reference analogs: profiler hooks bracket
 # ThreadedEngine::ExecuteOprBlock, src/profiler/profiler.h; and
 # MXNET_ENGINE_TYPE=NaiveEngine forces synchronous execution as the
-# debugging oracle, src/engine/naive_engine.cc).  When either is active
-# every op blocks until computed so measured time = true op time.
-_op_observer = None       # set by mx.profiler: callback(op_name, seconds)
+# debugging oracle, src/engine/naive_engine.cc).  Observation is
+# multi-subscriber via the telemetry event bus: OP_TIMED subscribers
+# (the profiler) force every op to block until computed so measured time
+# = true op time; OP_DISPATCH subscribers (the telemetry collector) get a
+# cheap count-only event that never forces a sync.  The legacy
+# single-slot ``_op_observer`` is still honored for third-party code.
+_op_observer = None       # legacy single slot: callback(op_name, seconds)
 _sync_dispatch = False    # set by mx.engine for NaiveEngine parity
+_TRACER = None            # jax.core.Tracer, bound on first instrumented op
+
+
+def _tracer_cls():
+    global _TRACER
+    if _TRACER is None:
+        import jax
+        _TRACER = jax.core.Tracer
+    return _TRACER
 
 
 def _invoke(fun: Callable, inputs: Sequence[NDArray], *,
             name: str = "op", differentiable: bool = True):
-    if _op_observer is None and not _sync_dispatch:
-        return _invoke_async(fun, inputs, name=name,
-                             differentiable=differentiable)
+    # the timed path below costs a per-op device sync — enter it only for
+    # subscribers that asked to force it (the profiler), not for passive
+    # listeners like the telemetry collector
+    if _op_observer is None and not _sync_dispatch \
+            and not _OP_TIMED.forcing:
+        out = _invoke_async(fun, inputs, name=name,
+                            differentiable=differentiable)
+        if _OP_DISPATCH.subscribers:
+            first = out[0] if type(out) is list else out
+            # traced ops run once at compile time, not per step — counting
+            # them would skew dispatch rates (all outputs of one op are
+            # tracers or none are, so checking the first suffices)
+            if not isinstance(first._data, _TRACER or _tracer_cls()):
+                _OP_DISPATCH.publish(name)
+        return out
     import time as _time
     t0 = _time.perf_counter()
     out = _invoke_async(fun, inputs, name=name,
@@ -581,13 +621,19 @@ def _invoke(fun: Callable, inputs: Sequence[NDArray], *,
     # inside a jit trace the outputs are Tracers: blocking is impossible
     # and per-op timing meaningless — the compiled program is profiled as
     # one unit (XLA trace), so skip instrumentation there
-    import jax
-    if any(isinstance(o._data, jax.core.Tracer) for o in outs):
+    if any(isinstance(o._data, _TRACER or _tracer_cls()) for o in outs):
         return out
     for o in outs:
-        o.wait_to_read()
+        # block directly: routing through wait_to_read would count every
+        # profiler-forced sync as a user sync in the SYNC stream
+        o._data.block_until_ready()
+    seconds = _time.perf_counter() - t0
     if _op_observer is not None:
-        _op_observer(name, _time.perf_counter() - t0)
+        _op_observer(name, seconds)
+    if _OP_TIMED.subscribers:
+        _OP_TIMED.publish(name, seconds)
+    if _OP_DISPATCH.subscribers:
+        _OP_DISPATCH.publish(name)
     return out
 
 
@@ -674,7 +720,10 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
         if dtype is None:
             dtype = (_np.float32 if src.dtype.kind in "fiu"
                      else src.dtype)
-    return _place(jnp.asarray(src, dtype=dtype), ctx)
+    out = _place(jnp.asarray(src, dtype=dtype), ctx)
+    if _TRANSFER.subscribers and not isinstance(source, NDArray):
+        _TRANSFER.publish("h2d", out._data.nbytes)
+    return out
 
 
 def from_jax(jarr, ctx: Optional[Context] = None) -> NDArray:

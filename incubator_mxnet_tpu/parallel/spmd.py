@@ -21,12 +21,14 @@ batch shard.
 from __future__ import annotations
 
 import re
+import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
 
 from ..base import MXNetError
 from ..context import current_context
+from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from ..gluon.block import functional_call
 from . import mesh as mesh_mod
@@ -363,11 +365,11 @@ class SPMDTrainer:
             return loss, new_tr, new_aux, new_opt
 
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(
+        return _telemetry.instrument_jit("spmd", jax.jit(
             pure_step,
             out_shardings=(None, self._tr_shardings, self._aux_shardings,
                            self._opt_state_shardings),
-            donate_argnums=donate)
+            donate_argnums=donate))
 
     def _shard_batch(self, arr):
         import jax
@@ -387,6 +389,15 @@ class SPMDTrainer:
     def step(self, *batch) -> float:
         """Run one train step; returns the (replicated) scalar loss as a
         jax array (non-blocking — async dispatch)."""
+        observe = bool(_telemetry.TRAINER.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
+        out = self._step_impl(*batch)
+        if observe:
+            _telemetry.TRAINER.publish(
+                phase="step", seconds=_time.perf_counter() - t0)
+        return out
+
+    def _step_impl(self, *batch):
         from .. import random as _random
         import jax.numpy as jnp
         sharded = tuple(self._shard_batch(b) for b in batch)

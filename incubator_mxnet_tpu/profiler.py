@@ -7,11 +7,18 @@ Two complementary planes, mirroring the reference's design:
   dispatch is bracketed (the analog of ``ProfileOperator`` wrapping
   ``ThreadedEngine::ExecuteOprBlock``); ops run synchronously during
   profiling so durations are true compute times.  ``dump()`` writes
-  chrome-trace JSON (the reference's output format); ``dumps()`` returns
-  the min/max/avg aggregate table (reference: aggregate_stats.cc).
+  chrome-trace JSON (the reference's output format) including ``ph:"C"``
+  counter tracks (profiler Counters + telemetry counters when the
+  telemetry collector is on) and ``ph:"i"`` instant events (Markers);
+  ``dumps()`` returns the min/max/avg aggregate table (reference:
+  aggregate_stats.cc).
 * **XLA trace** — ``set_config(xla_trace_dir=...)`` additionally records a
   jax.profiler trace (TensorBoard/Perfetto), the TPU-native superset of
   the reference's NVTX/VTune emitters.
+
+Op events arrive via the telemetry event bus (``telemetry.OP_TIMED``), so
+the profiler and the telemetry collector can observe the same op stream
+concurrently — there is no single observer slot to fight over.
 
 Env autostart: ``MXNET_PROFILER_AUTOSTART=1`` (reference parity).
 """
@@ -22,6 +29,7 @@ import threading
 import time
 
 from .base import MXNetError, getenv_bool
+from . import telemetry as _telemetry
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Counter", "Marker", "scope"]
@@ -39,9 +47,12 @@ _config = {
 }
 _state = "stop"
 _paused = False
-_events = []          # (name, t_start_us, dur_us)
+# (ph, name, t_start_us, value) — ph "X": value = dur_us;
+# ph "C": value = counter value; ph "i": value unused
+_events = []
 _t0 = None
 _xla_tracing = False
+_run_start_counters = {}   # telemetry counter sample taken at set_state(run)
 
 
 def set_config(**kwargs):
@@ -53,29 +64,45 @@ def set_config(**kwargs):
 
 
 def _observer(name, seconds):
-    if _paused:
+    if _paused or _t0 is None:
         return
     now = time.perf_counter()
     with _lock:
-        _events.append((name, (now - seconds - _t0) * 1e6, seconds * 1e6))
+        _events.append(("X", name, (now - seconds - _t0) * 1e6,
+                        seconds * 1e6))
+
+
+def _emit(ph, name, value=None):
+    """Record a counter sample / instant event at 'now' while running."""
+    if _state != "run" or _paused or _t0 is None:
+        return
+    ts = (time.perf_counter() - _t0) * 1e6
+    with _lock:
+        _events.append((ph, name, ts, value))
 
 
 def set_state(state="stop"):
     """'run' starts op bracketing (+XLA trace if configured); 'stop' ends
-    it (reference: mx.profiler.set_state)."""
-    global _state, _t0, _xla_tracing
-    from .ndarray import ndarray as nd_mod
+    it (reference: mx.profiler.set_state).  Each new run starts a FRESH
+    session: prior events are cleared, the clock re-zeroed, and a stale
+    pause() undone — back-to-back sessions never mix timelines."""
+    global _state, _t0, _paused, _xla_tracing, _run_start_counters
     if state == "run":
-        _state = "run"
+        with _lock:
+            _events.clear()
+        _paused = False
         _t0 = time.perf_counter()
-        nd_mod._op_observer = _observer
+        _state = "run"
+        _telemetry.OP_TIMED.subscribe(_observer)
+        _run_start_counters = (_telemetry.counters_flat()
+                               if _telemetry.enabled() else {})
         if _config["xla_trace_dir"] and not _xla_tracing:
             import jax
             jax.profiler.start_trace(_config["xla_trace_dir"])
             _xla_tracing = True
     elif state == "stop":
         _state = "stop"
-        nd_mod._op_observer = None
+        _telemetry.OP_TIMED.unsubscribe(_observer)
         if _xla_tracing:
             import jax
             jax.profiler.stop_trace()
@@ -98,33 +125,51 @@ def resume(profile_process="worker"):
     _paused = False
 
 
+def _trace_event(ph, name, ts, value):
+    if ph == "X":
+        return {"name": name, "ph": "X", "ts": ts, "dur": value,
+                "pid": 0, "tid": 0, "cat": "operator"}
+    if ph == "C":
+        return {"name": name, "ph": "C", "ts": ts, "pid": 0,
+                "cat": "counter", "args": {"value": value}}
+    return {"name": name, "ph": "i", "ts": ts, "pid": 0, "tid": 0,
+            "cat": "marker", "s": "p"}
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON to the configured filename
-    (reference: MXDumpProfile → chrome trace)."""
+    (reference: MXDumpProfile → chrome trace).  Telemetry counters (when
+    the collector is on) are woven in as ``ph:"C"`` samples: one at the
+    run start, one at dump time — a per-session delta track on top of the
+    profiler's own Counter series."""
     with _lock:
         events = list(_events)
-    trace = {
-        "traceEvents": [
-            {"name": n, "ph": "X", "ts": ts, "dur": dur,
-             "pid": 0, "tid": 0, "cat": "operator"}
-            for n, ts, dur in events
-        ],
-        "displayTimeUnit": "ms",
-    }
+    trace_events = [_trace_event(*e) for e in events]
+    if _telemetry.enabled() and _t0 is not None:
+        now_ts = (time.perf_counter() - _t0) * 1e6
+        current = _telemetry.counters_flat()
+        for name, v in sorted(current.items()):
+            if name in _run_start_counters:
+                trace_events.append(
+                    _trace_event("C", name, 0.0, _run_start_counters[name]))
+            trace_events.append(_trace_event("C", name, now_ts, v))
+    trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     with open(_config["filename"], "w") as f:
         json.dump(trace, f)
 
 
 def dumps(reset=False):
     """Aggregate per-op stats table (reference: aggregate_stats.cc
-    DumpTable): name, calls, total/min/max/avg ms."""
-    global _events
+    DumpTable): name, calls, total/min/max/avg ms.  Duration ("X") events
+    only — counter/marker events live in the chrome trace."""
     with _lock:
         events = list(_events)
         if reset:
-            _events = []
+            _events.clear()
     agg = {}
-    for name, _ts, dur in events:
+    for ph, name, _ts, dur in events:
+        if ph != "X":
+            continue
         rec = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
         rec[0] += 1
         rec[1] += dur
@@ -173,31 +218,37 @@ class Frame(_Named):
 
 
 class Marker:
-    """Instant event (reference: MXProfileSetMarker)."""
+    """Instant event (reference: MXProfileSetMarker) — appears in dump()
+    as a chrome-trace ``ph:"i"`` event."""
 
     def __init__(self, name):
         self.name = name
 
     def mark(self, scope="process"):
-        if _state == "run":
-            _observer(f"Marker:{self.name}", 0.0)
+        _emit("i", f"Marker:{self.name}")
 
 
 class Counter:
-    """reference: MXProfileCreateCounter."""
+    """reference: MXProfileCreateCounter.  Every value change while the
+    profiler runs is recorded as a chrome-trace ``ph:"C"`` sample, so the
+    counter renders as a proper time series in the trace viewer."""
 
     def __init__(self, name, value=0):
         self.name = name
         self.value = value
+        _emit("C", f"Counter:{self.name}", value)
 
     def set_value(self, value):
         self.value = value
+        _emit("C", f"Counter:{self.name}", self.value)
 
     def increment(self, delta=1):
         self.value += delta
+        _emit("C", f"Counter:{self.name}", self.value)
 
     def decrement(self, delta=1):
         self.value -= delta
+        _emit("C", f"Counter:{self.name}", self.value)
 
 
 class scope:

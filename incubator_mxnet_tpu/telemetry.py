@@ -1,0 +1,721 @@
+"""Unified runtime telemetry: multi-subscriber event bus + cross-layer
+metrics registry (reference analog: the reference's profiler counters +
+``MXNET_PROFILER_*`` plane, generalized into an always-on, low-overhead
+observability spine for the whole runtime).
+
+Two cooperating pieces:
+
+* **Event bus** — named :class:`Topic` objects that any number of
+  subscribers can attach to concurrently.  This replaces the single-slot
+  ``_op_observer`` hook in ``ndarray/ndarray.py``: the profiler and the
+  telemetry collector (and any user code) can observe the same op stream
+  at once.  Publishing to a topic with no subscribers is a single list
+  truthiness check — the instrumented hot paths stay effectively free
+  when nothing is listening.
+* **Metrics registry** — process-wide :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` (bounded reservoir with p50/p95/max), exported three
+  ways: :func:`render_prometheus` (text exposition format),
+  :func:`snapshot` (JSON-ready dict, merged into ``bench.py``'s output
+  line), and counter samples woven into the profiler's chrome-trace
+  ``dump()`` as ``ph:"C"`` events.
+
+Instrumented layers (see docs/observability.md):
+
+* eager op dispatch — op counts per name, sync-block counts, host<->device
+  transfer bytes (``ndarray/ndarray.py``)
+* JIT/compile — compile count, cache hit/miss, compile seconds
+  (``executor.py``, ``gluon/block.py`` _CachedGraph, ``parallel/spmd.py``,
+  ``kvstore.py`` mesh reducer) via :func:`instrument_jit`
+* kvstore — push/pull/pushpull calls, bytes, latency histograms
+* gluon trainer — step/update timing
+* dataloader — per-batch fetch-wait time
+* device memory — gauges sampled from ``jax.live_arrays()`` /
+  ``device.memory_stats()`` at export time
+
+Control plane: ``MXNET_TELEMETRY=1`` starts collection at import;
+``MXNET_TELEMETRY_DUMP=/path`` additionally writes a dump at process exit
+(Prometheus text if the path ends in ``.prom``/``.txt``, JSON otherwise).
+The ``mxtpu-stats`` console script (``_cli.py``) runs any script under
+telemetry and prints the dump.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .base import MXNetError, getenv, getenv_bool
+
+__all__ = [
+    "Topic", "EventBus", "bus",
+    "OP_DISPATCH", "OP_TIMED", "SYNC", "TRANSFER", "COMPILE", "KVSTORE",
+    "TRAINER", "DATALOADER",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "counter", "gauge", "histogram",
+    "start", "stop", "enabled", "reset",
+    "snapshot", "render_prometheus", "counters_flat", "dump",
+    "instrument_jit", "sample_device_memory",
+]
+
+
+# ---------------------------------------------------------------------------
+# Event bus
+# ---------------------------------------------------------------------------
+class Topic:
+    """A named event stream.  ``subscribers`` is copy-on-write so
+    ``publish`` iterates a stable snapshot without locking the hot path;
+    a subscriber that raises is counted in ``errors`` and skipped — an
+    observer must never take the observed program down.
+
+    ``forcing`` counts non-passive subscribers.  Publishers whose
+    instrumentation is expensive (OP_TIMED forces a per-op device sync)
+    key the decision to pay that cost on ``forcing``, so a passive
+    listener (the telemetry collector) can ride along whenever an active
+    one (the profiler) turns the firehose on, without turning it on
+    itself."""
+
+    __slots__ = ("name", "subscribers", "errors", "last_error", "forcing",
+                 "_passive")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.subscribers: List[Callable] = []
+        self.errors = 0
+        self.last_error: Optional[BaseException] = None
+        self.forcing = 0
+        self._passive = set()
+
+    def subscribe(self, fn: Callable, passive: bool = False) -> Callable:
+        if fn not in self.subscribers:
+            self.subscribers = self.subscribers + [fn]
+            if passive:
+                self._passive.add(id(fn))
+            else:
+                self.forcing += 1
+        return fn
+
+    def unsubscribe(self, fn: Callable) -> None:
+        if fn in self.subscribers:
+            self.subscribers = [s for s in self.subscribers if s is not fn]
+            if id(fn) in self._passive:
+                self._passive.discard(id(fn))
+            else:
+                self.forcing -= 1
+
+    def publish(self, *args, **kwargs) -> None:
+        for fn in self.subscribers:
+            try:
+                fn(*args, **kwargs)
+            except Exception as e:
+                self.errors += 1
+                self.last_error = e
+
+
+class EventBus:
+    """Registry of Topics; ``topic(name)`` is get-or-create."""
+
+    def __init__(self):
+        self._topics: Dict[str, Topic] = {}
+        self._lock = threading.Lock()
+
+    def topic(self, name: str) -> Topic:
+        t = self._topics.get(name)
+        if t is None:
+            with self._lock:
+                t = self._topics.setdefault(name, Topic(name))
+        return t
+
+    def subscribe(self, name: str, fn: Callable,
+                  passive: bool = False) -> Callable:
+        return self.topic(name).subscribe(fn, passive=passive)
+
+    def unsubscribe(self, name: str, fn: Callable) -> None:
+        self.topic(name).unsubscribe(fn)
+
+    def publish(self, name: str, *args, **kwargs) -> None:
+        self.topic(name).publish(*args, **kwargs)
+
+    def topics(self) -> List[str]:
+        return sorted(self._topics)
+
+
+bus = EventBus()
+
+# Canonical runtime topics.  Payload contracts:
+#   OP_DISPATCH(name)                 — one eager op dispatched (not traced)
+#   OP_TIMED(name, seconds)           — op with true synchronous duration;
+#                                       subscribing FORCES per-op sync
+#   SYNC(kind)                        — a blocking call (wait_to_read/asnumpy)
+#   TRANSFER(direction, nbytes)       — "h2d" | "d2h" host<->device bytes
+#   COMPILE(where=, event=, seconds=) — event in {"miss","hit"}; miss carries
+#                                       trace+compile seconds when measurable
+#   KVSTORE(op=, nbytes=, seconds=)   — op in {"push","pull","pushpull"}
+#   TRAINER(phase=, seconds=)         — phase in {"step","update"}
+#   DATALOADER(seconds=)              — consumer-side batch fetch wait
+OP_DISPATCH = bus.topic("op.dispatch")
+OP_TIMED = bus.topic("op.timed")
+SYNC = bus.topic("op.sync")
+TRANSFER = bus.topic("transfer")
+COMPILE = bus.topic("compile")
+KVSTORE = bus.topic("kvstore")
+TRAINER = bus.topic("trainer")
+DATALOADER = bus.topic("dataloader")
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+def _label_key(labels: dict):
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class Counter:
+    """Monotonic counter, optionally broken out by labels
+    (``c.inc(3, op="dot")``)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise MXNetError(f"counter {self.name}: negative increment")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    @property
+    def value(self) -> float:
+        return sum(self._values.values())
+
+    def sample(self):
+        """JSON-ready value: plain number when unlabeled, else
+        ``{"total": t, "by": {"op=dot": n, ...}}``."""
+        with self._lock:
+            vals = dict(self._values)
+        if not vals or set(vals) == {()}:
+            return vals.get((), 0.0)
+        return {
+            "total": sum(vals.values()),
+            "by": {",".join(f"{k}={v}" for k, v in key): val
+                   for key, val in sorted(vals.items()) if key},
+        }
+
+    def _reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Gauge:
+    """Last-write-wins value, optionally labeled."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._values.get((), 0.0) if not self._values else \
+                sum(self._values.values())
+
+    def sample(self):
+        with self._lock:
+            vals = dict(self._values)
+        if not vals or set(vals) == {()}:
+            return vals.get((), 0.0)
+        return {",".join(f"{k}={v}" for k, v in key) or "_": val
+                for key, val in sorted(vals.items())}
+
+    def _reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Histogram:
+    """Bounded-reservoir histogram: keeps the last ``max_samples``
+    observations for percentiles plus exact count/sum/max over the full
+    stream.  Exported in Prometheus summary form (quantile series +
+    ``_count``/``_sum``) with an extra ``_max`` series."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", max_samples: int = 2048):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._samples = deque(maxlen=max_samples)
+        self._count = 0
+        self._sum = 0.0
+        self._max = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._samples.append(v)
+            self._count += 1
+            self._sum += v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return None
+        idx = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+        return data[idx]
+
+    def stats(self) -> dict:
+        with self._lock:
+            data = sorted(self._samples)
+            count, total, mx = self._count, self._sum, self._max
+        if not data:
+            return {"count": 0, "sum": 0.0, "p50": None, "p95": None,
+                    "max": None}
+
+        def pct(q):
+            return data[min(len(data) - 1,
+                            max(0, int(round(q * (len(data) - 1)))))]
+        return {"count": count, "sum": total, "p50": pct(0.5),
+                "p95": pct(0.95), "max": mx}
+
+    def sample(self):
+        return self.stats()
+
+    def _reset(self):
+        with self._lock:
+            self._samples.clear()
+            self._count = 0
+            self._sum = 0.0
+            self._max = None
+
+
+class MetricsRegistry:
+    """Process-wide name → metric store with get-or-create accessors and
+    the three exporters."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name, help, **kw)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise MXNetError(
+                f"metric {name!r} already registered as {m.kind}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  max_samples: int = 2048) -> Histogram:
+        return self._get(Histogram, name, help, max_samples=max_samples)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def metrics(self):
+        return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self) -> None:
+        """Zero every metric (registrations survive)."""
+        for m in list(self._metrics.values()):
+            m._reset()
+
+    # -- exporters ------------------------------------------------------
+    def snapshot(self) -> dict:
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            out[m.kind + "s"][m.name] = m.sample()
+        return out
+
+    def counters_flat(self) -> Dict[str, float]:
+        """name → total value for every counter and gauge (the chrome-trace
+        ``ph:"C"`` feed used by profiler.dump())."""
+        return {m.name: m.value for m in self.metrics()
+                if m.kind in ("counter", "gauge")}
+
+    def render_prometheus(self) -> str:
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            if m.kind in ("counter", "gauge"):
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                with m._lock:
+                    vals = dict(m._values)
+                if not vals:
+                    lines.append(f"{m.name} 0")
+                for key, val in sorted(vals.items()):
+                    label = "{" + ",".join(
+                        f'{k}="{v}"' for k, v in key) + "}" if key else ""
+                    lines.append(f"{m.name}{label} {_fmt_num(val)}")
+            else:
+                lines.append(f"# TYPE {m.name} summary")
+                s = m.stats()
+                for q, k in (("0.5", "p50"), ("0.95", "p95")):
+                    if s[k] is not None:
+                        lines.append(
+                            f'{m.name}{{quantile="{q}"}} {repr(s[k])}')
+                lines.append(f"{m.name}_sum {repr(float(s['sum']))}")
+                lines.append(f"{m.name}_count {int(s['count'])}")
+                if s["max"] is not None:
+                    lines.append(f"{m.name}_max {repr(s['max'])}")
+        return "\n".join(lines) + "\n"
+
+
+registry = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              max_samples: int = 2048) -> Histogram:
+    return registry.histogram(name, help, max_samples=max_samples)
+
+
+# ---------------------------------------------------------------------------
+# Device memory gauges
+# ---------------------------------------------------------------------------
+def sample_device_memory() -> None:
+    """Refresh the device-memory gauges from the live jax client.  Never
+    raises: backends without memory_stats (CPU) just contribute the
+    live-array total."""
+    try:
+        import jax
+    except Exception:
+        return
+    g_live = registry.gauge(
+        "mx_device_live_array_bytes",
+        "total bytes of live jax arrays (all devices)")
+    try:
+        live = jax.live_arrays()
+        g_live.set(sum(getattr(a, "nbytes", 0) or 0 for a in live))
+    except Exception:
+        pass
+    try:
+        g_use = registry.gauge("mx_device_bytes_in_use",
+                               "per-device bytes in use (memory_stats)")
+        g_peak = registry.gauge("mx_device_peak_bytes_in_use",
+                                "per-device peak bytes (memory_stats)")
+        for d in jax.devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                continue
+            if not stats:
+                continue
+            dev = f"{d.platform}:{d.id}"
+            if "bytes_in_use" in stats:
+                g_use.set(stats["bytes_in_use"], device=dev)
+            if "peak_bytes_in_use" in stats:
+                g_peak.set(stats["peak_bytes_in_use"], device=dev)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Compile instrumentation
+# ---------------------------------------------------------------------------
+def instrument_jit(where: str, jitted: Callable) -> Callable:
+    """Wrap a ``jax.jit`` callable so compile-cache behavior is published
+    on the COMPILE topic.  When the pjit object exposes ``_cache_size``,
+    per-shape recompiles are detected exactly (the cache grew across the
+    call → miss, with the blocking trace+compile seconds); otherwise the
+    first invocation counts as the one miss.  Zero-subscriber calls go
+    straight through."""
+    size_fn = getattr(jitted, "_cache_size", None)
+    state = {"first": True}
+
+    def call(*args, **kwargs):
+        if not COMPILE.subscribers:
+            return jitted(*args, **kwargs)
+        if size_fn is not None:
+            try:
+                before = size_fn()
+            except Exception:
+                before = None
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            grew = None
+            if before is not None:
+                try:
+                    grew = size_fn() > before
+                except Exception:
+                    grew = None
+            if grew is None:
+                grew = state["first"]
+            state["first"] = False
+            if grew:
+                COMPILE.publish(where=where, event="miss", seconds=dt)
+            else:
+                COMPILE.publish(where=where, event="hit")
+            return out
+        if state["first"]:
+            state["first"] = False
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            COMPILE.publish(where=where, event="miss",
+                            seconds=time.perf_counter() - t0)
+            return out
+        COMPILE.publish(where=where, event="hit")
+        return jitted(*args, **kwargs)
+
+    call.__wrapped__ = jitted
+    return call
+
+
+# ---------------------------------------------------------------------------
+# Collector: the default subscribers that turn bus events into metrics
+# ---------------------------------------------------------------------------
+_started = False
+_m: Dict[str, object] = {}
+
+
+def _metrics_init():
+    c, h = registry.counter, registry.histogram
+    _m["ops"] = c("mx_op_dispatch_total",
+                  "eager ops dispatched, by op name")
+    _m["op_seconds"] = h("mx_op_seconds",
+                         "synchronous per-op seconds (profiler-timed path)")
+    _m["sync"] = c("mx_sync_block_total",
+                   "blocking sync calls (wait_to_read/asnumpy), by kind")
+    _m["h2d"] = c("mx_transfer_h2d_bytes_total",
+                  "host->device transfer bytes")
+    _m["d2h"] = c("mx_transfer_d2h_bytes_total",
+                  "device->host transfer bytes")
+    _m["compile"] = c("mx_compile_total", "XLA compiles, by site")
+    _m["compile_hit"] = c("mx_compile_cache_hits_total",
+                          "compiled-executable cache hits, by site")
+    _m["compile_miss"] = c("mx_compile_cache_misses_total",
+                           "compiled-executable cache misses, by site")
+    _m["compile_seconds"] = h("mx_compile_seconds",
+                              "blocking trace+compile seconds")
+    _m["kv_calls"] = c("mx_kvstore_calls_total",
+                       "kvstore calls, by op (push/pull/pushpull)")
+    _m["kv_push_bytes"] = c("mx_kvstore_push_bytes_total",
+                            "bytes pushed into the kvstore")
+    _m["kv_pull_bytes"] = c("mx_kvstore_pull_bytes_total",
+                            "bytes pulled out of the kvstore")
+    _m["kv_push_seconds"] = h("mx_kvstore_push_seconds",
+                              "kvstore push latency")
+    _m["kv_pull_seconds"] = h("mx_kvstore_pull_seconds",
+                              "kvstore pull latency")
+    _m["kv_pushpull_seconds"] = h("mx_kvstore_pushpull_seconds",
+                                  "kvstore fused push+pull latency")
+    _m["steps"] = c("mx_trainer_steps_total", "trainer optimization steps")
+    _m["step_seconds"] = h("mx_trainer_step_seconds",
+                           "trainer step dispatch seconds")
+    _m["update_seconds"] = h("mx_trainer_update_seconds",
+                             "trainer update dispatch seconds")
+    _m["batches"] = c("mx_dataloader_batches_total",
+                      "dataloader batches fetched")
+    _m["fetch_wait"] = h("mx_dataloader_fetch_wait_seconds",
+                         "consumer wait per dataloader batch")
+
+
+_op_keys: Dict[str, tuple] = {}   # op name -> label key, spares the hot
+                                  # path the kwargs/sort work of inc()
+
+
+def _on_op_dispatch(name):
+    key = _op_keys.get(name)
+    if key is None:
+        key = _op_keys[name] = (("op", name),)
+    c = _m["ops"]
+    with c._lock:
+        c._values[key] = c._values.get(key, 0.0) + 1.0
+
+
+def _on_op_timed(name, seconds):
+    _m["op_seconds"].observe(seconds)
+
+
+def _on_sync(kind):
+    _m["sync"].inc(kind=kind)
+
+
+def _on_transfer(direction, nbytes):
+    _m["h2d" if direction == "h2d" else "d2h"].inc(nbytes)
+
+
+def _on_compile(where="?", event="miss", seconds=None):
+    if event == "miss":
+        _m["compile"].inc(site=where)
+        _m["compile_miss"].inc(site=where)
+        if seconds is not None:
+            _m["compile_seconds"].observe(seconds)
+    else:
+        _m["compile_hit"].inc(site=where)
+
+
+def _on_kvstore(op="push", nbytes=0, seconds=0.0):
+    _m["kv_calls"].inc(op=op)
+    if op == "push" and nbytes:
+        _m["kv_push_bytes"].inc(nbytes)
+    elif op == "pull" and nbytes:
+        _m["kv_pull_bytes"].inc(nbytes)
+    key = f"kv_{op}_seconds"
+    if key in _m:
+        _m[key].observe(seconds)
+
+
+def _on_trainer(phase="step", seconds=0.0):
+    if phase == "step":
+        _m["steps"].inc()
+        _m["step_seconds"].observe(seconds)
+    else:
+        _m["update_seconds"].observe(seconds)
+
+
+def _on_dataloader(seconds=0.0):
+    _m["batches"].inc()
+    _m["fetch_wait"].observe(seconds)
+
+
+_HANDLERS = (
+    (OP_DISPATCH, _on_op_dispatch),
+    (OP_TIMED, _on_op_timed),
+    (SYNC, _on_sync),
+    (TRANSFER, _on_transfer),
+    (COMPILE, _on_compile),
+    (KVSTORE, _on_kvstore),
+    (TRAINER, _on_trainer),
+    (DATALOADER, _on_dataloader),
+)
+
+
+def start() -> None:
+    """Begin collecting: subscribe the metric handlers to every runtime
+    topic.  Idempotent."""
+    global _started
+    if _started:
+        return
+    _metrics_init()
+    for topic, fn in _HANDLERS:
+        # OP_TIMED passively: the collector must never itself force the
+        # per-op syncs that feed it — mx_op_seconds only fills while the
+        # profiler (an active subscriber) has the timed path on
+        topic.subscribe(fn, passive=topic is OP_TIMED)
+    _started = True
+
+
+def stop() -> None:
+    """Detach the collector (metric values are kept; see reset())."""
+    global _started
+    for topic, fn in _HANDLERS:
+        topic.unsubscribe(fn)
+    _started = False
+
+
+def enabled() -> bool:
+    return _started
+
+
+def reset() -> None:
+    """Zero all metric values."""
+    registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Exporters (module-level conveniences over the default registry)
+# ---------------------------------------------------------------------------
+def snapshot(include_memory: bool = True) -> dict:
+    """JSON-ready dict of every metric; refreshes device-memory gauges
+    first (when collecting)."""
+    if _started and include_memory:
+        sample_device_memory()
+    out = registry.snapshot()
+    out["enabled"] = _started
+    return out
+
+
+def render_prometheus(include_memory: bool = True) -> str:
+    """Prometheus text exposition of every metric."""
+    if _started and include_memory:
+        sample_device_memory()
+    return registry.render_prometheus()
+
+
+def counters_flat() -> Dict[str, float]:
+    return registry.counters_flat()
+
+
+def dump(path: str, fmt: Optional[str] = None) -> None:
+    """Write the current metrics to ``path``: Prometheus text when ``fmt``
+    is 'prometheus' (or the path ends in .prom/.txt), JSON otherwise."""
+    if fmt is None:
+        fmt = "prometheus" if path.endswith((".prom", ".txt")) else "json"
+    with open(path, "w") as f:
+        if fmt == "prometheus":
+            f.write(render_prometheus())
+        else:
+            json.dump(snapshot(), f, indent=2, default=str)
+            f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Env autostart (reference parity with MXNET_PROFILER_AUTOSTART)
+# ---------------------------------------------------------------------------
+_dump_path = getenv("MXNET_TELEMETRY_DUMP")
+if _dump_path:
+    def _dump_at_exit(path=_dump_path):
+        try:
+            dump(path)
+        except Exception:
+            pass
+    atexit.register(_dump_at_exit)
+
+if getenv_bool("MXNET_TELEMETRY", False):
+    start()

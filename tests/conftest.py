@@ -11,10 +11,18 @@ and pins jax_platforms='axon' (the live single-client TPU tunnel), so
 os.environ edits are too late — only jax.config.update can redirect tests to
 CPU.  Without this override the whole suite serializes on (and can deadlock
 against) the TPU tunnel."""
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.4.38 jax: the option doesn't exist, but the XLA flag read at
+    # backend creation (which hasn't happened yet) does the same thing
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
 
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402

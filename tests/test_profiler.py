@@ -1,0 +1,286 @@
+"""Profiler + runtime telemetry tests (reference model:
+tests/python/unittest/test_profiler.py; the telemetry plane is this
+port's generalization of the reference profiler counters)."""
+import json
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability_state():
+    """Every test starts and ends with both observers detached and the
+    metric values zeroed, so tests cannot leak into each other (or into
+    the rest of the suite)."""
+    mx.profiler.set_state("stop")
+    telemetry.stop()
+    telemetry.reset()
+    yield
+    mx.profiler.set_state("stop")
+    telemetry.stop()
+    telemetry.reset()
+
+
+def _trace(tmp_path, fname="profile.json"):
+    f = str(tmp_path / fname)
+    mx.profiler.set_config(filename=f)
+    return f
+
+
+# ---------------------------------------------------------------- profiler
+def test_back_to_back_runs_start_fresh(tmp_path):
+    f = _trace(tmp_path)
+    mx.profiler.set_state("run")
+    a = mx.nd.ones((8, 8))
+    mx.nd.dot(a, a).wait_to_read()
+    mx.profiler.pause()          # leave it paused AND with events recorded
+    mx.profiler.set_state("stop")
+    assert "dot" in mx.profiler.dumps(reset=False)
+
+    # second session: stale events must be gone and the pause undone
+    mx.profiler.set_state("run")
+    (mx.nd.ones((4, 4)) * 2).wait_to_read()
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps(reset=False)
+    assert "dot" not in table            # first session's events cleared
+    assert "multiply" in table           # pause() didn't leak into run 2
+    mx.profiler.dump()
+    trace = json.load(open(f))
+    assert all(e["ts"] >= 0 for e in trace["traceEvents"])
+
+
+def test_counter_marker_in_chrome_trace(tmp_path):
+    f = _trace(tmp_path)
+    mx.profiler.set_state("run")
+    c = mx.profiler.Counter("queue_depth", 2)
+    c.increment(3)
+    c.set_value(7)
+    c.decrement()
+    mx.profiler.Marker("epoch_end").mark()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    trace = json.load(open(f))
+    counters = [e for e in trace["traceEvents"]
+                if e["ph"] == "C" and e["name"] == "Counter:queue_depth"]
+    assert [e["args"]["value"] for e in counters] == [2, 5, 7, 6]
+    markers = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert any(e["name"] == "Marker:epoch_end" for e in markers)
+    # counter/marker events stay out of the aggregate op table
+    assert "queue_depth" not in mx.profiler.dumps(reset=False)
+
+
+def test_counter_silent_when_stopped():
+    c = mx.profiler.Counter("idle", 1)
+    c.increment(2)
+    assert c.value == 3          # value tracking works without a session
+
+
+# ------------------------------------------------------------- event bus
+def test_multiple_subscribers_all_receive_every_op():
+    """The contract the single-slot _op_observer could not provide: the
+    profiler and two more observers see the same op stream at once."""
+    seen_a, seen_b = [], []
+    fa = telemetry.OP_TIMED.subscribe(lambda n, s: seen_a.append(n))
+    fb = telemetry.OP_TIMED.subscribe(lambda n, s: seen_b.append(n))
+    mx.profiler.set_state("run")
+    try:
+        a = mx.nd.ones((8, 8))
+        mx.nd.dot(a, a).wait_to_read()
+        (a + a).wait_to_read()
+    finally:
+        mx.profiler.set_state("stop")
+        telemetry.OP_TIMED.unsubscribe(fa)
+        telemetry.OP_TIMED.unsubscribe(fb)
+    assert seen_a == seen_b and "dot" in seen_a and "add" in seen_a
+    assert "dot" in mx.profiler.dumps(reset=False)   # profiler saw it too
+
+    # unsubscribe is effective: no further delivery
+    n = len(seen_a)
+    (mx.nd.ones((4,)) * 3).wait_to_read()
+    assert len(seen_a) == n
+
+
+def test_subscriber_exception_is_isolated():
+    topic = telemetry.bus.topic("test.isolation")
+
+    def bad(*a, **k):
+        raise RuntimeError("observer bug")
+    got = []
+    topic.subscribe(bad)
+    topic.subscribe(lambda *a, **k: got.append(a))
+    errs = topic.errors
+    topic.publish("x")
+    topic.publish("y")
+    assert got == [("x",), ("y",)]       # later subscriber still ran
+    assert topic.errors == errs + 2
+    assert isinstance(topic.last_error, RuntimeError)
+    topic.unsubscribe(bad)
+    topic.publish("z")
+    assert topic.errors == errs + 2
+
+
+def test_telemetry_never_forces_the_timed_path():
+    """The collector rides OP_TIMED passively: only the profiler (an
+    active subscriber) may turn on the per-op sync firehose."""
+    telemetry.start()
+    assert telemetry.OP_TIMED.forcing == 0
+    mx.profiler.set_state("run")
+    assert telemetry.OP_TIMED.forcing == 1
+    mx.profiler.set_state("stop")
+    assert telemetry.OP_TIMED.forcing == 0
+    # without the profiler, ops are counted but never timed-synced
+    (mx.nd.ones((4,)) * 2).wait_to_read()
+    assert telemetry.registry.get("mx_op_seconds").count == 0
+    assert telemetry.registry.get("mx_op_dispatch_total").value >= 1
+
+
+def test_profiler_and_telemetry_observe_concurrently():
+    telemetry.start()
+    mx.profiler.set_state("run")
+    try:
+        a = mx.nd.ones((8, 8))
+        for _ in range(3):
+            a = mx.nd.dot(a, a)
+        a.wait_to_read()
+    finally:
+        mx.profiler.set_state("stop")
+    assert "dot" in mx.profiler.dumps(reset=False)
+    ops = telemetry.registry.get("mx_op_dispatch_total").sample()
+    assert ops["by"].get("op=dot") == 3
+    assert telemetry.registry.get("mx_op_seconds").count >= 3
+
+
+# ------------------------------------------------------------- telemetry
+def test_counter_labels_and_snapshot():
+    telemetry.start()
+    c = telemetry.counter("mx_test_requests_total")
+    c.inc(2, op="push")
+    c.inc(op="pull")
+    assert c.value == 3
+    s = c.sample()
+    assert s == {"total": 3.0, "by": {"op=push": 2.0, "op=pull": 1.0}}
+    snap = telemetry.snapshot(include_memory=False)
+    assert snap["enabled"] is True
+    assert snap["counters"]["mx_test_requests_total"]["total"] == 3.0
+    with pytest.raises(mx.MXNetError):
+        c.inc(-1)
+    with pytest.raises(mx.MXNetError):
+        telemetry.gauge("mx_test_requests_total")   # kind mismatch
+
+
+def test_histogram_percentiles_and_reset():
+    h = telemetry.histogram("mx_test_latency_seconds")
+    for v in (1, 2, 3, 4, 100):
+        h.observe(v)
+    s = h.stats()
+    assert s["count"] == 5 and s["sum"] == 110.0
+    assert s["p50"] == 3 and s["max"] == 100
+    telemetry.reset()
+    assert h.stats()["count"] == 0 and h.stats()["p50"] is None
+
+
+def test_render_prometheus_format():
+    telemetry.counter("mx_test_total", "help text").inc(4, op="dot")
+    telemetry.gauge("mx_test_gauge").set(2.5)
+    telemetry.histogram("mx_test_seconds").observe(0.25)
+    text = telemetry.render_prometheus(include_memory=False)
+    assert "# HELP mx_test_total help text" in text
+    assert "# TYPE mx_test_total counter" in text
+    assert 'mx_test_total{op="dot"} 4' in text
+    assert "mx_test_gauge 2.5" in text
+    assert "# TYPE mx_test_seconds summary" in text
+    assert "mx_test_seconds_count 1" in text
+    assert 'mx_test_seconds{quantile="0.5"} 0.25' in text
+
+
+def test_telemetry_dump_formats(tmp_path):
+    telemetry.start()
+    telemetry.counter("mx_test_dump_total").inc()
+    prom = tmp_path / "m.prom"
+    js = tmp_path / "m.json"
+    telemetry.dump(str(prom))
+    telemetry.dump(str(js))
+    assert "mx_test_dump_total 1" in prom.read_text()
+    assert json.loads(js.read_text())["counters"]["mx_test_dump_total"] == 1.0
+
+
+def test_op_dispatch_counted_without_sync():
+    """The count-only plane must see ops even with no profiler running
+    (no OP_TIMED subscriber → async dispatch path)."""
+    telemetry.start()
+    a = mx.nd.ones((4, 4))
+    (a * 2).wait_to_read()
+    mx.nd.dot(a, a).asnumpy()
+    ops = telemetry.registry.get("mx_op_dispatch_total").sample()
+    assert ops["by"].get("op=multiply", 0) >= 1
+    assert ops["by"].get("op=dot", 0) >= 1
+    sync = telemetry.registry.get("mx_sync_block_total").sample()
+    assert sync["by"].get("kind=wait_to_read", 0) >= 1
+    assert sync["by"].get("kind=asnumpy", 0) >= 1
+    d2h = telemetry.registry.get("mx_transfer_d2h_bytes_total").value
+    assert d2h >= 4 * 4 * 4          # the asnumpy'd float32 (4,4)
+
+
+def test_compile_and_trainer_metrics():
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.gluon import nn
+    telemetry.start()
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 5))
+    net(x).wait_to_read()        # inference forward: the actual compile
+    net(x).wait_to_read()        # same shapes: cache hit
+    for _ in range(2):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+    compiles = telemetry.registry.get("mx_compile_total").sample()
+    hits = telemetry.registry.get("mx_compile_cache_hits_total").sample()
+    assert compiles["by"].get("site=cached_op", 0) >= 1
+    assert hits["by"].get("site=cached_op", 0) >= 1   # 2nd fwd reused it
+    assert telemetry.registry.get("mx_compile_seconds").count >= 1
+    assert telemetry.registry.get("mx_trainer_steps_total").value == 2
+    assert telemetry.registry.get("mx_trainer_step_seconds").count == 2
+
+
+def test_dataloader_fetch_wait_metric():
+    from incubator_mxnet_tpu import gluon
+    telemetry.start()
+    ds = gluon.data.ArrayDataset(mx.nd.ones((12, 3)), mx.nd.ones((12,)))
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    assert len(list(loader)) == 3
+    assert telemetry.registry.get("mx_dataloader_batches_total").value == 3
+    assert telemetry.registry.get(
+        "mx_dataloader_fetch_wait_seconds").count == 3
+
+
+def test_kvstore_metrics():
+    telemetry.start()
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.ones((4,)))
+    kv.push("w", mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    out.wait_to_read()
+    calls = telemetry.registry.get("mx_kvstore_calls_total").sample()
+    assert calls["by"].get("op=push", 0) >= 1
+    assert calls["by"].get("op=pull", 0) >= 1
+    assert telemetry.registry.get(
+        "mx_kvstore_push_bytes_total").value >= 4 * 4
+    assert telemetry.registry.get("mx_kvstore_push_seconds").count >= 1
+
+
+def test_stop_detaches_collector():
+    telemetry.start()
+    (mx.nd.ones((2, 2)) * 2).wait_to_read()
+    telemetry.stop()
+    assert not telemetry.enabled()
+    before = telemetry.registry.get("mx_op_dispatch_total").value
+    (mx.nd.ones((2, 2)) * 2).wait_to_read()
+    assert telemetry.registry.get("mx_op_dispatch_total").value == before
