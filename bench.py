@@ -41,6 +41,10 @@ PEAK_FLOPS = {
 # ResNet-50 v1 224x224 forward FLOPs per image (mul+add), the standard
 # 4.1 GFLOPs accounting; training ~= fwd + 2x bwd = 3x forward.
 RESNET50_FWD_FLOPS = 4.1e9
+# ResNet-18 v1 224x224 forward FLOPs per image (1.8 GFLOPs standard
+# accounting); conv FLOPs scale with spatial area, so the CPU smoke at
+# H x H uses 1.8e9 * (H/224)^2.
+RESNET18_FWD_FLOPS_224 = 1.8e9
 
 
 def _peak_flops(kind):
@@ -52,6 +56,18 @@ def _peak_flops(kind):
         if key in k and (best is None or len(key) > len(best[0])):
             best = (key, val)
     return best[1] if best else 197e12  # unknown TPU kind: v5e-class
+
+
+def _cpu_peak_flops():
+    """Host peak-FLOP/s estimate (telemetry's cores x clock x SIMD-width
+    model) so CPU smoke records report a finite mfu instead of null.  An
+    order-of-magnitude denominator: comparable across runs on the same
+    box, not across machines."""
+    try:
+        from incubator_mxnet_tpu import telemetry
+        return telemetry.cpu_peak_flops()
+    except Exception:
+        return None
 
 
 def _telemetry_snapshot():
@@ -74,28 +90,41 @@ def _probe_backend(timeout=90):
     """Probe the default (axon TPU tunnel) backend in a SUBPROCESS so a
     hung PJRT init cannot take the bench down with it (round-1 failure
     mode: rc=1/rc=124 and no JSON emitted).  Returns (platform, kind,
-    probe_error): probe_error is None on success and otherwise records WHY
-    the accelerator was unreachable, so a CPU-fallback record is never
-    ambiguous about whether a TPU was attempted (round-3 failure mode:
-    "device": "cpu:" with no trace of the dead tunnel)."""
+    probe): probe is a structured record — ``probe_attempts`` (how many
+    subprocess probes ran), ``probe_seconds`` (total wall time they took,
+    so a tunnel that hangs until timeout is distinguishable from one that
+    fails fast), and ``probe_error`` (None on success, else WHY the
+    accelerator was unreachable) — so a CPU-fallback record is never
+    ambiguous about whether a TPU was attempted, or how long the attempt
+    blocked, from the JSON alone (round-3 failure mode: "device": "cpu:"
+    with no trace of the dead tunnel)."""
     code = ("import jax; d=jax.devices()[0]; "
             "print(d.platform, '|', getattr(d,'device_kind',''))")
     errs = []
+    attempts = 0
+    t_start = time.perf_counter()
+
+    def probe_info(error):
+        return {"probe_attempts": attempts,
+                "probe_seconds": round(time.perf_counter() - t_start, 3),
+                "probe_error": error}
+
     for attempt in range(2):
+        attempts += 1
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 text=True, timeout=timeout)
             if out.returncode == 0 and out.stdout.strip():
                 platform, _, kind = out.stdout.strip().partition("|")
-                return platform.strip(), kind.strip(), None
+                return platform.strip(), kind.strip(), probe_info(None)
             tail = (out.stderr or out.stdout or "").strip().splitlines()
             errs.append(f"attempt {attempt + 1}: rc={out.returncode} "
                         + (tail[-1][:160] if tail else "no output"))
         except subprocess.TimeoutExpired:
             errs.append(f"attempt {attempt + 1}: probe hung >{timeout}s "
                         "(PJRT init never returned — tunnel down?)")
-    return None, None, "; ".join(errs)[:400]
+    return None, None, probe_info("; ".join(errs)[:400])
 
 
 def _model_flops_per_step(cfg, batch, seqlen):
@@ -204,7 +233,7 @@ def _bench_bert(on_accel, kind, dev, seq_len=None, batch_ladder=None,
     assert samples_per_sec is not None  # loop breaks or re-raises
 
     flops = _model_flops_per_step(cfg, B_used, T)
-    peak = _peak_flops(kind) if on_accel else None
+    peak = _peak_flops(kind) if on_accel else _cpu_peak_flops()
     mfu = (samples_per_sec / B_used) * flops / peak if peak else None
     return samples_per_sec, B_used, T, mfu, remat_used
 
@@ -229,7 +258,7 @@ def _bench_resnet50(on_accel, kind, dev):
         H = 32
         batch_ladder = [4]
         steps, warmup = 3, 1
-        flops_per_img = None
+        flops_per_img = 3.0 * RESNET18_FWD_FLOPS_224 * (H / 224.0) ** 2
 
     mx.random.seed(0)
     net.initialize(init=mx.init.Xavier())
@@ -276,7 +305,7 @@ def _bench_resnet50(on_accel, kind, dev):
             import gc
             gc.collect()
 
-    peak = _peak_flops(kind) if on_accel else None
+    peak = _peak_flops(kind) if on_accel else _cpu_peak_flops()
     mfu = (imgs_per_sec * flops_per_img / peak
            if (peak and flops_per_img) else None)
     return {
@@ -571,12 +600,15 @@ def _sub_main(name):
 
 
 def _main(preset_fusion):
-    probe_error = None
+    probe = None
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         platform, kind = "cpu", ""
-        probe_error = os.environ.get("BENCH_PROBE_ERROR") or None
+        err = os.environ.get("BENCH_PROBE_ERROR") or None
+        if err:
+            probe = {"probe_attempts": 0, "probe_seconds": 0.0,
+                     "probe_error": err}
     else:
-        platform, kind, probe_error = _probe_backend()
+        platform, kind, probe = _probe_backend()
     on_accel = platform not in (None, "cpu")
 
     if on_accel:
@@ -675,8 +707,8 @@ def _main(preset_fusion):
         "int8_inference": int8,
         "dp_scaling": scaling,
     }
-    if probe_error:
-        out["probe_error"] = probe_error
+    if probe is not None:
+        out.update({k: v for k, v in probe.items() if v is not None})
     if not on_accel:
         # point the reader at the most recent ON-CHIP record when one
         # exists: a dead-relay CPU smoke does not erase the mid-round

@@ -15,6 +15,9 @@ usage: ci/run_tests.sh <function>
   smoke                 60-second end-to-end slice (gluon MNIST)
   telemetry_smoke       MNIST slice under MXNET_TELEMETRY=1; asserts the
                         Prometheus dump has nonzero op/step/compile counters
+  trace_smoke           MNIST slice with the profiler+tracer on; asserts the
+                        chrome trace is valid JSON with NESTED ph:"X" spans
+                        and the snapshot reports a finite mfu > 0
   bench                 judged benchmark (prints one JSON line; includes a
                         telemetry snapshot when MXNET_TELEMETRY=1)
   multichip_dryrun      8-virtual-device full-train-step compile+run
@@ -68,6 +71,54 @@ for metric in ("mx_op_dispatch_total", "mx_trainer_steps_total",
 print("telemetry_smoke ok:",
       {k: vals[k] for k in ("mx_op_dispatch_total",
                             "mx_trainer_steps_total", "mx_compile_total")})
+EOF
+}
+
+trace_smoke() {
+    local trace=/tmp/mxtpu_trace_smoke.json
+    local snap=/tmp/mxtpu_trace_smoke_snapshot.json
+    rm -f "$trace" "$snap"
+    TRACE_OUT="$trace" SNAP_OUT="$snap" python - <<'EOF'
+import json, os, runpy, sys
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+
+telemetry.start()
+mx.profiler.set_config(filename=os.environ["TRACE_OUT"])
+mx.profiler.set_state("run")
+sys.argv = ["mnist.py", "--cpu", "--epochs", "1", "--hybridize"]
+runpy.run_path("example/gluon/mnist.py", run_name="__main__")
+mx.profiler.set_state("stop")
+mx.profiler.dump()
+with open(os.environ["SNAP_OUT"], "w") as f:
+    json.dump(telemetry.snapshot(include_memory=False), f)
+EOF
+    python - "$trace" "$snap" <<'EOF'
+import json, math, sys
+
+trace = json.load(open(sys.argv[1]))          # must be valid JSON
+spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+assert spans, "trace_smoke: no ph:X events at all"
+
+def contains(outer, inner):
+    return (outer is not inner
+            and outer.get("pid") == inner.get("pid")
+            and outer.get("tid") == inner.get("tid")
+            and outer["ts"] <= inner["ts"]
+            and inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"])
+
+nested = [(o["name"], i["name"]) for o in spans for i in spans
+          if contains(o, i)]
+assert nested, "trace_smoke: no nested ph:X spans in the trace"
+
+snap = json.load(open(sys.argv[2]))
+mfu = snap["gauges"].get("mxtpu_mfu")
+assert mfu is not None and math.isfinite(mfu) and mfu > 0, \
+    f"trace_smoke: mfu not finite/positive: {mfu!r}"
+assert snap["histograms"]["mxtpu_step_seconds"]["count"] > 0
+print("trace_smoke ok: %d spans, %d nestings, mfu=%.3g"
+      % (len(spans), len(nested), mfu))
 EOF
 }
 

@@ -40,13 +40,17 @@ def stats_main():
     """``mxtpu-stats`` — run a script under runtime telemetry and print
     the metrics afterwards::
 
-        mxtpu-stats [--format prometheus|json] [--out PATH] script.py [args...]
+        mxtpu-stats [--format prometheus|json] [--out PATH]
+                    [--serve [--port N]] script.py [args...]
 
     The script runs in-process (as ``__main__``) with the telemetry
     collector started, so every layer (op dispatch, compile cache,
     kvstore, trainer, dataloader) is observed without touching the
     script.  Metrics go to --out (or stdout) when the script finishes —
-    including when it raises."""
+    including when it raises.  With ``--serve`` the live HTTP exporter
+    runs for the duration of the script (``/metrics``, ``/healthz``,
+    ``/trace`` on --port, default 9100), so a long training run can be
+    scraped and its span tree inspected while it executes."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -57,6 +61,12 @@ def stats_main():
                     default="prometheus")
     ap.add_argument("--out", default=None,
                     help="write the dump here instead of stdout")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve live /metrics, /healthz and /trace over "
+                         "HTTP while the script runs")
+    ap.add_argument("--port", type=int, default=9100,
+                    help="HTTP exporter port for --serve (default 9100; "
+                         "0 picks an ephemeral port)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script")
@@ -64,6 +74,12 @@ def stats_main():
 
     from . import telemetry
     telemetry.start()
+    if ns.serve:
+        from . import telemetry_http
+        srv = telemetry_http.start_server(ns.port)
+        sys.stderr.write(
+            f"mxtpu-stats: serving /metrics /healthz /trace on "
+            f"http://0.0.0.0:{srv.server_address[1]}\n")
 
     import runpy
     sys.argv = [ns.script] + ns.args

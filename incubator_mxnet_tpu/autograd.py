@@ -174,6 +174,14 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     ``variables`` is given, returns grads w.r.t. those arrays instead
     (autograd.grad semantics).
     """
+    from . import telemetry as _telemetry
+    with _telemetry.trace_span("autograd.backward", cat="autograd"):
+        return _backward_impl(heads, head_grads, retain_graph, train_mode,
+                              create_graph, variables)
+
+
+def _backward_impl(heads, head_grads, retain_graph, train_mode,
+                   create_graph, variables):
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray, _invoke
 

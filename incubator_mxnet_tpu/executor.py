@@ -201,41 +201,46 @@ class Executor:
 
     # ------------------------------------------------------------------
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
-        for n, v in kwargs.items():
-            if n not in self._arg_names:
-                raise MXNetError(f"forward: unknown input '{n}'")
-            self.arg_arrays[self._arg_names.index(n)] = self._as_nd(v)
-        from . import random as _random
-        key = _random.new_key(self._ctx)
-        arg_vals = tuple(a._data for a in self.arg_arrays)
-        aux_vals = tuple(a._data for a in self.aux_arrays)
-        outs, new_aux = self._fwd(bool(is_train))(arg_vals, aux_vals, key)
-        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
-        if is_train:
-            self._last_primals = (arg_vals, aux_vals, key)
-            for a, v in zip(self.aux_arrays, new_aux):
-                a._data = v
-        return self.outputs
+        with _telemetry.trace_span("executor.forward", cat="executor",
+                                   is_train=bool(is_train)):
+            for n, v in kwargs.items():
+                if n not in self._arg_names:
+                    raise MXNetError(f"forward: unknown input '{n}'")
+                self.arg_arrays[self._arg_names.index(n)] = self._as_nd(v)
+            from . import random as _random
+            key = _random.new_key(self._ctx)
+            arg_vals = tuple(a._data for a in self.arg_arrays)
+            aux_vals = tuple(a._data for a in self.aux_arrays)
+            outs, new_aux = self._fwd(bool(is_train))(arg_vals, aux_vals,
+                                                      key)
+            self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+            if is_train:
+                self._last_primals = (arg_vals, aux_vals, key)
+                for a, v in zip(self.aux_arrays, new_aux):
+                    a._data = v
+            return self.outputs
 
     def backward(self, out_grads=None) -> None:
         if self._last_primals is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        arg_vals, aux_vals, key = self._last_primals
-        if out_grads is None:
-            import jax.numpy as jnp
-            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
-        else:
-            if isinstance(out_grads, NDArray):
-                out_grads = [out_grads]
-            cots = [self._as_nd(g)._data for g in out_grads]
-        bwd, diff_idx = self._bwd()
-        grads = bwd(arg_vals, aux_vals, key, tuple(cots))
-        for k, g in zip(diff_idx, grads):
-            name = self._arg_names[k]
-            if self._grad_req[name] == "add":
-                self.grad_arrays[k]._data = self.grad_arrays[k]._data + g
+        with _telemetry.trace_span("executor.backward", cat="executor"):
+            arg_vals, aux_vals, key = self._last_primals
+            if out_grads is None:
+                import jax.numpy as jnp
+                cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
             else:
-                self.grad_arrays[k]._data = g
+                if isinstance(out_grads, NDArray):
+                    out_grads = [out_grads]
+                cots = [self._as_nd(g)._data for g in out_grads]
+            bwd, diff_idx = self._bwd()
+            grads = bwd(arg_vals, aux_vals, key, tuple(cots))
+            for k, g in zip(diff_idx, grads):
+                name = self._arg_names[k]
+                if self._grad_req[name] == "add":
+                    self.grad_arrays[k]._data = \
+                        self.grad_arrays[k]._data + g
+                else:
+                    self.grad_arrays[k]._data = g
 
     # ------------------------------------------------------------------
     def reshape(self, partial_shaping=False, allow_up_sizing=False,

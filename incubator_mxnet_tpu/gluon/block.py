@@ -337,6 +337,11 @@ class _CachedGraph:
         return trainable, aux
 
     def __call__(self, *args):
+        with _telemetry.trace_span("cached_op", cat="executor",
+                                   block=self.block.name):
+            return self._call_impl(*args)
+
+    def _call_impl(self, *args):
         import jax
         inputs = [a for a in args if isinstance(a, NDArray)]
         # non-NDArray positionals (None holes, python literals) are part

@@ -140,19 +140,23 @@ class DataLoader:
 
     def __iter__(self):
         it = self._iter_impl()
-        if not _telemetry.DATALOADER.subscribers:
+        observe = bool(_telemetry.DATALOADER.subscribers)
+        if not observe and not _telemetry.tracer.active:
             yield from it
             return
         # fetch-wait plane: time the consumer spends blocked obtaining the
-        # next batch (worker stalls surface here, compute does not)
+        # next batch (worker stalls surface here, compute does not); the
+        # same window is a "dataloader.fetch" span in the trace
         while True:
             t0 = _time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                return
-            _telemetry.DATALOADER.publish(
-                seconds=_time.perf_counter() - t0)
+            with _telemetry.trace_span("dataloader.fetch", cat="data"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            if observe:
+                _telemetry.DATALOADER.publish(
+                    seconds=_time.perf_counter() - t0)
             yield batch
 
     def _iter_impl(self):

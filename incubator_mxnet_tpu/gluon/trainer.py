@@ -117,11 +117,14 @@ class Trainer:
         not here."""
         observe = bool(_telemetry.TRAINER.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with _telemetry.trace_span("trainer.step", cat="trainer",
+                                   batch_size=batch_size):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
+            with _telemetry.trace_span("trainer.update", cat="trainer"):
+                self._update(ignore_stale_grad)
         if observe:
             _telemetry.TRAINER.publish(
                 phase="step", seconds=_time.perf_counter() - t0)
@@ -154,10 +157,11 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         observe = bool(_telemetry.TRAINER.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        with _telemetry.trace_span("trainer.update", cat="trainer"):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._update(ignore_stale_grad)
         if observe:
             _telemetry.TRAINER.publish(
                 phase="update", seconds=_time.perf_counter() - t0)

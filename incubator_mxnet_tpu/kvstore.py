@@ -231,22 +231,23 @@ class KVStore:
         observe = bool(_telemetry.KVSTORE.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         nbytes = 0
-        _, keys, values = self._norm_keys(key, value)
-        for k, v in zip(keys, values):
-            agg = self._aggregate(v)
-            if k not in self._store:
-                raise MXNetError(f"key {k!r} was not init()-ed")
-            if observe:
-                nbytes += _nd_nbytes(agg)
-            if self._is_dist():
-                if self._compression_params and \
-                        self._compression_params.get("type") == "2bit":
-                    agg = self._compress(k, agg)
-                agg = self._cross_process_sum(agg)
-            if self._updater is not None:
-                self._updater(_key_int(k), agg, self._store[k])
-            else:
-                self._store[k] = agg.copy()
+        with _telemetry.trace_span("kvstore.push", cat="kvstore"):
+            _, keys, values = self._norm_keys(key, value)
+            for k, v in zip(keys, values):
+                agg = self._aggregate(v)
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} was not init()-ed")
+                if observe:
+                    nbytes += _nd_nbytes(agg)
+                if self._is_dist():
+                    if self._compression_params and \
+                            self._compression_params.get("type") == "2bit":
+                        agg = self._compress(k, agg)
+                    agg = self._cross_process_sum(agg)
+                if self._updater is not None:
+                    self._updater(_key_int(k), agg, self._store[k])
+                else:
+                    self._store[k] = agg.copy()
         if observe:
             _telemetry.KVSTORE.publish(
                 op="push", nbytes=nbytes,
@@ -256,23 +257,24 @@ class KVStore:
         observe = bool(_telemetry.KVSTORE.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         nbytes = 0
-        _, keys, outs = self._norm_keys(key, out)
-        for k, o in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError(f"key {k!r} was not init()-ed")
-            src = self._store[k]
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            if observe:
-                nbytes += _nd_nbytes(src) * len(targets)
-            from .ndarray import sparse as _sp
-            for t in targets:
-                if isinstance(t, _sp.BaseSparseNDArray):
-                    t._replace_with(src if src.stype == t.stype
-                                    else src.tostype(t.stype))
-                elif isinstance(src, _sp.BaseSparseNDArray):
-                    src.tostype("default").copyto(t)
-                else:
-                    src.copyto(t)
+        with _telemetry.trace_span("kvstore.pull", cat="kvstore"):
+            _, keys, outs = self._norm_keys(key, out)
+            for k, o in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} was not init()-ed")
+                src = self._store[k]
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                if observe:
+                    nbytes += _nd_nbytes(src) * len(targets)
+                from .ndarray import sparse as _sp
+                for t in targets:
+                    if isinstance(t, _sp.BaseSparseNDArray):
+                        t._replace_with(src if src.stype == t.stype
+                                        else src.tostype(t.stype))
+                    elif isinstance(src, _sp.BaseSparseNDArray):
+                        src.tostype("default").copyto(t)
+                    else:
+                        src.copyto(t)
         if observe:
             _telemetry.KVSTORE.publish(
                 op="pull", nbytes=nbytes,
@@ -284,9 +286,10 @@ class KVStore:
         fused-call count and end-to-end latency."""
         observe = bool(_telemetry.KVSTORE.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
-        self.push(key, value, priority)
-        if out is not None:
-            self.pull(key, out, priority)
+        with _telemetry.trace_span("kvstore.pushpull", cat="kvstore"):
+            self.push(key, value, priority)
+            if out is not None:
+                self.pull(key, out, priority)
         if observe:
             _telemetry.KVSTORE.publish(
                 op="pushpull", nbytes=0,

@@ -1,10 +1,24 @@
 """Monitor (reference: python/mxnet/monitor.py) — periodic statistics over
-executor outputs and arguments during training; the symbol-era debugging
-lens (``Module.fit(monitor=...)``).
+tensors during training; the symbol-era debugging lens
+(``Module.fit(monitor=...)``).
 
-The reference hooks a stat callback into every executor op output; here
-the executor exposes its arg/grad/output dicts after each forward/backward,
-and the Monitor samples them on ``tic()``/``toc()`` boundaries."""
+Two sources, both behind the same tic()/toc() API:
+
+* **Executor mode** (reference flow) — ``install(executor)`` attaches an
+  executor whose arg/grad/output dicts are sampled on each activated
+  window, exactly like the reference's monitor_callback.
+* **Bus mode** — ``install()`` with no executor subscribes the monitor to
+  the telemetry event bus's ``OP_TIMED`` topic, so it observes the
+  eager/gluon path too: every op dispatched inside an activated window is
+  recorded as ``(step, "op:<name>", seconds)`` for names matching
+  ``pattern``.  This is an ACTIVE subscription — it forces the per-op
+  synchronous timed path while installed (same cost as running the
+  profiler), which is the right trade for a debugging tool; call
+  ``uninstall()`` when done.
+
+The two modes compose: an executor-installed monitor that is also bus-
+installed reports both tensor stats and op timings.
+"""
 from __future__ import annotations
 
 import re
@@ -14,6 +28,7 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from . import telemetry as _telemetry
 
 __all__ = ["Monitor"]
 
@@ -33,6 +48,13 @@ class Monitor:
             mon.tic()
             ...forward/backward/update...
             mon.toc_print()
+
+    Gluon/eager path (no executor)::
+
+        mon = Monitor(interval=10, pattern="dot|softmax")
+        mon.install()                   # subscribe to the op stream
+        ...
+        mon.uninstall()
     """
 
     def __init__(self, interval: int = 1,
@@ -43,15 +65,34 @@ class Monitor:
         self.re_pattern = re.compile(pattern)
         self.sort = sort
         self._executors: List = []
+        self._bus_installed = False
         self.step = 0
         self.activated = False
         self.queue: List[Tuple[int, str, object]] = []
 
-    def install(self, executor):
-        """Attach an executor whose tensors are sampled (reference:
-        Monitor.install via monitor_callback)."""
+    def install(self, executor=None):
+        """Attach a source.  With an executor: its tensors are sampled on
+        toc() (reference: Monitor.install via monitor_callback).  Without:
+        subscribe to the telemetry op stream (bus mode — observes the
+        eager/gluon path; forces per-op sync while installed)."""
+        if executor is None:
+            if not self._bus_installed:
+                _telemetry.OP_TIMED.subscribe(self._on_op)
+                self._bus_installed = True
+            return None
         self._executors.append(executor)
         return executor
+
+    def uninstall(self):
+        """Detach from the op stream and drop installed executors."""
+        if self._bus_installed:
+            _telemetry.OP_TIMED.unsubscribe(self._on_op)
+            self._bus_installed = False
+        self._executors = []
+
+    def _on_op(self, name, seconds):
+        if self.activated and self.re_pattern.match(name):
+            self.queue.append((self.step, f"op:{name}", float(seconds)))
 
     def tic(self):
         """Start sampling if this step is on the interval (reference:
@@ -81,7 +122,8 @@ class Monitor:
 
     def toc(self):
         """Finish the sampling window; returns [(step, name, stat)]
-        (reference: Monitor.toc)."""
+        (reference: Monitor.toc).  Bus-mode op records from the window are
+        included ahead of the executor tensor stats."""
         if not self.activated:
             return []
         self._collect()

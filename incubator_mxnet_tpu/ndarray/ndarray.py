@@ -144,7 +144,8 @@ class NDArray:
         """Block and copy to host (reference: NDArray::SyncCopyToCPU)."""
         if _SYNC.subscribers:
             _SYNC.publish("asnumpy")
-        out = _np.asarray(self._data)
+        with _telemetry.trace_span("sync:asnumpy", cat="sync"):
+            out = _np.asarray(self._data)
         if _TRANSFER.subscribers:
             _TRANSFER.publish("d2h", out.nbytes)
         return out
@@ -162,7 +163,8 @@ class NDArray:
         (reference: NDArray::WaitToRead via engine WaitForVar)."""
         if _SYNC.subscribers:
             _SYNC.publish("wait_to_read")
-        self._data.block_until_ready()
+        with _telemetry.trace_span("sync:wait_to_read", cat="sync"):
+            self._data.block_until_ready()
         return self
 
     wait_to_write = wait_to_read
@@ -720,7 +722,11 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
         if dtype is None:
             dtype = (_np.float32 if src.dtype.kind in "fiu"
                      else src.dtype)
-    out = _place(jnp.asarray(src, dtype=dtype), ctx)
+    if _telemetry.tracer.active:
+        with _telemetry.trace_span("transfer:h2d", cat="transfer"):
+            out = _place(jnp.asarray(src, dtype=dtype), ctx)
+    else:
+        out = _place(jnp.asarray(src, dtype=dtype), ctx)
     if _TRANSFER.subscribers and not isinstance(source, NDArray):
         _TRANSFER.publish("h2d", out._data.nbytes)
     return out

@@ -391,7 +391,8 @@ class SPMDTrainer:
         jax array (non-blocking — async dispatch)."""
         observe = bool(_telemetry.TRAINER.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
-        out = self._step_impl(*batch)
+        with _telemetry.trace_span("spmd.step", cat="trainer"):
+            out = self._step_impl(*batch)
         if observe:
             _telemetry.TRAINER.publish(
                 phase="step", seconds=_time.perf_counter() - t0)
@@ -400,7 +401,8 @@ class SPMDTrainer:
     def _step_impl(self, *batch):
         from .. import random as _random
         import jax.numpy as jnp
-        sharded = tuple(self._shard_batch(b) for b in batch)
+        with _telemetry.trace_span("spmd.shard_batch", cat="transfer"):
+            sharded = tuple(self._shard_batch(b) for b in batch)
         if self._accum > 1:
             B = sharded[0].shape[0]
             dp = self._mesh.shape[self._data_axis]

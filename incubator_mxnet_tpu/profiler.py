@@ -92,6 +92,8 @@ def set_state(state="stop"):
             _events.clear()
         _paused = False
         _t0 = time.perf_counter()
+        if _state != "run":          # transition only: tracer is refcounted
+            _telemetry.tracer.enable()
         _state = "run"
         _telemetry.OP_TIMED.subscribe(_observer)
         _run_start_counters = (_telemetry.counters_flat()
@@ -101,6 +103,8 @@ def set_state(state="stop"):
             jax.profiler.start_trace(_config["xla_trace_dir"])
             _xla_tracing = True
     elif state == "stop":
+        if _state == "run":
+            _telemetry.tracer.disable()
         _state = "stop"
         _telemetry.OP_TIMED.unsubscribe(_observer)
         if _xla_tracing:
@@ -145,6 +149,10 @@ def dump(finished=True, profile_process="worker"):
     with _lock:
         events = list(_events)
     trace_events = [_trace_event(*e) for e in events]
+    if _t0 is not None:
+        # telemetry spans from this session nest as ph:"X" flame-graph
+        # rows next to the op events (main thread shares tid 0)
+        trace_events.extend(_telemetry.tracer.chrome_events(_t0))
     if _telemetry.enabled() and _t0 is not None:
         now_ts = (time.perf_counter() - _t0) * 1e6
         current = _telemetry.counters_flat()

@@ -32,16 +32,38 @@ Instrumented layers (see docs/observability.md):
 * device memory — gauges sampled from ``jax.live_arrays()`` /
   ``device.memory_stats()`` at export time
 
+Three further planes layered on the same spine (this file + satellites):
+
+* **Span tracer** — hierarchical :class:`Span` trees with thread-local
+  context propagation (``with trace_span("trainer.step"): ...``,
+  ``@traced``).  The training path is instrumented end-to-end (trainer
+  step → spmd dispatch → kvstore push/pull → dataloader fetch →
+  executor/cached-op compile+dispatch), and finished spans render as
+  nested ``ph:"X"`` events in the profiler's chrome-trace ``dump()`` —
+  a proper flame graph next to the ``ph:"C"`` counter tracks.
+* **Cost-analysis accountant** — :func:`instrument_jit` captures XLA's
+  ``jit(...).lower(...).compile().cost_analysis()`` flops/bytes once per
+  compiled executable and publishes them per call on the ``XLA_COST``
+  topic; the collector accumulates them and, at each trainer-step
+  boundary, computes **MFU** = step-window FLOPs / wall seconds /
+  :func:`device_peak_flops` (TPU generation table, CPU estimate) into
+  the ``mxtpu_mfu`` gauge and the ``mxtpu_step_seconds`` histogram.
+* **HTTP exporter** (``telemetry_http.py``) — stdlib ``http.server``
+  background thread serving ``/metrics`` (Prometheus text), ``/healthz``
+  and ``/trace`` (live span tree as JSON).
+
 Control plane: ``MXNET_TELEMETRY=1`` starts collection at import;
 ``MXNET_TELEMETRY_DUMP=/path`` additionally writes a dump at process exit
-(Prometheus text if the path ends in ``.prom``/``.txt``, JSON otherwise).
+(Prometheus text if the path ends in ``.prom``/``.txt``, JSON otherwise);
+``MXNET_TELEMETRY_PORT=<port>`` starts collection AND the HTTP exporter.
 The ``mxtpu-stats`` console script (``_cli.py``) runs any script under
-telemetry and prints the dump.
+telemetry and prints the dump (``--serve`` adds the live endpoint).
 """
 from __future__ import annotations
 
 import atexit
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -52,12 +74,15 @@ from .base import MXNetError, getenv, getenv_bool
 __all__ = [
     "Topic", "EventBus", "bus",
     "OP_DISPATCH", "OP_TIMED", "SYNC", "TRANSFER", "COMPILE", "KVSTORE",
-    "TRAINER", "DATALOADER",
+    "TRAINER", "DATALOADER", "SPAN", "XLA_COST",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram",
+    "Span", "Tracer", "tracer", "trace_span", "traced", "current_span",
     "start", "stop", "enabled", "reset",
     "snapshot", "render_prometheus", "counters_flat", "dump",
     "instrument_jit", "sample_device_memory",
+    "TPU_PEAK_FLOPS", "tpu_peak_flops", "cpu_peak_flops",
+    "device_peak_flops",
 ]
 
 
@@ -65,10 +90,14 @@ __all__ = [
 # Event bus
 # ---------------------------------------------------------------------------
 class Topic:
-    """A named event stream.  ``subscribers`` is copy-on-write so
-    ``publish`` iterates a stable snapshot without locking the hot path;
-    a subscriber that raises is counted in ``errors`` and skipped — an
-    observer must never take the observed program down.
+    """A named event stream.  ``subscribers`` is copy-on-write: mutations
+    build a NEW list under ``_lock`` and swap it in atomically, so
+    ``publish`` fans out over a stable snapshot without ever taking the
+    lock on the hot path — a subscribe/unsubscribe racing a concurrent
+    publish can neither drop another subscriber's registration nor
+    deliver an event to the same subscriber twice.  A subscriber that
+    raises is counted in ``errors`` and skipped — an observer must never
+    take the observed program down.
 
     ``forcing`` counts non-passive subscribers.  Publishers whose
     instrumentation is expensive (OP_TIMED forces a per-op device sync)
@@ -78,7 +107,7 @@ class Topic:
     itself."""
 
     __slots__ = ("name", "subscribers", "errors", "last_error", "forcing",
-                 "_passive")
+                 "_passive", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -87,25 +116,40 @@ class Topic:
         self.last_error: Optional[BaseException] = None
         self.forcing = 0
         self._passive = set()
+        self._lock = threading.Lock()
 
     def subscribe(self, fn: Callable, passive: bool = False) -> Callable:
-        if fn not in self.subscribers:
-            self.subscribers = self.subscribers + [fn]
-            if passive:
-                self._passive.add(id(fn))
-            else:
-                self.forcing += 1
+        with self._lock:
+            if fn not in self.subscribers:
+                self.subscribers = self.subscribers + [fn]
+                if passive:
+                    self._passive.add(id(fn))
+                else:
+                    self.forcing += 1
         return fn
 
     def unsubscribe(self, fn: Callable) -> None:
-        if fn in self.subscribers:
-            self.subscribers = [s for s in self.subscribers if s is not fn]
-            if id(fn) in self._passive:
-                self._passive.discard(id(fn))
+        with self._lock:
+            # locate by EQUALITY, first occurrence: a re-created bound
+            # method (obj.meth is a fresh object per access) must still
+            # unsubscribe the one registered earlier — but the passive
+            # bookkeeping is keyed on the REGISTERED object's id
+            try:
+                idx = self.subscribers.index(fn)
+            except ValueError:
+                return
+            registered = self.subscribers[idx]
+            fresh = list(self.subscribers)
+            del fresh[idx]
+            self.subscribers = fresh
+            if id(registered) in self._passive:
+                self._passive.discard(id(registered))
             else:
                 self.forcing -= 1
 
     def publish(self, *args, **kwargs) -> None:
+        # one atomic attribute read = the fan-out snapshot; mutations only
+        # ever swap in fresh lists, never modify this one in place
         for fn in self.subscribers:
             try:
                 fn(*args, **kwargs)
@@ -155,6 +199,10 @@ bus = EventBus()
 #   KVSTORE(op=, nbytes=, seconds=)   — op in {"push","pull","pushpull"}
 #   TRAINER(phase=, seconds=)         — phase in {"step","update"}
 #   DATALOADER(seconds=)              — consumer-side batch fetch wait
+#   SPAN(span)                        — a finished ROOT span (full subtree)
+#   XLA_COST(where=, flops=, nbytes=) — one dispatch of a compiled
+#                                       executable, with its cost-analysis
+#                                       flops / bytes-accessed
 OP_DISPATCH = bus.topic("op.dispatch")
 OP_TIMED = bus.topic("op.timed")
 SYNC = bus.topic("op.sync")
@@ -163,6 +211,8 @@ COMPILE = bus.topic("compile")
 KVSTORE = bus.topic("kvstore")
 TRAINER = bus.topic("trainer")
 DATALOADER = bus.topic("dataloader")
+SPAN = bus.topic("span")
+XLA_COST = bus.topic("xla.cost")
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +474,334 @@ def histogram(name: str, help: str = "",
 
 
 # ---------------------------------------------------------------------------
+# Span tracer
+# ---------------------------------------------------------------------------
+class Span:
+    """One timed region of the program: name, category, wall window
+    (``time.perf_counter`` floats), free-form attrs, child spans, and the
+    ident of the thread that opened it.  Spans form trees: a span opened
+    while another is current on the same thread (or under an explicit
+    ``parent=``) becomes its child."""
+
+    __slots__ = ("name", "cat", "t0", "t1", "attrs", "children", "tid",
+                 "parent")
+
+    def __init__(self, name: str, cat: str = "span", attrs: dict = None):
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs or None
+        self.t0 = None
+        self.t1 = None
+        self.tid = 0
+        self.parent: Optional["Span"] = None
+        self.children: List["Span"] = []
+
+    @property
+    def seconds(self) -> Optional[float]:
+        if self.t0 is None or self.t1 is None:
+            return None
+        return self.t1 - self.t0
+
+    def to_dict(self, epoch: float = 0.0, now: float = None) -> dict:
+        d = {"name": self.name, "cat": self.cat,
+             "start_s": None if self.t0 is None
+             else round(self.t0 - epoch, 6)}
+        if self.t1 is not None:
+            d["duration_s"] = round(self.t1 - self.t0, 6)
+        else:
+            d["open"] = True
+            if now is not None and self.t0 is not None:
+                d["duration_s"] = round(now - self.t0, 6)
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict(epoch, now)
+                             for c in list(self.children)]
+        return d
+
+
+class _SpanCtx:
+    """Context manager returned by :func:`trace_span` — a no-op when the
+    tracer is inactive, so instrumented hot paths pay one attribute check
+    (no generator frame) when nothing is tracing."""
+
+    __slots__ = ("_name", "_cat", "_parent", "_attrs", "span")
+
+    def __init__(self, name, cat, parent, attrs):
+        self._name = name
+        self._cat = cat
+        self._parent = parent
+        self._attrs = attrs
+        self.span = None
+
+    def __enter__(self):
+        if tracer.active:
+            self.span = tracer._begin(self._name, self._cat, self._parent,
+                                      self._attrs)
+        return self.span
+
+    def __exit__(self, *exc):
+        if self.span is not None:
+            tracer._end(self.span)
+        return False
+
+
+class Tracer:
+    """Hierarchical span recorder with thread-local context propagation.
+
+    Enabled by refcount (:meth:`enable` / :meth:`disable`):
+    ``telemetry.start()`` and ``profiler.set_state("run")`` each hold one
+    reference, so tracing is on whenever either plane collects.  Finished
+    ROOT spans (whole subtrees) land in a bounded deque and on the
+    ``SPAN`` topic; open roots are tracked for the live ``/trace`` view.
+
+    Cross-thread propagation: capture the current span in the parent
+    thread (``ctx = tracer.current()``) and either open child spans with
+    ``trace_span(..., parent=ctx)`` or wrap the worker's body in
+    ``with tracer.attach(ctx): ...`` so its spans nest under ``ctx``."""
+
+    def __init__(self, max_finished: int = 512):
+        self._tl = threading.local()
+        self._lock = threading.Lock()
+        self._enable_count = 0
+        self._live: Dict[int, Span] = {}
+        self._finished = deque(maxlen=max_finished)
+        self._epoch = time.perf_counter()
+        self._main_tid = threading.main_thread().ident
+
+    @property
+    def active(self) -> bool:
+        return self._enable_count > 0
+
+    def enable(self) -> None:
+        with self._lock:
+            self._enable_count += 1
+
+    def disable(self) -> None:
+        with self._lock:
+            self._enable_count = max(0, self._enable_count - 1)
+
+    def clear(self) -> None:
+        """Drop recorded spans (live roots stay: their owners still hold
+        them open)."""
+        with self._lock:
+            self._finished.clear()
+
+    # -- span lifecycle -------------------------------------------------
+    def _stack(self) -> List[Span]:
+        s = getattr(self._tl, "stack", None)
+        if s is None:
+            s = self._tl.stack = []
+        return s
+
+    def _begin(self, name, cat="span", parent=None, attrs=None) -> Span:
+        stack = self._stack()
+        par = parent if parent is not None else \
+            (stack[-1] if stack else None)
+        sp = Span(name, cat, attrs)
+        sp.t0 = time.perf_counter()
+        sp.tid = threading.get_ident()
+        sp.parent = par
+        if par is not None:
+            par.children.append(sp)     # list.append: atomic under the GIL
+        else:
+            with self._lock:
+                self._live[id(sp)] = sp
+        stack.append(sp)
+        return sp
+
+    def _end(self, sp: Span) -> None:
+        sp.t1 = time.perf_counter()
+        stack = self._stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        elif sp in stack:               # mis-nested exit: still unwind
+            stack.remove(sp)
+        if sp.parent is None:
+            with self._lock:
+                self._live.pop(id(sp), None)
+                self._finished.append(sp)
+            if SPAN.subscribers:
+                SPAN.publish(sp)
+
+    def span(self, name: str, cat: str = "span", parent: Span = None,
+             **attrs) -> _SpanCtx:
+        return _SpanCtx(name, cat, parent, attrs)
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._tl, "stack", None)
+        return stack[-1] if stack else None
+
+    class _Attach:
+        __slots__ = ("_span",)
+
+        def __init__(self, span):
+            self._span = span
+
+        def __enter__(self):
+            tracer._stack().append(self._span)
+            return self._span
+
+        def __exit__(self, *exc):
+            stack = tracer._stack()
+            if stack and stack[-1] is self._span:
+                stack.pop()
+            elif self._span in stack:
+                stack.remove(self._span)
+            return False
+
+    def attach(self, span: Span) -> "Tracer._Attach":
+        """Adopt ``span`` as this thread's current span (does not close
+        it) — the worker-thread half of cross-thread propagation."""
+        return Tracer._Attach(span)
+
+    # -- exports --------------------------------------------------------
+    def _roots(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished) + list(self._live.values())
+
+    def tree(self, max_finished: int = 64) -> dict:
+        """JSON-ready view for the HTTP ``/trace`` endpoint: currently
+        open root spans plus the most recent finished ones.  Times are
+        seconds since tracer creation."""
+        now = time.perf_counter()
+        with self._lock:
+            live = list(self._live.values())
+            fin = list(self._finished)[-max_finished:]
+        return {
+            "epoch_perf_counter": self._epoch,
+            "live": [s.to_dict(self._epoch, now) for s in live],
+            "finished": [s.to_dict(self._epoch) for s in fin],
+        }
+
+    def chrome_events(self, t0: float) -> List[dict]:
+        """Finished spans (any depth) overlapping [t0, now) as chrome
+        ``ph:"X"`` events with ts/dur in µs relative to ``t0`` — the
+        profiler merges these into its ``dump()``.  The main thread maps
+        to tid 0 so spans nest with the profiler's own op events."""
+        out = []
+        tid_map = {self._main_tid: 0}
+
+        def walk(sp: Span):
+            if sp.t0 is not None and sp.t1 is not None and sp.t1 >= t0:
+                tid = tid_map.setdefault(sp.tid, len(tid_map))
+                ev = {"name": sp.name, "ph": "X",
+                      "ts": max(0.0, (sp.t0 - t0) * 1e6),
+                      "dur": max(0.0, (sp.t1 - max(sp.t0, t0)) * 1e6),
+                      "pid": 0, "tid": tid, "cat": sp.cat}
+                if sp.attrs:
+                    ev["args"] = dict(sp.attrs)
+                out.append(ev)
+            for ch in list(sp.children):
+                walk(ch)
+
+        for root in self._roots():
+            walk(root)
+        return out
+
+
+tracer = Tracer()
+
+
+def trace_span(name: str, cat: str = "span", parent: Span = None,
+               **attrs) -> _SpanCtx:
+    """``with trace_span("trainer.step"): ...`` — open a span under the
+    thread's current one (no-op while tracing is off)."""
+    return tracer.span(name, cat, parent, **attrs)
+
+
+def current_span() -> Optional[Span]:
+    return tracer.current()
+
+
+def traced(arg=None, cat: str = "span"):
+    """Decorator form: ``@traced`` or ``@traced("name", cat=...)`` wraps
+    the function body in a span."""
+    import functools
+
+    def make(fn, name):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not tracer.active:
+                return fn(*args, **kwargs)
+            with tracer.span(name, cat=cat):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    if callable(arg):
+        return make(arg, getattr(arg, "__qualname__", arg.__name__))
+    return lambda fn: make(fn, arg or getattr(fn, "__qualname__",
+                                              fn.__name__))
+
+
+# ---------------------------------------------------------------------------
+# Device peak FLOP/s detection (MFU denominator)
+# ---------------------------------------------------------------------------
+# bf16 peak FLOP/s PER CHIP by TPU generation (public specs); longest key
+# wins so 'v5 lite' beats 'v5'.  bench.py delegates here.
+TPU_PEAK_FLOPS = {
+    "v2": 46e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def tpu_peak_flops(kind: str) -> float:
+    """Per-chip bf16 peak for a jax ``device_kind`` string (e.g. 'TPU v5
+    lite'); unknown kinds fall back to the v5e-class 197 TFLOP/s."""
+    k = (kind or "").lower().replace("tpu", "").strip()
+    best = None
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in k and (best is None or len(key) > len(best[0])):
+            best = (key, val)
+    return best[1] if best else 197e12
+
+
+def cpu_peak_flops() -> float:
+    """Order-of-magnitude host fp32 peak: cores x clock x 32 FLOPs/cycle
+    (two 256-bit FMA ports).  An ESTIMATE — good enough to make CPU MFU
+    finite and comparable across runs on the same box, not across
+    machines (see docs/observability.md for the caveats)."""
+    cores = os.cpu_count() or 1
+    ghz = 2.0
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    ghz = max(ghz, float(line.split(":")[1]) / 1000.0)
+                    break
+    except Exception:
+        pass
+    return cores * ghz * 1e9 * 32.0
+
+
+def device_peak_flops() -> Optional[float]:
+    """Aggregate peak FLOP/s over the LOCAL devices — TPU: per-chip table
+    x local chip count (bf16); CPU: one host-wide estimate regardless of
+    virtual device count.  None when undetectable (unknown platform)."""
+    try:
+        import jax
+        devs = jax.local_devices()
+    except Exception:
+        return None
+    if not devs:
+        return None
+    platform = getattr(devs[0], "platform", "")
+    if platform == "tpu":
+        return tpu_peak_flops(getattr(devs[0], "device_kind", "")) \
+            * len(devs)
+    if platform == "cpu":
+        return cpu_peak_flops()
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Device memory gauges
 # ---------------------------------------------------------------------------
 def sample_device_memory() -> None:
@@ -465,29 +843,103 @@ def sample_device_memory() -> None:
 
 
 # ---------------------------------------------------------------------------
-# Compile instrumentation
+# Compile instrumentation + cost accountant
 # ---------------------------------------------------------------------------
+def _arg_signature(args, kwargs):
+    """Hashable (treedef, leaf shapes/dtypes) key identifying which cached
+    executable a call hits — python scalars key by type only (jit treats
+    them as dynamic weak-typed args, one compilation per type)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return treedef, tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+        else (type(leaf).__name__,)
+        for leaf in leaves)
+
+
+def _cost_analysis_dict(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` — a list of dicts on CPU
+    backends, a plain dict elsewhere — to one dict (possibly empty)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
 def instrument_jit(where: str, jitted: Callable) -> Callable:
-    """Wrap a ``jax.jit`` callable so compile-cache behavior is published
-    on the COMPILE topic.  When the pjit object exposes ``_cache_size``,
-    per-shape recompiles are detected exactly (the cache grew across the
-    call → miss, with the blocking trace+compile seconds); otherwise the
-    first invocation counts as the one miss.  Zero-subscriber calls go
-    straight through."""
+    """Wrap a ``jax.jit`` callable so the runtime can observe it three
+    ways, each gated on its own consumer and free when nobody listens:
+
+    * **COMPILE topic** — cache hit/miss per call.  When the pjit object
+      exposes ``_cache_size``, per-shape recompiles are detected exactly
+      (the cache grew across the call → miss, with the blocking
+      trace+compile seconds); otherwise the first invocation counts as
+      the one miss.
+    * **XLA_COST topic** — cost-analysis FLOPs / bytes-accessed of the
+      executable this call dispatches, captured once per argument
+      signature via AOT ``lower(...).compile().cost_analysis()`` and
+      republished on every call (the MFU numerator).  Capture runs
+      BEFORE the real call: with ``donate_argnums`` the arguments are
+      dead afterwards.  The AOT compile does not warm jit's call cache,
+      so each new signature costs one extra trace+compile — only while a
+      cost subscriber is attached.
+    * **Span tracer** — the dispatch is wrapped in a ``jit:<where>`` span
+      while tracing is active, so compiled-call time nests under the
+      caller's step/forward span in the flame graph."""
     size_fn = getattr(jitted, "_cache_size", None)
+    lower_fn = getattr(jitted, "lower", None)
     state = {"first": True}
+    costs: Dict[tuple, tuple] = {}
+    span_name = "jit:" + where
+
+    def _cost(args, kwargs):
+        try:
+            sig = _arg_signature(args, kwargs)
+        except Exception:
+            sig = None
+        if sig is not None:
+            hit = costs.get(sig)
+            if hit is not None:
+                return hit
+        val = (0.0, 0.0)
+        if lower_fn is not None:
+            try:
+                ca = _cost_analysis_dict(lower_fn(*args, **kwargs).compile())
+                val = (float(ca.get("flops", 0.0) or 0.0),
+                       float(ca.get("bytes accessed", 0.0) or 0.0))
+            except Exception:
+                pass
+        if sig is not None:
+            costs[sig] = val
+        return val
 
     def call(*args, **kwargs):
-        if not COMPILE.subscribers:
+        observing = bool(COMPILE.subscribers)
+        costing = bool(XLA_COST.subscribers)
+        tracing = tracer.active
+        if not (observing or costing or tracing):
             return jitted(*args, **kwargs)
-        if size_fn is not None:
+        flops = nbytes = 0.0
+        if costing:
+            flops, nbytes = _cost(args, kwargs)
+        before = None
+        if observing and size_fn is not None:
             try:
                 before = size_fn()
             except Exception:
                 before = None
-            t0 = time.perf_counter()
+        sp = tracer._begin(span_name, "jit",
+                           attrs={"flops": flops} if flops else None) \
+            if tracing else None
+        t0 = time.perf_counter()
+        try:
             out = jitted(*args, **kwargs)
-            dt = time.perf_counter() - t0
+        finally:
+            if sp is not None:
+                tracer._end(sp)
+        dt = time.perf_counter() - t0
+        if observing:
             grew = None
             if before is not None:
                 try:
@@ -496,21 +948,14 @@ def instrument_jit(where: str, jitted: Callable) -> Callable:
                     grew = None
             if grew is None:
                 grew = state["first"]
-            state["first"] = False
             if grew:
                 COMPILE.publish(where=where, event="miss", seconds=dt)
             else:
                 COMPILE.publish(where=where, event="hit")
-            return out
-        if state["first"]:
-            state["first"] = False
-            t0 = time.perf_counter()
-            out = jitted(*args, **kwargs)
-            COMPILE.publish(where=where, event="miss",
-                            seconds=time.perf_counter() - t0)
-            return out
-        COMPILE.publish(where=where, event="hit")
-        return jitted(*args, **kwargs)
+        state["first"] = False
+        if costing:
+            XLA_COST.publish(where=where, flops=flops, nbytes=nbytes)
+        return out
 
     call.__wrapped__ = jitted
     return call
@@ -563,6 +1008,22 @@ def _metrics_init():
                       "dataloader batches fetched")
     _m["fetch_wait"] = h("mx_dataloader_fetch_wait_seconds",
                          "consumer wait per dataloader batch")
+    g = registry.gauge
+    _m["xla_flops"] = c("mx_xla_flops_total",
+                        "cost-analysis FLOPs dispatched to compiled "
+                        "executables, by site")
+    _m["xla_bytes"] = c("mx_xla_bytes_total",
+                        "cost-analysis bytes accessed by compiled "
+                        "executables, by site")
+    _m["step_wall"] = h("mxtpu_step_seconds",
+                        "wall seconds between consecutive trainer step "
+                        "boundaries (the MFU window)")
+    _m["step_flops"] = g("mxtpu_step_flops",
+                         "cost-analysis FLOPs in the last step window")
+    _m["peak_flops"] = g("mxtpu_device_peak_flops",
+                         "detected aggregate device peak FLOP/s")
+    _m["mfu"] = g("mxtpu_mfu",
+                  "model FLOPs utilization over the last step window")
 
 
 _op_keys: Dict[str, tuple] = {}   # op name -> label key, spares the hot
@@ -611,10 +1072,42 @@ def _on_kvstore(op="push", nbytes=0, seconds=0.0):
         _m[key].observe(seconds)
 
 
+# MFU accounting state.  FLOPs accumulate from XLA_COST as executables
+# are dispatched; at each trainer-step boundary the window since the
+# PREVIOUS boundary is closed: mfu = window FLOPs / wall seconds / peak.
+# Wall time between boundaries (not the async dispatch seconds the
+# TRAINER event carries) is the honest denominator — the device is busy
+# long after dispatch returns.
+_mfu = {"flops": 0.0, "last_t": None, "last_flops": 0.0, "peak": None}
+
+
+def _on_xla_cost(where="?", flops=0.0, nbytes=0.0):
+    if flops:
+        _m["xla_flops"].inc(flops, site=where)
+        _mfu["flops"] += flops
+    if nbytes:
+        _m["xla_bytes"].inc(nbytes, site=where)
+
+
 def _on_trainer(phase="step", seconds=0.0):
     if phase == "step":
         _m["steps"].inc()
         _m["step_seconds"].observe(seconds)
+        now = time.perf_counter()
+        last_t = _mfu["last_t"]
+        if last_t is not None and now > last_t:
+            wall = now - last_t
+            dflops = _mfu["flops"] - _mfu["last_flops"]
+            _m["step_wall"].observe(wall)
+            _m["step_flops"].set(dflops)
+            peak = _mfu["peak"]
+            if peak is None:
+                peak = _mfu["peak"] = device_peak_flops() or 0.0
+                _m["peak_flops"].set(peak)
+            if peak > 0 and dflops > 0:
+                _m["mfu"].set(dflops / wall / peak)
+        _mfu["last_t"] = now
+        _mfu["last_flops"] = _mfu["flops"]
     else:
         _m["update_seconds"].observe(seconds)
 
@@ -633,12 +1126,13 @@ _HANDLERS = (
     (KVSTORE, _on_kvstore),
     (TRAINER, _on_trainer),
     (DATALOADER, _on_dataloader),
+    (XLA_COST, _on_xla_cost),
 )
 
 
 def start() -> None:
     """Begin collecting: subscribe the metric handlers to every runtime
-    topic.  Idempotent."""
+    topic and turn the span tracer on.  Idempotent."""
     global _started
     if _started:
         return
@@ -648,6 +1142,7 @@ def start() -> None:
         # per-op syncs that feed it — mx_op_seconds only fills while the
         # profiler (an active subscriber) has the timed path on
         topic.subscribe(fn, passive=topic is OP_TIMED)
+    tracer.enable()
     _started = True
 
 
@@ -656,6 +1151,8 @@ def stop() -> None:
     global _started
     for topic, fn in _HANDLERS:
         topic.unsubscribe(fn)
+    if _started:
+        tracer.disable()
     _started = False
 
 
@@ -664,8 +1161,11 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Zero all metric values."""
+    """Zero all metric values, drop recorded spans, restart the MFU
+    window."""
     registry.reset()
+    tracer.clear()
+    _mfu.update(flops=0.0, last_t=None, last_flops=0.0, peak=None)
 
 
 # ---------------------------------------------------------------------------
@@ -719,3 +1219,14 @@ if _dump_path:
 
 if getenv_bool("MXNET_TELEMETRY", False):
     start()
+
+_port = getenv("MXNET_TELEMETRY_PORT")
+if _port:
+    try:
+        start()
+        from . import telemetry_http as _telemetry_http
+        _telemetry_http.start_server(int(_port))
+    except Exception as _e:                       # never break import
+        import warnings
+        warnings.warn(f"MXNET_TELEMETRY_PORT={_port}: exporter not "
+                      f"started ({_e})")
