@@ -20,6 +20,12 @@ usage: ci/run_tests.sh <function>
                         and the snapshot reports a finite mfu > 0
   bench                 judged benchmark (prints one JSON line; includes a
                         telemetry snapshot when MXNET_TELEMETRY=1)
+  fault_smoke           resilience drill: tiny run with an injected
+                        transient kvstore fault, a mid-run kill (exit 17)
+                        and a checkpoint resume; asserts retries > 0, the
+                        resumed params are bit-identical to an
+                        uninterrupted golden run, and losses stay
+                        continuous across the kill
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -124,6 +130,25 @@ EOF
 
 bench() {
     python bench.py
+}
+
+fault_smoke() {
+    local out=/tmp/mxtpu_fault_smoke
+    rm -rf "$out"
+    local plan="kvstore.push:ioerror@2"
+    # golden: no faults, no kill — the reference trajectory
+    env -u MXNET_FAULT_PLAN python tools/fault_smoke.py golden --out "$out"
+    # kill: same run under an injected transient fault, preempted mid-run
+    set +e
+    MXNET_FAULT_PLAN="$plan" python tools/fault_smoke.py kill --out "$out"
+    local rc=$?
+    set -e
+    [ "$rc" -eq 17 ] || {
+        echo "fault_smoke: kill run exited $rc (wanted 17)"; exit 1; }
+    # resume: restore the checkpoint, absorb the fault again, finish
+    MXNET_FAULT_PLAN="$plan" python tools/fault_smoke.py resume --out "$out"
+    # check: bit-identical params, continuous losses
+    env -u MXNET_FAULT_PLAN python tools/fault_smoke.py check --out "$out"
 }
 
 multichip_dryrun() {

@@ -59,6 +59,7 @@ from . import numpy as np
 from . import numpy_extension as npx
 from . import engine
 from . import telemetry
+from . import fault
 from . import profiler
 from . import test_utils
 from . import library
@@ -73,4 +74,5 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
            "parallel", "symbol", "sym", "Executor", "io", "recordio",
            "image", "metric", "callback", "model", "module", "mod", "np",
-           "npx", "engine", "telemetry", "profiler", "runtime", "contrib"]
+           "npx", "engine", "telemetry", "fault", "profiler", "runtime",
+           "contrib"]

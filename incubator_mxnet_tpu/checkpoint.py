@@ -10,15 +10,34 @@ goodput-relevant pieces a pod run needs):
   never blocks on storage;
 * **atomic**: writes go to a temp file + os.replace, so a preemption
   mid-write never corrupts the newest checkpoint;
-* **retention**: keep the last k checkpoints (default 3);
-* **resume**: ``latest_checkpoint`` finds the newest complete step.
+* **full training state**: ``save(step, params, trainer=..., scaler=...,
+  epoch=...)`` additionally snapshots the Trainer's updater/optimizer
+  states, the LossScaler, and the RNG key streams, committed by a
+  manifest written LAST — a checkpoint without its manifest is
+  incomplete by definition, so a kill between the params publish and the
+  manifest publish can never shadow the previous complete step;
+* **retried storage**: every publish runs under ``fault.retry_call``
+  (site ``checkpoint.write``) — a transient IOError costs a retry, not
+  the checkpoint;
+* **retention**: keep the last k checkpoints (default 3), seeded from
+  ALL steps already on disk so a restarted run keeps garbage-collecting
+  its predecessor's files; orphaned ``*.tmp-<pid>`` files from an
+  interrupted write are swept at startup;
+* **resume**: ``latest_checkpoint`` finds the newest complete params
+  file; ``latest_resumable_step`` the newest step with a full-state
+  manifest; ``restore_into`` rehydrates params + trainer + scaler + RNG
+  in one call.
 
 Format: the same reference-compatible ``.params`` container
 (ndarray/utils.save) everything else uses, named ``<prefix>-NNNNNNN.params``
-— readable by load_checkpoint/load_parameters tooling.
+— readable by load_checkpoint/load_parameters tooling.  Full-state
+checkpoints add ``<prefix>-NNNNNNN.states`` (the Trainer's pickled
+updater/optimizer states) and ``<prefix>-NNNNNNN.meta.json`` (the
+manifest: step/epoch, RNG key streams, inlined scaler state, file map).
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -29,29 +48,62 @@ import numpy as _np
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
-__all__ = ["AsyncCheckpointer", "latest_checkpoint"]
+__all__ = ["AsyncCheckpointer", "latest_checkpoint", "all_checkpoints",
+           "latest_resumable_step"]
+
+MANIFEST_FORMAT = 1
 
 
 def _step_path(prefix: str, step: int) -> str:
     return f"{prefix}-{step:07d}.params"
 
 
-def latest_checkpoint(prefix: str) -> Optional[int]:
-    """Newest complete checkpoint step for ``prefix``, or None."""
+def _states_path(prefix: str, step: int) -> str:
+    return f"{prefix}-{step:07d}.states"
+
+
+def _meta_path(prefix: str, step: int) -> str:
+    return f"{prefix}-{step:07d}.meta.json"
+
+
+def _scan(prefix: str, suffix: str) -> List[int]:
+    """Steps for which ``<prefix>-NNNNNNN<suffix>`` exists, sorted."""
     d = os.path.dirname(prefix) or "."
     base = os.path.basename(prefix)
     # exact-prefix anchor: 'm' must not match 'model-*'; 7+ digits so
     # steps >= 10^7 (which format wider than the zero-padding) still parse
-    pat = re.compile(rf"^{re.escape(base)}-(\d{{7,}})\.params$")
-    best = None
+    pat = re.compile(rf"^{re.escape(base)}-(\d{{7,}}){re.escape(suffix)}$")
     if not os.path.isdir(d):
-        return None
+        return []
+    steps = []
     for name in os.listdir(d):
         m = pat.match(name)
         if m:
-            step = int(m.group(1))
-            best = step if best is None else max(best, step)
-    return best
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def all_checkpoints(prefix: str) -> List[int]:
+    """All complete checkpoint steps for ``prefix``, sorted ascending."""
+    return _scan(prefix, ".params")
+
+
+def latest_checkpoint(prefix: str) -> Optional[int]:
+    """Newest complete checkpoint step for ``prefix``, or None."""
+    steps = all_checkpoints(prefix)
+    return steps[-1] if steps else None
+
+
+def latest_resumable_step(prefix: str) -> Optional[int]:
+    """Newest step with a COMMITTED full-state checkpoint: the manifest
+    (written last) and the params file it points at must both exist, so
+    a write interrupted anywhere short of the manifest publish is
+    invisible here."""
+    have_params = set(all_checkpoints(prefix))
+    for step in reversed(_scan(prefix, ".meta.json")):
+        if step in have_params:
+            return step
+    return None
 
 
 class AsyncCheckpointer:
@@ -63,7 +115,8 @@ class AsyncCheckpointer:
         for step, batch in enumerate(loader):
             ...train...
             if step % 500 == 0:
-                ckpt.save(step, {name: p.data() for name, p in params})
+                ckpt.save(step, {name: p.data() for name, p in params},
+                          trainer=trainer)
         ckpt.wait_until_finished()    # before exit
     """
 
@@ -75,15 +128,45 @@ class AsyncCheckpointer:
         self._keep = max(1, int(keep))
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
-        self._saved_steps: List[int] = []
-        lt = latest_checkpoint(prefix)
-        if lt is not None:
-            self._saved_steps.append(lt)
+        self._sweep_orphans()
+        # seed retention from EVERY step already on disk — a restarted
+        # run must keep GC-ing its predecessor's checkpoints past `keep`
+        self._saved_steps: List[int] = all_checkpoints(prefix)
+
+    def _sweep_orphans(self):
+        """Remove ``*.tmp-<pid>`` leftovers of a write that a preemption
+        interrupted before its atomic os.replace — otherwise a
+        repeatedly-preempted run leaks temp files without bound."""
+        d = os.path.dirname(self._prefix) or "."
+        base = os.path.basename(self._prefix)
+        pat = re.compile(
+            rf"^{re.escape(base)}-\d{{7,}}"
+            rf"\.(?:params|states|meta\.json)\.tmp-\d+$")
+        if not os.path.isdir(d):
+            return
+        for name in os.listdir(d):
+            if pat.match(name):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------------
-    def save(self, step: int, params: Dict[str, NDArray]):
-        """Snapshot ``params`` and write asynchronously.  Raises any error
-        from the PREVIOUS save (errors never vanish silently)."""
+    def save(self, step: int, params: Dict[str, NDArray], trainer=None,
+             scaler=None, epoch: Optional[int] = None,
+             extra: Optional[dict] = None):
+        """Snapshot ``params`` (and optionally the full training state)
+        and write asynchronously.  Raises any error from the PREVIOUS
+        save (errors never vanish silently).
+
+        With only ``(step, params)`` this writes the legacy single
+        ``.params`` file.  Passing ``trainer`` / ``scaler`` / ``epoch`` /
+        ``extra`` upgrades it to a full-state checkpoint: the Trainer's
+        updater+optimizer states (``Trainer.get_states()``), the
+        LossScaler state, the RNG key streams, and ``extra`` are
+        captured ON THIS THREAD (so the training loop may mutate
+        everything freely after return) and committed by a
+        ``.meta.json`` manifest published after all data files."""
         self.wait_until_finished()
         # snapshot on the caller's thread: after return the trainer may
         # mutate the arrays freely
@@ -93,19 +176,69 @@ class AsyncCheckpointer:
                 snap[k] = v.asnumpy().copy()
             else:
                 snap[k] = _np.asarray(v).copy()
+        states = trainer.get_states() if trainer is not None else None
+        manifest = None
+        if trainer is not None or scaler is not None or epoch is not None \
+                or extra is not None:
+            from . import random as _random
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "step": int(step),
+                "rng": _random.get_state(),
+                "files": {
+                    "params": os.path.basename(
+                        _step_path(self._prefix, step)),
+                },
+            }
+            if epoch is not None:
+                manifest["epoch"] = int(epoch)
+            if states is not None:
+                manifest["files"]["states"] = os.path.basename(
+                    _states_path(self._prefix, step))
+            if scaler is not None:
+                manifest["scaler"] = scaler.get_state()
+            if extra is not None:
+                manifest["extra"] = extra
         self._thread = threading.Thread(
-            target=self._write, args=(step, snap), daemon=True)
+            target=self._write, args=(step, snap, states, manifest),
+            daemon=True)
         self._thread.start()
 
-    def _write(self, step: int, snap: Dict[str, _np.ndarray]):
+    def _publish(self, path: str, write_fn):
+        """tmp-write + atomic rename, with transient storage errors
+        absorbed by retry (the injection site fires before any bytes are
+        written, so a retried attempt replays cleanly)."""
+        from . import fault as _fault
+        tmp = f"{path}.tmp-{os.getpid()}"
+
+        def attempt():
+            _fault.inject("checkpoint.write")
+            write_fn(tmp)
+            os.replace(tmp, path)    # atomic publish
+
+        _fault.retry_call(attempt, site="checkpoint.write")
+
+    def _write(self, step: int, snap: Dict[str, _np.ndarray],
+               states: Optional[bytes], manifest: Optional[dict]):
         try:
             from .ndarray import utils as nd_utils
-            final = _step_path(self._prefix, step)
-            tmp = f"{final}.tmp-{os.getpid()}"
             # host numpy straight into the container format — no
             # host->device->host round trip on the background thread
-            nd_utils.save(tmp, snap)
-            os.replace(tmp, final)    # atomic publish
+            self._publish(_step_path(self._prefix, step),
+                          lambda tmp: nd_utils.save(tmp, snap))
+            if states is not None:
+                def write_states(tmp, _b=states):
+                    with open(tmp, "wb") as f:
+                        f.write(_b)
+                self._publish(_states_path(self._prefix, step),
+                              write_states)
+            if manifest is not None:
+                # the COMMIT record: published last, so every file it
+                # names is already in place when it becomes visible
+                def write_meta(tmp, _m=manifest):
+                    with open(tmp, "w") as f:
+                        json.dump(_m, f, indent=1)
+                self._publish(_meta_path(self._prefix, step), write_meta)
             self._saved_steps.append(step)
             self._gc()
         except BaseException as e:   # surfaced on the next save()/wait
@@ -115,10 +248,13 @@ class AsyncCheckpointer:
         self._saved_steps.sort()
         while len(self._saved_steps) > self._keep:
             step = self._saved_steps.pop(0)
-            try:
-                os.unlink(_step_path(self._prefix, step))
-            except OSError:
-                pass
+            for path in (_meta_path(self._prefix, step),
+                         _states_path(self._prefix, step),
+                         _step_path(self._prefix, step)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def wait_until_finished(self):
         """Block until the in-flight write completes; re-raise its error."""
@@ -132,7 +268,7 @@ class AsyncCheckpointer:
 
     # ------------------------------------------------------------------
     def restore(self, step: Optional[int] = None) -> Dict[str, NDArray]:
-        """Load the checkpoint at ``step`` (default: newest)."""
+        """Load the params at ``step`` (default: newest)."""
         from .ndarray import utils as nd_utils
         if step is None:
             step = latest_checkpoint(self._prefix)
@@ -140,3 +276,66 @@ class AsyncCheckpointer:
                 raise MXNetError(
                     f"no checkpoint found for prefix {self._prefix!r}")
         return nd_utils.load(_step_path(self._prefix, step))
+
+    def latest_resumable_step(self) -> Optional[int]:
+        return latest_resumable_step(self._prefix)
+
+    def restore_full(self, step: Optional[int] = None) -> dict:
+        """Load a full-state checkpoint: the parsed manifest plus
+        ``params`` (name → NDArray) and raw ``trainer_states`` bytes
+        (None when the checkpoint carried no trainer)."""
+        from .ndarray import utils as nd_utils
+        if step is None:
+            step = self.latest_resumable_step()
+            if step is None:
+                raise MXNetError(
+                    f"no resumable (full-state) checkpoint for prefix "
+                    f"{self._prefix!r}")
+        meta = _meta_path(self._prefix, step)
+        try:
+            with open(meta) as f:
+                state = json.load(f)
+        except OSError as e:
+            raise MXNetError(
+                f"checkpoint step {step} has no manifest {meta!r} — not "
+                f"a full-state checkpoint (use restore())") from e
+        state["params"] = nd_utils.load(_step_path(self._prefix, step))
+        state["trainer_states"] = None
+        if state.get("files", {}).get("states"):
+            with open(_states_path(self._prefix, step), "rb") as f:
+                state["trainer_states"] = f.read()
+        return state
+
+    def restore_into(self, params=None, trainer=None, scaler=None,
+                     step: Optional[int] = None) -> Optional[int]:
+        """Rehydrate a killed run from the newest complete full-state
+        checkpoint (or ``step``): copy saved arrays into ``params`` (a
+        ParameterDict / name→Parameter mapping), restore the Trainer's
+        updater/optimizer states, the LossScaler, and the RNG key
+        streams.  Returns the restored step, or None when no full-state
+        checkpoint exists — callers start fresh in that case."""
+        if step is None:
+            step = self.latest_resumable_step()
+            if step is None:
+                return None
+        state = self.restore_full(step)
+        if params is not None:
+            for name, arr in state["params"].items():
+                if name not in params:
+                    continue
+                p = params[name]
+                if (getattr(p, "_data", 1) is None
+                        and getattr(p, "_deferred_init", None) is not None):
+                    # net not yet shaped by a forward pass: the saved
+                    # array knows the shape — finish deferred init here
+                    p.shape = arr.shape
+                    p._finish_deferred_init()
+                p.set_data(arr)
+        if trainer is not None and state.get("trainer_states"):
+            trainer.set_states(state["trainer_states"])
+        if scaler is not None and state.get("scaler"):
+            scaler.set_state(state["scaler"])
+        if state.get("rng"):
+            from . import random as _random
+            _random.set_state(state["rng"])
+        return step

@@ -21,10 +21,11 @@ from contextlib import contextmanager
 
 from ...base import MXNetError
 from . import lists
-from .loss_scaler import LossScaler
+from .loss_scaler import LossScaler, all_finite
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
-           "convert_hybrid_block", "convert_model", "lists", "LossScaler"]
+           "convert_hybrid_block", "convert_model", "lists", "LossScaler",
+           "all_finite"]
 
 _state = {"initialized": False, "target_dtype": None}
 

@@ -6,7 +6,24 @@ trains unscaled — the scaler then stays at 1.0 and never skips.
 """
 from __future__ import annotations
 
-__all__ = ["LossScaler"]
+__all__ = ["LossScaler", "all_finite"]
+
+
+def all_finite(arrays) -> bool:
+    """One fused all-finite check over many arrays (reference:
+    multi_all_finite).  Per-array finite flags are combined device-side
+    with logical_and, so the whole sweep costs a SINGLE blocking host
+    sync — the per-param ``bool(isfinite(...).all())`` loop it replaces
+    paid one sync per parameter."""
+    import jax.numpy as jnp
+    flag = None
+    for a in arrays:
+        data = getattr(a, "_data", a)
+        if not jnp.issubdtype(data.dtype, jnp.inexact):
+            continue                     # integer grads are always finite
+        f = jnp.isfinite(data).all()
+        flag = f if flag is None else jnp.logical_and(flag, f)
+    return True if flag is None else bool(flag)   # the one sync
 
 
 class LossScaler:
@@ -20,13 +37,9 @@ class LossScaler:
     def has_overflow(self, params):
         """True if any gradient is non-finite (reference:
         LossScaler.has_overflow via multi_all_finite)."""
-        import jax.numpy as jnp
-        for p in params:
-            if p.grad_req == "null" or p.grad() is None:
-                continue
-            if not bool(jnp.isfinite(p.grad()._data).all()):
-                return True
-        return False
+        grads = [p.grad() for p in params
+                 if p.grad_req != "null" and p.grad() is not None]
+        return not all_finite(grads)
 
     def update_scale(self, overflow: bool):
         """Halve on overflow; double every scale_window clean steps
@@ -39,3 +52,14 @@ class LossScaler:
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+
+    # checkpoint/resume: the scale and the clean-step counter ARE the
+    # scaler — losing them on preemption restarts the warmup from 2^16
+    # and skips the first post-resume steps for nothing
+    def get_state(self) -> dict:
+        return {"loss_scale": self.loss_scale,
+                "unskipped": self._unskipped}
+
+    def set_state(self, state: dict) -> None:
+        self.loss_scale = float(state["loss_scale"])
+        self._unskipped = int(state.get("unskipped", 0))

@@ -1,0 +1,317 @@
+"""Deterministic fault injection + retry with jittered exponential
+backoff (the resilience layer; see docs/robustness.md).
+
+Pod-scale TPU training meets transient failures as a matter of course —
+preempted hosts, flaky DCN links, slow storage.  The reference's answer
+is restart-from-epoch-checkpoint; this module makes failure a
+first-class, *testable* runtime concept instead:
+
+* **Fault plan** — an env/API-configurable schedule of injected faults at
+  named sites (``MXNET_FAULT_PLAN``).  Sites are plain strings; the
+  instrumented ones are ``kvstore.push`` / ``kvstore.pull`` /
+  ``kvstore.pushpull`` (transport), ``dataloader.fetch`` (input
+  pipeline), ``checkpoint.write`` (storage), and ``trainer.grad``
+  (numerics).  Kinds: ``ioerror`` (raise a transient
+  :class:`FaultInjected`), ``latency`` (sleep), ``nonfinite`` (poison a
+  gradient — consumed by the trainer's guard via :func:`take`).
+  Injection is deterministic: each site keeps a call counter and a rule
+  names the 1-based call indices it fires on, so a test or CI run can
+  say "the 2nd kvstore push fails" and get exactly that.
+
+  Plan syntax (``;``-separated rules)::
+
+      rule  := site ":" kind [":" arg] ["@" calls]
+      calls := N | N-M | "every=" K          (default: 1)
+
+      MXNET_FAULT_PLAN="kvstore.push:ioerror@2;dataloader.fetch:latency:0.05@1-3"
+
+* **Retry** — :func:`retry_call` wraps a callable in retries with
+  jittered exponential backoff under a wall-clock deadline
+  (:class:`RetryPolicy`; knobs ``MXNET_RETRY_MAX``,
+  ``MXNET_RETRY_BASE_SECONDS``, ``MXNET_RETRY_DEADLINE_SECONDS``).  The
+  kvstore transport and checkpoint storage writes run through it, so a
+  transient failure (injected or real) costs a retry, not the run.
+
+Every injection, retry, give-up, skipped step, and dataloader fallback
+is published on the telemetry ``FAULT`` topic and lands in the
+``mxtpu_faults_injected`` / ``mxtpu_retries`` / ``mxtpu_giveups`` /
+``mxtpu_skipped_steps`` / ``mxtpu_dataloader_fallbacks`` counters
+(docs/observability.md).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+from .base import MXNetError, getenv
+from . import telemetry as _telemetry
+
+__all__ = [
+    "FaultInjected", "FaultRule", "FaultPlan", "RetryPolicy",
+    "install_plan", "clear_plan", "current_plan", "active",
+    "inject", "take", "site_calls", "retry_call", "TRANSIENT",
+]
+
+KINDS = ("ioerror", "latency", "nonfinite")
+
+
+class FaultInjected(IOError):
+    """Raised by an injected ``ioerror`` fault.  An :class:`IOError`
+    subclass so the retry layer (and any caller handling real transient
+    storage/transport failures) treats it identically."""
+
+    def __init__(self, site: str, rule: "FaultRule"):
+        msg = rule.message or f"injected fault at {site} ({rule})"
+        super().__init__(msg)
+        self.site = site
+
+
+class FaultRule:
+    """One parsed plan rule: which ``kind`` fires at ``site`` on which
+    1-based call indices."""
+
+    __slots__ = ("site", "kind", "seconds", "message", "every", "lo", "hi")
+
+    def __init__(self, site: str, kind: str, arg: Optional[str],
+                 calls: str):
+        if kind not in KINDS:
+            raise MXNetError(
+                f"fault rule {site!r}: unknown kind {kind!r} "
+                f"(expected one of {KINDS})")
+        self.site = site
+        self.kind = kind
+        self.seconds = None
+        self.message = None
+        if kind == "latency":
+            try:
+                self.seconds = float(arg) if arg else 0.05
+            except ValueError:
+                raise MXNetError(
+                    f"fault rule {site!r}: latency arg {arg!r} is not a "
+                    f"number of seconds")
+        elif kind == "ioerror":
+            self.message = arg
+        self.every = None
+        self.lo = self.hi = None
+        try:
+            if calls.startswith("every="):
+                self.every = int(calls[len("every="):])
+                if self.every <= 0:
+                    raise ValueError
+            elif "-" in calls:
+                lo, hi = calls.split("-", 1)
+                self.lo, self.hi = int(lo), int(hi)
+            else:
+                self.lo = self.hi = int(calls)
+        except ValueError:
+            raise MXNetError(
+                f"fault rule {site!r}: bad call spec {calls!r} "
+                f"(expected N, N-M, or every=K)")
+
+    def fires(self, n: int) -> bool:
+        if self.every is not None:
+            return n % self.every == 0
+        return self.lo <= n <= self.hi
+
+    def __repr__(self):
+        calls = f"every={self.every}" if self.every is not None else (
+            str(self.lo) if self.lo == self.hi else f"{self.lo}-{self.hi}")
+        arg = "" if self.seconds is None else f":{self.seconds}"
+        return f"{self.site}:{self.kind}{arg}@{calls}"
+
+
+class FaultPlan:
+    """Rules grouped by site + thread-safe deterministic call counters."""
+
+    def __init__(self, rules: List[FaultRule]):
+        self.rules: Dict[str, List[FaultRule]] = {}
+        for r in rules:
+            self.rules.setdefault(r.site, []).append(r)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def fire(self, site: str) -> List[FaultRule]:
+        """Count one call at ``site``; return the rules that fire on it."""
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+        return [r for r in self.rules.get(site, ()) if r.fires(n)]
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def __repr__(self):
+        return "FaultPlan(%s)" % "; ".join(
+            repr(r) for rs in self.rules.values() for r in rs)
+
+
+def _parse_plan(spec: str) -> FaultPlan:
+    rules = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        body, _, calls = chunk.partition("@")
+        parts = body.split(":")
+        if len(parts) < 2 or not parts[0].strip():
+            raise MXNetError(
+                f"fault rule {chunk!r}: expected site:kind[:arg][@calls]")
+        site = parts[0].strip()
+        kind = parts[1].strip().lower()
+        arg = ":".join(parts[2:]).strip() or None
+        rules.append(FaultRule(site, kind, arg, calls.strip() or "1"))
+    return FaultPlan(rules)
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def install_plan(spec) -> FaultPlan:
+    """Install a fault plan (a spec string or a :class:`FaultPlan`);
+    replaces any current plan and resets call counters."""
+    global _plan
+    _plan = spec if isinstance(spec, FaultPlan) else _parse_plan(spec)
+    return _plan
+
+
+def clear_plan() -> None:
+    global _plan
+    _plan = None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def site_calls(site: str) -> int:
+    """How many times ``site`` has been polled (0 without a plan)."""
+    plan = _plan
+    return plan.calls(site) if plan is not None else 0
+
+
+def inject(site: str) -> None:
+    """Poll ``site`` against the plan: sleep for ``latency`` rules, raise
+    :class:`FaultInjected` for ``ioerror`` rules.  A single attribute
+    check when no plan is installed — safe on hot paths."""
+    plan = _plan
+    if plan is None:
+        return
+    for r in plan.fire(site):
+        if r.kind == "latency":
+            _telemetry.FAULT.publish(site=site, event="injected",
+                                     kind=r.kind)
+            _time.sleep(r.seconds)
+        elif r.kind == "ioerror":
+            _telemetry.FAULT.publish(site=site, event="injected",
+                                     kind=r.kind)
+            raise FaultInjected(site, r)
+        # 'nonfinite' rules are consumed via take() at numeric sites
+
+
+def take(site: str, kind: str) -> bool:
+    """Poll ``site``; True when a rule of ``kind`` fires on this call.
+    Used for faults the *caller* realizes (e.g. the trainer poisons a
+    gradient when a ``nonfinite`` rule fires)."""
+    plan = _plan
+    if plan is None:
+        return False
+    hit = False
+    for r in plan.fire(site):
+        if r.kind == kind:
+            _telemetry.FAULT.publish(site=site, event="injected",
+                                     kind=r.kind)
+            hit = True
+    return hit
+
+
+# ---------------------------------------------------------------------------
+# Retry with jittered exponential backoff + deadline
+# ---------------------------------------------------------------------------
+# What counts as transient: OS/storage/transport errors (FaultInjected is
+# an IOError == OSError).  Framework errors (MXNetError) are NOT retried —
+# a missing kvstore key will not fix itself.
+TRANSIENT = (OSError, TimeoutError)
+
+
+class RetryPolicy:
+    """Backoff schedule: delay(attempt) = min(max_delay, base *
+    multiplier^(attempt-1)), jittered DOWNWARD by up to ``jitter`` so
+    synchronized workers de-correlate.  Jitter draws from a seeded
+    generator — deterministic per policy instance, reproducible in CI."""
+
+    def __init__(self, max_retries: Optional[int] = None,
+                 base_seconds: Optional[float] = None,
+                 multiplier: float = 2.0,
+                 max_delay_seconds: float = 2.0,
+                 deadline_seconds: Optional[float] = None,
+                 jitter: float = 0.5, seed: int = 0x5EED):
+        self.max_retries = int(getenv("MXNET_RETRY_MAX", 4)) \
+            if max_retries is None else int(max_retries)
+        self.base_seconds = float(getenv("MXNET_RETRY_BASE_SECONDS", 0.05)) \
+            if base_seconds is None else float(base_seconds)
+        self.multiplier = float(multiplier)
+        self.max_delay_seconds = float(max_delay_seconds)
+        self.deadline_seconds = float(
+            getenv("MXNET_RETRY_DEADLINE_SECONDS", 30.0)) \
+            if deadline_seconds is None else float(deadline_seconds)
+        self.jitter = float(jitter)
+        self._rng = _pyrandom.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.max_delay_seconds,
+                self.base_seconds * (self.multiplier ** (attempt - 1)))
+        return d * (1.0 - self.jitter * self._rng.random())
+
+
+def retry_call(fn, *args, site: str = "?",
+               policy: Optional[RetryPolicy] = None,
+               retry_on=TRANSIENT, **kwargs):
+    """Call ``fn(*args, **kwargs)``, absorbing up to
+    ``policy.max_retries`` transient failures with backoff, under a
+    wall-clock deadline.  Each retry publishes a ``FAULT`` ``retry``
+    event (→ ``mxtpu_retries``); exhaustion publishes ``giveup``
+    (→ ``mxtpu_giveups``) and re-raises the last error.  The success
+    path costs one try/except frame — no policy object is built unless
+    something actually fails."""
+    try:
+        return fn(*args, **kwargs)
+    except retry_on as e:
+        err = e
+    if policy is None:
+        policy = RetryPolicy()
+    deadline = _time.monotonic() + policy.deadline_seconds
+    attempt = 0
+    while True:
+        attempt += 1
+        delay = policy.delay(attempt)
+        if attempt > policy.max_retries \
+                or _time.monotonic() + delay > deadline:
+            _telemetry.FAULT.publish(site=site, event="giveup",
+                                     kind=type(err).__name__)
+            raise err
+        _telemetry.FAULT.publish(site=site, event="retry",
+                                 kind=type(err).__name__,
+                                 attempt=attempt, seconds=delay)
+        _time.sleep(delay)
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            err = e
+
+
+# env-configured plan (reference-style config plane; docs/env_var.md)
+_spec = getenv("MXNET_FAULT_PLAN")
+if _spec:
+    try:
+        install_plan(_spec)
+    except MXNetError as _e:
+        import warnings
+        warnings.warn(f"MXNET_FAULT_PLAN ignored: {_e}")
+del _spec

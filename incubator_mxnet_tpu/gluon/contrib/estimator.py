@@ -87,6 +87,9 @@ class Estimator:
         self.train_loss = 0.0
         self.val_metrics = []
         self.stop_training = False
+        # a resume-aware CheckpointHandler sets this in train_begin; fit()
+        # then starts the epoch loop there instead of at 0
+        self.resume_from_epoch = 0
 
     # ------------------------------------------------------------------
     def _batches(self, data):
@@ -139,9 +142,10 @@ class Estimator:
         self.val_metrics = []
         self.val_metrics_epoch = -1
         self.processed_samples = 0
-        fire("train_begin")
+        self.resume_from_epoch = 0
+        fire("train_begin")   # a resuming CheckpointHandler restores here
         try:
-            for epoch in range(epochs):
+            for epoch in range(self.resume_from_epoch, epochs):
                 self.current_epoch = epoch
                 for m in self.train_metrics:
                     m.reset()
@@ -215,20 +219,50 @@ class LoggingHandler(TrainBegin, EpochEnd, TrainEnd):
               f"({estimator.processed_samples} samples)")
 
 
-class CheckpointHandler(EpochEnd):
-    """Save params every epoch (reference: CheckpointHandler; rides the
-    async checkpointer)."""
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save every epoch (reference: CheckpointHandler; rides the async
+    checkpointer).
 
-    def __init__(self, model_dir, model_prefix="model", keep=3):
+    With ``save_states=True`` (default) each checkpoint is a FULL training
+    snapshot — params + Trainer optimizer states + loss-scaler + RNG —
+    published atomically.  With ``resume=True``, ``train_begin`` rehydrates
+    net/trainer/scaler/RNG from the newest complete checkpoint and tells
+    ``fit`` to continue from the following epoch, so a preempted run picks
+    up where it stopped instead of restarting."""
+
+    def __init__(self, model_dir, model_prefix="model", keep=3,
+                 resume=False, save_states=True):
         from ...checkpoint import AsyncCheckpointer
         import os
         self._ckpt = AsyncCheckpointer(
             os.path.join(model_dir, model_prefix), keep=keep)
+        self._resume = bool(resume)
+        self._save_states = bool(save_states)
+
+    def train_begin(self, estimator):
+        if not self._resume:
+            return
+        scaler = getattr(estimator.trainer, "_amp_loss_scaler", None)
+        step = self._ckpt.restore_into(
+            params=estimator.net.collect_params(),
+            trainer=estimator.trainer,
+            scaler=scaler)
+        if step is not None:
+            # checkpoints are stamped with the epoch they finished —
+            # resume at the next one
+            estimator.resume_from_epoch = step + 1
 
     def epoch_end(self, estimator):
-        self._ckpt.save(estimator.current_epoch,
-                        {k: p.data() for k, p in
-                         estimator.net.collect_params().items()})
+        params = {k: p.data() for k, p in
+                  estimator.net.collect_params().items()}
+        if self._save_states:
+            self._ckpt.save(
+                estimator.current_epoch, params,
+                trainer=estimator.trainer,
+                scaler=getattr(estimator.trainer, "_amp_loss_scaler", None),
+                epoch=estimator.current_epoch)
+        else:
+            self._ckpt.save(estimator.current_epoch, params)
 
     def train_end(self, estimator):
         self._ckpt.wait_until_finished()

@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as _np
 
 from ...base import MXNetError
+from ... import fault as _fault
 from ... import telemetry as _telemetry
 from ...ndarray import ndarray as _ndmod
 from ...ndarray.ndarray import NDArray
@@ -162,12 +163,25 @@ class DataLoader:
     def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
+                _fault.inject("dataloader.fetch")
                 yield self._make_batch(indices)
             return
         if self._thread_pool:
             yield from self._iter_threaded()
         else:
             yield from self._iter_multiprocess()
+
+    def _fallback_batch(self, indices, exc):
+        """A worker crashed or its result is unusable: rebuild the batch
+        in-process so the epoch survives (graceful degradation — one slow
+        batch instead of a dead run).  Publishes a FAULT fallback event so
+        ``mxtpu_dataloader_fallbacks`` records the rescue."""
+        import logging
+        logging.getLogger(__name__).warning(
+            "dataloader worker failed (%s: %s); rebuilding batch of %d "
+            "samples in-process", type(exc).__name__, exc, len(indices))
+        _telemetry.FAULT.publish(site="dataloader.fetch", event="fallback")
+        return self._make_batch(indices)
 
     def _iter_threaded(self):
         # prefetching pool: keep `prefetch` batch futures in flight
@@ -176,22 +190,31 @@ class DataLoader:
             inflight = []
             try:
                 for _ in range(max(1, self._prefetch)):
-                    inflight.append(pool.submit(self._make_batch,
-                                                next(batches)))
+                    indices = next(batches)
+                    inflight.append(
+                        (pool.submit(self._make_batch, indices), indices))
             except StopIteration:
                 pass
             while inflight:
-                fut = inflight.pop(0)
+                fut, indices = inflight.pop(0)
                 try:
-                    inflight.append(pool.submit(self._make_batch,
-                                                next(batches)))
+                    nxt = next(batches)
+                    inflight.append(
+                        (pool.submit(self._make_batch, nxt), nxt))
                 except StopIteration:
                     pass
-                yield fut.result()
+                try:
+                    _fault.inject("dataloader.fetch")
+                    batch = fut.result()
+                except Exception as exc:     # noqa: BLE001 — rescue any
+                    batch = self._fallback_batch(indices, exc)
+                yield batch
 
     def _iter_multiprocess(self):
         """Reference _MultiWorkerIter flow: dispatch index batches to forked
-        workers, keep `prefetch` in flight, reorder-free FIFO collection."""
+        workers, keep `prefetch` in flight, reorder-free FIFO collection.
+        A crashed/hung worker result falls back to an in-process rebuild of
+        the same index batch (order and content preserved)."""
         global _worker_dataset, _worker_batchify
         ctx = multiprocessing.get_context("fork")
         _worker_dataset = self._dataset
@@ -202,18 +225,25 @@ class DataLoader:
             inflight = []
             try:
                 for _ in range(max(1, self._prefetch)):
-                    inflight.append(pool.apply_async(_worker_fn,
-                                                     (next(batches),)))
+                    indices = next(batches)
+                    inflight.append(
+                        (pool.apply_async(_worker_fn, (indices,)), indices))
             except StopIteration:
                 pass
             while inflight:
-                res = inflight.pop(0)
+                res, indices = inflight.pop(0)
                 try:
-                    inflight.append(pool.apply_async(_worker_fn,
-                                                     (next(batches),)))
+                    nxt = next(batches)
+                    inflight.append(
+                        (pool.apply_async(_worker_fn, (nxt,)), nxt))
                 except StopIteration:
                     pass
-                yield _to_device(res.get(self._timeout))
+                try:
+                    _fault.inject("dataloader.fetch")
+                    batch = res.get(self._timeout)
+                except Exception as exc:     # noqa: BLE001 — rescue any
+                    batch = self._fallback_batch(indices, exc)
+                yield _to_device(batch)
         finally:
             pool.terminate()
             pool.join()

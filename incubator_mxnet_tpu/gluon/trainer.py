@@ -12,9 +12,10 @@ from __future__ import annotations
 import time as _time
 from typing import Optional
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv_bool
 from .. import optimizer as opt_mod
 from .. import telemetry as _telemetry
+from .. import fault as _fault
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -23,7 +24,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, skip_nonfinite=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -51,6 +52,11 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._states_to_load = None
+        # opt-in non-finite grad guard (graceful degradation: skip the
+        # update instead of corrupting params); costs one fused device
+        # sync per step, so it stays off unless asked for
+        self._skip_nonfinite = getenv_bool("MXNET_SKIP_NONFINITE", False) \
+            if skip_nonfinite is None else bool(skip_nonfinite)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -114,7 +120,13 @@ class Trainer:
         """One optimization step; grads are rescaled by 1/batch_size
         (reference: Trainer.step).  Timing is dispatch time: the update
         itself is async, so blocking waits show up in the op/sync planes,
-        not here."""
+        not here.
+
+        With ``skip_nonfinite`` on (ctor arg or ``MXNET_SKIP_NONFINITE``),
+        a step whose gradients contain NaN/Inf is SKIPPED — grads are
+        zeroed, ``mxtpu_skipped_steps`` is bumped, and params stay
+        untouched — instead of poisoning the weights and every step
+        after."""
         observe = bool(_telemetry.TRAINER.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         with _telemetry.trace_span("trainer.step", cat="trainer",
@@ -123,11 +135,37 @@ class Trainer:
                 self._init_kvstore()
             self._optimizer.rescale_grad = self._scale / batch_size
             self._allreduce_grads()
-            with _telemetry.trace_span("trainer.update", cat="trainer"):
-                self._update(ignore_stale_grad)
+            if _fault.take("trainer.grad", "nonfinite"):
+                self._poison_grads()
+            if self._skip_nonfinite and self._grads_nonfinite():
+                _telemetry.FAULT.publish(site="trainer.step",
+                                         event="skipped_step")
+                for p in self._params:
+                    if p.grad_req != "null":
+                        p.zero_grad()
+            else:
+                with _telemetry.trace_span("trainer.update", cat="trainer"):
+                    self._update(ignore_stale_grad)
         if observe:
             _telemetry.TRAINER.publish(
                 phase="step", seconds=_time.perf_counter() - t0)
+
+    def _grads_nonfinite(self) -> bool:
+        # one fused check, one host sync (amp.all_finite)
+        from ..contrib.amp.loss_scaler import all_finite
+        grads = [p.grad() for p in self._params
+                 if p.grad_req != "null" and p.grad() is not None]
+        return not all_finite(grads)
+
+    def _poison_grads(self):
+        """Inject a non-finite gradient (fault site ``trainer.grad``) —
+        the deterministic test hook behind the skip guard."""
+        import jax.numpy as jnp
+        for p in self._params:
+            if p.grad_req != "null" and p.grad() is not None:
+                g = p.grad()
+                g._set_data(jnp.full_like(g._data, jnp.nan))
+                break
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -175,6 +213,27 @@ class Trainer:
             self._updaters(i, p.grad(), p.data())
 
     # ------------------------------------------------------------------
+    def get_states(self) -> bytes:
+        """Serialized updater states incl. the optimizer (the in-memory
+        twin of save_states — the checkpointer snapshots these on the
+        caller thread so the async write sees a frozen picture)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            return self._kvstore._updater.get_states(dump_optimizer=True)
+        return self._updaters.get_states(dump_optimizer=True)
+
+    def set_states(self, states: bytes):
+        """Restore updater states serialized by :meth:`get_states`."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore._updater.set_states(states)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            self._updaters.set_states(states)
+            self._optimizer = self._updaters.optimizer
+
     def save_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()

@@ -29,6 +29,7 @@ import time as _time
 from typing import Dict, List, Optional
 
 from .base import MXNetError
+from . import fault as _fault
 from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 from .ndarray import ndarray as _ndmod
@@ -224,10 +225,27 @@ class KVStore:
         return NDArray(q, ctx=value.ctx)
 
     # ------------------------------------------------------------------
+    def _push_one(self, k, agg):
+        """The retried unit of push: transport + store mutation for one
+        key.  The fault site fires FIRST, before any mutation, so a
+        retried attempt replays an idempotent computation (compression's
+        error-feedback residual is updated by the caller, outside the
+        retry, exactly once per push)."""
+        _fault.inject("kvstore.push")
+        if self._is_dist():
+            agg = self._cross_process_sum(agg)
+        if self._updater is not None:
+            self._updater(_key_int(k), agg, self._store[k])
+        else:
+            self._store[k] = agg.copy()
+
     def push(self, key, value, priority=0):
         """Push value(s); multiple values per key are summed; dist types
         also sum across processes.  With an updater set, the update is
-        applied here — the 'update_on_kvstore' path."""
+        applied here — the 'update_on_kvstore' path.  Transport faults
+        (OSError/TimeoutError — DCN hiccups, injected IOErrors) are
+        absorbed by jittered-backoff retries; MXNetError (bad key, bad
+        usage) is never retried."""
         observe = bool(_telemetry.KVSTORE.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         nbytes = 0
@@ -239,19 +257,30 @@ class KVStore:
                     raise MXNetError(f"key {k!r} was not init()-ed")
                 if observe:
                     nbytes += _nd_nbytes(agg)
-                if self._is_dist():
-                    if self._compression_params and \
-                            self._compression_params.get("type") == "2bit":
-                        agg = self._compress(k, agg)
-                    agg = self._cross_process_sum(agg)
-                if self._updater is not None:
-                    self._updater(_key_int(k), agg, self._store[k])
-                else:
-                    self._store[k] = agg.copy()
+                if self._is_dist() and self._compression_params and \
+                        self._compression_params.get("type") == "2bit":
+                    agg = self._compress(k, agg)
+                _fault.retry_call(self._push_one, k, agg,
+                                  site="kvstore.push")
         if observe:
             _telemetry.KVSTORE.publish(
                 op="push", nbytes=nbytes,
                 seconds=_time.perf_counter() - t0)
+
+    def _pull_one(self, src, targets):
+        """The retried unit of pull: transport + target copies for one
+        key.  Copies overwrite the targets wholesale, so a replay after
+        a mid-copy fault converges to the same picture."""
+        _fault.inject("kvstore.pull")
+        from .ndarray import sparse as _sp
+        for t in targets:
+            if isinstance(t, _sp.BaseSparseNDArray):
+                t._replace_with(src if src.stype == t.stype
+                                else src.tostype(t.stype))
+            elif isinstance(src, _sp.BaseSparseNDArray):
+                src.tostype("default").copyto(t)
+            else:
+                src.copyto(t)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         observe = bool(_telemetry.KVSTORE.subscribers)
@@ -266,15 +295,8 @@ class KVStore:
                 targets = o if isinstance(o, (list, tuple)) else [o]
                 if observe:
                     nbytes += _nd_nbytes(src) * len(targets)
-                from .ndarray import sparse as _sp
-                for t in targets:
-                    if isinstance(t, _sp.BaseSparseNDArray):
-                        t._replace_with(src if src.stype == t.stype
-                                        else src.tostype(t.stype))
-                    elif isinstance(src, _sp.BaseSparseNDArray):
-                        src.tostype("default").copyto(t)
-                    else:
-                        src.copyto(t)
+                _fault.retry_call(self._pull_one, src, targets,
+                                  site="kvstore.pull")
         if observe:
             _telemetry.KVSTORE.publish(
                 op="pull", nbytes=nbytes,
@@ -287,6 +309,10 @@ class KVStore:
         observe = bool(_telemetry.KVSTORE.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         with _telemetry.trace_span("kvstore.pushpull", cat="kvstore"):
+            # own fault site (retry-wrapped so an injected transient is
+            # absorbed here); the nested push/pull keep their own sites
+            _fault.retry_call(_fault.inject, "kvstore.pushpull",
+                              site="kvstore.pushpull")
             self.push(key, value, priority)
             if out is not None:
                 self.pull(key, out, priority)

@@ -12,7 +12,8 @@ import threading
 
 from .context import Context, current_context
 
-__all__ = ["seed", "new_key", "current_key", "numpy_rng", "trace_stream"]
+__all__ = ["seed", "new_key", "current_key", "numpy_rng", "trace_stream",
+           "get_state", "set_state"]
 
 _lock = threading.Lock()
 _streams: dict = {}
@@ -89,6 +90,45 @@ def numpy_rng(ctx=None):
     kv = np.asarray(key, dtype=np.uint32).reshape(-1)
     s = int(kv[0]) << 32 | int(kv[-1])
     return _np.random.default_rng(s)
+
+
+def get_state() -> dict:
+    """JSON-serializable snapshot of every key stream (checkpoint/resume:
+    the manifest carries this so a resumed run continues the SAME key
+    sequence instead of replaying or diverging).  Keys map stream name
+    ("all" for the global stream, "cpu:0"-style for per-context ones) to
+    the raw uint32 key words."""
+    import numpy as np
+    import jax
+    with _lock:
+        items = list(_streams.items())
+    out = {}
+    for ctx, key in items:
+        try:
+            data = np.asarray(jax.random.key_data(key))
+        except Exception:           # already a raw uint32 key array
+            data = np.asarray(key)
+        name = "all" if ctx is None else \
+            f"{ctx.device_type}:{ctx.device_id}"
+        out[name] = data.astype(np.uint32).reshape(-1).tolist()
+    return out
+
+
+def set_state(state: dict) -> None:
+    """Restore streams captured by :func:`get_state`.  Streams absent
+    from ``state`` are dropped (exactly the captured picture comes
+    back)."""
+    import numpy as np
+    import jax.numpy as jnp
+    with _lock:
+        _streams.clear()
+        for name, data in state.items():
+            key = jnp.asarray(np.asarray(data, dtype=np.uint32))
+            if name == "all":
+                _streams[None] = key
+            else:
+                dev, _, idx = name.partition(":")
+                _streams[Context(dev, int(idx or 0))] = key
 
 
 def current_key(ctx=None):

@@ -31,6 +31,9 @@ Instrumented layers (see docs/observability.md):
 * dataloader — per-batch fetch-wait time
 * device memory — gauges sampled from ``jax.live_arrays()`` /
   ``device.memory_stats()`` at export time
+* resilience — injected faults, retries/give-ups, skipped steps and
+  dataloader fallbacks (``fault.py``; FAULT topic, ``mxtpu_retries`` /
+  ``mxtpu_giveups`` / ``mxtpu_skipped_steps`` counters)
 
 Three further planes layered on the same spine (this file + satellites):
 
@@ -74,7 +77,7 @@ from .base import MXNetError, getenv, getenv_bool
 __all__ = [
     "Topic", "EventBus", "bus",
     "OP_DISPATCH", "OP_TIMED", "SYNC", "TRANSFER", "COMPILE", "KVSTORE",
-    "TRAINER", "DATALOADER", "SPAN", "XLA_COST",
+    "TRAINER", "DATALOADER", "SPAN", "XLA_COST", "FAULT",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram",
     "Span", "Tracer", "tracer", "trace_span", "traced", "current_span",
@@ -203,6 +206,10 @@ bus = EventBus()
 #   XLA_COST(where=, flops=, nbytes=) — one dispatch of a compiled
 #                                       executable, with its cost-analysis
 #                                       flops / bytes-accessed
+#   FAULT(site=, event=, kind=, ...)  — resilience plane (fault.py): event
+#                                       in {"injected","retry","giveup",
+#                                       "skipped_step","fallback"}; retry
+#                                       adds attempt=/seconds=
 OP_DISPATCH = bus.topic("op.dispatch")
 OP_TIMED = bus.topic("op.timed")
 SYNC = bus.topic("op.sync")
@@ -213,6 +220,7 @@ TRAINER = bus.topic("trainer")
 DATALOADER = bus.topic("dataloader")
 SPAN = bus.topic("span")
 XLA_COST = bus.topic("xla.cost")
+FAULT = bus.topic("fault")
 
 
 # ---------------------------------------------------------------------------
@@ -1024,6 +1032,18 @@ def _metrics_init():
                          "detected aggregate device peak FLOP/s")
     _m["mfu"] = g("mxtpu_mfu",
                   "model FLOPs utilization over the last step window")
+    _m["faults"] = c("mxtpu_faults_injected",
+                     "deterministic faults injected, by site/kind")
+    _m["retries"] = c("mxtpu_retries",
+                      "transient failures absorbed by retry, by site")
+    _m["giveups"] = c("mxtpu_giveups",
+                      "retries exhausted (max attempts/deadline), by site")
+    _m["skipped_steps"] = c("mxtpu_skipped_steps",
+                            "optimizer steps skipped on non-finite "
+                            "gradients")
+    _m["dl_fallbacks"] = c("mxtpu_dataloader_fallbacks",
+                           "dataloader worker failures absorbed by "
+                           "in-process fetch")
 
 
 _op_keys: Dict[str, tuple] = {}   # op name -> label key, spares the hot
@@ -1117,6 +1137,19 @@ def _on_dataloader(seconds=0.0):
     _m["fetch_wait"].observe(seconds)
 
 
+def _on_fault(site="?", event="injected", kind=None, **_kw):
+    if event == "injected":
+        _m["faults"].inc(site=site, kind=kind or "?")
+    elif event == "retry":
+        _m["retries"].inc(site=site)
+    elif event == "giveup":
+        _m["giveups"].inc(site=site)
+    elif event == "skipped_step":
+        _m["skipped_steps"].inc()
+    elif event == "fallback":
+        _m["dl_fallbacks"].inc(site=site)
+
+
 _HANDLERS = (
     (OP_DISPATCH, _on_op_dispatch),
     (OP_TIMED, _on_op_timed),
@@ -1127,6 +1160,7 @@ _HANDLERS = (
     (TRAINER, _on_trainer),
     (DATALOADER, _on_dataloader),
     (XLA_COST, _on_xla_cost),
+    (FAULT, _on_fault),
 )
 
 

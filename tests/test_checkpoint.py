@@ -1,0 +1,266 @@
+"""Checkpoint subsystem tests: atomic/async publish, retention GC across
+restarts, orphan sweep, full-state (params + optimizer + scaler + RNG)
+resume round trips, and the manifest-last commit protocol that keeps a
+partial write from ever shadowing the last complete checkpoint.  The
+process-level kill+resume drill lives in ci/run_tests.sh fault_smoke."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.checkpoint import (AsyncCheckpointer,
+                                            all_checkpoints,
+                                            latest_checkpoint,
+                                            latest_resumable_step)
+from incubator_mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+from incubator_mxnet_tpu.gluon import Trainer, nn
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+
+
+def _params(**arrs):
+    return {k: mx.nd.array(v) for k, v in arrs.items()}
+
+
+def _train_setup(seed=7):
+    # fixed prefix: saved param names must match across net instances
+    mx.random.seed(seed)
+    net = nn.Dense(1, prefix="net_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05})
+    return net, trainer
+
+
+def _train_steps(net, trainer, steps, first=0):
+    for s in range(first, first + steps):
+        rng = np.random.default_rng(100 + s)
+        x = mx.nd.array(rng.standard_normal((4, 3)).astype(np.float32))
+        y = mx.nd.array(rng.standard_normal((4, 1)).astype(np.float32))
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+
+
+# ---------------------------------------------------------------- format
+def test_legacy_params_only_save_writes_single_file(tmp_path):
+    """save(step, params) stays the reference-compatible single .params
+    file — no manifest, no states."""
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=3)
+    ck.save(1, _params(w=np.ones((2, 2), np.float32)))
+    ck.wait_until_finished()
+    assert sorted(os.listdir(tmp_path)) == ["m-0000001.params"]
+
+
+def test_full_state_save_writes_manifest_last_commit_set(tmp_path):
+    net, trainer = _train_setup()
+    _train_steps(net, trainer, 2)
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=3)
+    scaler = LossScaler(init_scale=128.0)
+    scaler.update_scale(False)
+    ck.save(2, {k: p.data() for k, p in net.collect_params().items()},
+            trainer=trainer, scaler=scaler, epoch=1, extra={"note": "hi"})
+    ck.wait_until_finished()
+    assert sorted(os.listdir(tmp_path)) == [
+        "m-0000002.meta.json", "m-0000002.params", "m-0000002.states"]
+    meta = json.load(open(tmp_path / "m-0000002.meta.json"))
+    assert meta["step"] == 2 and meta["epoch"] == 1
+    assert meta["files"]["params"] == "m-0000002.params"
+    assert meta["files"]["states"] == "m-0000002.states"
+    assert meta["scaler"]["loss_scale"] == 128.0
+    assert meta["extra"] == {"note": "hi"}
+    assert meta["rng"]                    # key streams captured
+    assert latest_resumable_step(str(tmp_path / "m")) == 2
+
+
+# ------------------------------------------------------------- retention
+def test_retention_gc_survives_restart(tmp_path):
+    """A NEW checkpointer must seed retention from every step already on
+    disk, so the predecessor's checkpoints keep being garbage-collected
+    (not just the ones saved by this instance)."""
+    prefix = str(tmp_path / "m")
+    ck = AsyncCheckpointer(prefix, keep=2)
+    for step in (1, 2, 3):
+        ck.save(step, _params(w=np.full((2,), step, np.float32)))
+    ck.wait_until_finished()
+    assert all_checkpoints(prefix) == [2, 3]
+    # simulate a restart: fresh instance, one more save
+    ck2 = AsyncCheckpointer(prefix, keep=2)
+    ck2.save(4, _params(w=np.full((2,), 4, np.float32)))
+    ck2.wait_until_finished()
+    assert all_checkpoints(prefix) == [3, 4]
+
+
+def test_retention_gc_removes_full_state_sidecars(tmp_path):
+    prefix = str(tmp_path / "m")
+    net, trainer = _train_setup()
+    _train_steps(net, trainer, 1)
+    ck = AsyncCheckpointer(prefix, keep=1)
+    for step in (1, 2):
+        ck.save(step, {k: p.data() for k, p in
+                       net.collect_params().items()},
+                trainer=trainer)
+    ck.wait_until_finished()
+    assert sorted(os.listdir(tmp_path)) == [
+        "m-0000002.meta.json", "m-0000002.params", "m-0000002.states"]
+
+
+def test_orphaned_tmp_files_swept_at_startup(tmp_path):
+    prefix = str(tmp_path / "m")
+    orphans = ["m-0000005.params.tmp-1234", "m-0000005.states.tmp-1234",
+               "m-0000005.meta.json.tmp-99"]
+    keep = ["m-0000004.params",          # a real checkpoint
+            "other-0000005.params.tmp-1", "m-notatmp.txt"]
+    for name in orphans + keep:
+        (tmp_path / name).write_bytes(b"x")
+    AsyncCheckpointer(prefix, keep=3)
+    names = sorted(os.listdir(tmp_path))
+    assert names == sorted(keep)
+
+
+# ---------------------------------------------------------------- resume
+def test_full_resume_round_trip_bit_identical(tmp_path):
+    """Checkpoint mid-run, keep training to the end; then rebuild
+    everything from scratch, restore, replay the same tail — params must
+    come out BIT-identical (optimizer momenta included, or adam would
+    diverge)."""
+    prefix = str(tmp_path / "m")
+    net, trainer = _train_setup()
+    scaler = LossScaler(init_scale=64.0, scale_window=3)
+    _train_steps(net, trainer, 3)
+    ck = AsyncCheckpointer(prefix, keep=2)
+    ck.save(3, {k: p.data() for k, p in net.collect_params().items()},
+            trainer=trainer, scaler=scaler)
+    ck.wait_until_finished()
+    _train_steps(net, trainer, 2, first=3)
+    want = {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+    net2, trainer2 = _train_setup(seed=99)   # different seed on purpose
+    _train_steps(net2, trainer2, 1)          # diverge before restoring
+    scaler2 = LossScaler(init_scale=2.0 ** 16)
+    step = AsyncCheckpointer(prefix, keep=2).restore_into(
+        params=net2.collect_params(), trainer=trainer2, scaler=scaler2)
+    assert step == 3
+    assert scaler2.loss_scale == 64.0
+    _train_steps(net2, trainer2, 2, first=3)
+    got = {k: p.data().asnumpy()
+           for k, p in net2.collect_params().items()}
+    assert sorted(got) == sorted(want)
+    for k in want:
+        assert np.array_equal(want[k], got[k]), f"param {k} diverged"
+
+
+def test_restore_into_completes_deferred_init(tmp_path):
+    """Restoring into a net that has never seen a forward pass (deferred
+    shapes) must work — the saved arrays carry the shapes."""
+    prefix = str(tmp_path / "m")
+    net, trainer = _train_setup()
+    _train_steps(net, trainer, 1)
+    ck = AsyncCheckpointer(prefix, keep=1)
+    ck.save(1, {k: p.data() for k, p in net.collect_params().items()},
+            trainer=trainer)
+    ck.wait_until_finished()
+
+    net2 = nn.Dense(1, prefix="net_")
+    net2.initialize()      # deferred: no forward yet
+    trainer2 = Trainer(net2.collect_params(), "adam",
+                       {"learning_rate": 0.05})
+    step = AsyncCheckpointer(prefix, keep=1).restore_into(
+        params=net2.collect_params(), trainer=trainer2)
+    assert step == 1
+    for (k, p), (_, q) in zip(sorted(net.collect_params().items()),
+                              sorted(net2.collect_params().items())):
+        assert np.array_equal(p.data().asnumpy(), q.data().asnumpy())
+
+
+def test_restore_into_restores_rng_streams(tmp_path):
+    prefix = str(tmp_path / "m")
+    mx.random.seed(5)
+    mx.nd.random.uniform(shape=(2,))       # advance the stream
+    expect = mx.random.get_state()
+    ck = AsyncCheckpointer(prefix, keep=1)
+    ck.save(1, _params(w=np.ones((2,), np.float32)), epoch=0)
+    ck.wait_until_finished()
+    mx.random.seed(12345)                  # clobber
+    assert mx.random.get_state() != expect
+    assert ck.restore_into(step=1) == 1
+    assert mx.random.get_state() == expect
+
+
+def test_restore_into_without_checkpoint_returns_none(tmp_path):
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=1)
+    assert ck.restore_into() is None
+
+
+# ----------------------------------------------- partial-write shadowing
+def test_partial_write_never_shadows_last_complete(tmp_path):
+    """The manifest is the commit record: newer params without a
+    manifest, or a manifest whose params file is missing, must both be
+    invisible to resume."""
+    prefix = str(tmp_path / "m")
+    net, trainer = _train_setup()
+    _train_steps(net, trainer, 1)
+    ck = AsyncCheckpointer(prefix, keep=5)
+    ck.save(5, {k: p.data() for k, p in net.collect_params().items()},
+            trainer=trainer)
+    ck.wait_until_finished()
+    # a kill after the params publish but before the manifest publish:
+    ck.save(6, {k: p.data() for k, p in net.collect_params().items()})
+    ck.wait_until_finished()               # params-only → no manifest
+    assert latest_checkpoint(prefix) == 6  # params-level view sees it
+    assert latest_resumable_step(prefix) == 5
+    # a manifest whose params vanished (e.g. manual tampering)
+    (tmp_path / "m-0000007.meta.json").write_text(
+        json.dumps({"format": 1, "step": 7,
+                    "files": {"params": "m-0000007.params"}}))
+    assert latest_resumable_step(prefix) == 5
+    step = ck.restore_into(params=net.collect_params(), trainer=trainer)
+    assert step == 5
+
+
+def test_atomic_publish_leaves_no_tmp_files(tmp_path):
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=3)
+    for step in range(1, 4):
+        ck.save(step, _params(w=np.full((4,), step, np.float32)))
+    ck.wait_until_finished()
+    assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+
+
+# ---------------------------------------------------------- fault/retry
+def test_checkpoint_write_fault_absorbed_by_retry(tmp_path):
+    telemetry.start()
+    fault.install_plan("checkpoint.write:ioerror@1")
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=3)
+    ck.save(1, _params(w=np.ones((2,), np.float32)))
+    ck.wait_until_finished()               # would raise on giveup
+    assert latest_checkpoint(str(tmp_path / "m")) == 1
+    flat = telemetry.counters_flat()
+    assert flat.get("mxtpu_retries", 0) > 0
+    assert flat.get("mxtpu_giveups", 0) == 0
+
+
+def test_checkpoint_write_giveup_surfaces_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_RETRY_MAX", "1")
+    monkeypatch.setenv("MXNET_RETRY_BASE_SECONDS", "0.001")
+    fault.install_plan("checkpoint.write:ioerror@1-99")
+    ck = AsyncCheckpointer(str(tmp_path / "m"), keep=3)
+    ck.save(1, _params(w=np.ones((2,), np.float32)))
+    with pytest.raises(mx.base.MXNetError, match="checkpoint"):
+        ck.wait_until_finished()
+    # the failed write never published anything
+    assert all_checkpoints(str(tmp_path / "m")) == []

@@ -1,0 +1,324 @@
+"""Resilience-layer tests: fault-plan parsing, deterministic injection,
+retry/backoff/giveup, the kvstore transport retry path, the trainer's
+non-finite step guard, and the dataloader worker-crash fallback."""
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.gluon import Trainer, nn
+from incubator_mxnet_tpu.gluon.data import DataLoader
+from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+
+
+# ----------------------------------------------------------- plan parsing
+def test_plan_parse_forms():
+    plan = fault.install_plan(
+        "kvstore.push:ioerror@2;"
+        "dataloader.fetch:latency:0.25@3-5;"
+        "checkpoint.write:ioerror:disk full;"
+        "trainer.grad:nonfinite@every=4")
+    rules = {r.site: r for rs in plan.rules.values() for r in rs}
+    r = rules["kvstore.push"]
+    assert (r.kind, r.lo, r.hi) == ("ioerror", 2, 2)
+    assert not r.fires(1) and r.fires(2) and not r.fires(3)
+    r = rules["dataloader.fetch"]
+    assert r.kind == "latency" and r.seconds == 0.25
+    assert not r.fires(2) and r.fires(3) and r.fires(5) and not r.fires(6)
+    r = rules["checkpoint.write"]
+    assert r.message == "disk full" and r.fires(1)   # default @1
+    r = rules["trainer.grad"]
+    assert r.every == 4
+    assert r.fires(4) and r.fires(8) and not r.fires(5)
+
+
+@pytest.mark.parametrize("bad", [
+    "kvstore.push",                       # no kind
+    "kvstore.push:explode",               # unknown kind
+    "kvstore.push:ioerror@x",             # bad call index
+    "kvstore.push:ioerror@every=0",       # non-positive period
+    "dataloader.fetch:latency:fast",      # non-numeric seconds
+    ":ioerror",                           # empty site
+])
+def test_plan_parse_rejects_bad_specs(bad):
+    with pytest.raises(MXNetError):
+        fault.install_plan(bad)
+
+
+def test_inject_is_deterministic_per_site_counter():
+    fault.install_plan("s:ioerror@2")
+    fault.inject("s")                      # call 1: clean
+    with pytest.raises(fault.FaultInjected) as ei:
+        fault.inject("s")                  # call 2: fires
+    assert ei.value.site == "s"
+    assert isinstance(ei.value, IOError)   # transient by construction
+    fault.inject("s")                      # call 3: clean again
+    assert fault.site_calls("s") == 3
+    fault.inject("other")                  # independent counter
+    assert fault.site_calls("other") == 1
+
+
+def test_inject_noop_without_plan():
+    assert not fault.active()
+    fault.inject("anything")               # must not raise
+    assert fault.site_calls("anything") == 0
+
+
+def test_latency_injection_sleeps():
+    fault.install_plan("slow:latency:0.05@1")
+    t0 = time.monotonic()
+    fault.inject("slow")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_take_consumes_matching_kind_only():
+    fault.install_plan("g:nonfinite@2")
+    assert not fault.take("g", "nonfinite")    # call 1
+    assert fault.take("g", "nonfinite")        # call 2 fires
+    assert not fault.take("g", "ioerror")      # kind mismatch never takes
+
+
+# ----------------------------------------------------------- retry layer
+def test_retry_absorbs_transient_and_publishes_events():
+    telemetry.start()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    pol = fault.RetryPolicy(max_retries=4, base_seconds=0.001,
+                            deadline_seconds=5.0)
+    assert fault.retry_call(flaky, site="t", policy=pol) == "ok"
+    assert len(calls) == 3
+    flat = telemetry.counters_flat()
+    assert flat["mxtpu_retries"] == 2
+    assert flat.get("mxtpu_giveups", 0) == 0
+
+
+def test_retry_gives_up_after_max_and_reraises():
+    telemetry.start()
+
+    def always():
+        raise TimeoutError("down")
+
+    pol = fault.RetryPolicy(max_retries=2, base_seconds=0.001,
+                            deadline_seconds=5.0)
+    with pytest.raises(TimeoutError):
+        fault.retry_call(always, site="t", policy=pol)
+    flat = telemetry.counters_flat()
+    assert flat["mxtpu_retries"] == 2
+    assert flat["mxtpu_giveups"] == 1
+
+
+def test_retry_never_retries_framework_errors():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise MXNetError("bad key")
+
+    with pytest.raises(MXNetError):
+        fault.retry_call(broken, site="t")
+    assert len(calls) == 1
+
+
+def test_retry_respects_deadline():
+    def always():
+        raise OSError("down")
+
+    pol = fault.RetryPolicy(max_retries=1000, base_seconds=10.0,
+                            deadline_seconds=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        fault.retry_call(always, site="t", policy=pol)
+    assert time.monotonic() - t0 < 5.0     # never slept the 10s backoff
+
+
+def test_backoff_is_jittered_downward_and_capped():
+    pol = fault.RetryPolicy(max_retries=10, base_seconds=0.1,
+                            deadline_seconds=60.0)
+    for attempt in (1, 2, 3, 8):
+        raw = min(pol.max_delay_seconds,
+                  pol.base_seconds * pol.multiplier ** (attempt - 1))
+        d = pol.delay(attempt)
+        assert 0 <= d <= raw
+
+
+# ---------------------------------------------------- kvstore retry path
+def test_kvstore_push_transient_fault_absorbed():
+    telemetry.start()
+    fault.install_plan("kvstore.push:ioerror@2")
+    net = nn.Dense(1, prefix="kvr_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1},
+                      kvstore="device", update_on_kvstore=True)
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    y = mx.nd.array(np.ones((2, 1), np.float32))
+    before = None
+    for _ in range(2):
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(2)
+        if before is None:
+            before = {k: p.data().asnumpy()
+                      for k, p in net.collect_params().items()}
+    flat = telemetry.counters_flat()
+    assert flat["mxtpu_retries"] >= 1
+    assert flat.get("mxtpu_giveups", 0) == 0
+    # the faulted push still applied: step 2 changed the params
+    after = {k: p.data().asnumpy()
+             for k, p in net.collect_params().items()}
+    assert any(not np.array_equal(before[k], after[k]) for k in after)
+
+
+def test_kvstore_pushpull_fault_absorbed():
+    telemetry.start()
+    fault.install_plan("kvstore.pushpull:ioerror@1")
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pushpull(3, mx.nd.ones((2, 2)) * 2, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 2.0))
+    assert telemetry.counters_flat()["mxtpu_retries"] >= 1
+
+
+# ----------------------------------------------------- non-finite guard
+def test_trainer_skips_nonfinite_step_and_recovers():
+    telemetry.start()
+    fault.install_plan("trainer.grad:nonfinite@1")
+    net = nn.Dense(1, prefix="nf_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, skip_nonfinite=True)
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    y = mx.nd.array(np.ones((2, 1), np.float32))
+
+    def step():
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(2)
+
+    net(x)                                  # settle deferred shapes
+    before = {k: p.data().asnumpy()
+              for k, p in net.collect_params().items()}
+    step()                                  # grads poisoned → skipped
+    mid = {k: p.data().asnumpy()
+           for k, p in net.collect_params().items()}
+    for k in before:
+        assert np.array_equal(before[k], mid[k]), \
+            "skipped step must not touch params"
+    assert np.isfinite(
+        list(net.collect_params().values())[0].data().asnumpy()).all()
+    assert telemetry.counters_flat()["mxtpu_skipped_steps"] == 1
+
+    step()                                  # clean step updates again
+    after = {k: p.data().asnumpy()
+             for k, p in net.collect_params().items()}
+    assert any(not np.array_equal(mid[k], after[k]) for k in after)
+    assert np.isfinite(
+        list(net.collect_params().values())[0].data().asnumpy()).all()
+    assert telemetry.counters_flat()["mxtpu_skipped_steps"] == 1
+
+
+def test_trainer_guard_off_by_default():
+    net = nn.Dense(1, prefix="nfoff_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert trainer._skip_nonfinite is False
+
+
+def test_trainer_guard_env_default(monkeypatch):
+    monkeypatch.setenv("MXNET_SKIP_NONFINITE", "1")
+    net = nn.Dense(1, prefix="nfenv_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert trainer._skip_nonfinite is True
+
+
+def test_amp_all_finite_fused():
+    from incubator_mxnet_tpu.contrib.amp import all_finite
+    good = [mx.nd.ones((3,)), mx.nd.zeros((2, 2))]
+    assert all_finite(good)
+    bad = good + [mx.nd.array(np.array([1.0, np.nan], np.float32))]
+    assert not all_finite(bad)
+    assert all_finite([])                     # vacuous truth
+    ints = [mx.nd.array(np.array([1, 2], np.int32))]
+    assert all_finite(ints)                   # integers skip the check
+
+
+# --------------------------------------------------- dataloader fallback
+class _RangeDS(Dataset):
+    def __init__(self, n=8):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return np.float32(i)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(num_workers=2, thread_pool=True),
+    dict(num_workers=2),                      # forked worker processes
+])
+def test_dataloader_fetch_fault_falls_back_in_process(kwargs):
+    telemetry.start()
+    fault.install_plan("dataloader.fetch:ioerror@2")
+    dl = DataLoader(_RangeDS(8), batch_size=2, **kwargs)
+    got = [b.asnumpy().reshape(-1).tolist() for b in dl]
+    assert got == [[0, 1], [2, 3], [4, 5], [6, 7]]   # nothing lost
+    assert telemetry.counters_flat()["mxtpu_dataloader_fallbacks"] == 1
+
+
+def test_dataloader_inprocess_path_has_no_fallback():
+    fault.install_plan("dataloader.fetch:ioerror@2")
+    dl = DataLoader(_RangeDS(8), batch_size=2, num_workers=0)
+    with pytest.raises(fault.FaultInjected):
+        list(dl)
+
+
+def test_dataloader_worker_crash_falls_back():
+    class Crashy(_RangeDS):
+        def __getitem__(self, i):
+            import multiprocessing
+            # crash only inside a worker process; the in-process rebuild
+            # (parent) succeeds
+            if (i == 3 and multiprocessing.current_process().name
+                    != "MainProcess"):
+                raise RuntimeError("worker died")
+            return np.float32(i)
+
+    telemetry.start()
+    dl = DataLoader(Crashy(8), batch_size=2, num_workers=2)
+    got = [b.asnumpy().reshape(-1).tolist() for b in dl]
+    assert got == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert telemetry.counters_flat()["mxtpu_dataloader_fallbacks"] == 1
+
+
+# --------------------------------------------------------- env wiring
+def test_env_plan_installed_at_import(monkeypatch):
+    spec = "kvstore.push:ioerror@7"
+    plan = fault._parse_plan(spec)
+    assert repr(plan) == "FaultPlan(kvstore.push:ioerror@7)"

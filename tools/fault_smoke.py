@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Kill-and-resume fault smoke (ci/run_tests.sh fault_smoke).
+
+Trains a tiny deterministic regression model in four modes driven by the
+CI script:
+
+* ``golden`` — the full run, uninterrupted, no faults.  Reference
+  trajectory.
+* ``kill``   — same run with ``MXNET_FAULT_PLAN`` injecting a transient
+  kvstore fault; checkpoints every CKPT_EVERY steps and hard-kills the
+  process (``os._exit(17)``) right after step KILL_STEP.
+* ``resume`` — restores the newest complete checkpoint (params +
+  optimizer state), replays the remaining steps under the same fault
+  plan, and asserts ``mxtpu_retries > 0`` in the telemetry snapshot.
+* ``check``  — loads the artifacts of the three runs and asserts the
+  acceptance contract: resumed final params BIT-IDENTICAL to golden,
+  losses continuous across the kill (kill's prefix and resume's suffix
+  both match golden exactly).
+
+Batches are a pure function of the step index, so a replay from step k
+sees exactly the data the uninterrupted run saw — any divergence is a
+checkpoint/restore bug, not noise.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.checkpoint import AsyncCheckpointer
+from incubator_mxnet_tpu.gluon import Trainer, nn
+
+TOTAL_STEPS = 20
+CKPT_EVERY = 5
+KILL_STEP = 12
+BATCH = 8
+FEATS = 3
+
+
+def batch_for(step):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((BATCH, FEATS)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def build():
+    mx.random.seed(42)
+    # fixed prefix so checkpointed names match across processes
+    net = nn.Dense(1, prefix="net_")
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05},
+                      kvstore="device", update_on_kvstore=True)
+    return net, trainer
+
+
+def train(net, trainer, first_step, last_step):
+    losses = {}
+    for step in range(first_step, last_step + 1):
+        x, y = batch_for(step)
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(BATCH)
+        losses[step] = float(loss.asscalar())
+    return losses
+
+
+def dump(out, mode, losses, net):
+    with open(os.path.join(out, f"losses_{mode}.json"), "w") as f:
+        json.dump({str(k): v for k, v in losses.items()}, f)
+    np.savez(os.path.join(out, f"params_{mode}.npz"),
+             **{k: p.data().asnumpy()
+                for k, p in net.collect_params().items()})
+
+
+def run_golden(out):
+    net, trainer = build()
+    losses = train(net, trainer, 1, TOTAL_STEPS)
+    dump(out, "golden", losses, net)
+    print(f"golden: {TOTAL_STEPS} steps, final loss "
+          f"{losses[TOTAL_STEPS]:.6f}")
+
+
+def run_kill(out):
+    net, trainer = build()
+    ck = AsyncCheckpointer(os.path.join(out, "ckpt", "m"), keep=2)
+    losses = {}
+    for step in range(1, TOTAL_STEPS + 1):
+        losses.update(train(net, trainer, step, step))
+        if step % CKPT_EVERY == 0:
+            ck.save(step,
+                    {k: p.data() for k, p in
+                     net.collect_params().items()},
+                    trainer=trainer)
+        if step == KILL_STEP:
+            ck.wait_until_finished()
+            dump(out, "kill", losses, net)
+            print(f"kill: simulating preemption after step {step}",
+                  flush=True)
+            os._exit(17)   # hard kill: no atexit, no cleanup
+    raise AssertionError("kill mode never reached KILL_STEP")
+
+
+def run_resume(out):
+    telemetry.start()
+    net, trainer = build()
+    ck = AsyncCheckpointer(os.path.join(out, "ckpt", "m"), keep=2)
+    step = ck.restore_into(params=net.collect_params(), trainer=trainer)
+    assert step is not None, "resume: no complete checkpoint found"
+    expected = (KILL_STEP // CKPT_EVERY) * CKPT_EVERY
+    assert step == expected, \
+        f"resume: restored step {step}, expected {expected}"
+    losses = train(net, trainer, step + 1, TOTAL_STEPS)
+    ck.save(TOTAL_STEPS,
+            {k: p.data() for k, p in net.collect_params().items()},
+            trainer=trainer)
+    ck.wait_until_finished()
+    dump(out, "resume", losses, net)
+    flat = telemetry.counters_flat()
+    snap = {k: v for k, v in flat.items()
+            if k.startswith(("mxtpu_retries", "mxtpu_faults",
+                             "mxtpu_giveups", "mxtpu_skipped"))}
+    print("resume telemetry:", snap)
+    assert flat.get("mxtpu_retries", 0) > 0, \
+        f"resume: expected retries > 0, telemetry: {snap}"
+    assert flat.get("mxtpu_giveups", 0) == 0, \
+        f"resume: transient fault was NOT absorbed: {snap}"
+    print(f"resume: restored step {step}, replayed to {TOTAL_STEPS}")
+
+
+def run_check(out):
+    golden = np.load(os.path.join(out, "params_golden.npz"))
+    resume = np.load(os.path.join(out, "params_resume.npz"))
+    assert sorted(golden.files) == sorted(resume.files)
+    for name in golden.files:
+        assert np.array_equal(golden[name], resume[name]), \
+            f"check: param {name!r} differs between golden and resume"
+
+    def load(mode):
+        with open(os.path.join(out, f"losses_{mode}.json")) as f:
+            return {int(k): v for k, v in json.load(f).items()}
+
+    g, k, r = load("golden"), load("kill"), load("resume")
+    for step in sorted(k):        # pre-kill prefix matches golden
+        assert g[step] == k[step], \
+            f"check: loss diverged before the kill at step {step}"
+    for step in sorted(r):        # post-resume suffix matches golden
+        assert g[step] == r[step], \
+            f"check: loss discontinuity after resume at step {step}"
+    print(f"check ok: {len(golden.files)} params bit-identical, "
+          f"{len(k)}+{len(r)} losses continuous with golden")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["golden", "kill", "resume", "check"])
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    {"golden": run_golden, "kill": run_kill,
+     "resume": run_resume, "check": run_check}[args.mode](args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
