@@ -398,7 +398,7 @@ def _bench_int8_conv(on_accel, kind, dev):
     from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
 
     H, B = (224, 32) if on_accel else (112, 4)
-    steps, warmup = (20, 3) if on_accel else (3, 1)
+    steps, warmup = (20, 3) if on_accel else (8, 2)
 
     def build():
         mx.random.seed(0)
@@ -411,7 +411,81 @@ def _bench_int8_conv(on_accel, kind, dev):
     rec = _int8_ab_record(build, x, B, steps, warmup, "imgs_per_sec")
     rec["model"] = "resnet18_v1 (QuantizedConv2D path)"
     rec["image_size"] = H
+    # regression floor: the quantized conv path must stay within 20% of
+    # fp32 (it was 17x slower before the one-compiled-call rewrite)
+    rec["speedup_floor"] = 0.8
+    rec["floor_ok"] = bool(rec["int8_speedup"] >= 0.8)
+    if not rec["floor_ok"]:
+        rec["regression"] = (
+            f"int8 conv speedup {rec['int8_speedup']} < floor 0.8")
     return rec
+
+
+def _bench_optim(on_accel, kind, dev):
+    """Fused whole-tree optimizer step vs the per-param update loop:
+    same net, same grads, adam; isolates the update cost by re-stepping
+    on held grads (ignore_stale_grad) so forward/backward stays out of
+    the timed region.  Records update throughput in param elements/sec
+    and the dispatch count per step (1 fused jit call vs one call per
+    parameter) — the dispatch reduction is the whole point."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    D, L, B = (1024, 12, 32) if on_accel else (256, 8, 8)
+    steps, warmup = (50, 5) if on_accel else (20, 3)
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        for _ in range(L):
+            net.add(nn.Dense(D, in_units=D, activation="relu"))
+        net.initialize(init=mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (B, D)).astype(np.float32))
+    telemetry.start()
+
+    def run(fused):
+        net = build()
+        tr = Trainer(net.collect_params(), "adam",
+                     {"learning_rate": 1e-3}, fused=fused)
+        params = list(net.collect_params().values())
+        n_elems = sum(int(np.prod(p.shape)) for p in params)
+        with ag.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        for _ in range(warmup):
+            tr.step(B, ignore_stale_grad=True)
+        mx.nd.waitall()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            tr.step(B, ignore_stale_grad=True)
+        mx.nd.waitall()
+        rate = steps / (time.perf_counter() - t0)
+        g = telemetry.registry.get("mxtpu_optimizer_dispatches_per_step")
+        dispatches = int(sum(g._values.values())) if g is not None \
+            and g._values else len(params)
+        return rate, n_elems, dispatches, len(params)
+
+    loop_rate, n_elems, loop_disp, n_tensors = run(fused=False)
+    fused_rate, _, fused_disp, _ = run(fused=True)
+    return {
+        "optimizer": "adam",
+        "param_tensors": n_tensors,
+        "param_elements": n_elems,
+        "fused_updates_per_sec": round(fused_rate, 1),
+        "loop_updates_per_sec": round(loop_rate, 1),
+        "fused_param_elements_per_sec": round(fused_rate * n_elems),
+        "loop_param_elements_per_sec": round(loop_rate * n_elems),
+        "fused_dispatches_per_step": fused_disp,
+        "loop_dispatches_per_step": loop_disp,
+        "dispatch_reduction": round(loop_disp / max(fused_disp, 1), 1),
+        "step_speedup": round(fused_rate / loop_rate, 3),
+    }
 
 
 _SCALING_SCRIPT = r"""
@@ -591,6 +665,8 @@ def _sub_main(name):
         rec = _bench_int8(on_accel, kind, dev)
     elif name == "int8_conv":
         rec = _bench_int8_conv(on_accel, kind, dev)
+    elif name == "optim":
+        rec = _bench_optim(on_accel, kind, dev)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
     tel = _telemetry_snapshot()
@@ -664,6 +740,7 @@ def _main(preset_fusion):
         resnet = _run_sub("resnet50", platform, kind, timeout=2700)
         int8 = _run_sub("int8", platform, kind, timeout=1800)
         int8["conv"] = _run_sub("int8_conv", platform, kind, timeout=2700)
+        optim = _run_sub("optim", platform, kind, timeout=1800)
         scaling = _scaling_dryrun()
     else:
         import jax
@@ -685,6 +762,10 @@ def _main(preset_fusion):
             int8["conv"] = _bench_int8_conv(False, kind, dev)
         except Exception as e:
             int8["conv"] = {"error": str(e)[:200]}
+        try:
+            optim = _bench_optim(False, kind, dev)
+        except Exception as e:
+            optim = {"error": str(e)[:200]}
         scaling = _scaling_dryrun()
 
     out = {
@@ -705,6 +786,7 @@ def _main(preset_fusion):
         "remat": remat,
         "resnet50": resnet,
         "int8_inference": int8,
+        "optimizer_update": optim,
         "dp_scaling": scaling,
     }
     if probe is not None:

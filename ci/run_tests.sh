@@ -20,6 +20,10 @@ usage: ci/run_tests.sh <function>
                         and the snapshot reports a finite mfu > 0
   bench                 judged benchmark (prints one JSON line; includes a
                         telemetry snapshot when MXNET_TELEMETRY=1)
+  fused_smoke           fused-optimizer drill: short training run under
+                        telemetry; asserts ONE optimizer dispatch per
+                        step, fused_updates == steps, and the fused jit
+                        cache stops missing after warmup
   fault_smoke           resilience drill: tiny run with an injected
                         transient kvstore fault, a mid-run kill (exit 17)
                         and a checkpoint resume; asserts retries > 0, the
@@ -130,6 +134,57 @@ EOF
 
 bench() {
     python bench.py
+}
+
+fused_smoke() {
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.gluon import Trainer, nn
+
+telemetry.start()
+mx.random.seed(0)
+net = nn.HybridSequential()
+for _ in range(3):
+    net.add(nn.Dense(32, in_units=32, activation="relu"))
+net.initialize(init=mx.init.Xavier())
+net.hybridize()
+x = mx.nd.array(np.random.default_rng(0).standard_normal(
+    (8, 32)).astype(np.float32))
+
+trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+STEPS = 6
+for _ in range(STEPS):
+    with ag.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(8)
+mx.nd.waitall()
+
+assert trainer._fused is not None, \
+    "fused_smoke: fused updater not engaged (default path regressed)"
+flat = telemetry.counters_flat()
+fused = flat.get("mxtpu_optimizer_fused_updates", 0)
+assert fused == STEPS, \
+    f"fused_smoke: fused_updates {fused} != steps {STEPS}"
+g = telemetry.registry.get("mxtpu_optimizer_dispatches_per_step")
+disp = sum(g._values.values())
+assert disp == 1, \
+    f"fused_smoke: {disp} optimizer dispatches in last step (wanted 1)"
+key = (("site", "fused_update"),)
+hits = telemetry.registry.get(
+    "mx_compile_cache_hits_total")._values.get(key, 0)
+miss = telemetry.registry.get(
+    "mx_compile_cache_misses_total")._values.get(key, 0)
+assert 1 <= miss <= 2 and hits + miss == STEPS, \
+    f"fused_smoke: compile cache hits={hits} misses={miss} (steps {STEPS})"
+print(f"fused_smoke ok: {STEPS} steps, 1 dispatch/step, "
+      f"fused_updates={int(fused)}, cache hits={int(hits)} "
+      f"misses={int(miss)}")
+EOF
 }
 
 fault_smoke() {

@@ -6,23 +6,35 @@ trains unscaled — the scaler then stays at 1.0 and never skips.
 """
 from __future__ import annotations
 
-__all__ = ["LossScaler", "all_finite"]
+__all__ = ["LossScaler", "all_finite", "all_finite_flag"]
 
 
-def all_finite(arrays) -> bool:
-    """One fused all-finite check over many arrays (reference:
-    multi_all_finite).  Per-array finite flags are combined device-side
-    with logical_and, so the whole sweep costs a SINGLE blocking host
-    sync — the per-param ``bool(isfinite(...).all())`` loop it replaces
-    paid one sync per parameter."""
+def all_finite_flag(arrays):
+    """Device-side all-finite reduction over many arrays (reference:
+    multi_all_finite) WITHOUT the host sync: returns a 0-d bool array
+    (or ``None`` when no array has an inexact dtype — integer grads are
+    always finite).  Accepts NDArrays or raw jax arrays, and is safe to
+    call under a jit trace — the fused optimizer step folds this exact
+    reduction into its compiled program so the non-finite guard costs no
+    dispatch boundary at all."""
     import jax.numpy as jnp
     flag = None
     for a in arrays:
         data = getattr(a, "_data", a)
         if not jnp.issubdtype(data.dtype, jnp.inexact):
-            continue                     # integer grads are always finite
+            continue
         f = jnp.isfinite(data).all()
         flag = f if flag is None else jnp.logical_and(flag, f)
+    return flag
+
+
+def all_finite(arrays) -> bool:
+    """One fused all-finite check over many arrays.  Per-array finite
+    flags are combined device-side with logical_and
+    (:func:`all_finite_flag`), so the whole sweep costs a SINGLE blocking
+    host sync — the per-param ``bool(isfinite(...).all())`` loop it
+    replaces paid one sync per parameter."""
+    flag = all_finite_flag(arrays)
     return True if flag is None else bool(flag)   # the one sync
 
 

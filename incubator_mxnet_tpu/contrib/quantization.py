@@ -146,7 +146,13 @@ def _apply_act(out, act_type):
 
 
 class _QuantizedBase(HybridBlock):
-    """Shared int8 machinery: frozen int8 weights + scales as constants."""
+    """Shared int8 machinery: frozen int8 weights + scales as constants.
+
+    The whole quantize → int8 compute → requantize chain runs as ONE
+    compiled call per layer (``jax.jit``, built lazily on first forward
+    and cached per input shape/dtype by jit itself) instead of an eager
+    op round trip per stage — the weights/scales are passed as runtime
+    arguments so they are not baked into the executable as constants."""
 
     def __init__(self, w_q: _np.ndarray, w_scale: _np.ndarray,
                  bias: Optional[_np.ndarray], act_scale: float, **kwargs):
@@ -158,6 +164,7 @@ class _QuantizedBase(HybridBlock):
         self._bias = None if bias is None else jnp.asarray(
             bias, jnp.float32)
         self._xscale = float(max(act_scale, 1e-12)) / 127.0
+        self._kernel = None
 
     def _quantize_input(self, x):
         jnp = _jnp()
@@ -182,24 +189,38 @@ class QuantizedDense(_QuantizedBase):
                 f"{dense._act_type!r}; exclude the layer instead")
         self._act_type = dense._act_type
 
-    def hybrid_forward(self, F, x):
-        def run(xv):
-            import jax
-            jnp = _jnp()
+    def _build_kernel(self):
+        import jax
+        import jax.numpy as jnp
+        from .. import telemetry as _telemetry
+        xscale, flatten = self._xscale, self._flatten
+        act_type, has_bias = self._act_type, self._bias is not None
+
+        def kernel(xv, wq, wscale, *bias):
             orig_dtype = xv.dtype
             xf = xv.astype(jnp.float32)
-            if self._flatten and xf.ndim > 2:
+            if flatten and xf.ndim > 2:
                 xf = xf.reshape(xf.shape[0], -1)
-            xq = jnp.clip(jnp.round(xf / self._xscale), -127,
+            xq = jnp.clip(jnp.round(xf / xscale), -127,
                           127).astype(jnp.int8)
             acc = jax.lax.dot_general(
-                xq, self._wq, (((xf.ndim - 1,), (1,)), ((), ())),
+                xq, wq, (((xf.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32)
-            out = acc.astype(jnp.float32) * (self._xscale * self._wscale)
-            if self._bias is not None:
-                out = out + self._bias
-            out = _apply_act(out, self._act_type)
+            out = acc.astype(jnp.float32) * (xscale * wscale)
+            if has_bias:
+                out = out + bias[0]
+            out = _apply_act(out, act_type)
             return out.astype(orig_dtype)
+        self._kernel = _telemetry.instrument_jit("quantized_dense",
+                                                 jax.jit(kernel))
+        return self._kernel
+
+    def hybrid_forward(self, F, x):
+        kern = self._kernel or self._build_kernel()
+
+        def run(xv):
+            args = (self._bias,) if self._bias is not None else ()
+            return kern(xv, self._wq, self._wscale, *args)
         return _invoke(run, [x], name="quantized_dense",
                        differentiable=False)
 
@@ -223,28 +244,58 @@ class QuantizedConv2D(_QuantizedBase):
         self._groups = conv._groups
         self._act_type = conv._act_type
 
-    def hybrid_forward(self, F, x):
-        def run(xv):
-            import jax
-            jnp = _jnp()
+    def _build_kernel(self):
+        import jax
+        import jax.numpy as jnp
+        from .. import telemetry as _telemetry
+        xscale, act_type = self._xscale, self._act_type
+        strides, padding = self._strides, self._padding
+        dilation, groups = self._dilation, self._groups
+        has_bias = self._bias is not None
+        # XLA:CPU has no fast s8xs8 conv kernels (an order of magnitude
+        # SLOWER than f32); the quantized values are integers in
+        # [-127, 127], exactly representable in f32, so on CPU the conv
+        # runs on the quantized values in f32 at full speed.  TPU/GPU
+        # keep the int8 x int8 -> int32 MXU path.
+        int8_compute = jax.default_backend() != "cpu"
+        # hoist the weight representation the backend computes in — the
+        # CPU path would otherwise recast the full weight tensor every
+        # forward
+        self._wrun = self._wq if int8_compute \
+            else self._wq.astype(jnp.float32)
+
+        def kernel(xv, wq, wscale, *bias):
             orig_dtype = xv.dtype
             xf = xv.astype(jnp.float32)
-            xq = jnp.clip(jnp.round(xf / self._xscale), -127,
-                          127).astype(jnp.int8)
+            xq = jnp.clip(jnp.round(xf / xscale), -127, 127)
+            if int8_compute:
+                lhs, pref = xq.astype(jnp.int8), jnp.int32
+            else:
+                lhs, pref = xq, jnp.float32
             acc = jax.lax.conv_general_dilated(
-                xq, self._wq,
-                window_strides=self._strides,
-                padding=[(p, p) for p in self._padding],
-                rhs_dilation=self._dilation,
+                lhs, wq,
+                window_strides=strides,
+                padding=[(p, p) for p in padding],
+                rhs_dilation=dilation,
                 dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                feature_group_count=self._groups,
-                preferred_element_type=jnp.int32)
+                feature_group_count=groups,
+                preferred_element_type=pref)
             out = acc.astype(jnp.float32) * (
-                self._xscale * self._wscale.reshape(1, -1, 1, 1))
-            if self._bias is not None:
-                out = out + self._bias.reshape(1, -1, 1, 1)
-            out = _apply_act(out, self._act_type)
+                xscale * wscale.reshape(1, -1, 1, 1))
+            if has_bias:
+                out = out + bias[0].reshape(1, -1, 1, 1)
+            out = _apply_act(out, act_type)
             return out.astype(orig_dtype)
+        self._kernel = _telemetry.instrument_jit("quantized_conv2d",
+                                                 jax.jit(kernel))
+        return self._kernel
+
+    def hybrid_forward(self, F, x):
+        kern = self._kernel or self._build_kernel()
+
+        def run(xv):
+            args = (self._bias,) if self._bias is not None else ()
+            return kern(xv, self._wrun, self._wscale, *args)
         return _invoke(run, [x], name="quantized_conv2d",
                        differentiable=False)
 

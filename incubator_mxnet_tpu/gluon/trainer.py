@@ -24,7 +24,8 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, skip_nonfinite=None):
+                 update_on_kvstore=None, skip_nonfinite=None,
+                 fused=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -57,6 +58,18 @@ class Trainer:
         # sync per step, so it stays off unless asked for
         self._skip_nonfinite = getenv_bool("MXNET_SKIP_NONFINITE", False) \
             if skip_nonfinite is None else bool(skip_nonfinite)
+        # fused whole-tree update: one donated jit dispatch per step
+        # instead of one dispatch per parameter (optimizer/fused.py);
+        # falls back to the per-param loop automatically for sparse
+        # params, update_on_kvstore, dist stores, and optimizers the
+        # fused envelope does not cover
+        self._fused_requested = getenv_bool("MXNET_FUSED_OPTIMIZER", True) \
+            if fused is None else bool(fused)
+        self._fused = None
+        self._updatable = None
+        # device-side all-finite flags from fused guarded steps awaiting
+        # async readback (skipped-step accounting without a host sync)
+        self._pending_nonfinite = []
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -75,6 +88,11 @@ class Trainer:
 
     def _init_kvstore(self):
         from .. import kvstore as kv_mod
+        # the updatable-param list is static for the life of the Trainer
+        # — precompute it once instead of re-checking grad_req and
+        # re-calling p.grad()/p.data() accessors on every step
+        self._updatable = [(i, p) for i, p in enumerate(self._params)
+                           if p.grad_req != "null"]
         if self._kvstore_type is None:
             self._kvstore = None
         elif isinstance(self._kvstore_type, str):
@@ -95,9 +113,19 @@ class Trainer:
                                           or self._distributed):
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    self._kvstore.init(i, p.data())
+            for i, p in self._updatable:
+                self._kvstore.init(i, p.data())
+        self._fused = None
+        sparse_grads = any(
+            getattr(p, "_grad_stype", "default") != "default"
+            for _, p in self._updatable)
+        multi_worker = (self._distributed
+                        and getattr(self._kvstore, "num_workers", 1) > 1)
+        if (self._fused_requested and not self._contains_sparse
+                and not sparse_grads
+                and not self._update_on_kvstore and not multi_worker):
+            from ..optimizer.fused import FusedUpdater
+            self._fused = FusedUpdater(self._updaters)
         self._kv_initialized = True
         if self._states_to_load is not None:
             self.load_states(self._states_to_load)
@@ -126,43 +154,80 @@ class Trainer:
         a step whose gradients contain NaN/Inf is SKIPPED — grads are
         zeroed, ``mxtpu_skipped_steps`` is bumped, and params stay
         untouched — instead of poisoning the weights and every step
-        after."""
+        after.  On the fused path the all-finite check and the gating
+        run INSIDE the one compiled dispatch (no host sync); skipped
+        steps are counted on async readback, so the counter can trail
+        by the in-flight steps until :meth:`sync_nonfinite_guard`."""
         observe = bool(_telemetry.TRAINER.subscribers)
         t0 = _time.perf_counter() if observe else 0.0
         with _telemetry.trace_span("trainer.step", cat="trainer",
                                    batch_size=batch_size):
             if not self._kv_initialized:
                 self._init_kvstore()
+            self._drain_nonfinite(block=False)
             self._optimizer.rescale_grad = self._scale / batch_size
             self._allreduce_grads()
             if _fault.take("trainer.grad", "nonfinite"):
                 self._poison_grads()
-            if self._skip_nonfinite and self._grads_nonfinite():
-                _telemetry.FAULT.publish(site="trainer.step",
-                                         event="skipped_step")
-                for p in self._params:
-                    if p.grad_req != "null":
-                        p.zero_grad()
-            else:
+            fused_done = False
+            # an instance-level _update (e.g. amp.init_trainer's overflow
+            # wrapper) must stay in the path: route through it and let the
+            # fused call inside the class _update take over afterwards
+            if self._fused is not None and "_update" not in self.__dict__:
                 with _telemetry.trace_span("trainer.update", cat="trainer"):
-                    self._update(ignore_stale_grad)
+                    fused_done, flag = self._fused.step(
+                        self._updatable, guard=self._skip_nonfinite)
+                if fused_done and flag is not None:
+                    self._pending_nonfinite.append(flag)
+            if not fused_done:
+                if self._skip_nonfinite and self._grads_nonfinite():
+                    _telemetry.FAULT.publish(site="trainer.step",
+                                             event="skipped_step")
+                    for _, p in self._updatable:
+                        p.zero_grad()
+                else:
+                    with _telemetry.trace_span("trainer.update",
+                                               cat="trainer"):
+                        self._update(ignore_stale_grad)
         if observe:
             _telemetry.TRAINER.publish(
                 phase="step", seconds=_time.perf_counter() - t0)
 
+    def _drain_nonfinite(self, block=False):
+        """Account skipped steps from fused guarded dispatches.  Without
+        ``block`` only flags whose computation already finished are
+        consumed (``is_ready`` — no host sync on the hot path)."""
+        if not self._pending_nonfinite:
+            return
+        keep = []
+        for flag in self._pending_nonfinite:
+            if not block and not flag.is_ready():
+                keep.append(flag)
+                continue
+            if not bool(flag):
+                _telemetry.FAULT.publish(site="trainer.step",
+                                         event="skipped_step")
+        self._pending_nonfinite = keep
+
+    def sync_nonfinite_guard(self):
+        """Block until every in-flight fused ``skip_nonfinite`` flag is
+        known, so ``mxtpu_skipped_steps`` is exact.  Call before reading
+        the counter (monitors do; the training loop never needs to)."""
+        self._drain_nonfinite(block=True)
+
     def _grads_nonfinite(self) -> bool:
         # one fused check, one host sync (amp.all_finite)
         from ..contrib.amp.loss_scaler import all_finite
-        grads = [p.grad() for p in self._params
-                 if p.grad_req != "null" and p.grad() is not None]
+        grads = [p.grad() for _, p in self._updatable
+                 if p.grad() is not None]
         return not all_finite(grads)
 
     def _poison_grads(self):
         """Inject a non-finite gradient (fault site ``trainer.grad``) —
         the deterministic test hook behind the skip guard."""
         import jax.numpy as jnp
-        for p in self._params:
-            if p.grad_req != "null" and p.grad() is not None:
+        for _, p in self._updatable:
+            if p.grad() is not None:
                 g = p.grad()
                 g._set_data(jnp.full_like(g._data, jnp.nan))
                 break
@@ -180,17 +245,15 @@ class Trainer:
         if self._kvstore is None:
             return
         if self._update_on_kvstore:
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    self._kvstore.push(i, p.grad())
-                    self._kvstore.pull(i, p.data())
+            for i, p in self._updatable:
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, p.data())
         elif self._distributed and (self._kvstore.num_workers > 1
                                     or self._compress_active):
             # single process without compression: the DCN sum is the
             # identity — skip the two full-parameter copies per step
-            for i, p in enumerate(self._params):
-                if p.grad_req != "null":
-                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
+            for i, p in self._updatable:
+                self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
         observe = bool(_telemetry.TRAINER.subscribers)
@@ -207,10 +270,14 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._kvstore is not None and self._update_on_kvstore:
             return  # server applied it in _allreduce_grads
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
+        if self._fused is not None and \
+                self._fused.step(self._updatable, guard=False)[0]:
+            return
+        for i, p in self._updatable:
             self._updaters(i, p.grad(), p.data())
+        if _telemetry.enabled():
+            _telemetry.gauge("mxtpu_optimizer_dispatches_per_step").set(
+                len(self._updatable))
 
     # ------------------------------------------------------------------
     def get_states(self) -> bytes:

@@ -23,14 +23,18 @@ def _jnp():
     return jnp
 
 
+def _c():
+    # shared pure update cores (lazy: optimizer package imports ndarray)
+    from ..optimizer import cores
+    return cores
+
+
 def _prep_grad(g, rescale_grad, clip_gradient, wd=0.0, w=None):
-    jnp = _jnp()
-    g = g * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
-    if wd and w is not None:
-        g = g + wd * w
-    return g
+    return _c().prep_grad(
+        g, rescale_grad,
+        clip_gradient if clip_gradient is not None
+        and clip_gradient > 0 else None,
+        wd if wd else None, w)
 
 
 def _row_sparse_grad(grad, lazy_update=True):
@@ -64,11 +68,11 @@ def sgd_update(weight: NDArray, grad: NDArray, lr, wd=0.0, rescale_grad=1.0,
         wr = w[rows]
         g = _prep_grad(gd, rescale_grad, clip, wd, wr)
         tgt = out if out is not None else weight
-        tgt._set_data(w.at[rows].set((wr - lr * g).astype(w.dtype)))
+        tgt._set_data(w.at[rows].set(_c().sgd(wr, g, lr).astype(w.dtype)))
         return tgt
     w, g = weight._data, _as_dense_grad(grad)._data
     g = _prep_grad(g, rescale_grad, clip, wd, w)
-    new_w = w - lr * g
+    new_w = _c().sgd(w, g, lr)
     tgt = out if out is not None else weight
     tgt._set_data(new_w.astype(w.dtype))
     return tgt
@@ -84,15 +88,14 @@ def sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
         w, m = weight._data, mom._data
         wr, mr = w[rows], m[rows]
         g = _prep_grad(gd, rescale_grad, clip, wd, wr)
-        new_mr = momentum * mr - lr * g
+        new_wr, new_mr = _c().sgd_momentum(wr, g, mr, lr, momentum)
         mom._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
         tgt = out if out is not None else weight
-        tgt._set_data(w.at[rows].set((wr + new_mr).astype(w.dtype)))
+        tgt._set_data(w.at[rows].set(new_wr.astype(w.dtype)))
         return tgt
     w, g, m = weight._data, _as_dense_grad(grad)._data, mom._data
     g = _prep_grad(g, rescale_grad, clip, wd, w)
-    new_m = momentum * m - lr * g
-    new_w = w + new_m
+    new_w, new_m = _c().sgd_momentum(w, g, m, lr, momentum)
     mom._set_data(new_m.astype(m.dtype))
     tgt = out if out is not None else weight
     tgt._set_data(new_w.astype(w.dtype))
@@ -106,8 +109,7 @@ def nag_mom_update(weight: NDArray, grad: NDArray, mom: NDArray, lr,
     w, g, m = weight._data, _as_dense_grad(grad)._data, mom._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
-    new_m = momentum * m + g
-    new_w = w - lr * (g + momentum * new_m)
+    new_w, new_m = _c().nag_momentum(w, g, m, lr, momentum)
     mom._set_data(new_m.astype(m.dtype))
     tgt = out if out is not None else weight
     tgt._set_data(new_w.astype(w.dtype))
@@ -130,9 +132,8 @@ def adam_update(weight: NDArray, grad: NDArray, mean: NDArray, var: NDArray,
         w, m, v = weight._data, mean._data, var._data
         wr, mr, vr = w[rows], m[rows], v[rows]
         g = _prep_grad(gd, rescale_grad, clip, wd, wr)
-        new_mr = beta1 * mr + (1 - beta1) * g
-        new_vr = beta2 * vr + (1 - beta2) * g * g
-        new_wr = wr - lr * new_mr / (jnp.sqrt(new_vr) + epsilon)
+        new_wr, new_mr, new_vr = _c().adam(wr, g, mr, vr, lr, beta1,
+                                           beta2, epsilon)
         mean._set_data(m.at[rows].set(new_mr.astype(m.dtype)))
         var._set_data(v.at[rows].set(new_vr.astype(v.dtype)))
         tgt = out if out is not None else weight
@@ -141,9 +142,7 @@ def adam_update(weight: NDArray, grad: NDArray, mean: NDArray, var: NDArray,
     w, g = weight._data, _as_dense_grad(grad)._data
     m, v = mean._data, var._data
     g = _prep_grad(g, rescale_grad, clip, wd, w)
-    new_m = beta1 * m + (1 - beta1) * g
-    new_v = beta2 * v + (1 - beta2) * g * g
-    new_w = w - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    new_w, new_m, new_v = _c().adam(w, g, m, v, lr, beta1, beta2, epsilon)
     mean._set_data(new_m.astype(m.dtype))
     var._set_data(new_v.astype(v.dtype))
     tgt = out if out is not None else weight
@@ -158,8 +157,7 @@ def rmsprop_update(weight: NDArray, grad: NDArray, n: NDArray, lr,
     w, g, nn = weight._data, _as_dense_grad(grad)._data, n._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w)
-    new_n = (1 - gamma1) * g * g + gamma1 * nn
-    new_w = w - lr * g / jnp.sqrt(new_n + epsilon)
+    new_w, new_n = _c().rmsprop(w, g, nn, lr, gamma1, epsilon)
     if clip_weights and clip_weights > 0:
         new_w = jnp.clip(new_w, -clip_weights, clip_weights)
     n._set_data(new_n.astype(nn.dtype))
@@ -247,7 +245,7 @@ def mp_sgd_update(weight: NDArray, grad: NDArray, weight32: NDArray, lr,
     w32, g = weight32._data, _as_dense_grad(grad)._data.astype(jnp.float32)
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w32)
-    new_w32 = w32 - lr * g
+    new_w32 = _c().sgd(w32, g, lr)
     weight32._set_data(new_w32)
     tgt = out if out is not None else weight
     tgt._set_data(new_w32.astype(weight._data.dtype))
@@ -262,8 +260,7 @@ def mp_sgd_mom_update(weight: NDArray, grad: NDArray, mom: NDArray,
     w32, g, m = weight32._data, _as_dense_grad(grad)._data.astype(jnp.float32), mom._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None, wd, w32)
-    new_m = momentum * m - lr * g
-    new_w32 = w32 + new_m
+    new_w32, new_m = _c().sgd_momentum(w32, g, m, lr, momentum)
     mom._set_data(new_m)
     weight32._set_data(new_w32)
     tgt = out if out is not None else weight
@@ -281,8 +278,7 @@ def lamb_update_phase1(weight: NDArray, grad: NDArray, mean: NDArray,
     m, v = mean._data, var._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
-    new_m = beta1 * m + (1 - beta1) * g
-    new_v = beta2 * v + (1 - beta2) * g * g
+    new_m, new_v = _c().moments(m, v, g, beta1, beta2)
     mean._set_data(new_m.astype(m.dtype))
     var._set_data(new_v.astype(v.dtype))
     if bias_correction:
@@ -319,8 +315,7 @@ def adagrad_update(weight: NDArray, grad: NDArray, history: NDArray, lr,
     w, g, h = weight._data, _as_dense_grad(grad)._data, history._data
     g = _prep_grad(g, rescale_grad,
                    clip_gradient if clip_gradient > 0 else None)
-    new_h = h + g * g
-    new_w = w - lr * (g / jnp.sqrt(new_h + epsilon) + wd * w)
+    new_w, new_h = _c().adagrad(w, g, h, lr, epsilon, wd)
     history._set_data(new_h.astype(h.dtype))
     tgt = out if out is not None else weight
     tgt._set_data(new_w.astype(w.dtype))

@@ -1,5 +1,6 @@
 """``mx.optimizer`` (reference: python/mxnet/optimizer/)."""
 from .optimizer import *  # noqa: F401,F403
 from .optimizer import __all__ as _a
+from .fused import FusedUpdater  # noqa: F401
 
-__all__ = list(_a)
+__all__ = list(_a) + ["FusedUpdater"]

@@ -1,0 +1,105 @@
+"""Pure functional optimizer update cores.
+
+One set of update-rule formulas shared by all three execution tiers:
+
+* the eager per-param ops (``ndarray/optimizer_ops.py`` — reference
+  kernels src/operator/optimizer_op.cc),
+* the fused whole-tree Trainer step (``optimizer/fused.py`` — one
+  donated jit dispatch per ``Trainer.step``),
+* the compiled SPMD optimizers (``parallel/optim.py``).
+
+Every core is a pure function over raw ``jnp`` arrays; scalars may be
+Python floats (baked into the trace) or traced 0-d arrays (per-step /
+per-param hyperparameters) — the arithmetic and its evaluation order are
+IDENTICAL either way, which is what makes the fused path bit-compatible
+with the per-param loop it replaces.  Keep the expressions in lockstep
+with the reference kernels; parity is asserted in
+tests/test_optimizer.py (vs hand NumPy) and tests/test_fused_optimizer.py
+(fused vs loop).
+"""
+from __future__ import annotations
+
+__all__ = ["prep_grad", "sgd", "sgd_momentum", "nag_momentum", "moments",
+           "adam", "adamw", "rmsprop", "adagrad"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def prep_grad(g, rescale_grad=None, clip_gradient=None, wd=None, w=None):
+    """rescale → clip → fold wd*w into the gradient (reference: the
+    common prologue of every optimizer kernel).  ``None`` skips a stage —
+    the callers decide statically (at trace time) which stages apply, so
+    a zero wd produces the exact same graph as the reference's
+    ``if wd`` branch."""
+    jnp = _jnp()
+    if rescale_grad is not None:
+        g = g * rescale_grad
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd is not None and w is not None:
+        g = g + wd * w
+    return g
+
+
+def sgd(w, g, lr):
+    """reference: sgd_update (g already prepped, wd folded)."""
+    return w - lr * g
+
+
+def sgd_momentum(w, g, m, lr, momentum):
+    """reference: sgd_mom_update → (new_w, new_mom)."""
+    new_m = momentum * m - lr * g
+    return w + new_m, new_m
+
+
+def nag_momentum(w, g, m, lr, momentum):
+    """reference: nag_mom_update → (new_w, new_mom)."""
+    new_m = momentum * m + g
+    return w - lr * (g + momentum * new_m), new_m
+
+
+def moments(m, v, g, beta1, beta2):
+    """Adam-family first/second moment EMA → (new_m, new_v)."""
+    return beta1 * m + (1 - beta1) * g, beta2 * v + (1 - beta2) * g * g
+
+
+def adam(w, g, m, v, lr, beta1, beta2, epsilon):
+    """reference: adam_update — ``lr`` arrives PRE-SCALED by
+    sqrt(1-beta2^t)/(1-beta1^t) (the Python Adam class folds the bias
+    correction into lr); wd is folded into g by prep_grad.
+    → (new_w, new_m, new_v)."""
+    jnp = _jnp()
+    new_m, new_v = moments(m, v, g, beta1, beta2)
+    return w - lr * new_m / (jnp.sqrt(new_v) + epsilon), new_m, new_v
+
+
+def adamw(w, g, m, v, lr, wd, beta1, beta2, epsilon, coef1, coef2):
+    """reference: AdamW (decoupled weight decay).  ``coef1``/``coef2``
+    are the bias-correction denominators 1-beta1^t / 1-beta2^t, passed
+    in so a traced step count and the eager Python-float path share one
+    formula.  → (new_w, new_m, new_v)."""
+    jnp = _jnp()
+    new_m, new_v = moments(m, v, g, beta1, beta2)
+    m_hat = new_m / coef1
+    v_hat = new_v / coef2
+    return (w - lr * (m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w),
+            new_m, new_v)
+
+
+def rmsprop(w, g, n, lr, gamma1, epsilon):
+    """reference: rmsprop_update (non-centered; epsilon inside the
+    sqrt); wd folded into g by prep_grad.  → (new_w, new_n)."""
+    jnp = _jnp()
+    new_n = (1 - gamma1) * g * g + gamma1 * n
+    return w - lr * g / jnp.sqrt(new_n + epsilon), new_n
+
+
+def adagrad(w, g, h, lr, epsilon, wd):
+    """reference: adagrad_update — wd applies decoupled (outside the
+    adaptive term), epsilon inside the sqrt.  → (new_w, new_h)."""
+    jnp = _jnp()
+    new_h = h + g * g
+    return w - lr * (g / jnp.sqrt(new_h + epsilon) + wd * w), new_h

@@ -1,0 +1,291 @@
+"""Fused whole-tree optimizer step: ONE donated jit dispatch per
+``Trainer.step``.
+
+The eager Gluon update loop pays one dispatch per parameter per step —
+the dispatch-overhead wall PyGraph (arXiv:2503.19779) attacks with graph
+capture, and the dominant step-time term on TPU once compute is sharded
+(arXiv:2004.13336).  :class:`FusedUpdater` gathers every
+``(weight, grad, state)`` triple into one pytree and applies the update
+rule — the same pure cores the per-param ops and the SPMD path use
+(``optimizer/cores.py``) — as a single ``jax.jit`` call with donated
+buffers, so XLA fuses hundreds of tiny updates into one executable and
+reuses the parameter memory in place.
+
+What is folded inside the compiled program:
+
+* grad rescale (traced scalar — changing batch size does NOT recompile),
+* ``clip_gradient`` (traced scalar when enabled),
+* per-param lr / wd multipliers (traced ``(n,)`` vectors — lr schedules
+  and ``set_learning_rate`` do not recompile),
+* multi-precision fp16 master weights (fp32 master in the state, fp16
+  view written back, exactly like ``update_multi_precision``),
+* the ``skip_nonfinite`` guard: the all-finite check
+  (``amp.all_finite_flag`` — the SAME reduction the eager guard uses)
+  becomes a fused reduction whose result gates every output through
+  ``jnp.where``, so the guard costs no blocking host sync per step;
+  skipped-step counting moves to an async readback
+  (``Trainer.sync_nonfinite_guard`` forces it).
+
+Compiled programs are cached by static configuration — (rule, baked
+hyperparameters, multi-precision/wd patterns, clip/guard flags) — and by
+tree structure/shapes/dtypes (jax's own jit cache).  Changing a baked
+hyperparameter (momentum, betas, epsilon) recompiles; changing lr, wd,
+rescale, or clip values does not.
+
+Numerics: bit-compatible with the per-param loop it replaces — the cores
+keep expression and evaluation order identical, and host-side
+bookkeeping (update counts, Adam bias-corrected lr in Python doubles)
+mirrors the eager classes — asserted by tests/test_fused_optimizer.py.
+One documented divergence: update counts advance even on a
+guard-skipped step (the flag is not known at dispatch time); the eager
+guard skips the whole update including the count.
+"""
+from __future__ import annotations
+
+import math
+import warnings
+from typing import Dict, Optional, Tuple
+
+from .. import telemetry as _telemetry
+from ..ndarray.ndarray import NDArray
+from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, Updater
+
+__all__ = ["FusedUpdater"]
+
+# donation is best-effort: CPU jax has no buffer donation — harmless,
+# the dispatch win stands — and the per-call warning is pure noise
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+# exact-type table: NAG subclasses SGD but has a different rule; LARS /
+# Signum / centered-RMSProp etc. are absent → per-param fallback
+_RULES = {SGD: "sgd", NAG: "nag", Adam: "adam", AdamW: "adamw",
+          RMSProp: "rmsprop", AdaGrad: "adagrad"}
+
+# rules whose eager kernel folds wd into the gradient (prep_grad) only
+# when wd != 0; adamw/adagrad apply wd decoupled, unconditionally
+_FOLD_WD = ("sgd", "nag", "adam", "rmsprop")
+
+
+def _raw_state(s):
+    """updater.states[i] structure (NDArrays / tuples / None) → raw jax
+    arrays with the same structure."""
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s._data
+    return tuple(_raw_state(x) for x in s)
+
+
+def _writeback_state(s, new):
+    """Write raw output arrays back into the (stable) NDArray wrappers —
+    save/load_states and a later fallback to the loop keep working."""
+    if s is None:
+        return
+    if isinstance(s, NDArray):
+        s._set_data(new)
+        return
+    for a, b in zip(s, new):
+        _writeback_state(a, b)
+
+
+class FusedUpdater:
+    """Whole-tree fused twin of :class:`optimizer.Updater`.
+
+    Shares the wrapped Updater's ``states`` dict and ``optimizer``
+    (re-read every step, so ``set_states`` / ``load_states`` swapping
+    the optimizer keeps working), creates missing states exactly like
+    the eager path, and leaves the per-param loop usable at any time —
+    :meth:`step` returns ``(False, None)`` whenever the current
+    optimizer or parameter set is outside the fused envelope.
+    """
+
+    def __init__(self, updater: Updater):
+        self._updater = updater
+        self._cache: Dict[tuple, object] = {}
+
+    # -- per-step host side --------------------------------------------
+    def step(self, updatable, guard: bool):
+        """Apply one fused update to ``updatable`` (list of
+        ``(index, Parameter)``).
+
+        Returns ``(handled, flag)``: ``handled`` False means the caller
+        must run the per-param loop instead; ``flag`` is the device-side
+        all-finite bool (only when ``guard``) for async readback."""
+        import numpy as np
+        import jax
+
+        opt = self._updater.optimizer
+        rule = _RULES.get(type(opt))
+        if rule is None:
+            return False, None
+        if rule == "rmsprop" and (opt.centered or opt.clip_weights):
+            return False, None
+        n = len(updatable)
+        if n == 0:
+            return True, None
+
+        ws_nd, gs_nd = [], []
+        for _, p in updatable:
+            if p.stype != "default" or \
+                    getattr(p, "_grad_stype", "default") != "default":
+                return False, None
+            ws_nd.append(p.data())
+            gs_nd.append(p.grad())
+
+        states = self._updater.states
+        for (i, _), w in zip(updatable, ws_nd):
+            if i not in states:
+                states[i] = opt.create_state_multi_precision(i, w)
+
+        # host bookkeeping in eager order: every param's count advances
+        # before any lr is read, so a shared lr_scheduler sees the same
+        # num_update for the whole tree (what the per-param loop
+        # converges to after the first param)
+        for i, _ in updatable:
+            opt._update_count(i)
+        mp_pattern, wd_pattern = [], []
+        lrs = np.empty(n, np.float32)
+        wds = np.empty(n, np.float32)
+        for k, (i, p) in enumerate(updatable):
+            lr, wd = opt._get_lr(i), opt._get_wd(i)
+            if rule == "adam":
+                # bias correction folds into lr in Python doubles, then
+                # rounds once — the same bits the eager Adam class feeds
+                # adam_update
+                t = opt._index_update_count[i]
+                lr *= math.sqrt(1. - opt.beta2 ** t) / (1. - opt.beta1 ** t)
+            lrs[k] = lr
+            wds[k] = wd
+            wd_pattern.append(bool(wd))
+            mp_pattern.append(bool(opt.multi_precision
+                                   and ws_nd[k].dtype == np.float16))
+        if rule == "adamw":
+            counts = [opt._index_update_count[i] for i, _ in updatable]
+            extras = (np.array([1. - opt.beta1 ** t for t in counts],
+                               np.float32),
+                      np.array([1. - opt.beta2 ** t for t in counts],
+                               np.float32))
+        else:
+            extras = ()
+
+        clip = opt.clip_gradient
+        clip_on = bool(clip and clip > 0)
+        if rule in ("sgd", "nag"):
+            baked = (opt.momentum,)
+        elif rule in ("adam", "adamw"):
+            baked = (opt.beta1, opt.beta2, opt.epsilon)
+        elif rule == "rmsprop":
+            baked = (opt.gamma1, opt.epsilon)
+        else:
+            baked = (opt.float_stable_eps,)
+
+        ws = tuple(w._data for w in ws_nd)
+        gs = tuple(g._data for g in gs_nd)
+        sts = tuple(_raw_state(states[i]) for i, _ in updatable)
+        donated = list(ws) + jax.tree_util.tree_leaves(sts) + \
+            (list(gs) if guard else [])
+        if len({id(x) for x in donated}) != len(donated):
+            return False, None   # aliased buffers cannot be donated
+
+        key = (rule, n, baked, tuple(mp_pattern), tuple(wd_pattern),
+               clip_on, guard)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._cache[key] = self._build(key)
+        new_ws, new_sts, new_gs, flag = fn(
+            ws, gs, sts, lrs, wds, extras, np.float32(opt.rescale_grad),
+            np.float32(clip if clip_on else 0.0))
+
+        for k, (i, _) in enumerate(updatable):
+            ws_nd[k]._set_data(new_ws[k])
+            _writeback_state(states[i], new_sts[k])
+            if new_gs is not None:
+                gs_nd[k]._set_data(new_gs[k])
+        c = _telemetry.counter(
+            "mxtpu_optimizer_fused_updates",
+            "whole-tree fused optimizer dispatches "
+            "(one jit call updating every parameter)")
+        c.inc(site="fused_update")
+        _telemetry.gauge(
+            "mxtpu_optimizer_dispatches_per_step",
+            "optimizer-update dispatches in the last trainer step "
+            "(1 = fused; num_params = per-param loop)").set(1)
+        return True, flag
+
+    # -- compiled side -------------------------------------------------
+    def _build(self, key):
+        import jax
+        import jax.numpy as jnp
+        from . import cores
+        from ..contrib.amp.loss_scaler import all_finite_flag
+
+        rule, n, baked, mp_pattern, wd_pattern, clip_on, guard = key
+
+        def fn(ws, gs, states, lrs, wds, extras, rescale, clip):
+            # guard decides on the RAW grads (pre-rescale), exactly like
+            # the eager _grads_nonfinite → amp.all_finite check
+            allfin = all_finite_flag(gs) if guard else None
+            new_ws, new_sts = [], []
+            for k in range(n):
+                w, g, st = ws[k], gs[k], states[k]
+                if mp_pattern[k]:
+                    w32, inner = st
+                    tw, tst, gk = w32, inner, g.astype(jnp.float32)
+                else:
+                    tw, tst, gk = w, st, g
+                lr, wd = lrs[k], wds[k]
+                gp = cores.prep_grad(
+                    gk, rescale, clip if clip_on else None,
+                    wd if (rule in _FOLD_WD and wd_pattern[k]) else None,
+                    tw)
+                if rule in ("sgd", "nag"):
+                    momentum, = baked
+                    if tst is None:
+                        nw, nst = cores.sgd(tw, gp, lr), None
+                    elif rule == "sgd":
+                        nw, nst = cores.sgd_momentum(tw, gp, tst, lr,
+                                                     momentum)
+                    else:
+                        nw, nst = cores.nag_momentum(tw, gp, tst, lr,
+                                                     momentum)
+                elif rule == "adam":
+                    b1, b2, eps = baked
+                    nw, nm, nv = cores.adam(tw, gp, tst[0], tst[1], lr,
+                                            b1, b2, eps)
+                    nst = (nm, nv)
+                elif rule == "adamw":
+                    b1, b2, eps = baked
+                    coef1s, coef2s = extras
+                    nw, nm, nv = cores.adamw(tw, gp, tst[0], tst[1], lr,
+                                             wd, b1, b2, eps,
+                                             coef1s[k], coef2s[k])
+                    nst = (nm, nv)
+                elif rule == "rmsprop":
+                    g1, eps = baked
+                    nw, nst = cores.rmsprop(tw, gp, tst, lr, g1, eps)
+                else:
+                    eps, = baked
+                    nw, nst = cores.adagrad(tw, gp, tst, lr, eps, wd)
+                if mp_pattern[k]:
+                    new_sts.append((nw, nst))
+                    new_ws.append(nw.astype(w.dtype))
+                else:
+                    new_sts.append(nst)
+                    new_ws.append(nw)
+            new_ws, new_sts = tuple(new_ws), tuple(new_sts)
+            if not guard:
+                return new_ws, new_sts, None, None
+            ok = jnp.asarray(True) if allfin is None else allfin
+            # grads gate to ZERO on a skipped step (the eager guard
+            # zeroes them so grad_req='add' does not re-poison the next
+            # step); on a clean step they pass through into fresh
+            # buffers (theirs were donated)
+            return (tuple(jnp.where(ok, a, b) for a, b in zip(new_ws, ws)),
+                    jax.tree.map(lambda a, b: jnp.where(ok, a, b),
+                                 new_sts, states),
+                    tuple(jnp.where(ok, g, jnp.zeros_like(g)) for g in gs),
+                    ok)
+
+        jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if guard else (0, 2))
+        return _telemetry.instrument_jit("fused_update", jitted)

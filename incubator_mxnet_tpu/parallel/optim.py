@@ -1,12 +1,14 @@
-"""Functional optimizer cores for the compiled SPMD train step.
+"""Functional optimizers for the compiled SPMD train step.
 
 The imperative ``mx.optimizer`` classes (reference parity layer) mutate
 NDArrays eagerly; inside one jitted+sharded train step the update must be a
-pure function of (params, grads, state, step).  These mirror the same
-update rules as ndarray/optimizer_ops.py (reference:
-src/operator/optimizer_op.cc) in pytree form — the analog of the
-reference's "server-side optimizer" (update_on_kvstore), except the
-"server" is the compiled program itself (SURVEY §2.4).
+pure function of (params, grads, state, step).  The update-rule arithmetic
+lives in ``optimizer/cores.py`` — ONE set of pure per-leaf cores shared
+with the eager ops (ndarray/optimizer_ops.py) and the fused whole-tree
+Trainer step (optimizer/fused.py); this module lifts those cores to
+pytrees — the analog of the reference's "server-side optimizer"
+(update_on_kvstore), except the "server" is the compiled program itself
+(SURVEY §2.4).
 """
 from __future__ import annotations
 
@@ -14,12 +16,18 @@ from typing import Any, Dict, NamedTuple
 
 from ..base import MXNetError
 
-__all__ = ["FunctionalOptimizer", "sgd", "adam", "lamb", "create"]
+__all__ = ["FunctionalOptimizer", "sgd", "adam", "adamw", "rmsprop",
+           "adagrad", "nag", "lamb", "create"]
 
 
 def _jnp():
     import jax.numpy as jnp
     return jnp
+
+
+def _cores():
+    from ..optimizer import cores
+    return cores
 
 
 class FunctionalOptimizer(NamedTuple):
@@ -33,24 +41,55 @@ class FunctionalOptimizer(NamedTuple):
     update: Any
 
 
+def _zeros_state(params):
+    import jax
+    return jax.tree.map(lambda p: _jnp().zeros_like(p), params)
+
+
 def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None):
     import jax
+    c = _cores()
 
     def init(params):
         if momentum == 0.0:
             return {}
-        return {"mom": jax.tree.map(lambda p: _jnp().zeros_like(p), params)}
+        return {"mom": _zeros_state(params)}
 
     def update(params, grads, state, step):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+
+        def prep(g, w):
+            return c.prep_grad(g, wd=wd if wd else None, w=w)
         if momentum == 0.0:
-            new_p = jax.tree.map(lambda w, g: w - lr * (g + wd * w),
-                                 params, grads)
+            new_p = jax.tree.map(
+                lambda w, g: c.sgd(w, prep(g, w), lr), params, grads)
             return new_p, state
-        new_mom = jax.tree.map(
-            lambda m, g, w: momentum * m - lr * (g + wd * w),
-            state["mom"], grads, params)
-        new_p = jax.tree.map(lambda w, m: w + m, params, new_mom)
+        pairs = jax.tree.map(
+            lambda w, g, m: c.sgd_momentum(w, prep(g, w), m, lr, momentum),
+            params, grads, state["mom"])
+        new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
+        new_mom = jax.tree.map(lambda w, pr: pr[1], params, pairs)
+        return new_p, {"mom": new_mom}
+    return FunctionalOptimizer(init, update)
+
+
+def nag(learning_rate=0.01, momentum=0.9, wd=0.0, lr_schedule=None):
+    """Nesterov momentum SGD (reference: nag_mom_update)."""
+    import jax
+    c = _cores()
+
+    def init(params):
+        return {"mom": _zeros_state(params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        pairs = jax.tree.map(
+            lambda w, g, m: c.nag_momentum(
+                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                m, lr, momentum),
+            params, grads, state["mom"])
+        new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
+        new_mom = jax.tree.map(lambda w, pr: pr[1], params, pairs)
         return new_p, {"mom": new_mom}
     return FunctionalOptimizer(init, update)
 
@@ -59,28 +98,95 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
          lr_schedule=None):
     import jax
     jnp = _jnp()
+    c = _cores()
 
     def init(params):
-        z = lambda p: jnp.zeros_like(p)  # noqa: E731
-        return {"m": jax.tree.map(z, params),
-                "v": jax.tree.map(z, params)}
+        return {"m": _zeros_state(params), "v": _zeros_state(params)}
 
     def update(params, grads, state, step):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         t = step.astype(jnp.float32)
         coef = jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
-        # wd folds into the gradient BEFORE the moment updates, matching the
-        # eager adam_update (ndarray/optimizer_ops.py / reference
-        # src/operator/optimizer_op-inl.h AdamUpdate) — not AdamW-style
-        geff = jax.tree.map(lambda g, w: g + wd * w, grads, params)
-        new_m = jax.tree.map(lambda m, g: beta1 * m + (1 - beta1) * g,
-                             state["m"], geff)
-        new_v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
-                             state["v"], geff)
-        new_p = jax.tree.map(
-            lambda w, m, v: w - lr * coef * m / (jnp.sqrt(v) + epsilon),
-            params, new_m, new_v)
+        # wd folds into the gradient BEFORE the moment updates, matching
+        # the eager adam_update (reference AdamUpdate) — not AdamW-style;
+        # bias correction folds into lr exactly like the eager Adam class
+        triples = jax.tree.map(
+            lambda w, g, m, v: c.adam(
+                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                m, v, lr * coef, beta1, beta2, epsilon),
+            params, grads, state["m"], state["v"])
+        new_p = jax.tree.map(lambda w, tr: tr[0], params, triples)
+        new_m = jax.tree.map(lambda w, tr: tr[1], params, triples)
+        new_v = jax.tree.map(lambda w, tr: tr[2], params, triples)
         return new_p, {"m": new_m, "v": new_v}
+    return FunctionalOptimizer(init, update)
+
+
+def adamw(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+          wd=0.0, lr_schedule=None):
+    """AdamW — decoupled weight decay (reference: contrib.adamw)."""
+    import jax
+    jnp = _jnp()
+    c = _cores()
+
+    def init(params):
+        return {"m": _zeros_state(params), "v": _zeros_state(params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        t = step.astype(jnp.float32)
+        coef1 = 1.0 - beta1 ** t
+        coef2 = 1.0 - beta2 ** t
+        triples = jax.tree.map(
+            lambda w, g, m, v: c.adamw(w, g, m, v, lr, wd, beta1, beta2,
+                                       epsilon, coef1, coef2),
+            params, grads, state["m"], state["v"])
+        new_p = jax.tree.map(lambda w, tr: tr[0], params, triples)
+        new_m = jax.tree.map(lambda w, tr: tr[1], params, triples)
+        new_v = jax.tree.map(lambda w, tr: tr[2], params, triples)
+        return new_p, {"m": new_m, "v": new_v}
+    return FunctionalOptimizer(init, update)
+
+
+def rmsprop(learning_rate=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
+            lr_schedule=None):
+    """Non-centered RMSProp (reference: rmsprop_update)."""
+    import jax
+    c = _cores()
+
+    def init(params):
+        return {"n": _zeros_state(params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        pairs = jax.tree.map(
+            lambda w, g, n: c.rmsprop(
+                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                n, lr, gamma1, epsilon),
+            params, grads, state["n"])
+        new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
+        new_n = jax.tree.map(lambda w, pr: pr[1], params, pairs)
+        return new_p, {"n": new_n}
+    return FunctionalOptimizer(init, update)
+
+
+def adagrad(learning_rate=0.01, epsilon=1e-7, wd=0.0, lr_schedule=None):
+    """AdaGrad (reference: adagrad_update — decoupled wd, epsilon inside
+    the sqrt)."""
+    import jax
+    c = _cores()
+
+    def init(params):
+        return {"h": _zeros_state(params)}
+
+    def update(params, grads, state, step):
+        lr = lr_schedule(step) if lr_schedule is not None else learning_rate
+        pairs = jax.tree.map(
+            lambda w, g, h: c.adagrad(w, g, h, lr, epsilon, wd),
+            params, grads, state["h"])
+        new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
+        new_h = jax.tree.map(lambda w, pr: pr[1], params, pairs)
+        return new_p, {"h": new_h}
     return FunctionalOptimizer(init, update)
 
 
@@ -90,19 +196,19 @@ def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
     lamb_update_phase1/2)."""
     import jax
     jnp = _jnp()
+    c = _cores()
 
     def init(params):
-        z = lambda p: jnp.zeros_like(p)  # noqa: E731
-        return {"m": jax.tree.map(z, params),
-                "v": jax.tree.map(z, params)}
+        return {"m": _zeros_state(params), "v": _zeros_state(params)}
 
     def update(params, grads, state, step):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         t = step.astype(jnp.float32)
-        new_m = jax.tree.map(lambda m, g: beta1 * m + (1 - beta1) * g,
-                             state["m"], grads)
-        new_v = jax.tree.map(lambda v, g: beta2 * v + (1 - beta2) * g * g,
-                             state["v"], grads)
+        pairs = jax.tree.map(
+            lambda m, g, v: c.moments(m, v, g, beta1, beta2),
+            state["m"], grads, state["v"])
+        new_m = jax.tree.map(lambda m, pr: pr[0], state["m"], pairs)
+        new_v = jax.tree.map(lambda m, pr: pr[1], state["m"], pairs)
 
         def upd(w, m, v):
             mhat = m / (1 - beta1 ** t)
@@ -117,7 +223,8 @@ def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
     return FunctionalOptimizer(init, update)
 
 
-_REGISTRY = {"sgd": sgd, "adam": adam, "lamb": lamb}
+_REGISTRY = {"sgd": sgd, "nag": nag, "adam": adam, "adamw": adamw,
+             "rmsprop": rmsprop, "adagrad": adagrad, "lamb": lamb}
 
 
 def create(name, **kwargs) -> FunctionalOptimizer:

@@ -1044,6 +1044,13 @@ def _metrics_init():
     _m["dl_fallbacks"] = c("mxtpu_dataloader_fallbacks",
                            "dataloader worker failures absorbed by "
                            "in-process fetch")
+    _m["fused_updates"] = c("mxtpu_optimizer_fused_updates",
+                            "whole-tree fused optimizer dispatches "
+                            "(one jit call updating every parameter)")
+    _m["dispatches_per_step"] = g("mxtpu_optimizer_dispatches_per_step",
+                                  "optimizer-update dispatches in the "
+                                  "last trainer step (1 = fused; "
+                                  "num_params = per-param loop)")
 
 
 _op_keys: Dict[str, tuple] = {}   # op name -> label key, spares the hot

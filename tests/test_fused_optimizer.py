@@ -1,0 +1,350 @@
+"""Fused whole-tree optimizer step tests: bit parity vs the per-param
+loop for every fused-capable optimizer (incl. fp16 master weights and
+clip_gradient), the in-graph sync-free non-finite guard, fallback
+conditions, env/ctor wiring, and telemetry counters."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.gluon import Trainer, nn
+from incubator_mxnet_tpu.optimizer import FusedUpdater
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+
+
+def _make_net(dtype="float32"):
+    np.random.seed(7)
+    mx.random.seed(7)
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(5, 6).astype(dtype))
+    y = mx.nd.array(np.random.randn(5, 3).astype(dtype))
+    if dtype != "float32":
+        net.cast(dtype)
+    net(x)                                  # settle deferred shapes
+    return net, x, y
+
+
+def _train(fused, optimizer, opt_params, steps=4, dtype="float32",
+           skip_nonfinite=None):
+    net, x, y = _make_net(dtype)
+    trainer = Trainer(net.collect_params(), optimizer, dict(opt_params),
+                      fused=fused, skip_nonfinite=skip_nonfinite)
+    loss_fn = mx.gluon.loss.L2Loss()
+    for _ in range(steps):
+        with ag.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(5)
+    params = [p.data().asnumpy()
+              for p in net.collect_params().values()]
+    return params, trainer
+
+
+def _states(trainer):
+    out = []
+    for i in sorted(trainer._updaters.states):
+        s = trainer._updaters.states[i]
+        out.append(_flatten_state(s))
+    return out
+
+
+def _flatten_state(s):
+    if s is None:
+        return []
+    if isinstance(s, tuple):
+        return [a for x in s for a in _flatten_state(x)]
+    return [s.asnumpy()]
+
+
+FUSED_CONFIGS = [
+    ("sgd", {"learning_rate": 0.05}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-3}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-3}),
+    ("adamw", {"learning_rate": 0.01, "wd": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "wd": 1e-4}),
+    ("adagrad", {"learning_rate": 0.05, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01, "clip_gradient": 0.1}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9,
+             "clip_gradient": 0.05}),
+]
+
+
+# --------------------------------------------------- fused-vs-loop parity
+@pytest.mark.parametrize("optimizer,opt_params", FUSED_CONFIGS)
+def test_fused_matches_loop(optimizer, opt_params):
+    fused_p, fused_tr = _train(True, optimizer, opt_params)
+    loop_p, loop_tr = _train(False, optimizer, opt_params)
+    assert fused_tr._fused is not None
+    assert loop_tr._fused is None
+    for a, b in zip(fused_p, loop_p):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+    for sa, sb in zip(_states(fused_tr), _states(loop_tr)):
+        assert len(sa) == len(sb)
+        for a, b in zip(sa, sb):
+            np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+def test_fused_matches_loop_multi_precision_fp16():
+    cfg = {"learning_rate": 0.1, "momentum": 0.9,
+           "multi_precision": True, "clip_gradient": 0.5}
+    fused_p, fused_tr = _train(True, "sgd", cfg, dtype="float16")
+    loop_p, loop_tr = _train(False, "sgd", cfg, dtype="float16")
+    assert fused_tr._fused is not None
+    for a, b in zip(fused_p, loop_p):
+        assert a.dtype == np.float16
+        np.testing.assert_allclose(a.astype(np.float32),
+                                   b.astype(np.float32), rtol=2e-3)
+    # the fp32 master weights + momenta must agree at full precision
+    for sa, sb in zip(_states(fused_tr), _states(loop_tr)):
+        for a, b in zip(sa, sb):
+            assert a.dtype == np.float32
+            np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+def test_fused_adam_multi_precision_fp16():
+    cfg = {"learning_rate": 0.01, "multi_precision": True}
+    fused_p, fused_tr = _train(True, "adam", cfg, dtype="float16")
+    loop_p, _ = _train(False, "adam", cfg, dtype="float16")
+    assert fused_tr._fused is not None
+    for a, b in zip(fused_p, loop_p):
+        np.testing.assert_allclose(a.astype(np.float32),
+                                   b.astype(np.float32), rtol=2e-3)
+
+
+def test_fused_lr_change_no_mismatch():
+    """set_learning_rate mid-training is a traced input — values track
+    the loop path without recompiling per lr."""
+    def run(fused):
+        net, x, y = _make_net()
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.05}, fused=fused)
+        loss_fn = mx.gluon.loss.L2Loss()
+        for s in range(4):
+            if s == 2:
+                tr.set_learning_rate(0.01)
+            with ag.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(5)
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+# ------------------------------------------------------------- the guard
+def test_fused_guard_skips_poisoned_step_only(monkeypatch):
+    telemetry.start()
+    fault.install_plan("trainer.grad:nonfinite@2")
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, skip_nonfinite=True,
+                      fused=True)
+    # the fused guard must never take the eager synchronous path
+    def _boom(self):
+        raise AssertionError("fused guard must not host-sync via "
+                             "_grads_nonfinite")
+    monkeypatch.setattr(Trainer, "_grads_nonfinite", _boom)
+    loss_fn = mx.gluon.loss.L2Loss()
+
+    def step():
+        with ag.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(5)
+
+    step()                                  # clean
+    assert trainer._fused is not None
+    w1 = [p.data().asnumpy() for p in net.collect_params().values()]
+    step()                                  # poisoned → skipped in-graph
+    trainer.sync_nonfinite_guard()
+    w2 = [p.data().asnumpy() for p in net.collect_params().values()]
+    for a, b in zip(w1, w2):
+        np.testing.assert_array_equal(a, b)
+    assert telemetry.counters_flat()["mxtpu_skipped_steps"] == 1
+    step()                                  # clean again → updates
+    trainer.sync_nonfinite_guard()
+    w3 = [p.data().asnumpy() for p in net.collect_params().values()]
+    assert any((a != b).any() for a, b in zip(w2, w3))
+    assert telemetry.counters_flat()["mxtpu_skipped_steps"] == 1
+    # grads were zeroed on the skipped step, passed through otherwise
+    assert all(np.isfinite(p.grad().asnumpy()).all()
+               for p in net.collect_params().values())
+
+
+def test_fused_guard_counts_are_async(monkeypatch):
+    """The skipped-step counter may trail until sync_nonfinite_guard —
+    the guard costs no blocking host sync inside step()."""
+    telemetry.start()
+    fault.install_plan("trainer.grad:nonfinite@1")
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, skip_nonfinite=True,
+                      fused=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(5)
+    assert trainer._fused is not None
+    # flag readback pending or already drained opportunistically — but
+    # after the blocking sync it MUST be exact
+    trainer.sync_nonfinite_guard()
+    assert telemetry.counters_flat()["mxtpu_skipped_steps"] == 1
+    assert not trainer._pending_nonfinite
+
+
+# ------------------------------------------------------------- fallbacks
+def test_fused_fallback_unsupported_optimizer():
+    params, trainer = _train(True, "adadelta", {})
+    assert trainer._fused is not None        # constructed...
+    # ...but every step fell back to the loop: parity with fused=False
+    loop_p, _ = _train(False, "adadelta", {})
+    for a, b in zip(params, loop_p):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_fallback_update_on_kvstore():
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, update_on_kvstore=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(5)
+    assert trainer._fused is None
+
+
+def test_fused_fallback_sparse_params():
+    net = nn.Sequential()
+    net.add(nn.Dense(2))
+    net.initialize()
+    emb = net.collect_params()
+    # a row_sparse grad parameter forces the whole trainer off fused
+    p = mx.gluon.Parameter("rs_weight", shape=(4, 2),
+                           grad_stype="row_sparse")
+    p.initialize()
+    trainer = Trainer(list(emb.values()) + [p], "sgd",
+                      {"learning_rate": 0.1})
+    trainer._init_kvstore()
+    assert trainer._fused is None
+
+
+def test_fused_step_returns_false_for_unsupported():
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "rmsprop",
+                      {"learning_rate": 0.01, "centered": True},
+                      fused=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer._init_kvstore()
+    handled, flag = trainer._fused.step(trainer._updatable, guard=False)
+    assert handled is False and flag is None
+    trainer.step(5)                          # loop path still trains
+
+
+# ------------------------------------------------------- wiring/telemetry
+def test_fused_env_default(monkeypatch):
+    net, _, _ = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert trainer._fused_requested is True   # MXNET_FUSED_OPTIMIZER=1
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    net2, _, _ = _make_net()
+    t2 = Trainer(net2.collect_params(), "sgd", {"learning_rate": 0.1})
+    assert t2._fused_requested is False
+    t2._init_kvstore()
+    assert t2._fused is None
+
+
+def test_fused_ctor_overrides_env(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_OPTIMIZER", "0")
+    net, _, _ = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, fused=True)
+    assert trainer._fused_requested is True
+
+
+def test_fused_single_dispatch_and_counters():
+    telemetry.start()
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.01}, fused=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    steps = 3
+    for _ in range(steps):
+        with ag.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(5)
+    flat = telemetry.counters_flat()
+    assert flat["mxtpu_optimizer_fused_updates"] == steps
+    assert flat["mxtpu_optimizer_dispatches_per_step"] == 1
+    # instrument_jit("fused_update") sees every dispatch; at most two
+    # warmup compiles (the second when first-call outputs come back as
+    # committed buffers), then pure cache hits
+    hits = telemetry.registry.get("mx_compile_cache_hits_total")
+    misses = telemetry.registry.get("mx_compile_cache_misses_total")
+    site = (("site", "fused_update"),)
+    n_miss = misses._values.get(site, 0)
+    n_hit = hits._values.get(site, 0)
+    assert 1 <= n_miss <= 2
+    assert n_hit + n_miss == steps
+
+
+def test_loop_dispatch_gauge():
+    telemetry.start()
+    net, x, y = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, fused=False)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(5)
+    flat = telemetry.counters_flat()
+    assert flat["mxtpu_optimizer_dispatches_per_step"] == \
+        len(trainer._updatable) == 4
+    assert flat.get("mxtpu_optimizer_fused_updates", 0) == 0
+
+
+def test_fused_state_save_load_interop(tmp_path):
+    fused_p, fused_tr = _train(True, "adam", {"learning_rate": 0.01})
+    fn = str(tmp_path / "states")
+    fused_tr.save_states(fn)
+    # a loop trainer resumes from fused-written states and vice versa
+    net, x, y = _make_net()
+    loop_tr = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.01}, fused=False)
+    loop_tr.load_states(fn)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    loop_tr.step(5)
+    # and the states round-trip through pickle with live values
+    assert loop_tr._updaters.states
+
+
+def test_fused_updater_shares_cores_with_spmd():
+    """One set of update cores: the registry the SPMD path uses covers
+    every optimizer the fused envelope supports."""
+    from incubator_mxnet_tpu.parallel import optim as fopt
+    for name in ("sgd", "nag", "adam", "adamw", "rmsprop", "adagrad"):
+        f = fopt.create(name)
+        assert isinstance(f, fopt.FunctionalOptimizer)
