@@ -66,6 +66,10 @@ class Trainer:
         self._fused_requested = getenv_bool("MXNET_FUSED_OPTIMIZER", True) \
             if fused is None else bool(fused)
         self._fused = None
+        # True once the fused path was tried for the optimizer
+        # application in flight — _update must not re-run the host-side
+        # setup (and bookkeeping) when step() already attempted it
+        self._fused_attempted = False
         self._updatable = None
         # device-side all-finite flags from fused guarded steps awaiting
         # async readback (skipped-step accounting without a host sync)
@@ -170,10 +174,12 @@ class Trainer:
             if _fault.take("trainer.grad", "nonfinite"):
                 self._poison_grads()
             fused_done = False
+            self._fused_attempted = False
             # an instance-level _update (e.g. amp.init_trainer's overflow
             # wrapper) must stay in the path: route through it and let the
             # fused call inside the class _update take over afterwards
             if self._fused is not None and "_update" not in self.__dict__:
+                self._fused_attempted = True
                 with _telemetry.trace_span("trainer.update", cat="trainer"):
                     fused_done, flag = self._fused.step(
                         self._updatable, guard=self._skip_nonfinite)
@@ -262,6 +268,7 @@ class Trainer:
             if not self._kv_initialized:
                 self._init_kvstore()
             self._optimizer.rescale_grad = self._scale / batch_size
+            self._fused_attempted = False
             self._update(ignore_stale_grad)
         if observe:
             _telemetry.TRAINER.publish(
@@ -270,9 +277,10 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._kvstore is not None and self._update_on_kvstore:
             return  # server applied it in _allreduce_grads
-        if self._fused is not None and \
-                self._fused.step(self._updatable, guard=False)[0]:
-            return
+        if self._fused is not None and not self._fused_attempted:
+            self._fused_attempted = True
+            if self._fused.step(self._updatable, guard=False)[0]:
+                return
         for i, p in self._updatable:
             self._updaters(i, p.grad(), p.data())
         if _telemetry.enabled():

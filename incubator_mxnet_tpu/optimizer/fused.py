@@ -33,12 +33,17 @@ hyperparameter (momentum, betas, epsilon) recompiles; changing lr, wd,
 rescale, or clip values does not.
 
 Numerics: bit-compatible with the per-param loop it replaces — the cores
-keep expression and evaluation order identical, and host-side
-bookkeeping (update counts, Adam bias-corrected lr in Python doubles)
-mirrors the eager classes — asserted by tests/test_fused_optimizer.py.
-One documented divergence: update counts advance even on a
-guard-skipped step (the flag is not known at dispatch time); the eager
-guard skips the whole update including the count.
+keep expression and evaluation order identical, traced scalars are cast
+to each param's compute dtype (matching the weak-typed Python floats the
+eager ops receive, so fp16/bf16 params without master weights stay in
+their own dtype), and host-side bookkeeping (update counts, Adam
+bias-corrected lr in Python doubles) mirrors the eager classes —
+asserted by tests/test_fused_optimizer.py.  Documented divergences:
+update counts advance even on a guard-skipped step (the flag is not
+known at dispatch time; the eager guard skips the whole update including
+the count), and low-precision params may differ from the loop by ~1 ulp
+because the single fused program keeps elementwise intermediates in f32
+where the op-by-op dispatch rounds at every op boundary.
 """
 from __future__ import annotations
 
@@ -51,11 +56,6 @@ from ..ndarray.ndarray import NDArray
 from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, Updater
 
 __all__ = ["FusedUpdater"]
-
-# donation is best-effort: CPU jax has no buffer donation — harmless,
-# the dispatch win stands — and the per-call warning is pure noise
-warnings.filterwarnings(
-    "ignore", message="Some donated buffers were not usable")
 
 # exact-type table: NAG subclasses SGD but has a different rule; LARS /
 # Signum / centered-RMSProp etc. are absent → per-param fallback
@@ -138,6 +138,17 @@ class FusedUpdater:
             if i not in states:
                 states[i] = opt.create_state_multi_precision(i, w)
 
+        ws = tuple(w._data for w in ws_nd)
+        gs = tuple(g._data for g in gs_nd)
+        sts = tuple(_raw_state(states[i]) for i, _ in updatable)
+        donated = list(ws) + jax.tree_util.tree_leaves(sts) + \
+            (list(gs) if guard else [])
+        if len({id(x) for x in donated}) != len(donated):
+            # aliased buffers cannot be donated — bail BEFORE touching
+            # update counts / lr bookkeeping, so the per-param fallback
+            # (which advances them itself) sees them exactly once
+            return False, None
+
         # host bookkeeping in eager order: every param's count advances
         # before any lr is read, so a shared lr_scheduler sees the same
         # num_update for the whole tree (what the per-param loop
@@ -180,22 +191,21 @@ class FusedUpdater:
         else:
             baked = (opt.float_stable_eps,)
 
-        ws = tuple(w._data for w in ws_nd)
-        gs = tuple(g._data for g in gs_nd)
-        sts = tuple(_raw_state(states[i]) for i, _ in updatable)
-        donated = list(ws) + jax.tree_util.tree_leaves(sts) + \
-            (list(gs) if guard else [])
-        if len({id(x) for x in donated}) != len(donated):
-            return False, None   # aliased buffers cannot be donated
-
         key = (rule, n, baked, tuple(mp_pattern), tuple(wd_pattern),
                clip_on, guard)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._cache[key] = self._build(key)
-        new_ws, new_sts, new_gs, flag = fn(
-            ws, gs, sts, lrs, wds, extras, np.float32(opt.rescale_grad),
-            np.float32(clip if clip_on else 0.0))
+        # donation is best-effort: CPU jax has no buffer donation —
+        # harmless, the dispatch win stands — and the per-call warning
+        # is pure noise.  Scoped here so user jax code keeps seeing it.
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            new_ws, new_sts, new_gs, flag = fn(
+                ws, gs, sts, lrs, wds, extras,
+                np.float32(opt.rescale_grad),
+                np.float32(clip if clip_on else 0.0))
 
         for k, (i, _) in enumerate(updatable):
             ws_nd[k]._set_data(new_ws[k])
@@ -234,9 +244,18 @@ class FusedUpdater:
                     tw, tst, gk = w32, inner, g.astype(jnp.float32)
                 else:
                     tw, tst, gk = w, st, g
-                lr, wd = lrs[k], wds[k]
+                # the eager ops take Python-float hyperparameters, which
+                # jax weak-types to the array dtype — fp16/bf16 params
+                # without master weights compute (and stay) in their own
+                # dtype.  The traced scalars here are strongly-typed
+                # f32, so cast them to the compute dtype (a no-op for
+                # f32 weights and fp32 master weights) to keep the
+                # arithmetic and output dtypes identical to the loop.
+                cdt = tw.dtype
+                lr, wd = lrs[k].astype(cdt), wds[k].astype(cdt)
                 gp = cores.prep_grad(
-                    gk, rescale, clip if clip_on else None,
+                    gk, rescale.astype(cdt),
+                    clip.astype(cdt) if clip_on else None,
                     wd if (rule in _FOLD_WD and wd_pattern[k]) else None,
                     tw)
                 if rule in ("sgd", "nag"):
@@ -259,7 +278,8 @@ class FusedUpdater:
                     coef1s, coef2s = extras
                     nw, nm, nv = cores.adamw(tw, gp, tst[0], tst[1], lr,
                                              wd, b1, b2, eps,
-                                             coef1s[k], coef2s[k])
+                                             coef1s[k].astype(cdt),
+                                             coef2s[k].astype(cdt))
                     nst = (nm, nv)
                 elif rule == "rmsprop":
                     g1, eps = baked
@@ -267,12 +287,8 @@ class FusedUpdater:
                 else:
                     eps, = baked
                     nw, nst = cores.adagrad(tw, gp, tst, lr, eps, wd)
-                if mp_pattern[k]:
-                    new_sts.append((nw, nst))
-                    new_ws.append(nw.astype(w.dtype))
-                else:
-                    new_sts.append(nst)
-                    new_ws.append(nw)
+                new_sts.append((nw, nst) if mp_pattern[k] else nst)
+                new_ws.append(nw.astype(w.dtype))
             new_ws, new_sts = tuple(new_ws), tuple(new_sts)
             if not guard:
                 return new_ws, new_sts, None, None

@@ -115,6 +115,38 @@ def test_fused_matches_loop_multi_precision_fp16():
             np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
 
 
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_matches_loop_low_precision_no_master_weights(
+        dtype, optimizer, opt_params):
+    """fp16/bf16 params WITHOUT multi-precision master weights must
+    compute in — and write back — the weight dtype, exactly like the
+    eager loop (regression: strongly-typed f32 traced scalars silently
+    promoted these params to float32)."""
+    cfg = dict(opt_params, multi_precision=False)
+    fused_p, fused_tr = _train(True, optimizer, cfg, dtype=dtype)
+    loop_p, loop_tr = _train(False, optimizer, cfg, dtype=dtype)
+    assert fused_tr._fused is not None
+    # values agree to ~1 ulp of the low-precision dtype (one jit fuses
+    # the elementwise chain without per-op intermediate rounding)
+    for a, b in zip(fused_p, loop_p):
+        assert a.dtype == b.dtype
+        assert str(a.dtype) == dtype
+        np.testing.assert_allclose(a.astype(np.float32),
+                                   b.astype(np.float32),
+                                   rtol=1e-2, atol=1e-4)
+    for sa, sb in zip(_states(fused_tr), _states(loop_tr)):
+        assert len(sa) == len(sb)
+        for a, b in zip(sa, sb):
+            assert a.dtype == b.dtype
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       rtol=1e-2, atol=1e-4)
+
+
 def test_fused_adam_multi_precision_fp16():
     cfg = {"learning_rate": 0.01, "multi_precision": True}
     fused_p, fused_tr = _train(True, "adam", cfg, dtype="float16")
@@ -242,6 +274,62 @@ def test_fused_fallback_sparse_params():
                       {"learning_rate": 0.1})
     trainer._init_kvstore()
     assert trainer._fused is None
+
+
+def test_fused_aliased_fallback_counts_once():
+    """Donation-aliased buffers fall back to the loop BEFORE any fused
+    host-side bookkeeping ran, so update counts advance exactly once per
+    step (regression: 3x per step — fused attempt, _update re-attempt,
+    then the loop — corrupting lr schedules and Adam bias correction)."""
+    p1 = mx.gluon.Parameter("a", shape=(4,))
+    p2 = mx.gluon.Parameter("b", shape=(4,))
+    p1.initialize()
+    p2.initialize()
+    p2.data()._set_data(p1.data()._data)     # two params, one buffer
+    trainer = Trainer([p1, p2], "adam", {"learning_rate": 0.01},
+                      fused=True)
+    trainer.step(1)
+    assert trainer._fused is not None        # constructed, bailed at step
+    opt = trainer._optimizer
+    assert opt.num_update == 1
+    assert all(c == 1 for c in opt._index_update_count.values())
+    trainer.step(1)
+    assert opt.num_update == 2
+    assert all(c == 2 for c in opt._index_update_count.values())
+
+
+def test_fused_attempted_once_per_step(monkeypatch):
+    """step() falling back must not re-run the fused host-side setup
+    from _update — one attempt per step; the public update() entry
+    still gets its own attempt."""
+    calls = []
+    orig = FusedUpdater.step
+    def counting(self, updatable, guard):
+        calls.append(guard)
+        return orig(self, updatable, guard)
+    monkeypatch.setattr(FusedUpdater, "step", counting)
+    net, x, y = _make_net()
+    # adadelta: outside the fused envelope → every step falls back
+    trainer = Trainer(net.collect_params(), "adadelta", {}, fused=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(5)
+    assert calls == [False]
+    trainer.update(5)
+    assert calls == [False, False]
+
+
+def test_no_global_donation_warning_filter():
+    """Importing the fused module must not mutate the process-global
+    warning filter — the donation-noise suppression is scoped to the
+    fused dispatch."""
+    import warnings
+    import incubator_mxnet_tpu.optimizer.fused  # noqa: F401
+    assert not any(
+        f[1] is not None and "donated" in f[1].pattern
+        for f in warnings.filters)
 
 
 def test_fused_step_returns_false_for_unsupported():
