@@ -488,6 +488,114 @@ def _bench_optim(on_accel, kind, dev):
     }
 
 
+def _bench_serve(on_accel, kind, dev):
+    """Dynamic batching vs the unbatched per-request path, measured the
+    way a server sees it: N closed-loop client threads each firing
+    batch-1 requests.  The unbatched baseline drives the SAME bucketed
+    engine directly (one compiled dispatch per request — what a naive
+    server does); the batched run pushes through a DynamicBatcher that
+    coalesces the concurrent stream into one dispatch per group.  The
+    per-request outputs are asserted identical between the two paths
+    (fp tolerance), and the speedup floor (>= 2x at >= 16 clients on
+    the CPU config) is the acceptance bar of docs/serving.md."""
+    import threading
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.serving import DynamicBatcher, InferenceEngine
+    from incubator_mxnet_tpu.serving import metrics as smetrics
+
+    D, L = (1024, 6) if on_accel else (512, 4)
+    clients = 16
+    reqs_per_client = 48 if on_accel else 24
+    max_delay_ms = 2.0
+
+    telemetry.start()
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(L):
+        net.add(nn.Dense(D, in_units=D, activation="relu"))
+    net.initialize(init=mx.init.Xavier())
+    engine = InferenceEngine.from_block(
+        net, [(D,)], name="bench-serve", max_batch_size=clients)
+    engine.warmup()
+
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((1, D)).astype(np.float32)
+          for _ in range(clients)]
+    refs = [np.asarray(engine.predict([x])[0]) for x in xs]
+
+    def drive(fire):
+        """closed loop: each client fires its next request the moment
+        the previous one returns; per-request latencies in seconds."""
+        lat = [[] for _ in range(clients)]
+        errs = []
+
+        def client(i):
+            try:
+                for _ in range(reqs_per_client):
+                    t0 = time.perf_counter()
+                    out = fire(xs[i])
+                    lat[i].append(time.perf_counter() - t0)
+                    if not np.allclose(np.asarray(out), refs[i],
+                                       rtol=1e-4, atol=1e-5):
+                        errs.append(f"client {i}: output mismatch")
+                        return
+            except Exception as e:
+                errs.append(f"client {i}: {e!r}")
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        flat = sorted(s for per in lat for s in per)
+        total = len(flat)
+        return {"requests_per_sec": round(total / wall, 1),
+                "p50_ms": round(flat[total // 2] * 1e3, 3),
+                "p99_ms": round(flat[min(total - 1,
+                                         int(total * 0.99))] * 1e3, 3),
+                "wall_seconds": round(wall, 3)}
+
+    # unbatched baseline: per-request compiled dispatch, warmed
+    unbatched = drive(lambda x: engine.predict([x])[0])
+
+    batcher = DynamicBatcher(engine, max_batch_size=clients,
+                             max_delay_ms=max_delay_ms,
+                             name="bench-serve")
+    req0 = smetrics.REQUESTS.value
+    bat0 = smetrics.BATCHES.value
+    try:
+        batched = drive(lambda x: batcher.submit([x])[0])
+    finally:
+        batcher.close()
+    n_req = smetrics.REQUESTS.value - req0
+    n_bat = max(1.0, smetrics.BATCHES.value - bat0)
+
+    speedup = round(batched["requests_per_sec"]
+                    / max(unbatched["requests_per_sec"], 1e-9), 3)
+    return {
+        "model": f"mlp_{L}x{D}",
+        "clients": clients,
+        "requests": clients * reqs_per_client,
+        "max_delay_ms": max_delay_ms,
+        "buckets": list(engine.buckets),
+        "compiled_programs": engine.compiled_programs(),
+        "unbatched": unbatched,
+        "batched": batched,
+        "batches_dispatched": int(n_bat),
+        "mean_batch_size": round(n_req / n_bat, 2),
+        "speedup": speedup,
+        "speedup_floor": 2.0,
+        "floor_ok": bool(speedup >= 2.0),
+    }
+
+
 _SCALING_SCRIPT = r"""
 import json, time
 import numpy as np
@@ -667,6 +775,8 @@ def _sub_main(name):
         rec = _bench_int8_conv(on_accel, kind, dev)
     elif name == "optim":
         rec = _bench_optim(on_accel, kind, dev)
+    elif name == "serve":
+        rec = _bench_serve(on_accel, kind, dev)
     else:
         raise SystemExit(f"unknown sub-bench {name!r}")
     tel = _telemetry_snapshot()
@@ -741,6 +851,7 @@ def _main(preset_fusion):
         int8 = _run_sub("int8", platform, kind, timeout=1800)
         int8["conv"] = _run_sub("int8_conv", platform, kind, timeout=2700)
         optim = _run_sub("optim", platform, kind, timeout=1800)
+        serve = _run_sub("serve", platform, kind, timeout=1800)
         scaling = _scaling_dryrun()
     else:
         import jax
@@ -766,6 +877,10 @@ def _main(preset_fusion):
             optim = _bench_optim(False, kind, dev)
         except Exception as e:
             optim = {"error": str(e)[:200]}
+        try:
+            serve = _bench_serve(False, kind, dev)
+        except Exception as e:
+            serve = {"error": str(e)[:200]}
         scaling = _scaling_dryrun()
 
     out = {
@@ -787,6 +902,7 @@ def _main(preset_fusion):
         "resnet50": resnet,
         "int8_inference": int8,
         "optimizer_update": optim,
+        "serving": serve,
         "dp_scaling": scaling,
     }
     if probe is not None:

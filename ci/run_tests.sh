@@ -30,6 +30,10 @@ usage: ci/run_tests.sh <function>
                         resumed params are bit-identical to an
                         uninterrupted golden run, and losses stay
                         continuous across the kill
+  serve_smoke           serving drill: in-process ModelServer, concurrent
+                        HTTP clients; asserts batched dispatches << request
+                        count, per-request outputs match the direct engine,
+                        serve histograms on /metrics, and a clean drain
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -204,6 +208,84 @@ fault_smoke() {
     MXNET_FAULT_PLAN="$plan" python tools/fault_smoke.py resume --out "$out"
     # check: bit-identical params, continuous losses
     env -u MXNET_FAULT_PLAN python tools/fault_smoke.py check --out "$out"
+}
+
+serve_smoke() {
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.serving import InferenceEngine, ModelServer
+from incubator_mxnet_tpu.serving import metrics as smetrics
+
+telemetry.start()
+mx.random.seed(0)
+net = nn.HybridSequential()
+for _ in range(3):
+    net.add(nn.Dense(64, in_units=64, activation="relu"))
+net.initialize(init=mx.init.Xavier())
+
+CLIENTS, REQS = 16, 4
+engine = InferenceEngine.from_block(net, [(64,)], name="smoke",
+                                    max_batch_size=CLIENTS)
+rng = np.random.default_rng(0)
+xs = [rng.standard_normal((1, 64)).astype(np.float32)
+      for _ in range(CLIENTS)]
+refs = [np.asarray(engine.predict([x])[0]) for x in xs]
+
+srv = ModelServer(port=0, max_delay_ms=10.0)
+srv.add_model("smoke", engine, warmup=True)
+srv.start()
+url = f"http://127.0.0.1:{srv.port}"
+req0, bat0 = smetrics.REQUESTS.value, smetrics.BATCHES.value
+
+errors = []
+def client(i):
+    try:
+        body = json.dumps({"inputs": [xs[i].tolist()]}).encode()
+        for _ in range(REQS):
+            r = urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/models/smoke:predict", data=body), timeout=30)
+            out = np.array(json.loads(r.read())["outputs"][0],
+                           dtype=np.float32)
+            np.testing.assert_allclose(out, refs[i], rtol=1e-4,
+                                       atol=1e-5)
+    except Exception as e:
+        errors.append(f"client {i}: {e!r}")
+
+threads = [threading.Thread(target=client, args=(i,))
+           for i in range(CLIENTS)]
+[t.start() for t in threads]
+[t.join() for t in threads]
+assert not errors, "serve_smoke: " + "; ".join(errors[:3])
+
+n_req = smetrics.REQUESTS.value - req0
+n_bat = smetrics.BATCHES.value - bat0
+assert n_req == CLIENTS * REQS, \
+    f"serve_smoke: {n_req} requests counted (wanted {CLIENTS * REQS})"
+assert n_bat <= n_req / 2, \
+    f"serve_smoke: {int(n_bat)} batches for {int(n_req)} requests — " \
+    "dynamic batching is not coalescing"
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+for series in ("mxtpu_serve_batch_size", "mxtpu_serve_queue_wait_seconds",
+               "mxtpu_serve_latency_seconds"):
+    assert series in prom, f"serve_smoke: {series} missing from /metrics"
+assert engine.compiled_programs() == len(engine.buckets), \
+    f"serve_smoke: {engine.compiled_programs()} compiled programs for " \
+    f"{len(engine.buckets)} buckets — the jit cache is not bounded"
+srv.stop()                      # graceful drain + port release
+assert srv.models() == [], "serve_smoke: registry not empty after stop"
+print(f"serve_smoke ok: {int(n_req)} requests in {int(n_bat)} batches "
+      f"(mean {n_req / n_bat:.1f} rows), "
+      f"{engine.compiled_programs()} programs for "
+      f"{len(engine.buckets)} buckets, clean shutdown")
+EOF
 }
 
 multichip_dryrun() {

@@ -60,6 +60,7 @@ from . import numpy_extension as npx
 from . import engine
 from . import telemetry
 from . import fault
+from . import serving
 from . import profiler
 from . import test_utils
 from . import library
@@ -74,5 +75,5 @@ __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "gluon", "optimizer", "lr_scheduler", "kvstore", "kv",
            "parallel", "symbol", "sym", "Executor", "io", "recordio",
            "image", "metric", "callback", "model", "module", "mod", "np",
-           "npx", "engine", "telemetry", "fault", "profiler", "runtime",
-           "contrib"]
+           "npx", "engine", "telemetry", "fault", "serving", "profiler",
+           "runtime", "contrib"]

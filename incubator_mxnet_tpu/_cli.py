@@ -105,3 +105,107 @@ def stats_main():
     else:
         sys.stdout.write(text)
     sys.exit(status)
+
+
+def serve_main():
+    """``mxtpu-serve`` — dynamic-batching inference server over exported
+    model artifacts (see docs/serving.md)::
+
+        mxtpu-serve --model mnist=/models/mnist:7 \\
+                    --model small=/models/small \\
+                    [--port N] [--max-batch N] [--max-delay-ms F]
+                    [--queue N] [--input-names data]
+                    [--input-specs 784] [--warmup]
+
+    Each ``--model`` is ``NAME=PREFIX[:EPOCH]`` naming a
+    ``HybridBlock.export`` / ``model.save_checkpoint`` pair
+    (``PREFIX-symbol.json`` + ``PREFIX-EPOCH.params``).  Serves
+    ``/v1/models/<name>:predict``, the model registry, ``/healthz`` and
+    ``/metrics`` until interrupted; Ctrl-C drains queued requests before
+    exiting.  Knobs default from ``MXNET_SERVE_*`` (docs/env_var.md)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mxtpu-serve",
+        description="serve exported models with dynamic batching over "
+                    "shape-bucketed compiled engines")
+    ap.add_argument("--model", action="append", default=[],
+                    metavar="NAME=PREFIX[:EPOCH]",
+                    help="register an exported model (repeatable)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="HTTP port (default MXNET_SERVE_PORT or 8080; "
+                         "0 picks an ephemeral port)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="rows per coalesced dispatch "
+                         "(default MXNET_SERVE_MAX_BATCH or 32)")
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="batching deadline in ms "
+                         "(default MXNET_SERVE_MAX_DELAY_MS or 5)")
+    ap.add_argument("--queue", type=int, default=None,
+                    help="bounded queue size before backpressure "
+                         "(default MXNET_SERVE_QUEUE or 128)")
+    ap.add_argument("--input-names", default="data",
+                    help="comma-separated graph input names "
+                         "(default 'data')")
+    ap.add_argument("--input-specs", default=None,
+                    metavar="D1,D2[;D1,...]",
+                    help="per-example input shapes, batch dim excluded — "
+                         "one comma-separated shape per input, "
+                         "';'-separated (e.g. '784' or '3,224,224'); "
+                         "required for --warmup")
+    ap.add_argument("--warmup", action="store_true",
+                    help="AOT-compile every bucket before serving "
+                         "(needs --input-specs)")
+    ns = ap.parse_args()
+    if not ns.model:
+        ap.error("at least one --model NAME=PREFIX[:EPOCH] is required")
+    input_specs = None
+    if ns.input_specs is not None:
+        input_specs = [tuple(int(d) for d in part.split(",") if d)
+                       for part in ns.input_specs.split(";")]
+    if ns.warmup and input_specs is None:
+        ap.error("--warmup needs --input-specs (per-example shapes) to "
+                 "synthesize bucket batches")
+
+    from .base import getenv_int
+    from .serving import InferenceEngine, ModelServer
+
+    batcher_kw = {}
+    if ns.max_batch is not None:
+        batcher_kw["max_batch_size"] = ns.max_batch
+    if ns.max_delay_ms is not None:
+        batcher_kw["max_delay_ms"] = ns.max_delay_ms
+    if ns.queue is not None:
+        batcher_kw["queue_size"] = ns.queue
+    srv = ModelServer(port=ns.port, host=ns.host, **batcher_kw)
+    input_names = [s for s in ns.input_names.split(",") if s]
+    for spec in ns.model:
+        name, _, ref = spec.partition("=")
+        if not name or not ref:
+            ap.error(f"--model wants NAME=PREFIX[:EPOCH], got {spec!r}")
+        prefix, _, epoch = ref.rpartition(":")
+        if not prefix or not epoch.isdigit():
+            prefix, epoch = ref, "0"
+        engine = InferenceEngine.from_export(
+            prefix, int(epoch), input_names=input_names,
+            input_specs=input_specs,
+            max_batch_size=ns.max_batch
+            or getenv_int("MXNET_SERVE_MAX_BATCH", 32),
+            name=name)
+        srv.add_model(name, engine, warmup=ns.warmup)
+        sys.stderr.write(f"mxtpu-serve: loaded {name} from {prefix} "
+                         f"(epoch {int(epoch)}, buckets "
+                         f"{list(engine.buckets)})\n")
+    srv.start()
+    sys.stderr.write(f"mxtpu-serve: listening on "
+                     f"http://{ns.host}:{srv.port} "
+                     f"(/v1/models, /healthz, /metrics)\n")
+    import time as _time
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        sys.stderr.write("mxtpu-serve: draining...\n")
+        srv.stop(drain=True)
+    sys.exit(0)

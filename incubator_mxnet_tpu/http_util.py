@@ -1,0 +1,113 @@
+"""Shared stdlib HTTP plumbing for the runtime's embedded servers.
+
+Two subsystems expose HTTP endpoints from inside a training/serving
+process: the telemetry exporter (``telemetry_http.py``: ``/metrics``,
+``/healthz``, ``/trace``) and the model server (``serving/server.py``:
+``/v1/models/...``).  Both are stdlib-only ``http.server`` stacks on
+daemon threads; this module is the one copy of the plumbing they share
+so the two can't drift:
+
+* :class:`BaseJSONHandler` — a ``BaseHTTPRequestHandler`` with the
+  common response helpers (``_send``/``send_json``/``read_json``),
+  silent request logging (training stdout stays clean), and a
+  swallow-all error guard so a handler bug degrades to a 500, never a
+  crash-looping accept thread.
+* :func:`start_http_server` / :func:`stop_http_server` — daemon-thread
+  lifecycle.  Port 0 binds an ephemeral port; the bound port is
+  ``server.server_address[1]``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Type
+
+__all__ = ["BaseJSONHandler", "HTTPServerBase", "start_http_server",
+           "stop_http_server"]
+
+
+class HTTPServerBase(ThreadingHTTPServer):
+    """Default server class: daemon handler threads and a listen
+    backlog deep enough for a thundering herd of concurrent clients
+    (socketserver's default of 5 resets connections under load)."""
+    daemon_threads = True
+    request_queue_size = 128
+
+
+class BaseJSONHandler(BaseHTTPRequestHandler):
+    """Response/request helpers shared by every embedded HTTP server."""
+
+    server_version = "mxtpu-http/1.0"
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def send_text(self, code: int, body: str,
+                  ctype: str = "text/plain; charset=utf-8") -> None:
+        self._send(code, body, ctype)
+
+    def send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, default=str) + "\n",
+                   "application/json")
+
+    def read_json(self):
+        """Parse the request body as JSON (``ValueError`` on garbage;
+        an absent/empty body parses as ``{}``)."""
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length > 0 else b""
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"request body is not valid JSON: {e}")
+
+    def guard(self, fn) -> None:
+        """Run a route handler; an exporter/server bug must not
+        500-loop or kill the accept thread."""
+        try:
+            fn()
+        except Exception as e:
+            try:
+                self.send_text(500, f"server error: {e!r}\n")
+            except Exception:
+                pass
+
+    def log_message(self, fmt, *args):
+        pass                            # stay silent on training stdout
+
+
+def start_http_server(handler_cls: Type[BaseHTTPRequestHandler],
+                      port: int, host: str = "0.0.0.0",
+                      name: str = "mxtpu-http",
+                      server_cls: Type[ThreadingHTTPServer]
+                      = HTTPServerBase) -> ThreadingHTTPServer:
+    """Bind ``host:port`` and serve ``handler_cls`` from a daemon thread.
+    Raises ``OSError`` when the port cannot be bound.  The serving
+    thread is attached to the server object so :func:`stop_http_server`
+    can join it."""
+    srv = server_cls((host, int(port)), handler_cls)
+    srv.daemon_threads = True
+    th = threading.Thread(target=srv.serve_forever, name=name, daemon=True)
+    th.start()
+    srv._mxtpu_thread = th
+    return srv
+
+
+def stop_http_server(srv: Optional[ThreadingHTTPServer],
+                     timeout: float = 5.0) -> None:
+    """Shut a server started by :func:`start_http_server` down and
+    release its port (no-op on ``None``)."""
+    if srv is None:
+        return
+    th = getattr(srv, "_mxtpu_thread", None)
+    srv.shutdown()
+    srv.server_close()
+    if th is not None:
+        th.join(timeout=timeout)

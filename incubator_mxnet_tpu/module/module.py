@@ -227,7 +227,52 @@ class Module(BaseModule):
         if data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 feeds[name] = arr
+        n_real = None
+        if not is_train:
+            feeds, n_real = self._pad_feeds(feeds)
         self._exec.forward(is_train=is_train, **feeds)
+        if n_real is not None:
+            full = self._data_shapes[0].shape[0]
+            self._exec.outputs = [
+                o[0:n_real] if o.shape and o.shape[0] == full else o
+                for o in self._exec.outputs]
+
+    def _pad_feeds(self, feeds):
+        """Inference-time shape bucketing: a short last batch is
+        zero-padded up to the BOUND batch size so it dispatches the
+        already-compiled program instead of tracing a fresh one per
+        leftover size; ``forward`` slices the outputs back to the true
+        row count.  Only fires when every fed array differs from its
+        bound shape solely by a smaller leading dim (per-example
+        inference semantics — padding rows cannot perturb real rows with
+        ``is_train=False``)."""
+        bound = {d.name: tuple(d.shape)
+                 for d in self._data_shapes + self._label_shapes}
+        n = pad_to = None
+        for name, arr in feeds.items():
+            want = bound.get(name)
+            if want is None or tuple(arr.shape) == want:
+                continue
+            if (len(arr.shape) != len(want)
+                    or tuple(arr.shape[1:]) != want[1:]
+                    or arr.shape[0] >= want[0]
+                    or (n is not None and arr.shape[0] != n)):
+                return feeds, None      # not a pure short-batch case
+            n, pad_to = int(arr.shape[0]), int(want[0])
+        if n is None:
+            return feeds, None
+        padded = {}
+        for name, arr in feeds.items():
+            want = bound[name]
+            if tuple(arr.shape) == want:
+                padded[name] = arr
+                continue
+            arr = arr if isinstance(arr, NDArray) \
+                else nd.array(arr, ctx=self._context)
+            filler = nd.zeros((pad_to - n,) + want[1:], ctx=self._context,
+                              dtype=arr.dtype)
+            padded[name] = nd.concatenate([arr, filler], axis=0)
+        return padded, n
 
     def backward(self, out_grads=None):
         self._exec.backward(out_grads=out_grads)

@@ -5,10 +5,16 @@ deployment surface C/C++ applications link against).
 The native layer (``c_predict_api.cc``) embeds CPython and calls the
 functions here; this module owns everything above the marshaling line:
 parse the nnvm -symbol.json, decode the ``arg:``/``aux:`` ``.params``
-bytes, bind an Executor, run forwards.  The compute still lowers through
-jax/XLA — the C caller gets the same compiled program a Python caller
-would, which is the TPU-native answer to the reference's C++ engine
-behind its predict API."""
+bytes, run forwards.  The compute path is the serving subsystem's
+:class:`~incubator_mxnet_tpu.serving.InferenceEngine` in exact-shape
+mode: one engine per (inputs, outputs) selection is SHARED through the
+``_shared`` handle that ``MXPredReshape`` / ``MXPredCreateMultiThread``
+pass around, so every handle over the same checkpoint rides one
+per-shape compiled-program cache — a ``reshape`` to a previously seen
+shape dispatches a warm program instead of re-tracing.  The C caller
+gets the same compiled program a Python caller would, which is the
+TPU-native answer to the reference's C++ engine behind its predict
+API."""
 from __future__ import annotations
 
 from typing import Dict, List, Sequence, Tuple
@@ -48,16 +54,19 @@ class Predictor:
         """``output_names`` selects INTERNAL outputs by name (the
         reference's MXPredCreatePartialOut contract, e.g. "fc_output" or
         "fc"); empty means the symbol's own outputs.  ``_shared`` is the
-        (sym, arg_params, aux_params) triple an existing predictor hands
-        to MXPredReshape/MXPredCreateMultiThread so the checkpoint is
-        decoded once per process, not once per handle."""
+        (sym, arg_params, aux_params, engines) bundle an existing
+        predictor hands to MXPredReshape/MXPredCreateMultiThread so the
+        checkpoint is decoded — and each (inputs, outputs) selection
+        compiled — once per process, not once per handle."""
         _pin_device(dev_type)
         import incubator_mxnet_tpu as mx
         from incubator_mxnet_tpu.symbol import symbol as sym_mod
+        from incubator_mxnet_tpu.serving import InferenceEngine
 
         self._mx = mx
         if _shared is not None:
-            sym, arg_params, aux_params = _shared
+            sym, arg_params, aux_params = _shared[:3]
+            engines = _shared[3] if len(_shared) > 3 else {}
         else:
             from incubator_mxnet_tpu.ndarray.utils import load_frombuffer
             sym = sym_mod.load_json(symbol_json)
@@ -70,29 +79,30 @@ class Predictor:
                           if k.startswith("arg:")}
             aux_params = {k[4:]: v for k, v in loaded.items()
                           if k.startswith("aux:")}
-        self._shared = (sym, arg_params, aux_params)
+            engines = {}
+        self._shared = (sym, arg_params, aux_params, engines)
         self._dev = (dev_type, dev_id)
-        if output_names:
-            internals = sym.get_internals()
-            sym = sym_mod.Group([internals[str(n)]
-                                 for n in output_names])
         ctx = mx.cpu(dev_id) if dev_type == 1 else mx.tpu(dev_id)
 
         self._input_names = [k for k, _ in inputs]
+        self._input_shapes = {k: tuple(s) for k, s in inputs}
         self._output_names = list(output_names)
-        args = {}
-        for name, shape in inputs:
-            args[name] = mx.nd.zeros(shape, ctx=ctx)
-        for name in sym.list_arguments():
-            if name in args:
-                continue
-            if name not in arg_params:
-                raise ValueError(f"parameter {name!r} missing from the "
-                                 ".params bytes and not a declared input")
-            args[name] = arg_params[name]
-        self._exec = sym.bind(ctx=ctx, args=args,
-                              aux_states=aux_params or None,
-                              grad_req="null")
+        key = (tuple(self._input_names),
+               tuple(str(n) for n in output_names), self._dev)
+        engine = engines.get(key)
+        if engine is None:
+            # exact-shape mode: the jit cache keys on input shapes, one
+            # compiled program per shape set, shared by every handle
+            engine = InferenceEngine.from_symbol(
+                sym, arg_params, aux_params, self._input_names,
+                output_names=[str(n) for n in output_names],
+                name="predict:" + (getattr(sym, "name", None) or "net"),
+                ctx=ctx)
+            engines[key] = engine
+        self._engine = engine
+        self._inputs: Dict[str, _np.ndarray] = {
+            name: _np.zeros(shape, dtype=_np.float32)
+            for name, shape in inputs}
         self._pending: Dict[str, object] = {}
         self._outputs: List[_np.ndarray] = []
         self.forward()        # reference semantics: predictor is runnable
@@ -111,14 +121,16 @@ class Predictor:
             raise ValueError(f"unknown input {key!r}; declared inputs: "
                              f"{self._input_names}")
         arr = _np.frombuffer(data, dtype=_np.float32).reshape(
-            self._exec.arg_dict[key].shape)
-        self._pending[key] = self._mx.nd.array(arr, dtype=_np.float32)
+            self._input_shapes[key])
+        self._pending[key] = arr
 
     def forward(self) -> None:
-        outs = self._exec.forward(is_train=False, **self._pending)
+        self._inputs.update(self._pending)
         self._pending = {}
+        outs = self._engine.run_exact(
+            [self._inputs[n] for n in self._input_names])
         self._outputs = [_np.ascontiguousarray(
-            o.asnumpy().astype(_np.float32)) for o in outs]
+            _np.asarray(o).astype(_np.float32)) for o in outs]
 
     def num_outputs(self) -> int:
         return len(self._outputs)

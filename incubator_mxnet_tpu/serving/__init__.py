@@ -1,0 +1,28 @@
+"""Inference serving subsystem — dynamic-batching model server over
+shape-bucketed compiled engines (see docs/serving.md).
+
+Three layers, importable à la carte:
+
+* :class:`InferenceEngine` (``engine.py``) — a model (Gluon block,
+  Module, or exported symbol+params) as donated jitted forward
+  programs keyed by batch-size bucket; requests pad up to the next
+  bucket so the compile cache stays bounded.
+* :class:`DynamicBatcher` (``batcher.py``) — bounded queue coalescing
+  concurrent requests into ONE dispatch per batch, with backpressure,
+  retry + single-request fallback, and graceful drain.
+* :class:`ModelServer` (``server.py``) — stdlib HTTP front-end
+  (``/v1/models/<name>:predict``, multi-model registry, ``/healthz``,
+  ``/metrics``) sharing plumbing with the telemetry exporter.  CLI:
+  ``mxtpu-serve``.
+
+Importing this package registers the ``mxtpu_serve_*`` metrics on the
+shared telemetry registry, so they appear on every exporter
+automatically.
+"""
+from . import metrics
+from .engine import InferenceEngine, derive_buckets
+from .batcher import DynamicBatcher, QueueFullError
+from .server import ModelServer
+
+__all__ = ["InferenceEngine", "derive_buckets", "DynamicBatcher",
+           "QueueFullError", "ModelServer", "metrics"]

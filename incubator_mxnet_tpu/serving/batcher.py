@@ -1,0 +1,278 @@
+"""DynamicBatcher — coalesce concurrent inference requests into one
+compiled dispatch.
+
+Requests land in a bounded FIFO queue; a single worker thread pops the
+head and keeps gathering compatible requests (same per-example shapes
+and dtypes — FIFO order is never reordered past an incompatible head)
+until the group reaches ``max_batch_size`` rows or the head request's
+``max_delay_ms`` deadline expires.  The group is concatenated along the
+batch axis, padded up to the engine's next bucket, dispatched as ONE
+compiled program, and the output rows are scattered back to the waiting
+callers.
+
+Operational behavior is wired into the runtime's existing planes:
+
+* **backpressure** — a full queue rejects immediately with
+  :class:`QueueFullError` (``mxtpu_serve_rejected``); the client sees a
+  429 from the HTTP front-end instead of unbounded latency.
+* **faults** — ``serving.queue`` is polled at submit and
+  ``serving.infer`` inside the batched dispatch (``MXNET_FAULT_PLAN``
+  site grammar, docs/robustness.md).  A failed batch dispatch retries
+  under :func:`fault.retry_call`; on exhaustion the batcher publishes a
+  ``fallback`` FAULT event, bumps ``mxtpu_serve_fallbacks``, and
+  executes each request individually so one poisoned batch cannot fail
+  every rider.
+* **graceful drain** — :meth:`close` stops intake, lets the worker
+  drain everything already queued (coalescing without waiting out the
+  delay deadline), then joins the worker.
+* **telemetry** — ``serve.request`` (submit-to-result) and
+  ``serve.batch`` spans, queue-wait / batch-size / end-to-end latency
+  histograms, per-model queue-depth gauge.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+from ..base import MXNetError, getenv, getenv_int
+from ..ndarray.ndarray import NDArray
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from . import metrics as _m
+
+__all__ = ["DynamicBatcher", "QueueFullError"]
+
+
+class QueueFullError(MXNetError):
+    """The batcher's bounded queue is full — backpressure, not failure."""
+
+
+class _Request:
+    """One submitted batch: arrays + a latch the caller waits on."""
+
+    __slots__ = ("arrays", "n", "sig", "event", "outputs", "error",
+                 "t_submit")
+
+    def __init__(self, arrays, n, sig):
+        self.arrays = arrays
+        self.n = n
+        self.sig = sig
+        self.event = threading.Event()
+        self.outputs = None
+        self.error = None
+        self.t_submit = time.monotonic()
+
+    def result(self, timeout: Optional[float] = None) -> List:
+        """Block for the scattered outputs; re-raises dispatch errors."""
+        if not self.event.wait(timeout):
+            raise TimeoutError("inference request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.outputs
+
+
+class DynamicBatcher:
+    """Batch-coalescing front-end over one :class:`InferenceEngine`.
+
+    Defaults come from the serving env knobs (``MXNET_SERVE_MAX_BATCH``
+    = 32, ``MXNET_SERVE_MAX_DELAY_MS`` = 5.0, ``MXNET_SERVE_QUEUE`` =
+    128; docs/env_var.md)."""
+
+    def __init__(self, engine, *, max_batch_size: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_size: Optional[int] = None,
+                 name: Optional[str] = None, retry_policy=None):
+        self.engine = engine
+        self.name = str(name or engine.name)
+        if max_batch_size is None:
+            max_batch_size = getenv_int("MXNET_SERVE_MAX_BATCH", 32)
+        if engine.max_batch_size:
+            max_batch_size = min(int(max_batch_size),
+                                 int(engine.max_batch_size))
+        self.max_batch_size = max(1, int(max_batch_size))
+        if max_delay_ms is None:
+            max_delay_ms = float(getenv("MXNET_SERVE_MAX_DELAY_MS", 5.0))
+        self.max_delay = max(0.0, float(max_delay_ms)) / 1000.0
+        if queue_size is None:
+            queue_size = getenv_int("MXNET_SERVE_QUEUE", 128)
+        self.queue_size = max(1, int(queue_size))
+        self.retry_policy = retry_policy
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name=f"mxtpu-serve-{self.name}",
+            daemon=True)
+        self._thread.start()
+
+    # -- submit ---------------------------------------------------------
+    @staticmethod
+    def _signature(arrays):
+        return tuple((tuple(a.shape[1:]), str(getattr(a, "dtype", "?")))
+                     for a in arrays)
+
+    def submit_async(self, arrays: Sequence) -> _Request:
+        """Enqueue one request batch; returns a latch whose
+        ``result()`` blocks for the outputs.  Raises
+        :class:`QueueFullError` under backpressure and ``MXNetError``
+        after :meth:`close`."""
+        _fault.inject("serving.queue")
+        arrays = list(arrays)
+        n = int(arrays[0].shape[0])
+        req = _Request(arrays, n, self._signature(arrays))
+        with self._cv:
+            if self._closed:
+                raise MXNetError(f"batcher {self.name!r} is closed")
+            if len(self._queue) >= self.queue_size:
+                _m.REJECTED.inc(model=self.name)
+                raise QueueFullError(
+                    f"{self.name}: queue full ({self.queue_size} "
+                    "pending) — backpressure")
+            self._queue.append(req)
+            _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
+            self._cv.notify_all()
+        _m.REQUESTS.inc(model=self.name)
+        return req
+
+    def submit(self, arrays: Sequence,
+               timeout: Optional[float] = None) -> List:
+        """Synchronous request: enqueue, wait, return per-row outputs
+        (jax arrays, sliced to this request's rows)."""
+        with _telemetry.trace_span("serve.request", cat="serving",
+                                   model=self.name):
+            return self.submit_async(arrays).result(timeout)
+
+    # -- worker ---------------------------------------------------------
+    def _worker(self):
+        while True:
+            group = self._gather()
+            if group is None:
+                return
+            self._run_group(group)
+
+    def _gather(self):
+        """Block for the head request, then coalesce until the batch is
+        full, the head's delay deadline passes, or the next queued
+        request is shape-incompatible (FIFO preserved).  Returns None
+        when closed and drained."""
+        with self._cv:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cv.wait(0.05)
+            head = self._queue.popleft()
+            group, total = [head], head.n
+            deadline = time.monotonic() + self.max_delay
+            while total < self.max_batch_size:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if nxt.sig != head.sig \
+                            or total + nxt.n > self.max_batch_size:
+                        break
+                    group.append(self._queue.popleft())
+                    total += nxt.n
+                    continue
+                if self._closed:        # drain fast: no deadline wait
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
+        return group
+
+    def _run_group(self, group):
+        import jax.numpy as jnp
+        t0 = time.monotonic()
+        for r in group:
+            _m.QUEUE_WAIT.observe(t0 - r.t_submit)
+        total = sum(r.n for r in group)
+        _m.BATCH_SIZE.observe(total)
+        _m.BATCHES.inc(model=self.name)
+        with _telemetry.trace_span("serve.batch", cat="serving",
+                                   model=self.name,
+                                   requests=len(group), rows=total):
+            try:
+                def _val(a):
+                    return a._data if isinstance(a, NDArray) \
+                        else jnp.asarray(a)
+                if len(group) == 1:
+                    ins = group[0].arrays
+                else:
+                    ins = [jnp.concatenate(
+                        [_val(r.arrays[i]) for r in group], axis=0)
+                        for i in range(len(group[0].arrays))]
+
+                def run():
+                    _fault.inject("serving.infer")
+                    return self.engine.predict(ins)
+
+                try:
+                    outs = _fault.retry_call(run, site="serving.infer",
+                                             policy=self.retry_policy)
+                except Exception as e:
+                    self._fallback(group, e)
+                    return
+                off = 0
+                for r in group:
+                    r.outputs = [o[off:off + r.n] for o in outs]
+                    off += r.n
+            except Exception as e:      # worker must survive anything
+                for r in group:
+                    r.error = e
+            finally:
+                done = time.monotonic()
+                for r in group:
+                    _m.LATENCY.observe(done - r.t_submit)
+                    r.event.set()
+
+    def _fallback(self, group, err):
+        """Batched dispatch failed after retries: run each request on
+        its own so one poisoned batch can't fail every rider.  Singles
+        bypass the ``serving.infer`` fault site — the plan already fired
+        on the batch attempts."""
+        _telemetry.FAULT.publish(site="serving.infer", event="fallback",
+                                 kind=type(err).__name__,
+                                 requests=len(group))
+        _m.FALLBACKS.inc(model=self.name)
+        for r in group:
+            try:
+                r.outputs = self.engine.predict(r.arrays)
+            except Exception as e:
+                r.error = e
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop intake.  ``drain=True`` (default) lets the worker finish
+        everything already queued; ``drain=False`` fails pending
+        requests immediately.  Idempotent."""
+        with self._cv:
+            self._closed = True
+            dropped = []
+            if not drain:
+                dropped = list(self._queue)
+                self._queue.clear()
+            self._cv.notify_all()
+        for r in dropped:
+            r.error = MXNetError(f"batcher {self.name!r} closed")
+            r.event.set()
+        self._thread.join(timeout=timeout)
+        with self._cv:
+            _m.QUEUE_DEPTH.set(0, model=self.name)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        with self._cv:
+            depth = len(self._queue)
+        return {"model": self.name, "queue_depth": depth,
+                "queue_size": self.queue_size,
+                "max_batch_size": self.max_batch_size,
+                "max_delay_ms": self.max_delay * 1000.0,
+                "closed": self._closed,
+                "buckets": list(self.engine.buckets),
+                "compiled_programs": self.engine.compiled_programs()}

@@ -1,0 +1,356 @@
+"""InferenceEngine — donated, jitted forward programs keyed by shape
+bucket.
+
+The serving problem on XLA is compile-cache discipline: every distinct
+input shape is a fresh trace+compile, so serving raw request shapes
+means unbounded compilation.  The engine fixes the shape space up
+front — a sorted list of batch-size **buckets** (declared, or
+auto-derived powers of two up to ``max_batch_size``) — and pads every
+request batch up to the next bucket, so a stream of mixed-size requests
+leaves the jit cache bounded by the bucket count (the acceptance
+invariant: exactly one compiled program per (model, bucket)).
+
+One engine wraps one model — a Gluon ``(Hybrid)Block``
+(:meth:`from_block`), a bound ``Module`` (:meth:`from_module`), or an
+exported/checkpointed symbol+params pair (:meth:`from_symbol`,
+:meth:`from_export`) — as a single pure function
+``(inputs, params, aux, key) -> outputs`` under ``jax.jit`` with the
+input batch donated (the request buffers are dead after dispatch, so
+XLA may reuse them for outputs).  Parameter values are fetched per
+dispatch, so live weight updates (e.g. a trainer running in the same
+process) propagate without recompiling.
+
+The jit is wrapped in :func:`telemetry.instrument_jit` under
+``serving:<name>`` — compile cache hits/misses, cost analysis, and
+``jit:serving:<name>`` spans ride the existing observability plane.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .. import telemetry as _telemetry
+
+__all__ = ["InferenceEngine", "derive_buckets"]
+
+
+def derive_buckets(max_batch_size: int) -> Tuple[int, ...]:
+    """Powers of two up to (and always including) ``max_batch_size``:
+    ``derive_buckets(32) == (1, 2, 4, 8, 16, 32)``,
+    ``derive_buckets(24) == (1, 2, 4, 8, 16, 24)``."""
+    m = int(max_batch_size)
+    if m < 1:
+        raise MXNetError(f"max_batch_size must be >= 1, got {m}")
+    out, b = [], 1
+    while b < m:
+        out.append(b)
+        b *= 2
+    out.append(m)
+    return tuple(out)
+
+
+def _canon_specs(input_specs):
+    """[(per-example shape, dtype)] with the batch dim EXCLUDED."""
+    if input_specs is None:
+        return None
+    out = []
+    for spec in input_specs:
+        if isinstance(spec, tuple) and len(spec) == 2 \
+                and isinstance(spec[0], (tuple, list)):
+            shape, dtype = spec
+        else:
+            shape, dtype = spec, _np.float32
+        out.append((tuple(int(d) for d in shape), _np.dtype(dtype)))
+    return out
+
+
+class InferenceEngine:
+    """A model as a bucketed set of compiled inference programs.
+
+    ``pure_fn(in_vals, param_vals, aux_vals, key) -> tuple(outputs)``
+    must be a pure jax function; ``param_fn() -> (param_vals, aux_vals)``
+    supplies the CURRENT weight values per dispatch.  Most callers build
+    engines via :meth:`from_block` / :meth:`from_symbol` /
+    :meth:`from_module` / :meth:`from_export` instead of this
+    constructor.
+    """
+
+    def __init__(self, pure_fn: Callable, input_names: Sequence[str],
+                 param_fn: Callable, *, name: str = "model",
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch_size: Optional[int] = None,
+                 input_specs=None, ctx=None):
+        import jax
+        self.name = str(name)
+        self.input_names = [str(n) for n in input_names]
+        self._param_fn = param_fn
+        self._ctx = ctx if ctx is not None else current_context()
+        self.input_specs = _canon_specs(input_specs)
+        if buckets:
+            self.buckets = tuple(sorted({int(b) for b in buckets}))
+            if self.buckets[0] < 1:
+                raise MXNetError(f"buckets must be >= 1: {self.buckets}")
+        elif max_batch_size:
+            self.buckets = derive_buckets(max_batch_size)
+        else:
+            self.buckets = ()       # exact-shape mode (the predict ABI)
+        self.max_batch_size = self.buckets[-1] if self.buckets else None
+        self._jit = jax.jit(pure_fn, donate_argnums=(0,))
+        self._call = _telemetry.instrument_jit("serving:" + self.name,
+                                               self._jit)
+        self._shapes_seen = set()
+
+    # -- shape bucketing ------------------------------------------------
+    def bucket_for(self, n: int) -> Optional[int]:
+        """Smallest bucket that fits ``n`` rows (None when ``n`` exceeds
+        the largest bucket — the caller chunks)."""
+        for b in self.buckets:
+            if b >= int(n):
+                return b
+        return None
+
+    # -- dispatch -------------------------------------------------------
+    def _prepare(self, arrays, target: Optional[int]):
+        """Convert to jax values, pad the batch dim up to ``target``.
+        Buffers we did not create are copied — the jit donates its input
+        batch, and donation must never eat a caller-owned array."""
+        import jax.numpy as jnp
+        vals = []
+        for a in arrays:
+            if isinstance(a, NDArray):
+                v, owned = a._data, False
+            elif isinstance(a, jnp.ndarray) and not isinstance(a, _np.ndarray):
+                v, owned = a, False
+            else:
+                v, owned = jnp.asarray(a), True
+            if target is not None and v.shape[0] != target:
+                pad = target - int(v.shape[0])
+                if pad < 0:
+                    raise MXNetError(
+                        f"{self.name}: batch {v.shape[0]} exceeds bucket "
+                        f"{target}")
+                v = jnp.concatenate(
+                    [v, jnp.zeros((pad,) + tuple(v.shape[1:]), v.dtype)],
+                    axis=0)
+            elif not owned:
+                v = v.copy()
+            vals.append(v)
+        return tuple(vals)
+
+    def _dispatch(self, in_vals: tuple):
+        from .. import random as _random
+        self._shapes_seen.add(tuple(v.shape for v in in_vals))
+        param_vals, aux_vals = self._param_fn()
+        key = _random.new_key(self._ctx)
+        with _telemetry.trace_span("serve.infer", cat="serving",
+                                   model=self.name,
+                                   batch=int(in_vals[0].shape[0])):
+            # donation is advisory on CPU; silence the per-call notice
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                return self._call(in_vals, tuple(param_vals),
+                                  tuple(aux_vals), key)
+
+    def predict(self, arrays: Sequence) -> List:
+        """Run one batch: pad up to the next bucket, dispatch ONE
+        compiled program, slice outputs back to the true row count.
+        Batches larger than the biggest bucket are chunked.  Outputs are
+        jax arrays (``np.asarray`` them for host use)."""
+        arrays = list(arrays)
+        if len(arrays) != len(self.input_names):
+            raise MXNetError(
+                f"{self.name}: got {len(arrays)} inputs, expected "
+                f"{len(self.input_names)} ({self.input_names})")
+        if not self.buckets:
+            return list(self._dispatch(self._prepare(arrays, None)))
+        n = int(arrays[0].shape[0])
+        bucket = self.bucket_for(n)
+        if bucket is None:          # chunk by the largest bucket
+            import jax.numpy as jnp
+            step = self.buckets[-1]
+            chunks = [self.predict([a[i:i + step] for a in arrays])
+                      for i in range(0, n, step)]
+            return [jnp.concatenate([c[k] for c in chunks], axis=0)
+                    for k in range(len(chunks[0]))]
+        outs = self._dispatch(self._prepare(arrays, bucket))
+        if bucket == n:
+            return list(outs)
+        return [o[:n] for o in outs]
+
+    def run_exact(self, arrays: Sequence) -> List:
+        """Dispatch at the exact input shapes, no bucketing — the
+        per-shape compiled-program cache for the C predict ABI, where
+        shapes are declared up front and ``reshape`` handles share one
+        engine."""
+        return list(self._dispatch(self._prepare(list(arrays), None)))
+
+    def warmup(self) -> int:
+        """AOT-compile every declared bucket (requires ``input_specs``);
+        returns the number of buckets warmed."""
+        if not self.buckets:
+            return 0
+        if not self.input_specs:
+            raise MXNetError(
+                f"{self.name}: warmup needs input_specs (per-example "
+                "shapes) to synthesize bucket batches")
+        for b in self.buckets:
+            self.predict([_np.zeros((b,) + shape, dtype)
+                          for shape, dtype in self.input_specs])
+        return len(self.buckets)
+
+    def compiled_programs(self) -> int:
+        """Entries in the jit compile cache — bounded by the bucket
+        count for bucketed serving."""
+        try:
+            return int(self._jit._cache_size())
+        except Exception:
+            return len(self._shapes_seen)
+
+    def __repr__(self):
+        return (f"<InferenceEngine {self.name!r}: inputs="
+                f"{self.input_names}, buckets={list(self.buckets)}, "
+                f"programs={self.compiled_programs()}>")
+
+    # -- constructors ---------------------------------------------------
+    @classmethod
+    def from_block(cls, block, input_specs, *, name: Optional[str] = None,
+                   buckets=None, max_batch_size: Optional[int] = None,
+                   ctx=None):
+        """Wrap a Gluon ``Block``/``HybridBlock``.  ``input_specs`` are
+        per-example shapes (batch dim excluded), e.g. ``[(784,)]``;
+        deferred-init parameters are settled with one zero forward."""
+        from .. import ndarray as nd
+        from .. import autograd as _ag
+        from ..gluon.block import functional_call
+        specs = _canon_specs(input_specs)
+        if not specs:
+            raise MXNetError("from_block: input_specs is required")
+        ctx = ctx if ctx is not None else current_context()
+        params = list(block.collect_params().values())
+        if any(p._deferred_init is not None or p._data is None
+               for p in params):
+            probe = [nd.zeros((1,) + shape, ctx=ctx, dtype=dtype)
+                     for shape, dtype in specs]
+            with _ag.pause(train_mode=False):
+                block(*probe)
+            params = list(block.collect_params().values())
+        trainable = [p for p in params if p.grad_req != "null"]
+        aux = [p for p in params if p.grad_req == "null"]
+
+        def param_fn():
+            return (tuple(p._data._data for p in trainable),
+                    tuple(p._data._data for p in aux))
+
+        def pure(in_vals, param_vals, aux_vals, key):
+            inputs_nd = [NDArray(v) for v in in_vals]
+            out_vals, _ = functional_call(
+                block, trainable, list(param_vals), aux, list(aux_vals),
+                inputs_nd, False, key)
+            return tuple(out_vals)
+
+        names = ["data"] if len(specs) == 1 else \
+            [f"data{i}" for i in range(len(specs))]
+        return cls(pure, names, param_fn,
+                   name=name or getattr(block, "name", "block"),
+                   buckets=buckets, max_batch_size=max_batch_size or 32,
+                   input_specs=specs, ctx=ctx)
+
+    @classmethod
+    def from_symbol(cls, symbol, arg_params, aux_params, input_names,
+                    *, input_specs=None, output_names=(),
+                    name: Optional[str] = None, buckets=None,
+                    max_batch_size: Optional[int] = None, ctx=None):
+        """Wrap a symbol + decoded params (a checkpoint / export pair).
+        ``output_names`` selects internal outputs by name (the partial-out
+        contract of the predict ABI); empty means the symbol's own
+        outputs.  Without ``buckets``/``max_batch_size`` the engine runs
+        in exact-shape mode (:meth:`run_exact`)."""
+        from .. import ndarray as nd
+        from .. import autograd as _ag
+        from .. import random as _random
+        from ..symbol import symbol as sym_mod
+        from ..symbol.symbol import eval_graph
+        if output_names:
+            internals = symbol.get_internals()
+            symbol = sym_mod.Group([internals[str(n)]
+                                    for n in output_names])
+        input_names = [str(n) for n in input_names]
+        ctx = ctx if ctx is not None else current_context()
+        arg_params = arg_params or {}
+        aux_params = aux_params or {}
+        param_names = [n for n in symbol.list_arguments()
+                       if n not in input_names]
+        for n in param_names:
+            if n not in arg_params:
+                raise ValueError(f"parameter {n!r} missing from the "
+                                 ".params bytes and not a declared input")
+        aux_names = symbol.list_auxiliary_states()
+        for n in aux_names:
+            if n not in aux_params:
+                raise MXNetError(f"from_symbol: aux_states missing {n!r}")
+        as_nd = lambda v: v if isinstance(v, NDArray) \
+            else nd.array(v, ctx=ctx)
+        params = {n: as_nd(arg_params[n]) for n in param_names}
+        aux = {n: as_nd(aux_params[n]) for n in aux_names}
+
+        def param_fn():
+            return (tuple(params[n]._data for n in param_names),
+                    tuple(aux[n]._data for n in aux_names))
+
+        def pure(in_vals, param_vals, aux_vals, key):
+            values = {n: NDArray(v) for n, v in zip(input_names, in_vals)}
+            values.update({n: NDArray(v)
+                           for n, v in zip(param_names, param_vals)})
+            values.update({n: NDArray(v)
+                           for n, v in zip(aux_names, aux_vals)})
+            sink = {}
+            with _ag.pause(train_mode=False), _random.trace_stream(key):
+                outs = eval_graph(symbol, values, False, sink)
+            return tuple(o._data for o in outs)
+
+        return cls(pure, input_names, param_fn,
+                   name=name or getattr(symbol, "name", "symbol"),
+                   buckets=buckets, max_batch_size=max_batch_size,
+                   input_specs=input_specs, ctx=ctx)
+
+    @classmethod
+    def from_module(cls, module, **kw):
+        """Wrap a bound, initialized ``Module``.  Data names become the
+        engine inputs; label arguments (if the symbol has any) ride as
+        fixed arrays from the module's executor — suitable for
+        label-free inference outputs."""
+        if not module.binded or not module.params_initialized:
+            raise MXNetError("from_module: bind() and init_params() first")
+        input_names = list(module._data_names)
+        arg = dict(module._exec.arg_dict)
+        params = {n: v for n, v in arg.items() if n not in input_names}
+        kw.setdefault("input_specs",
+                      [(tuple(d.shape[1:]), d.dtype)
+                       for d in module._data_shapes])
+        kw.setdefault("max_batch_size",
+                      int(module._data_shapes[0].shape[0])
+                      if module._data_shapes else None)
+        kw.setdefault("name", getattr(module._symbol, "name", "module"))
+        return cls.from_symbol(module._symbol, params,
+                               dict(module._exec.aux_dict), input_names,
+                               **kw)
+
+    @classmethod
+    def from_export(cls, prefix: str, epoch: int = 0,
+                    input_names=("data",), **kw):
+        """Load a ``HybridBlock.export`` / ``model.save_checkpoint``
+        artifact pair (``<prefix>-symbol.json`` +
+        ``<prefix>-NNNN.params``)."""
+        import os
+        from .. import model
+        sym, arg_params, aux_params = model.load_checkpoint(prefix,
+                                                            int(epoch))
+        kw.setdefault("name", os.path.basename(str(prefix)) or "export")
+        return cls.from_symbol(sym, arg_params, aux_params, input_names,
+                               **kw)

@@ -1,0 +1,46 @@
+"""Serving metrics — registered on the SHARED telemetry registry at
+import, so they ride every existing exporter (``/metrics`` Prometheus
+scrape via ``telemetry_http``/the serving server, ``telemetry.snapshot``
+JSON, ``mxtpu-stats``, profiler counter tracks) with no extra wiring.
+
+Counters/gauges are labeled by ``model`` so a multi-model server stays
+legible on one scrape; histograms are registry-wide (bounded reservoir,
+p50/p95/max in the summary exposition).
+"""
+from __future__ import annotations
+
+from .. import telemetry as _telemetry
+
+# counters -----------------------------------------------------------------
+REQUESTS = _telemetry.registry.counter(
+    "mxtpu_serve_requests",
+    "inference requests accepted into a DynamicBatcher queue")
+BATCHES = _telemetry.registry.counter(
+    "mxtpu_serve_batches",
+    "coalesced batch dispatches (one compiled forward per batch)")
+REJECTED = _telemetry.registry.counter(
+    "mxtpu_serve_rejected",
+    "requests rejected with QueueFullError (backpressure)")
+FALLBACKS = _telemetry.registry.counter(
+    "mxtpu_serve_fallbacks",
+    "batched dispatches that failed after retries and fell back to "
+    "single-request execution")
+
+# histograms ---------------------------------------------------------------
+BATCH_SIZE = _telemetry.registry.histogram(
+    "mxtpu_serve_batch_size",
+    "rows per coalesced dispatch (before bucket padding)")
+QUEUE_WAIT = _telemetry.registry.histogram(
+    "mxtpu_serve_queue_wait_seconds",
+    "seconds a request waited in the queue before its batch dispatched")
+LATENCY = _telemetry.registry.histogram(
+    "mxtpu_serve_latency_seconds",
+    "end-to-end seconds from submit to scattered result")
+
+# gauges -------------------------------------------------------------------
+QUEUE_DEPTH = _telemetry.registry.gauge(
+    "mxtpu_serve_queue_depth",
+    "requests currently queued, per model")
+MODELS_LOADED = _telemetry.registry.gauge(
+    "mxtpu_serve_models_loaded",
+    "models registered on the ModelServer")

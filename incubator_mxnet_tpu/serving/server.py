@@ -1,0 +1,239 @@
+"""ModelServer — the HTTP front-end of the serving subsystem.
+
+A stdlib ``ThreadingHTTPServer`` (one handler thread per connection —
+the threads ARE the concurrent clients the batcher coalesces) over a
+multi-model registry of ``(InferenceEngine, DynamicBatcher)`` pairs.
+HTTP plumbing is shared with the telemetry exporter via
+:mod:`incubator_mxnet_tpu.http_util`.
+
+Routes (JSON tensors everywhere):
+
+* ``POST /v1/models/<name>:predict`` — ``{"inputs": [...]}``
+  (positional, nested lists with a leading batch dim) or
+  ``{"inputs": {"data": [...]}}`` (keyed by the engine's input names);
+  responds ``{"outputs": [...], "shapes": [...]}``.  429 under
+  backpressure, 404 for unknown models, 400 for malformed bodies.
+* ``POST /v1/models/<name>:load`` — ``{"prefix": ..., "epoch": 0,
+  "input_names": ["data"], "input_specs": [[784]]}`` loads an exported
+  symbol+params artifact into the registry.
+* ``POST /v1/models/<name>:unload`` — drain + remove.
+* ``GET /v1/models`` — registry with per-model batcher stats.
+* ``GET /healthz`` — liveness.
+* ``GET /metrics`` — the SHARED telemetry registry in Prometheus text
+  form; ``mxtpu_serve_*`` series ride along with every other runtime
+  metric, no extra wiring.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, getenv_int
+from ..http_util import BaseJSONHandler, HTTPServerBase, \
+    start_http_server, stop_http_server
+from .batcher import DynamicBatcher, QueueFullError
+from .engine import InferenceEngine
+from . import metrics as _m
+
+__all__ = ["ModelServer"]
+
+
+class _ServingHTTPServer(HTTPServerBase):
+    model_server: "ModelServer" = None
+
+
+class _Handler(BaseJSONHandler):
+    server_version = "mxtpu-serve/1.0"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        self.guard(self._get)
+
+    def do_POST(self):  # noqa: N802
+        self.guard(self._post)
+
+    def _get(self):
+        ms = self.server.model_server
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            self.send_json(200, {"status": "ok",
+                                 "models": sorted(ms.models())})
+        elif path == "/v1/models":
+            self.send_json(200, {"models": ms.model_stats()})
+        elif path in ("/metrics", "/"):
+            from .. import telemetry
+            self._send(200, telemetry.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        else:
+            self.send_text(404, "not found: try /v1/models /healthz "
+                                "/metrics\n")
+
+    def _post(self):
+        ms = self.server.model_server
+        path = self.path.split("?", 1)[0]
+        if not path.startswith("/v1/models/") or ":" not in path:
+            self.send_text(404,
+                           "not found: POST /v1/models/<name>:predict\n")
+            return
+        name, _, verb = path[len("/v1/models/"):].rpartition(":")
+        try:
+            payload = self.read_json()
+        except ValueError as e:
+            self.send_json(400, {"error": str(e)})
+            return
+        try:
+            if verb == "predict":
+                self.send_json(200, ms.predict_json(name, payload))
+            elif verb == "load":
+                ms.load_model(name, payload)
+                self.send_json(200, {"loaded": name})
+            elif verb == "unload":
+                ms.remove_model(name)
+                self.send_json(200, {"unloaded": name})
+            else:
+                self.send_json(404, {"error": f"unknown verb {verb!r}; "
+                                     "try :predict :load :unload"})
+        except KeyError:
+            self.send_json(404, {"error": f"model {name!r} is not "
+                                 "loaded", "models": sorted(ms.models())})
+        except QueueFullError as e:
+            self.send_json(429, {"error": str(e)})
+        except (ValueError, TypeError, MXNetError) as e:
+            self.send_json(400, {"error": str(e)})
+
+
+class ModelServer:
+    """Multi-model inference server.  Programmatic use::
+
+        srv = ModelServer(port=0)
+        srv.add_model("mnist", engine)          # or engine kwargs
+        srv.start()
+        ... requests against srv.port ...
+        srv.stop()                              # graceful drain
+
+    Batcher knobs passed to :meth:`add_model` override the env defaults
+    (``MXNET_SERVE_MAX_BATCH`` / ``MXNET_SERVE_MAX_DELAY_MS`` /
+    ``MXNET_SERVE_QUEUE``); the port default is ``MXNET_SERVE_PORT``
+    (8080)."""
+
+    def __init__(self, port: Optional[int] = None, host: str = "0.0.0.0",
+                 **batcher_defaults):
+        self._port = getenv_int("MXNET_SERVE_PORT", 8080) \
+            if port is None else int(port)
+        self._host = host
+        self._batcher_defaults = dict(batcher_defaults)
+        self._models: Dict[str, DynamicBatcher] = {}
+        self._lock = threading.Lock()
+        self._http: Optional[_ServingHTTPServer] = None
+
+    # -- registry -------------------------------------------------------
+    def add_model(self, name: str, engine: InferenceEngine,
+                  warmup: bool = False, **batcher_kw) -> DynamicBatcher:
+        """Register ``engine`` under ``name`` behind a fresh
+        :class:`DynamicBatcher`.  ``warmup=True`` AOT-compiles every
+        declared bucket before the model takes traffic."""
+        if warmup:
+            engine.warmup()
+        kw = dict(self._batcher_defaults)
+        kw.update(batcher_kw)
+        batcher = DynamicBatcher(engine, name=name, **kw)
+        with self._lock:
+            if name in self._models:
+                batcher.close(drain=False)
+                raise MXNetError(f"model {name!r} is already loaded")
+            self._models[name] = batcher
+            _m.MODELS_LOADED.set(len(self._models))
+        return batcher
+
+    def load_model(self, name: str, payload: dict) -> DynamicBatcher:
+        """Registry ``:load`` verb — build an engine from an exported
+        artifact described by the JSON payload."""
+        if not isinstance(payload, dict) or "prefix" not in payload:
+            raise ValueError(':load needs {"prefix": ..., "epoch": 0}')
+        engine = InferenceEngine.from_export(
+            str(payload["prefix"]), int(payload.get("epoch", 0)),
+            input_names=payload.get("input_names", ("data",)),
+            input_specs=payload.get("input_specs"),
+            max_batch_size=payload.get("max_batch_size"),
+            buckets=payload.get("buckets"), name=name)
+        return self.add_model(name, engine,
+                              warmup=bool(payload.get("warmup", False)))
+
+    def remove_model(self, name: str) -> None:
+        """Drain the model's batcher and drop it from the registry."""
+        with self._lock:
+            batcher = self._models.pop(name)   # KeyError → HTTP 404
+            _m.MODELS_LOADED.set(len(self._models))
+        batcher.close(drain=True)
+
+    def get_model(self, name: str) -> DynamicBatcher:
+        return self._models[name]
+
+    def models(self):
+        return list(self._models)
+
+    def model_stats(self) -> dict:
+        return {n: b.stats() for n, b in sorted(self._models.items())}
+
+    # -- inference ------------------------------------------------------
+    def predict_json(self, name: str, payload: dict) -> dict:
+        """Decode JSON tensors, run them through the model's batcher,
+        re-encode the per-request outputs."""
+        batcher = self._models[name]            # KeyError → HTTP 404
+        inputs = payload.get("inputs", payload) \
+            if isinstance(payload, dict) else payload
+        if isinstance(inputs, dict):
+            names = batcher.engine.input_names
+            missing = [n for n in names if n not in inputs]
+            if missing:
+                raise ValueError(f"missing inputs {missing}; "
+                                 f"{name!r} takes {names}")
+            inputs = [inputs[n] for n in names]
+        if not isinstance(inputs, (list, tuple)) or not inputs:
+            raise ValueError('"inputs" must be a non-empty list of '
+                             "tensors or a {name: tensor} object")
+        arrays = [_np.asarray(v, dtype=_np.float32) for v in inputs]
+        for a in arrays:
+            if a.ndim == 0:
+                raise ValueError("each input needs a leading batch dim")
+        outs = batcher.submit(arrays)
+        outs = [_np.asarray(o) for o in outs]
+        return {"outputs": [o.tolist() for o in outs],
+                "shapes": [list(o.shape) for o in outs]}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ModelServer":
+        """Bind and serve in daemon threads; returns self.  ``port=0``
+        binds an ephemeral port (see :attr:`port`)."""
+        if self._http is not None:
+            return self
+        srv = start_http_server(_Handler, self._port, self._host,
+                                name="mxtpu-serve-http",
+                                server_cls=_ServingHTTPServer)
+        srv.model_server = self
+        self._http = srv
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the HTTP front-end, then close every batcher
+        (``drain=True`` finishes queued work first)."""
+        stop_http_server(self._http)
+        self._http = None
+        with self._lock:
+            batchers = list(self._models.values())
+            self._models.clear()
+            _m.MODELS_LOADED.set(0)
+        for b in batchers:
+            b.close(drain=drain)
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port once :meth:`start` has run."""
+        return self._http.server_address[1] if self._http else self._port
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

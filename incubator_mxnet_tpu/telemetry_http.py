@@ -4,7 +4,8 @@ while it runs:
 
 * ``GET /metrics``  — Prometheus text exposition of the metrics registry
   (the same bytes :func:`telemetry.render_prometheus` writes), ready to
-  be scraped.
+  be scraped.  Serving metrics (``mxtpu_serve_*``) appear here the
+  moment ``serving`` is imported — the registry is shared, no wiring.
 * ``GET /healthz``  — liveness probe; JSON with collector state + uptime.
 * ``GET /trace``    — the span tracer's current tree (open roots with
   running durations + recent finished roots) as JSON.
@@ -14,6 +15,9 @@ Start it with ``MXNET_TELEMETRY_PORT=<port>`` (telemetry import tail),
 binds an ephemeral port — :func:`start_server` returns the server object
 whose ``server_address[1]`` is the bound port (used by the tests).
 
+The HTTP plumbing (response helpers, silent logging, daemon-thread
+lifecycle) lives in :mod:`incubator_mxnet_tpu.http_util`, shared with
+the model server (``serving/server.py``) so the two stacks can't drift.
 The telemetry module is imported lazily inside the handlers: this module
 is imported from telemetry's own tail, and the late import keeps the two
 acyclic at import time.
@@ -23,89 +27,68 @@ from __future__ import annotations
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional
+
+from .http_util import BaseJSONHandler, start_http_server, stop_http_server
 
 __all__ = ["start_server", "stop_server", "server"]
 
 _server: Optional[ThreadingHTTPServer] = None
-_thread: Optional[threading.Thread] = None
 _t_start: Optional[float] = None
 _lock = threading.Lock()
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(BaseJSONHandler):
     server_version = "mxtpu-telemetry/1.0"
 
-    def _send(self, code: int, body: str, ctype: str) -> None:
-        data = body.encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
     def do_GET(self):  # noqa: N802 (http.server API)
+        self.guard(self._route)
+
+    def _route(self):
         from . import telemetry
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
-        try:
-            if path in ("/metrics", "/"):
-                self._send(200, telemetry.render_prometheus(),
-                           "text/plain; version=0.0.4; charset=utf-8")
-            elif path == "/healthz":
-                self._send(200, json.dumps({
-                    "status": "ok",
-                    "collecting": telemetry.enabled(),
-                    "tracing": telemetry.tracer.active,
-                    "uptime_s": None if _t_start is None
-                    else round(time.time() - _t_start, 3),
-                }) + "\n", "application/json")
-            elif path == "/trace":
-                self._send(200,
-                           json.dumps(telemetry.tracer.tree(), indent=2,
-                                      default=str) + "\n",
-                           "application/json")
-            else:
-                self._send(404, "not found: try /metrics /healthz /trace\n",
-                           "text/plain; charset=utf-8")
-        except Exception as e:          # an exporter bug must not 500-loop
-            try:
-                self._send(500, f"exporter error: {e!r}\n",
-                           "text/plain; charset=utf-8")
-            except Exception:
-                pass
-
-    def log_message(self, fmt, *args):
-        pass                            # stay silent on training stdout
+        if path in ("/metrics", "/"):
+            self._send(200, telemetry.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._send(200, json.dumps({
+                "status": "ok",
+                "collecting": telemetry.enabled(),
+                "tracing": telemetry.tracer.active,
+                "uptime_s": None if _t_start is None
+                else round(time.time() - _t_start, 3),
+            }) + "\n", "application/json")
+        elif path == "/trace":
+            self._send(200,
+                       json.dumps(telemetry.tracer.tree(), indent=2,
+                                  default=str) + "\n",
+                       "application/json")
+        else:
+            self._send(404, "not found: try /metrics /healthz /trace\n",
+                       "text/plain; charset=utf-8")
 
 
 def start_server(port: int, host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Start (or return the already-running) exporter on ``host:port`` in
     a daemon thread.  Raises ``OSError`` if the port cannot be bound."""
-    global _server, _thread, _t_start
+    global _server, _t_start
     with _lock:
         if _server is not None:
             return _server
-        srv = ThreadingHTTPServer((host, int(port)), _Handler)
-        srv.daemon_threads = True
-        th = threading.Thread(target=srv.serve_forever,
-                              name="mxtpu-telemetry-http", daemon=True)
-        th.start()
-        _server, _thread, _t_start = srv, th, time.time()
+        srv = start_http_server(_Handler, port, host,
+                                name="mxtpu-telemetry-http")
+        _server, _t_start = srv, time.time()
         return srv
 
 
 def stop_server() -> None:
     """Shut the exporter down and release the port (no-op when idle)."""
-    global _server, _thread, _t_start
+    global _server, _t_start
     with _lock:
-        srv, th = _server, _thread
-        _server = _thread = _t_start = None
-    if srv is not None:
-        srv.shutdown()
-        srv.server_close()
-    if th is not None:
-        th.join(timeout=5)
+        srv = _server
+        _server = _t_start = None
+    stop_http_server(srv)
 
 
 def server() -> Optional[ThreadingHTTPServer]:

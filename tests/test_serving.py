@@ -1,0 +1,488 @@
+"""Serving subsystem tests: bucketed InferenceEngine (padding parity,
+bounded compile cache, warmup, symbol/export loading), DynamicBatcher
+(coalescing, deadline, backpressure, drain, fault retry + single-request
+fallback), the ModelServer HTTP front-end, and the two inference-path
+satellites (Module pad-and-slice, Predictor engine sharing)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.serving import (DynamicBatcher, InferenceEngine,
+                                         ModelServer, QueueFullError,
+                                         derive_buckets)
+from incubator_mxnet_tpu.serving import metrics as smetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+
+
+def _mlp(units=16, in_units=16, layers=2, seed=7):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(layers):
+        net.add(nn.Dense(units, in_units=in_units, activation="relu"))
+        in_units = units
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _block_engine(net=None, in_dim=16, **kw):
+    net = net or _mlp(in_units=in_dim)
+    kw.setdefault("max_batch_size", 8)
+    return net, InferenceEngine.from_block(net, [(in_dim,)], **kw)
+
+
+def _x(n, d=16, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, d)).astype(np.float32)
+
+
+# ------------------------------------------------------------- buckets
+def test_derive_buckets():
+    assert derive_buckets(32) == (1, 2, 4, 8, 16, 32)
+    assert derive_buckets(24) == (1, 2, 4, 8, 16, 24)
+    assert derive_buckets(1) == (1,)
+    with pytest.raises(MXNetError):
+        derive_buckets(0)
+
+
+def test_bucket_for():
+    _, eng = _block_engine()
+    assert eng.buckets == (1, 2, 4, 8)
+    assert eng.bucket_for(1) == 1
+    assert eng.bucket_for(3) == 4
+    assert eng.bucket_for(8) == 8
+    assert eng.bucket_for(9) is None     # caller chunks
+
+
+def test_declared_buckets_override():
+    _, eng = _block_engine(buckets=[4, 16])
+    assert eng.buckets == (4, 16)
+    assert eng.max_batch_size == 16
+    assert eng.bucket_for(1) == 4
+
+
+# -------------------------------------------------------------- engine
+def test_padding_parity_and_bounded_cache():
+    """Mixed-size request stream: every output matches the eager
+    forward row-for-row, and the jit cache is bounded by the BUCKETS
+    hit, not the distinct request sizes."""
+    net, eng = _block_engine()
+    sizes = [1, 3, 2, 5, 8, 7, 3, 6, 1, 4]
+    for i, n in enumerate(sizes):
+        x = _x(n, seed=i)
+        out = np.asarray(eng.predict([x])[0])
+        ref = net(mx.nd.array(x)).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    hit_buckets = {eng.bucket_for(n) for n in sizes}   # {1, 2, 4, 8}
+    assert eng.compiled_programs() == len(hit_buckets)
+    assert eng.compiled_programs() <= len(eng.buckets)
+
+
+def test_warmup_compiles_every_bucket():
+    _, eng = _block_engine()
+    assert eng.warmup() == len(eng.buckets)
+    assert eng.compiled_programs() == len(eng.buckets)
+    # serving traffic after warmup adds NO programs
+    for n in (1, 2, 3, 5, 8):
+        eng.predict([_x(n)])
+    assert eng.compiled_programs() == len(eng.buckets)
+
+
+def test_oversize_batch_chunks():
+    net, eng = _block_engine()
+    x = _x(19, seed=3)                   # > max bucket of 8: 8+8+3
+    out = np.asarray(eng.predict([x])[0])
+    ref = net(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_live_weight_updates_propagate():
+    """param_fn is read per dispatch — mutating the block's weights
+    changes the next prediction without recompiling."""
+    net, eng = _block_engine()
+    x = _x(2)
+    before = np.asarray(eng.predict([x])[0])
+    progs = eng.compiled_programs()
+    for p in net.collect_params().values():
+        p.set_data(p.data() * 2.0)
+    after = np.asarray(eng.predict([x])[0])
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after, net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert eng.compiled_programs() == progs
+
+
+def _export_pair(tmp_path):
+    net = _mlp()
+    net.hybridize()
+    net(mx.nd.array(_x(2)))
+    prefix = str(tmp_path / "m")
+    net.export(prefix, epoch=5)
+    return net, prefix
+
+
+def test_from_export_parity(tmp_path):
+    net, prefix = _export_pair(tmp_path)
+    eng = InferenceEngine.from_export(prefix, 5, input_names=["data"],
+                                      max_batch_size=8,
+                                      input_specs=[(16,)])
+    eng.warmup()
+    x = _x(3, seed=9)
+    np.testing.assert_allclose(np.asarray(eng.predict([x])[0]),
+                               net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_from_symbol_missing_param_message(tmp_path):
+    _, prefix = _export_pair(tmp_path)
+    from incubator_mxnet_tpu import model
+    sym, arg_params, aux_params = model.load_checkpoint(prefix, 5)
+    dropped = next(iter(arg_params))
+    partial = {k: v for k, v in arg_params.items() if k != dropped}
+    with pytest.raises(ValueError, match="missing from the .params"):
+        InferenceEngine.from_symbol(sym, partial, aux_params, ["data"])
+
+
+# ------------------------------------------------------------- batcher
+def test_batcher_coalesces_concurrent_requests():
+    net, eng = _block_engine(max_batch_size=16)
+    batcher = DynamicBatcher(eng, max_batch_size=16, max_delay_ms=25,
+                             name="coalesce")
+    req0, bat0 = smetrics.REQUESTS.value, smetrics.BATCHES.value
+    results, n_clients, per = {}, 8, 3
+    def client(i):
+        xi = _x(1, seed=i)
+        outs = [np.asarray(batcher.submit([xi])[0]) for _ in range(per)]
+        results[i] = (xi, outs)
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    batcher.close()
+    n_req = smetrics.REQUESTS.value - req0
+    n_bat = smetrics.BATCHES.value - bat0
+    assert n_req == n_clients * per
+    assert n_bat < n_req / 2, \
+        f"{n_bat} batches for {n_req} requests — no coalescing"
+    for i, (xi, outs) in results.items():
+        ref = net(mx.nd.array(xi)).asnumpy()
+        for out in outs:
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batcher_deadline_dispatches_lone_request():
+    delay_ms = 30.0
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=delay_ms, name="deadline")
+    eng.warmup()                         # keep compile out of the timing
+    batcher.submit([_x(1)])              # thread-start warmth
+    t0 = time.monotonic()
+    batcher.submit([_x(1)])
+    elapsed = time.monotonic() - t0
+    batcher.close()
+    # a lone request must wait out the coalescing window, then go —
+    # generous upper bound for slow CI boxes
+    assert elapsed < 5.0
+    assert smetrics.LATENCY.count >= 2
+
+
+def test_batcher_respects_max_batch_size():
+    _, eng = _block_engine(max_batch_size=8)
+    batcher = DynamicBatcher(eng, max_batch_size=8, max_delay_ms=50,
+                             name="cap")
+    bat0 = smetrics.BATCHES.value
+    reqs = []
+    def submit_5(seed):
+        reqs.append(np.asarray(batcher.submit([_x(5, seed=seed)])[0]))
+    threads = [threading.Thread(target=submit_5, args=(i,))
+               for i in range(2)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    batcher.close()
+    # 5 + 5 rows > max 8: the second request cannot ride along
+    assert smetrics.BATCHES.value - bat0 == 2
+
+
+def test_batcher_backpressure_rejects_when_full():
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=1, queue_size=2,
+                             name="backpressure")
+    block = threading.Event()
+    orig = eng.predict
+    eng.predict = lambda arrays: (block.wait(10), orig(arrays))[1]
+    rej0 = smetrics.REJECTED.value
+    try:
+        held = [batcher.submit_async([_x(1)])]   # worker picks this up
+        time.sleep(0.1)                          # ... and blocks in it
+        held += [batcher.submit_async([_x(1)]) for _ in range(2)]
+        with pytest.raises(QueueFullError):
+            batcher.submit_async([_x(1)])
+        assert smetrics.REJECTED.value - rej0 == 1
+    finally:
+        block.set()
+        batcher.close()
+    for r in held:                       # accepted work still completes
+        assert r.result(10) is not None
+
+
+def test_batcher_graceful_drain_on_close():
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=200, name="drain")
+    reqs = [batcher.submit_async([_x(1, seed=i)]) for i in range(5)]
+    batcher.close(drain=True)            # must NOT wait out the 200ms
+    for r in reqs:
+        assert r.result(5) is not None
+    assert batcher.closed
+
+
+def test_batcher_submit_after_close_raises():
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, name="closed")
+    batcher.close()
+    with pytest.raises(MXNetError):
+        batcher.submit([_x(1)])
+
+
+def test_batcher_close_without_drain_fails_pending():
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=500, name="nodrain")
+    block = threading.Event()
+    orig = eng.predict
+    eng.predict = lambda arrays: (block.wait(10), orig(arrays))[1]
+    first = batcher.submit_async([_x(1)])
+    time.sleep(0.1)
+    pending = batcher.submit_async([_x(1)])
+    block.set()
+    batcher.close(drain=False)
+    with pytest.raises(MXNetError):
+        pending.result(5)
+    assert first.result(10) is not None  # in-flight work still lands
+
+
+# ------------------------------------------------------ fault injection
+def test_fault_retry_recovers_batch():
+    telemetry.start()
+    net, eng = _block_engine()
+    fault.install_plan("serving.infer:ioerror@1")
+    batcher = DynamicBatcher(
+        eng, max_delay_ms=1, name="retry",
+        retry_policy=fault.RetryPolicy(max_retries=3,
+                                       base_seconds=0.001))
+    x = _x(2)
+    out = np.asarray(batcher.submit([x])[0])
+    batcher.close()
+    np.testing.assert_allclose(out, net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    flat = telemetry.counters_flat()
+    assert flat.get("mxtpu_retries", 0) > 0
+    assert flat.get("mxtpu_serve_fallbacks", 0) == 0
+
+
+def test_fault_fallback_to_single_requests():
+    """Batch dispatch keeps failing past the retry budget: the batcher
+    publishes a fallback and serves every rider individually — the
+    clients still get correct answers."""
+    telemetry.start()
+    net, eng = _block_engine(max_batch_size=16)
+    fault.install_plan("serving.infer:ioerror@1-50")
+    batcher = DynamicBatcher(
+        eng, max_batch_size=16, max_delay_ms=25, name="fallback",
+        retry_policy=fault.RetryPolicy(max_retries=1,
+                                       base_seconds=0.001))
+    fb0 = smetrics.FALLBACKS.value
+    results = {}
+    def client(i):
+        xi = _x(1, seed=i)
+        results[i] = (xi, np.asarray(batcher.submit([xi], timeout=30)[0]))
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    batcher.close()
+    assert smetrics.FALLBACKS.value - fb0 >= 1
+    assert len(results) == 4
+    for i, (xi, out) in results.items():
+        np.testing.assert_allclose(out, net(mx.nd.array(xi)).asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    flat = telemetry.counters_flat()
+    assert flat.get("mxtpu_giveups", 0) > 0
+
+
+def test_fault_on_queue_site_propagates_to_caller():
+    _, eng = _block_engine()
+    fault.install_plan("serving.queue:ioerror@1")
+    batcher = DynamicBatcher(eng, name="qfault")
+    with pytest.raises(fault.FaultInjected):
+        batcher.submit([_x(1)])
+    out = batcher.submit([_x(1)])        # rule fired once; next is clean
+    batcher.close()
+    assert out is not None
+
+
+# ---------------------------------------------------------- HTTP server
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_model_server_http_end_to_end():
+    net, eng = _block_engine(max_batch_size=8)
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=5.0)
+    srv.add_model("mlp", eng, warmup=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        x = _x(2, seed=4)
+        status, resp = _post(url + "/v1/models/mlp:predict",
+                             {"inputs": [x.tolist()]})
+        assert status == 200 and resp["shapes"] == [[2, 16]]
+        np.testing.assert_allclose(
+            np.array(resp["outputs"][0], dtype=np.float32),
+            net(mx.nd.array(x)).asnumpy(), rtol=1e-4, atol=1e-5)
+        # name-keyed inputs hit the same path
+        status, resp2 = _post(url + "/v1/models/mlp:predict",
+                              {"inputs": {"data": x.tolist()}})
+        assert resp2["outputs"] == resp["outputs"]
+
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok" and health["models"] == ["mlp"]
+
+        with urllib.request.urlopen(url + "/v1/models", timeout=10) as r:
+            registry = json.loads(r.read())
+        stats = registry["models"]["mlp"]
+        assert stats["buckets"] == [1, 2, 4, 8]
+        assert stats["compiled_programs"] == 4
+
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "mxtpu_serve_batch_size" in prom
+        assert "mxtpu_serve_queue_wait_seconds" in prom
+        assert "mxtpu_serve_requests" in prom
+
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            _post(url + "/v1/models/nope:predict", {"inputs": [[0.0]]})
+        assert e404.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e400:
+            _post(url + "/v1/models/mlp:predict", {"inputs": []})
+        assert e400.value.code == 400
+    finally:
+        srv.stop()
+    assert srv.models() == []
+
+
+def test_model_server_multi_model_registry():
+    _, eng_a = _block_engine(max_batch_size=4)
+    _, eng_b = _block_engine(net=_mlp(units=8, seed=11),
+                             max_batch_size=4)
+    srv = ModelServer(port=0, host="127.0.0.1")
+    srv.add_model("a", eng_a)
+    srv.add_model("b", eng_b)
+    assert sorted(srv.models()) == ["a", "b"]
+    assert smetrics.MODELS_LOADED.value == 2
+    with pytest.raises(MXNetError):
+        srv.add_model("a", eng_a)        # duplicate names refused
+    out_a = srv.predict_json("a", {"inputs": [_x(1).tolist()]})
+    out_b = srv.predict_json("b", {"inputs": [_x(1).tolist()]})
+    assert out_a["shapes"] == [[1, 16]] and out_b["shapes"] == [[1, 8]]
+    srv.remove_model("a")
+    assert srv.models() == ["b"]
+    assert smetrics.MODELS_LOADED.value == 1
+    with pytest.raises(KeyError):
+        srv.predict_json("a", {"inputs": [_x(1).tolist()]})
+    srv.stop()
+    assert smetrics.MODELS_LOADED.value == 0
+
+
+def test_request_counters_consistent():
+    _, eng = _block_engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="counters")
+    req0, bat0 = smetrics.REQUESTS.value, smetrics.BATCHES.value
+    for i in range(3):
+        batcher.submit([_x(1, seed=i)])
+    batcher.close()
+    assert smetrics.REQUESTS.value - req0 == 3
+    assert 1 <= smetrics.BATCHES.value - bat0 <= 3
+    assert smetrics.BATCH_SIZE.count >= 3
+
+
+# ----------------------------------------------- inference-path satellites
+def test_module_short_batch_pads_without_recompiling():
+    """Module.forward(is_train=False) pads a short last batch up to the
+    bound shape and slices the outputs back: parity with the full-batch
+    rows and NO fresh compile per leftover size."""
+    from incubator_mxnet_tpu import io, mod, sym
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = sym.Activation(fc, act_type="tanh", name="tanh")
+    m = mod.Module(out, data_names=("data",), label_names=())
+    m.bind(data_shapes=[("data", (8, 6))], for_training=False)
+    m.init_params(initializer=mx.init.Uniform(0.1))
+
+    x = _x(8, d=6, seed=2)
+    m.forward(io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    ref = m.get_outputs()[0].asnumpy()
+    jit = m._exec._fwd_cache[False].__wrapped__
+    progs = jit._cache_size()
+    for n in (1, 3, 5, 7):
+        m.forward(io.DataBatch(data=[mx.nd.array(x[:n])]), is_train=False)
+        outs = m.get_outputs()
+        assert outs[0].shape == (n, 4)
+        np.testing.assert_allclose(outs[0].asnumpy(), ref[:n],
+                                   rtol=1e-5, atol=1e-6)
+    assert jit._cache_size() == progs, \
+        "short batches must ride the already-compiled program"
+
+
+def test_predictor_reshape_shares_engine_cache(tmp_path):
+    """MXPredReshape handles share ONE InferenceEngine: a reshape to a
+    new shape adds exactly one compiled program, and reshaping back to
+    a seen shape adds none."""
+    from incubator_mxnet_tpu.native import predict_bridge
+    net = _mlp(units=4, in_units=4, layers=1)
+    net.hybridize()
+    net(mx.nd.array(_x(2, d=4)))
+    prefix = str(tmp_path / "p")
+    net.export(prefix, epoch=0)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+    pred = predict_bridge.create(sym_json, param_bytes, 1, 0,
+                                 [("data", (2, 4))])
+    eng = pred._engine
+    progs0 = eng.compiled_programs()
+    p2 = pred.reshape([("data", (5, 4))])
+    assert p2._engine is eng, "reshape must reuse the shared engine"
+    assert eng.compiled_programs() == progs0 + 1
+    p3 = p2.reshape([("data", (2, 4))])  # shape already compiled
+    assert p3._engine is eng
+    assert eng.compiled_programs() == progs0 + 1
+    x = _x(2, d=4, seed=5)
+    p3.set_input("data", x.tobytes())
+    p3.forward()
+    got = np.frombuffer(p3.get_output(0),
+                        dtype=np.float32).reshape(p3.get_output_shape(0))
+    np.testing.assert_allclose(got, net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
