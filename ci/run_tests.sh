@@ -34,6 +34,14 @@ usage: ci/run_tests.sh <function>
                         HTTP clients; asserts batched dispatches << request
                         count, per-request outputs match the direct engine,
                         serve histograms on /metrics, and a clean drain
+  lifecycle_smoke       lifecycle drill (three parts): SIGTERM a serving
+                        child under 16 concurrent clients — zero reset
+                        connections, /readyz flips 503 before the port
+                        closes, clean exit 0; a serving.infer:hang fault
+                        trips the watchdog + breaker and recovers to
+                        SERVING without a process restart; SIGTERM a
+                        training loop — emergency checkpoint at the step
+                        boundary, resume bit-identical to golden
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -286,6 +294,17 @@ print(f"serve_smoke ok: {int(n_req)} requests in {int(n_bat)} batches "
       f"{engine.compiled_programs()} programs for "
       f"{len(engine.buckets)} buckets, clean shutdown")
 EOF
+}
+
+lifecycle_smoke() {
+    local out=/tmp/mxtpu_lifecycle_smoke
+    rm -rf "$out"
+    # SIGTERM-under-load: zero dropped in-flight requests, readyz-first
+    JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py serve --out "$out"
+    # hung-worker drill: watchdog + breaker recover in-process
+    JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py hang --out "$out"
+    # preemption drill: cooperative SIGTERM checkpoint, exact resume
+    JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py train --out "$out"
 }
 
 multichip_dryrun() {

@@ -120,9 +120,12 @@ def serve_main():
     Each ``--model`` is ``NAME=PREFIX[:EPOCH]`` naming a
     ``HybridBlock.export`` / ``model.save_checkpoint`` pair
     (``PREFIX-symbol.json`` + ``PREFIX-EPOCH.params``).  Serves
-    ``/v1/models/<name>:predict``, the model registry, ``/healthz`` and
-    ``/metrics`` until interrupted; Ctrl-C drains queued requests before
-    exiting.  Knobs default from ``MXNET_SERVE_*`` (docs/env_var.md)."""
+    ``/v1/models/<name>:predict``, the model registry, ``/healthz``,
+    ``/readyz`` and ``/metrics`` until SIGTERM/Ctrl-C, then drains:
+    ``/readyz`` flips to 503, in-flight requests finish (within
+    ``MXNET_DRAIN_SECONDS``), and the port closes cleanly — no reset
+    connections.  Knobs default from ``MXNET_SERVE_*``
+    (docs/env_var.md)."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -200,12 +203,6 @@ def serve_main():
     srv.start()
     sys.stderr.write(f"mxtpu-serve: listening on "
                      f"http://{ns.host}:{srv.port} "
-                     f"(/v1/models, /healthz, /metrics)\n")
-    import time as _time
-    try:
-        while True:
-            _time.sleep(3600)
-    except KeyboardInterrupt:
-        sys.stderr.write("mxtpu-serve: draining...\n")
-        srv.stop(drain=True)
-    sys.exit(0)
+                     f"(/v1/models, /healthz, /readyz, /metrics)\n")
+    from .serving import lifecycle
+    sys.exit(lifecycle.run_until_shutdown(srv))

@@ -204,6 +204,17 @@ class AsyncCheckpointer:
             daemon=True)
         self._thread.start()
 
+    def save_sync(self, step: int, params: Dict[str, NDArray],
+                  **kwargs) -> None:
+        """:meth:`save` + :meth:`wait_until_finished` in one call — the
+        emergency/preemption path.  A SIGTERM'd training loop (see
+        ``lifecycle.shutdown_requested`` and docs/robustness.md) calls
+        this at a STEP BOUNDARY so the snapshot is a consistent,
+        bit-identically resumable state, and blocks until the manifest
+        is committed before exiting."""
+        self.save(step, params, **kwargs)
+        self.wait_until_finished()
+
     def _publish(self, path: str, write_fn):
         """tmp-write + atomic rename, with transient storage errors
         absorbed by retry (the injection site fires before any bytes are

@@ -10,10 +10,14 @@ first-class, *testable* runtime concept instead:
   named sites (``MXNET_FAULT_PLAN``).  Sites are plain strings; the
   instrumented ones are ``kvstore.push`` / ``kvstore.pull`` /
   ``kvstore.pushpull`` (transport), ``dataloader.fetch`` (input
-  pipeline), ``checkpoint.write`` (storage), and ``trainer.grad``
-  (numerics).  Kinds: ``ioerror`` (raise a transient
+  pipeline), ``checkpoint.write`` (storage), ``trainer.grad``
+  (numerics), and the serving pair ``serving.queue`` /
+  ``serving.infer``.  Kinds: ``ioerror`` (raise a transient
   :class:`FaultInjected`), ``latency`` (sleep), ``nonfinite`` (poison a
-  gradient — consumed by the trainer's guard via :func:`take`).
+  gradient — consumed by the trainer's guard via :func:`take`), and
+  ``hang`` (a long stall, default 3600 s, modeling a wedged dispatch —
+  the serving watchdog drill injects it at ``serving.infer`` to prove
+  hung-worker detection and recovery; docs/robustness.md).
   Injection is deterministic: each site keeps a call counter and a rule
   names the 1-based call indices it fires on, so a test or CI run can
   say "the 2nd kvstore push fails" and get exactly that.
@@ -54,7 +58,7 @@ __all__ = [
     "inject", "take", "site_calls", "retry_call", "TRANSIENT",
 ]
 
-KINDS = ("ioerror", "latency", "nonfinite")
+KINDS = ("ioerror", "latency", "nonfinite", "hang")
 
 
 class FaultInjected(IOError):
@@ -84,12 +88,13 @@ class FaultRule:
         self.kind = kind
         self.seconds = None
         self.message = None
-        if kind == "latency":
+        if kind in ("latency", "hang"):
             try:
-                self.seconds = float(arg) if arg else 0.05
+                self.seconds = float(arg) if arg \
+                    else (3600.0 if kind == "hang" else 0.05)
             except ValueError:
                 raise MXNetError(
-                    f"fault rule {site!r}: latency arg {arg!r} is not a "
+                    f"fault rule {site!r}: {kind} arg {arg!r} is not a "
                     f"number of seconds")
         elif kind == "ioerror":
             self.message = arg
@@ -204,7 +209,7 @@ def inject(site: str) -> None:
     if plan is None:
         return
     for r in plan.fire(site):
-        if r.kind == "latency":
+        if r.kind in ("latency", "hang"):
             _telemetry.FAULT.publish(site=site, event="injected",
                                      kind=r.kind)
             _time.sleep(r.seconds)

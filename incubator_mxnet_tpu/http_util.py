@@ -40,21 +40,26 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
 
     server_version = "mxtpu-http/1.0"
 
-    def _send(self, code: int, body: str, ctype: str) -> None:
+    def _send(self, code: int, body: str, ctype: str,
+              headers: Optional[dict] = None) -> None:
         data = body.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
     def send_text(self, code: int, body: str,
-                  ctype: str = "text/plain; charset=utf-8") -> None:
-        self._send(code, body, ctype)
+                  ctype: str = "text/plain; charset=utf-8",
+                  headers: Optional[dict] = None) -> None:
+        self._send(code, body, ctype, headers)
 
-    def send_json(self, code: int, obj) -> None:
+    def send_json(self, code: int, obj,
+                  headers: Optional[dict] = None) -> None:
         self._send(code, json.dumps(obj, default=str) + "\n",
-                   "application/json")
+                   "application/json", headers)
 
     def read_json(self):
         """Parse the request body as JSON (``ValueError`` on garbage;

@@ -1,7 +1,7 @@
 """Inference serving subsystem — dynamic-batching model server over
 shape-bucketed compiled engines (see docs/serving.md).
 
-Three layers, importable à la carte:
+Four layers, importable à la carte:
 
 * :class:`InferenceEngine` (``engine.py``) — a model (Gluon block,
   Module, or exported symbol+params) as donated jitted forward
@@ -9,20 +9,34 @@ Three layers, importable à la carte:
   bucket so the compile cache stays bounded.
 * :class:`DynamicBatcher` (``batcher.py``) — bounded queue coalescing
   concurrent requests into ONE dispatch per batch, with backpressure,
-  retry + single-request fallback, and graceful drain.
+  per-request deadlines, retry + single-request fallback, a per-model
+  circuit breaker, and graceful drain.
+* :mod:`lifecycle` — the fault-domain plane shared by batcher and
+  server: serving states (SERVING/DEGRADED/…), :class:`CircuitBreaker`,
+  the worker :class:`Watchdog`, deadline helpers, and the SIGTERM-safe
+  shutdown machinery (``install_signal_handler`` /
+  ``run_until_shutdown``); docs/robustness.md.
 * :class:`ModelServer` (``server.py``) — stdlib HTTP front-end
   (``/v1/models/<name>:predict``, multi-model registry, ``/healthz``,
-  ``/metrics``) sharing plumbing with the telemetry exporter.  CLI:
-  ``mxtpu-serve``.
+  ``/readyz``, ``/metrics``) sharing plumbing with the telemetry
+  exporter.  CLI: ``mxtpu-serve``.
 
 Importing this package registers the ``mxtpu_serve_*`` metrics on the
 shared telemetry registry, so they appear on every exporter
 automatically.
 """
 from . import metrics
+from . import lifecycle
+from .lifecycle import (
+    CircuitBreaker, Watchdog, DeadlineExceeded, BreakerOpen, Draining,
+    RequestAborted, SERVING, STARTING, DEGRADED, UNHEALTHY, DRAINING,
+)
 from .engine import InferenceEngine, derive_buckets
 from .batcher import DynamicBatcher, QueueFullError
 from .server import ModelServer
 
 __all__ = ["InferenceEngine", "derive_buckets", "DynamicBatcher",
-           "QueueFullError", "ModelServer", "metrics"]
+           "QueueFullError", "ModelServer", "metrics", "lifecycle",
+           "CircuitBreaker", "Watchdog", "DeadlineExceeded",
+           "BreakerOpen", "Draining", "RequestAborted",
+           "SERVING", "STARTING", "DEGRADED", "UNHEALTHY", "DRAINING"]

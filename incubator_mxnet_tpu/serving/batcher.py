@@ -15,19 +15,40 @@ Operational behavior is wired into the runtime's existing planes:
 * **backpressure** — a full queue rejects immediately with
   :class:`QueueFullError` (``mxtpu_serve_rejected``); the client sees a
   429 from the HTTP front-end instead of unbounded latency.
+* **deadlines** — a request may carry an end-to-end budget
+  (``timeout_ms``; env default ``MXNET_SERVE_TIMEOUT_MS``).  Admission
+  rejects a request whose queue-wait estimate already busts it, the
+  gather loop sheds requests that expired while queued, and the caller's
+  wait is bounded by the remaining budget — all three raise
+  ``lifecycle.DeadlineExceeded`` (HTTP 504,
+  ``mxtpu_serve_deadline_exceeded``), so a stuck dispatch can never pin
+  an HTTP handler thread forever.
+* **circuit breaker** — consecutive dispatch-after-retry failures (the
+  :meth:`_fallback` path) trip the model's ``lifecycle.CircuitBreaker``
+  CLOSED→OPEN; while OPEN, admission fast-fails with
+  ``lifecycle.BreakerOpen`` (HTTP 503 + ``Retry-After``) until a
+  half-open probe succeeds.
+* **watchdog** — the worker heartbeats; :meth:`check_worker` (driven by
+  ``lifecycle.Watchdog``) detects a dead or hung worker, fails that
+  group's riders with ``lifecycle.RequestAborted``, restarts the worker
+  on a fresh generation, trips the breaker and marks the model
+  DEGRADED until the next successful dispatch.
 * **faults** — ``serving.queue`` is polled at submit and
   ``serving.infer`` inside the batched dispatch (``MXNET_FAULT_PLAN``
-  site grammar, docs/robustness.md).  A failed batch dispatch retries
-  under :func:`fault.retry_call`; on exhaustion the batcher publishes a
+  site grammar, docs/robustness.md; the ``hang`` kind drills the
+  watchdog).  A failed batch dispatch retries under
+  :func:`fault.retry_call`; on exhaustion the batcher publishes a
   ``fallback`` FAULT event, bumps ``mxtpu_serve_fallbacks``, and
   executes each request individually so one poisoned batch cannot fail
   every rider.
 * **graceful drain** — :meth:`close` stops intake, lets the worker
   drain everything already queued (coalescing without waiting out the
-  delay deadline), then joins the worker.
+  delay deadline), then joins the worker; if the worker cannot finish
+  inside the join budget, every still-pending request is failed with a
+  clear error instead of being stranded on an event nobody will set.
 * **telemetry** — ``serve.request`` (submit-to-result) and
   ``serve.batch`` spans, queue-wait / batch-size / end-to-end latency
-  histograms, per-model queue-depth gauge.
+  histograms, per-model queue-depth gauge, breaker/watchdog series.
 """
 from __future__ import annotations
 
@@ -40,6 +61,7 @@ from ..base import MXNetError, getenv, getenv_int
 from ..ndarray.ndarray import NDArray
 from .. import fault as _fault
 from .. import telemetry as _telemetry
+from . import lifecycle as _lc
 from . import metrics as _m
 
 __all__ = ["DynamicBatcher", "QueueFullError"]
@@ -53,9 +75,9 @@ class _Request:
     """One submitted batch: arrays + a latch the caller waits on."""
 
     __slots__ = ("arrays", "n", "sig", "event", "outputs", "error",
-                 "t_submit")
+                 "t_submit", "deadline", "model")
 
-    def __init__(self, arrays, n, sig):
+    def __init__(self, arrays, n, sig, deadline=None, model="?"):
         self.arrays = arrays
         self.n = n
         self.sig = sig
@@ -63,10 +85,25 @@ class _Request:
         self.outputs = None
         self.error = None
         self.t_submit = time.monotonic()
+        self.deadline = deadline        # absolute monotonic, or None
+        self.model = model
 
     def result(self, timeout: Optional[float] = None) -> List:
-        """Block for the scattered outputs; re-raises dispatch errors."""
-        if not self.event.wait(timeout):
+        """Block for the scattered outputs; re-raises dispatch errors.
+        The wait is additionally bounded by the request's own deadline —
+        crossing it raises ``lifecycle.DeadlineExceeded`` (HTTP 504),
+        a caller-supplied ``timeout`` alone raises ``TimeoutError``."""
+        wait = timeout
+        if self.deadline is not None:
+            remaining = max(0.0, self.deadline - time.monotonic())
+            wait = remaining if timeout is None else min(timeout, remaining)
+        if not self.event.wait(wait):
+            if self.deadline is not None \
+                    and time.monotonic() >= self.deadline:
+                _m.DEADLINE_EXCEEDED.inc(model=self.model, stage="wait")
+                raise _lc.DeadlineExceeded(
+                    f"{self.model}: request deadline exceeded after "
+                    f"{time.monotonic() - self.t_submit:.3f}s")
             raise TimeoutError("inference request timed out")
         if self.error is not None:
             raise self.error
@@ -78,12 +115,15 @@ class DynamicBatcher:
 
     Defaults come from the serving env knobs (``MXNET_SERVE_MAX_BATCH``
     = 32, ``MXNET_SERVE_MAX_DELAY_MS`` = 5.0, ``MXNET_SERVE_QUEUE`` =
-    128; docs/env_var.md)."""
+    128, ``MXNET_SERVE_TIMEOUT_MS`` = 0 → deadline-free;
+    docs/env_var.md)."""
 
     def __init__(self, engine, *, max_batch_size: Optional[int] = None,
                  max_delay_ms: Optional[float] = None,
                  queue_size: Optional[int] = None,
-                 name: Optional[str] = None, retry_policy=None):
+                 name: Optional[str] = None, retry_policy=None,
+                 breaker: Optional[_lc.CircuitBreaker] = None,
+                 default_timeout_ms: Optional[float] = None):
         self.engine = engine
         self.name = str(name or engine.name)
         if max_batch_size is None:
@@ -98,14 +138,37 @@ class DynamicBatcher:
         if queue_size is None:
             queue_size = getenv_int("MXNET_SERVE_QUEUE", 128)
         self.queue_size = max(1, int(queue_size))
+        if default_timeout_ms is None:
+            default_timeout_ms = _lc.default_timeout_ms()
+        self.default_timeout_ms = float(default_timeout_ms)
         self.retry_policy = retry_policy
+        self.breaker = breaker if breaker is not None \
+            else _lc.CircuitBreaker(self.name)
         self._queue: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._worker, name=f"mxtpu-serve-{self.name}",
+        # worker health plane (all guarded by _cv): the generation
+        # counter lets the watchdog replace a wedged worker — the old
+        # thread notices its generation is stale and exits when (if) it
+        # ever wakes up
+        self._worker_gen = 0
+        self._heartbeat = time.monotonic()
+        self._busy_since: Optional[float] = None
+        self._inflight: Optional[list] = None
+        self._restarts = 0
+        self._degraded = False
+        self._avg_batch_seconds = 0.0
+        self._thread = self._start_worker()
+
+    def _start_worker(self) -> threading.Thread:
+        # _cv NOT required; called from __init__ and (under _cv) from
+        # check_worker/close — Thread.start is thread-safe either way
+        t = threading.Thread(
+            target=self._worker, args=(self._worker_gen,),
+            name=f"mxtpu-serve-{self.name}-g{self._worker_gen}",
             daemon=True)
-        self._thread.start()
+        t.start()
+        return t
 
     # -- submit ---------------------------------------------------------
     @staticmethod
@@ -113,15 +176,37 @@ class DynamicBatcher:
         return tuple((tuple(a.shape[1:]), str(getattr(a, "dtype", "?")))
                      for a in arrays)
 
-    def submit_async(self, arrays: Sequence) -> _Request:
+    def _estimate_wait_locked(self) -> float:
+        """Queue-wait estimate for a newly admitted request, from the
+        rows already queued and the EWMA batch service time (_cv held).
+        0 until the first batch has been measured — admission control
+        only ever sheds on *evidence* of a slow model."""
+        if self._avg_batch_seconds <= 0.0:
+            return 0.0
+        rows = sum(r.n for r in self._queue)
+        batches_ahead = rows // self.max_batch_size
+        if self._busy_since is not None:    # current dispatch finishes first
+            batches_ahead += 1
+        return batches_ahead * self._avg_batch_seconds
+
+    def submit_async(self, arrays: Sequence,
+                     timeout_ms: Optional[float] = None) -> _Request:
         """Enqueue one request batch; returns a latch whose
         ``result()`` blocks for the outputs.  Raises
-        :class:`QueueFullError` under backpressure and ``MXNetError``
-        after :meth:`close`."""
+        :class:`QueueFullError` under backpressure,
+        ``lifecycle.BreakerOpen`` while the model's breaker is OPEN,
+        ``lifecycle.DeadlineExceeded`` when the queue-wait estimate
+        already busts the request's budget, and ``MXNetError`` after
+        :meth:`close`."""
         _fault.inject("serving.queue")
+        self.breaker.allow()
         arrays = list(arrays)
         n = int(arrays[0].shape[0])
-        req = _Request(arrays, n, self._signature(arrays))
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        req = _Request(arrays, n, self._signature(arrays),
+                       deadline=_lc.deadline_from_ms(timeout_ms),
+                       model=self.name)
         with self._cv:
             if self._closed:
                 raise MXNetError(f"batcher {self.name!r} is closed")
@@ -130,6 +215,14 @@ class DynamicBatcher:
                 raise QueueFullError(
                     f"{self.name}: queue full ({self.queue_size} "
                     "pending) — backpressure")
+            if req.deadline is not None:
+                est = self._estimate_wait_locked()
+                if time.monotonic() + est > req.deadline:
+                    _m.DEADLINE_EXCEEDED.inc(model=self.name,
+                                             stage="admission")
+                    raise _lc.DeadlineExceeded(
+                        f"{self.name}: estimated queue wait {est:.3f}s "
+                        "already exceeds the request deadline")
             self._queue.append(req)
             _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
             self._cv.notify_all()
@@ -137,29 +230,68 @@ class DynamicBatcher:
         return req
 
     def submit(self, arrays: Sequence,
-               timeout: Optional[float] = None) -> List:
+               timeout: Optional[float] = None,
+               timeout_ms: Optional[float] = None) -> List:
         """Synchronous request: enqueue, wait, return per-row outputs
-        (jax arrays, sliced to this request's rows)."""
+        (jax arrays, sliced to this request's rows).  ``timeout_ms`` is
+        the end-to-end deadline budget (defaults from
+        ``MXNET_SERVE_TIMEOUT_MS``); ``timeout`` additionally bounds
+        just the wait."""
         with _telemetry.trace_span("serve.request", cat="serving",
                                    model=self.name):
-            return self.submit_async(arrays).result(timeout)
+            return self.submit_async(arrays,
+                                     timeout_ms=timeout_ms).result(timeout)
 
     # -- worker ---------------------------------------------------------
-    def _worker(self):
+    def _current_gen(self) -> int:
+        with self._cv:
+            return self._worker_gen
+
+    def _worker(self, gen: int):
         while True:
-            group = self._gather()
+            if self._current_gen() != gen:
+                return                  # replaced by the watchdog
+            group = self._gather(gen)
             if group is None:
                 return
+            with self._cv:
+                if gen == self._worker_gen:
+                    self._busy_since = time.monotonic()
+                    self._inflight = group
             self._run_group(group)
+            with self._cv:
+                if gen == self._worker_gen:
+                    self._busy_since = None
+                    self._inflight = None
 
-    def _gather(self):
+    def _expire_locked(self, req: _Request) -> None:
+        """Shed one already-expired request at gather time (_cv held;
+        event.set() under the lock is fine — waiters wake after we
+        release)."""
+        _m.DEADLINE_EXCEEDED.inc(model=self.name, stage="queue")
+        req.error = _lc.DeadlineExceeded(
+            f"{self.name}: request expired in queue after "
+            f"{time.monotonic() - req.t_submit:.3f}s")
+        req.event.set()
+
+    def _gather(self, gen: int):
         """Block for the head request, then coalesce until the batch is
         full, the head's delay deadline passes, or the next queued
-        request is shape-incompatible (FIFO preserved).  Returns None
-        when closed and drained."""
+        request is shape-incompatible (FIFO preserved).  Requests whose
+        end-to-end deadline already expired are shed here (504), never
+        dispatched.  Returns None when closed and drained or when this
+        worker generation has been replaced."""
         with self._cv:
-            while not self._queue:
+            while True:
+                self._heartbeat = time.monotonic()
+                while self._queue and self._queue[0].deadline is not None \
+                        and self._queue[0].deadline <= self._heartbeat:
+                    self._expire_locked(self._queue.popleft())
+                if self._queue:
+                    break
                 if self._closed:
+                    return None
+                if gen != self._worker_gen:
                     return None
                 self._cv.wait(0.05)
             head = self._queue.popleft()
@@ -168,6 +300,10 @@ class DynamicBatcher:
             while total < self.max_batch_size:
                 if self._queue:
                     nxt = self._queue[0]
+                    if nxt.deadline is not None \
+                            and nxt.deadline <= time.monotonic():
+                        self._expire_locked(self._queue.popleft())
+                        continue
                     if nxt.sig != head.sig \
                             or total + nxt.n > self.max_batch_size:
                         break
@@ -219,35 +355,126 @@ class DynamicBatcher:
                 for r in group:
                     r.outputs = [o[off:off + r.n] for o in outs]
                     off += r.n
+                dt = time.monotonic() - t0
+                self._avg_batch_seconds = dt \
+                    if self._avg_batch_seconds <= 0.0 \
+                    else 0.8 * self._avg_batch_seconds + 0.2 * dt
+                self._degraded = False
+                self.breaker.record_success()
             except Exception as e:      # worker must survive anything
                 for r in group:
                     r.error = e
             finally:
                 done = time.monotonic()
                 for r in group:
-                    _m.LATENCY.observe(done - r.t_submit)
-                    r.event.set()
+                    # the watchdog may already have failed (and woken)
+                    # this rider — never double-count or clobber it
+                    if not r.event.is_set():
+                        _m.LATENCY.observe(done - r.t_submit)
+                        r.event.set()
 
     def _fallback(self, group, err):
         """Batched dispatch failed after retries: run each request on
         its own so one poisoned batch can't fail every rider.  Singles
         bypass the ``serving.infer`` fault site — the plan already fired
-        on the batch attempts."""
+        on the batch attempts.  Counts one consecutive failure on the
+        circuit breaker (enough of these in a row trip it OPEN)."""
         _telemetry.FAULT.publish(site="serving.infer", event="fallback",
                                  kind=type(err).__name__,
                                  requests=len(group))
         _m.FALLBACKS.inc(model=self.name)
+        self.breaker.record_failure(f"batch dispatch failed: "
+                                    f"{type(err).__name__}")
         for r in group:
             try:
                 r.outputs = self.engine.predict(r.arrays)
             except Exception as e:
                 r.error = e
 
+    # -- watchdog plane -------------------------------------------------
+    def check_worker(self, hang_seconds: Optional[float] = None):
+        """Detect a dead or hung worker (driven by
+        ``lifecycle.Watchdog``, callable directly).  On detection: fail
+        the in-flight group's riders with ``lifecycle.RequestAborted``,
+        restart the worker on a fresh generation, trip the breaker and
+        mark the model DEGRADED.  Returns the reason (``"died"`` /
+        ``"hung"``) when a restart happened, else None.
+
+        ``hang_seconds <= 0`` disables hang detection (dead-worker
+        detection stays on)."""
+        if hang_seconds is None:
+            hang_seconds = _lc.default_hang_seconds()
+        now = time.monotonic()
+        with self._cv:
+            if self._closed:
+                return None
+            if not self._thread.is_alive():
+                reason = "died"
+            elif hang_seconds > 0 and self._busy_since is not None \
+                    and now - self._busy_since > float(hang_seconds):
+                reason = "hung"
+            else:
+                return None
+            failed = self._inflight or []
+            self._inflight = None
+            self._busy_since = None
+            self._worker_gen += 1
+            self._restarts += 1
+            self._degraded = True
+            self._thread = self._start_worker()
+            self._cv.notify_all()
+        for r in failed:
+            if not r.event.is_set():
+                r.error = _lc.RequestAborted(
+                    f"{self.name}: batcher worker {reason}; request "
+                    "failed by the watchdog — retry on another replica")
+                r.event.set()
+        self.breaker.trip(f"worker {reason}")
+        _m.WATCHDOG_RESTARTS.inc(model=self.name)
+        _telemetry.FAULT.publish(site="serving.worker", event="watchdog",
+                                 kind=reason, model=self.name,
+                                 riders=len(failed))
+        return reason
+
+    @property
+    def state(self) -> str:
+        """This model's serving state (``lifecycle.SERVING`` /
+        ``DEGRADED`` / ``UNHEALTHY`` / ``DRAINING``)."""
+        with self._cv:
+            if self._closed:
+                return _lc.DRAINING
+            worker_dead = not self._thread.is_alive()
+        bs = self.breaker.state
+        if worker_dead or bs == _lc.OPEN:
+            return _lc.UNHEALTHY
+        if self._degraded or bs == _lc.HALF_OPEN:
+            return _lc.DEGRADED
+        return _lc.SERVING
+
+    @property
+    def restarts(self) -> int:
+        with self._cv:
+            return self._restarts
+
     # -- lifecycle ------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        """Requests queued or riding the in-flight dispatch."""
+        with self._cv:
+            return len(self._queue) + len(self._inflight or ())
+
+    @property
+    def idle(self) -> bool:
+        with self._cv:
+            return not self._queue and self._busy_since is None
+
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop intake.  ``drain=True`` (default) lets the worker finish
         everything already queued; ``drain=False`` fails pending
-        requests immediately.  Idempotent."""
+        requests immediately.  If the worker cannot finish inside
+        ``timeout`` seconds (a wedged dispatch), every still-pending
+        request is failed with a clear error instead of being left
+        blocked on an event nobody will ever set.  Idempotent."""
         with self._cv:
             self._closed = True
             dropped = []
@@ -256,9 +483,27 @@ class DynamicBatcher:
                 self._queue.clear()
             self._cv.notify_all()
         for r in dropped:
-            r.error = MXNetError(f"batcher {self.name!r} closed")
-            r.event.set()
+            if not r.event.is_set():
+                r.error = MXNetError(f"batcher {self.name!r} closed")
+                r.event.set()
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # drain budget blown: the worker is wedged in a dispatch.
+            # Strand nobody — fail everything still pending and retire
+            # this worker generation so the zombie exits if it wakes.
+            with self._cv:
+                self._worker_gen += 1
+                stranded = list(self._queue)
+                self._queue.clear()
+                stranded.extend(self._inflight or ())
+                self._inflight = None
+                self._busy_since = None
+            for r in stranded:
+                if not r.event.is_set():
+                    r.error = _lc.RequestAborted(
+                        f"batcher {self.name!r}: drain timed out after "
+                        f"{timeout}s; request abandoned")
+                    r.event.set()
         with self._cv:
             _m.QUEUE_DEPTH.set(0, model=self.name)
 
@@ -269,10 +514,15 @@ class DynamicBatcher:
     def stats(self) -> dict:
         with self._cv:
             depth = len(self._queue)
+            restarts = self._restarts
         return {"model": self.name, "queue_depth": depth,
                 "queue_size": self.queue_size,
                 "max_batch_size": self.max_batch_size,
                 "max_delay_ms": self.max_delay * 1000.0,
+                "default_timeout_ms": self.default_timeout_ms,
                 "closed": self._closed,
+                "state": self.state,
+                "breaker": self.breaker.state,
+                "watchdog_restarts": restarts,
                 "buckets": list(self.engine.buckets),
                 "compiled_programs": self.engine.compiled_programs()}

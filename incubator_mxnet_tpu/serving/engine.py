@@ -104,6 +104,28 @@ class InferenceEngine:
         self._call = _telemetry.instrument_jit("serving:" + self.name,
                                                self._jit)
         self._shapes_seen = set()
+        self._warmup_done = False
+
+    @property
+    def input_dtypes(self):
+        """Declared per-input dtypes (from ``input_specs``), or None
+        when the engine was built without specs — the HTTP front-end
+        uses these to decode JSON tensors at the model's real dtypes
+        instead of forcing float32."""
+        if not self.input_specs:
+            return None
+        return [dtype for _, dtype in self.input_specs]
+
+    @property
+    def warm(self) -> bool:
+        """True once every declared bucket has a compiled program (the
+        readiness gate: a replica is not *ready* until its programs
+        are).  Bucket-free (exact-shape) engines are vacuously warm."""
+        if not self.buckets:
+            return True
+        if self._warmup_done:
+            return True
+        return self.compiled_programs() >= len(self.buckets)
 
     # -- shape bucketing ------------------------------------------------
     def bucket_for(self, n: int) -> Optional[int]:
@@ -202,6 +224,7 @@ class InferenceEngine:
         for b in self.buckets:
             self.predict([_np.zeros((b,) + shape, dtype)
                           for shape, dtype in self.input_specs])
+        self._warmup_done = True
         return len(self.buckets)
 
     def compiled_programs(self) -> int:

@@ -25,6 +25,16 @@ FALLBACKS = _telemetry.registry.counter(
     "mxtpu_serve_fallbacks",
     "batched dispatches that failed after retries and fell back to "
     "single-request execution")
+DEADLINE_EXCEEDED = _telemetry.registry.counter(
+    "mxtpu_serve_deadline_exceeded",
+    "requests shed because their end-to-end deadline expired "
+    "(stage=admission|queue|wait)")
+WATCHDOG_RESTARTS = _telemetry.registry.counter(
+    "mxtpu_serve_watchdog_restarts",
+    "batcher workers restarted by the serving watchdog (dead or hung)")
+BREAKER_TRIPS = _telemetry.registry.counter(
+    "mxtpu_serve_breaker_trips",
+    "per-model circuit breaker CLOSED/HALF_OPEN -> OPEN transitions")
 
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(
@@ -44,3 +54,10 @@ QUEUE_DEPTH = _telemetry.registry.gauge(
 MODELS_LOADED = _telemetry.registry.gauge(
     "mxtpu_serve_models_loaded",
     "models registered on the ModelServer")
+BREAKER_STATE = _telemetry.registry.gauge(
+    "mxtpu_serve_breaker_state",
+    "per-model circuit breaker state (0 CLOSED, 1 HALF_OPEN, 2 OPEN)")
+MODEL_STATE = _telemetry.registry.gauge(
+    "mxtpu_serve_model_state",
+    "per-model serving state (0 SERVING, 1 STARTING, 2 DEGRADED, "
+    "3 UNHEALTHY, 4 DRAINING)")

@@ -10,22 +10,42 @@ Routes (JSON tensors everywhere):
 
 * ``POST /v1/models/<name>:predict`` — ``{"inputs": [...]}``
   (positional, nested lists with a leading batch dim) or
-  ``{"inputs": {"data": [...]}}`` (keyed by the engine's input names);
-  responds ``{"outputs": [...], "shapes": [...]}``.  429 under
-  backpressure, 404 for unknown models, 400 for malformed bodies.
+  ``{"inputs": {"data": [...]}}`` (keyed by the engine's input names),
+  plus an optional ``"timeout_ms"`` end-to-end deadline (env default
+  ``MXNET_SERVE_TIMEOUT_MS``); responds ``{"outputs": [...],
+  "shapes": [...]}``.  Error mapping is the serving fault-domain
+  contract (docs/robustness.md): 429 under backpressure, 404 for
+  unknown models, 400 for malformed bodies, 504 when the deadline
+  expires anywhere in the pipeline, 503 + ``Retry-After`` when the
+  model's circuit breaker is OPEN, the watchdog failed the request, or
+  the server is draining.
 * ``POST /v1/models/<name>:load`` — ``{"prefix": ..., "epoch": 0,
   "input_names": ["data"], "input_specs": [[784]]}`` loads an exported
   symbol+params artifact into the registry.
 * ``POST /v1/models/<name>:unload`` — drain + remove.
 * ``GET /v1/models`` — registry with per-model batcher stats.
-* ``GET /healthz`` — liveness.
+* ``GET /healthz`` — pure liveness: 200 whenever the process can
+  answer, no matter how unhealthy the models are.
+* ``GET /readyz`` — readiness: 200 only when every model can take
+  traffic (every ``warmup=True`` model has its buckets compiled, no
+  breaker is OPEN, no worker is dead) and the server is not draining;
+  503 otherwise, so a load balancer / rollout controller pulls the
+  replica without killing it.
 * ``GET /metrics`` — the SHARED telemetry registry in Prometheus text
   form; ``mxtpu_serve_*`` series ride along with every other runtime
   metric, no extra wiring.
+
+Shutdown: ``stop()`` is the immediate programmatic teardown;
+``shutdown()`` is the SIGTERM-safe sequence (flip to DRAINING → 503 on
+new work and on ``/readyz`` → wait for in-flight work within
+``MXNET_DRAIN_SECONDS`` → close the port) used by ``mxtpu-serve`` via
+``lifecycle.run_until_shutdown``.
 """
 from __future__ import annotations
 
+import math
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as _np
@@ -35,9 +55,14 @@ from ..http_util import BaseJSONHandler, HTTPServerBase, \
     start_http_server, stop_http_server
 from .batcher import DynamicBatcher, QueueFullError
 from .engine import InferenceEngine
+from . import lifecycle as _lc
 from . import metrics as _m
 
 __all__ = ["ModelServer"]
+
+
+def _retry_after_header(seconds: float) -> dict:
+    return {"Retry-After": str(max(1, int(math.ceil(seconds))))}
 
 
 class _ServingHTTPServer(HTTPServerBase):
@@ -57,8 +82,14 @@ class _Handler(BaseJSONHandler):
         ms = self.server.model_server
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/healthz":
+            # liveness ONLY: answering at all is the signal
             self.send_json(200, {"status": "ok",
                                  "models": sorted(ms.models())})
+        elif path == "/readyz":
+            ready, body = ms.readiness()
+            self.send_json(200 if ready else 503, body,
+                           headers=None if ready
+                           else _retry_after_header(1.0))
         elif path == "/v1/models":
             self.send_json(200, {"models": ms.model_stats()})
         elif path in ("/metrics", "/"):
@@ -67,7 +98,7 @@ class _Handler(BaseJSONHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
-                                "/metrics\n")
+                                "/readyz /metrics\n")
 
     def _post(self):
         ms = self.server.model_server
@@ -84,7 +115,12 @@ class _Handler(BaseJSONHandler):
             return
         try:
             if verb == "predict":
-                self.send_json(200, ms.predict_json(name, payload))
+                ms._http_enter()
+                try:
+                    out = ms.predict_json(name, payload)
+                finally:
+                    ms._http_exit()
+                self.send_json(200, out)
             elif verb == "load":
                 ms.load_model(name, payload)
                 self.send_json(200, {"loaded": name})
@@ -99,6 +135,20 @@ class _Handler(BaseJSONHandler):
                                  "loaded", "models": sorted(ms.models())})
         except QueueFullError as e:
             self.send_json(429, {"error": str(e)})
+        except _lc.DeadlineExceeded as e:
+            self.send_json(504, {"error": str(e)})
+        except TimeoutError as e:
+            # a bare result() timeout (no deadline set) is still the
+            # server failing to answer in time, not a client error
+            self.send_json(504, {"error": str(e) or
+                                 "inference request timed out"})
+        except _lc.BreakerOpen as e:
+            self.send_json(503, {"error": str(e),
+                                 "retry_after": e.retry_after},
+                           headers=_retry_after_header(e.retry_after))
+        except (_lc.Draining, _lc.RequestAborted) as e:
+            self.send_json(503, {"error": str(e)},
+                           headers=_retry_after_header(e.retry_after))
         except (ValueError, TypeError, MXNetError) as e:
             self.send_json(400, {"error": str(e)})
 
@@ -110,12 +160,13 @@ class ModelServer:
         srv.add_model("mnist", engine)          # or engine kwargs
         srv.start()
         ... requests against srv.port ...
-        srv.stop()                              # graceful drain
+        srv.stop()                              # immediate teardown
+        # or srv.shutdown() — the SIGTERM drain sequence
 
     Batcher knobs passed to :meth:`add_model` override the env defaults
     (``MXNET_SERVE_MAX_BATCH`` / ``MXNET_SERVE_MAX_DELAY_MS`` /
-    ``MXNET_SERVE_QUEUE``); the port default is ``MXNET_SERVE_PORT``
-    (8080)."""
+    ``MXNET_SERVE_QUEUE`` / ``MXNET_SERVE_TIMEOUT_MS``); the port
+    default is ``MXNET_SERVE_PORT`` (8080)."""
 
     def __init__(self, port: Optional[int] = None, host: str = "0.0.0.0",
                  **batcher_defaults):
@@ -126,14 +177,28 @@ class ModelServer:
         self._models: Dict[str, DynamicBatcher] = {}
         self._lock = threading.Lock()
         self._http: Optional[_ServingHTTPServer] = None
+        self._watchdog: Optional[_lc.Watchdog] = None
+        self._draining = False
+        self._warm_pending: set = set()
+        self._warm_errors: Dict[str, BaseException] = {}
+        self._inflight_http = 0
+        self._last_http = time.monotonic()
 
     # -- registry -------------------------------------------------------
     def add_model(self, name: str, engine: InferenceEngine,
-                  warmup: bool = False, **batcher_kw) -> DynamicBatcher:
+                  warmup: bool = False, async_warmup: bool = False,
+                  **batcher_kw) -> DynamicBatcher:
         """Register ``engine`` under ``name`` behind a fresh
         :class:`DynamicBatcher`.  ``warmup=True`` AOT-compiles every
-        declared bucket before the model takes traffic."""
-        if warmup:
+        declared bucket before the model takes traffic;
+        ``async_warmup=True`` does that compilation on a background
+        thread instead — the model registers immediately in the
+        STARTING state and ``/readyz`` stays 503 until its programs
+        exist (the AOT-warmed readiness gate)."""
+        if self._draining:
+            raise _lc.Draining(
+                f"server is draining; refusing to load {name!r}")
+        if warmup and not async_warmup:
             engine.warmup()
         kw = dict(self._batcher_defaults)
         kw.update(batcher_kw)
@@ -143,12 +208,33 @@ class ModelServer:
                 batcher.close(drain=False)
                 raise MXNetError(f"model {name!r} is already loaded")
             self._models[name] = batcher
+            self._warm_errors.pop(name, None)
+            if warmup and async_warmup:
+                self._warm_pending.add(name)
             _m.MODELS_LOADED.set(len(self._models))
+        if warmup and async_warmup:
+            threading.Thread(target=self._warm_async,
+                             args=(name, engine),
+                             name=f"mxtpu-serve-warmup-{name}",
+                             daemon=True).start()
         return batcher
+
+    def _warm_async(self, name: str, engine: InferenceEngine) -> None:
+        try:
+            engine.warmup()
+        except Exception as e:          # readiness shows the model
+            with self._lock:            # UNHEALTHY instead of wedging
+                self._warm_errors[name] = e
+        finally:
+            with self._lock:
+                self._warm_pending.discard(name)
 
     def load_model(self, name: str, payload: dict) -> DynamicBatcher:
         """Registry ``:load`` verb — build an engine from an exported
         artifact described by the JSON payload."""
+        if self._draining:
+            raise _lc.Draining(
+                f"server is draining; refusing to load {name!r}")
         if not isinstance(payload, dict) or "prefix" not in payload:
             raise ValueError(':load needs {"prefix": ..., "epoch": 0}')
         engine = InferenceEngine.from_export(
@@ -164,25 +250,85 @@ class ModelServer:
         """Drain the model's batcher and drop it from the registry."""
         with self._lock:
             batcher = self._models.pop(name)   # KeyError → HTTP 404
+            self._warm_pending.discard(name)
+            self._warm_errors.pop(name, None)
             _m.MODELS_LOADED.set(len(self._models))
         batcher.close(drain=True)
 
     def get_model(self, name: str) -> DynamicBatcher:
-        return self._models[name]
+        with self._lock:                # :load/:unload mutate the dict
+            return self._models[name]
 
     def models(self):
-        return list(self._models)
+        with self._lock:
+            return list(self._models)
 
     def model_stats(self) -> dict:
-        return {n: b.stats() for n, b in sorted(self._models.items())}
+        with self._lock:
+            items = sorted(self._models.items())
+        return {n: b.stats() for n, b in items}
+
+    # -- health ---------------------------------------------------------
+    def model_state(self, name: str) -> str:
+        """One model's serving state, folding in async-warmup progress
+        (STARTING while compiling, UNHEALTHY if warmup failed)."""
+        with self._lock:
+            batcher = self._models[name]       # KeyError → HTTP 404
+            if name in self._warm_pending:
+                return _lc.STARTING
+            if name in self._warm_errors:
+                return _lc.UNHEALTHY
+        return batcher.state
+
+    def readiness(self):
+        """``(ready, body)`` for ``GET /readyz``: ready only when not
+        draining and every model's state is SERVING or DEGRADED (a
+        degraded model still takes traffic; STARTING and UNHEALTHY do
+        not)."""
+        with self._lock:
+            names = list(self._models)
+            draining = self._draining
+        states = {}
+        for n in names:
+            try:
+                states[n] = _lc.DRAINING if draining \
+                    else self.model_state(n)
+            except KeyError:            # unloaded while we looked
+                continue
+            _m.MODEL_STATE.set(_lc.STATE_CODE[states[n]], model=n)
+        blockers = sorted(n for n, s in states.items()
+                          if s not in (_lc.SERVING, _lc.DEGRADED))
+        ready = not draining and not blockers
+        body = {"status": "ready" if ready else
+                ("draining" if draining else "unready"),
+                "draining": draining, "models": states}
+        if blockers and not draining:
+            body["blockers"] = blockers
+        return ready, body
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # -- inference ------------------------------------------------------
     def predict_json(self, name: str, payload: dict) -> dict:
         """Decode JSON tensors, run them through the model's batcher,
-        re-encode the per-request outputs."""
-        batcher = self._models[name]            # KeyError → HTTP 404
-        inputs = payload.get("inputs", payload) \
-            if isinstance(payload, dict) else payload
+        re-encode the per-request outputs.  Inputs decode at the
+        engine's DECLARED dtypes when it has input specs (an int32
+        model served over HTTP gets int32 tensors, not a silent
+        float32 cast); ``timeout_ms`` in the payload sets the
+        end-to-end deadline."""
+        if self._draining:
+            raise _lc.Draining(f"server is draining; model {name!r} is "
+                               "not accepting new work")
+        batcher = self.get_model(name)          # KeyError → HTTP 404
+        timeout_ms = None
+        inputs = payload
+        if isinstance(payload, dict):
+            timeout_ms = payload.get("timeout_ms")
+            if timeout_ms is not None:
+                timeout_ms = float(timeout_ms)  # ValueError → HTTP 400
+            inputs = payload.get("inputs", payload)
         if isinstance(inputs, dict):
             names = batcher.engine.input_names
             missing = [n for n in names if n not in inputs]
@@ -193,19 +339,34 @@ class ModelServer:
         if not isinstance(inputs, (list, tuple)) or not inputs:
             raise ValueError('"inputs" must be a non-empty list of '
                              "tensors or a {name: tensor} object")
-        arrays = [_np.asarray(v, dtype=_np.float32) for v in inputs]
+        dtypes = batcher.engine.input_dtypes
+        arrays = []
+        for i, v in enumerate(inputs):
+            dt = dtypes[i] if dtypes and i < len(dtypes) else _np.float32
+            arrays.append(_np.asarray(v, dtype=dt))
         for a in arrays:
             if a.ndim == 0:
                 raise ValueError("each input needs a leading batch dim")
-        outs = batcher.submit(arrays)
+        outs = batcher.submit(arrays, timeout_ms=timeout_ms)
         outs = [_np.asarray(o) for o in outs]
         return {"outputs": [o.tolist() for o in outs],
                 "shapes": [list(o.shape) for o in outs]}
 
+    # -- drain bookkeeping (the HTTP handler reports in-flight work) ----
+    def _http_enter(self) -> None:
+        with self._lock:
+            self._inflight_http += 1
+
+    def _http_exit(self) -> None:
+        with self._lock:
+            self._inflight_http -= 1
+            self._last_http = time.monotonic()
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ModelServer":
         """Bind and serve in daemon threads; returns self.  ``port=0``
-        binds an ephemeral port (see :attr:`port`)."""
+        binds an ephemeral port (see :attr:`port`).  Also starts the
+        worker watchdog over the live registry."""
         if self._http is not None:
             return self
         srv = start_http_server(_Handler, self._port, self._host,
@@ -213,16 +374,60 @@ class ModelServer:
                                 server_cls=_ServingHTTPServer)
         srv.model_server = self
         self._http = srv
+        if self._watchdog is None:
+            self._watchdog = _lc.Watchdog(supplier=self._batchers)
+        self._watchdog.start()
         return self
+
+    def _batchers(self):
+        with self._lock:
+            return list(self._models.values())
+
+    def begin_drain(self) -> None:
+        """Flip to DRAINING: ``/readyz`` answers 503 and new predict /
+        load work is refused with 503 + ``Retry-After`` while in-flight
+        requests keep going.  The port stays OPEN — the balancer needs
+        the 503s, not a reset."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._last_http = time.monotonic()
+
+    def shutdown(self, drain_seconds: Optional[float] = None,
+                 linger_seconds: float = 0.3) -> None:
+        """The SIGTERM-safe teardown: :meth:`begin_drain`, wait (within
+        ``MXNET_DRAIN_SECONDS``) until every batcher is idle, no predict
+        handler is in flight, and traffic has been quiet for
+        ``linger_seconds`` — then :meth:`stop`.  In-flight requests
+        finish with 200; late arrivals see 503, never a reset."""
+        if drain_seconds is None:
+            drain_seconds = _lc.default_drain_seconds()
+        self.begin_drain()
+        deadline = time.monotonic() + max(0.0, float(drain_seconds))
+        while time.monotonic() < deadline:
+            with self._lock:
+                inflight = self._inflight_http
+                last = self._last_http
+                batchers = list(self._models.values())
+            if inflight == 0 and all(b.idle for b in batchers) \
+                    and time.monotonic() - last >= linger_seconds:
+                break
+            time.sleep(0.02)
+        self.stop(drain=True)
 
     def stop(self, drain: bool = True) -> None:
         """Stop the HTTP front-end, then close every batcher
         (``drain=True`` finishes queued work first)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
         stop_http_server(self._http)
         self._http = None
         with self._lock:
             batchers = list(self._models.values())
             self._models.clear()
+            self._warm_pending.clear()
+            self._warm_errors.clear()
             _m.MODELS_LOADED.set(0)
         for b in batchers:
             b.close(drain=drain)

@@ -1,0 +1,489 @@
+"""Serving fault-domain tests (docs/robustness.md "Serving fault
+domains"): circuit breaker state machine (unit + via-batcher), deadline
+shedding at all three stages (admission / queue / wait), watchdog
+hung/dead-worker restart, drain-under-load, the close() join-timeout
+fix, readiness aggregation + drain over HTTP, dtype-honoring
+predict_json, and the SIGTERM-safe shutdown plumbing."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.serving import (CircuitBreaker, DynamicBatcher,
+                                         InferenceEngine, ModelServer,
+                                         Watchdog, lifecycle)
+from incubator_mxnet_tpu.serving import metrics as smetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    lifecycle.reset_shutdown_state()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    lifecycle.reset_shutdown_state()
+
+
+def _double(in_vals, param_vals, aux_vals, key):
+    return [in_vals[0] * 2]             # int-preserving (dtype test)
+
+
+def _engine(dim=4, dtype=np.float32, buckets=(1, 2, 4), name="m"):
+    return InferenceEngine(_double, ("data",), lambda: ((), ()),
+                           input_specs=[((dim,), dtype)],
+                           buckets=buckets, name=name)
+
+
+def _x(n, dim=4, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, dim)).astype(np.float32)
+
+
+def _wait_for(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------ circuit breaker
+def test_breaker_state_machine():
+    b = CircuitBreaker("unit", threshold=2, cooldown_seconds=0.1)
+    assert b.state == lifecycle.CLOSED
+    b.allow()                            # CLOSED admits freely
+    b.record_failure("one")
+    assert b.state == lifecycle.CLOSED   # below threshold
+    b.record_failure("two")
+    assert b.state == lifecycle.OPEN
+    with pytest.raises(lifecycle.BreakerOpen) as e:
+        b.allow()
+    assert e.value.retry_after > 0
+    time.sleep(0.12)
+    b.allow()                            # cooldown elapsed: the probe
+    assert b.state == lifecycle.HALF_OPEN
+    with pytest.raises(lifecycle.BreakerOpen):
+        b.allow()                        # only ONE probe at a time
+    b.record_success()
+    assert b.state == lifecycle.CLOSED
+    b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker("reset", threshold=2, cooldown_seconds=0.1)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == lifecycle.CLOSED   # never 2 consecutive
+
+
+def test_breaker_half_open_failure_reopens():
+    b = CircuitBreaker("reopen", threshold=1, cooldown_seconds=0.05)
+    b.record_failure()
+    assert b.state == lifecycle.OPEN
+    time.sleep(0.06)
+    b.allow()
+    assert b.state == lifecycle.HALF_OPEN
+    b.record_failure("probe failed")
+    assert b.state == lifecycle.OPEN     # back to cooldown
+
+
+def test_breaker_trips_via_batcher_fallbacks():
+    """Consecutive dispatch-after-retry failures (the fallback path)
+    trip the breaker; once the fault clears, the half-open probe
+    re-closes it without any restart."""
+    eng = _engine(name="trippy")
+    fault.install_plan("serving.infer:ioerror@1-999")
+    batcher = DynamicBatcher(
+        eng, max_delay_ms=1, name="trippy",
+        retry_policy=fault.RetryPolicy(max_retries=0, base_seconds=0.001),
+        breaker=CircuitBreaker("trippy", threshold=2,
+                               cooldown_seconds=0.15))
+    try:
+        # fallbacks still answer the clients, but each counts a failure
+        for i in range(2):
+            out = batcher.submit([_x(1, seed=i)], timeout=10)
+            assert out is not None
+        assert batcher.breaker.state == lifecycle.OPEN
+        assert batcher.state == lifecycle.UNHEALTHY
+        with pytest.raises(lifecycle.BreakerOpen):
+            batcher.submit([_x(1)])
+        fault.clear_plan()               # model "recovers"
+        time.sleep(0.2)                  # past the cooldown
+        out = batcher.submit([_x(1)], timeout=10)   # the probe
+        assert out is not None
+        assert batcher.breaker.state == lifecycle.CLOSED
+        assert batcher.state == lifecycle.SERVING
+    finally:
+        batcher.close()
+
+
+# ------------------------------------------------------------ deadlines
+def test_deadline_wait_stage():
+    eng = _engine()
+    orig = eng.predict
+    eng.predict = lambda arrays: (time.sleep(0.5), orig(arrays))[1]
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="slow")
+    try:
+        with pytest.raises(lifecycle.DeadlineExceeded):
+            batcher.submit([_x(1)], timeout_ms=120)
+    finally:
+        batcher.close(timeout=5)
+    flat = telemetry.counters_flat()
+    assert flat.get("mxtpu_serve_deadline_exceeded", 0) >= 1
+
+
+def test_deadline_queue_stage_sheds_expired():
+    """A request that expires while queued behind a stuck dispatch is
+    shed by the gather loop (stage=queue), never dispatched."""
+    eng = _engine()
+    release = threading.Event()
+    orig = eng.predict
+    eng.predict = lambda arrays: (release.wait(10), orig(arrays))[1]
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="shed")
+    try:
+        first = batcher.submit_async([_x(1)])           # occupies worker
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        doomed = batcher.submit_async([_x(1)], timeout_ms=80)
+        time.sleep(0.15)                                # expires queued
+        release.set()
+        assert first.result(10) is not None
+        # the worker's next gather sheds it and sets its event
+        assert _wait_for(doomed.event.is_set)
+        with pytest.raises(lifecycle.DeadlineExceeded):
+            doomed.result(0)
+    finally:
+        release.set()
+        batcher.close(timeout=5)
+
+
+def test_deadline_admission_stage_rejects_up_front():
+    """When the queue-wait estimate already busts the budget, admission
+    rejects immediately — the request never queues."""
+    eng = _engine()
+    release = threading.Event()
+    orig = eng.predict
+    eng.predict = lambda arrays: (release.wait(10), orig(arrays))[1]
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="admit")
+    try:
+        batcher.submit_async([_x(1)])                   # worker busy
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        with batcher._cv:                               # evidence of a
+            batcher._avg_batch_seconds = 50.0           # slow model
+        with pytest.raises(lifecycle.DeadlineExceeded):
+            batcher.submit_async([_x(1)], timeout_ms=100)
+        assert batcher.pending == 1                     # never queued
+    finally:
+        release.set()
+        batcher.close(timeout=5)
+
+
+def test_no_deadline_by_default_keeps_blocking_semantics():
+    eng = _engine()
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="nodl")
+    try:
+        assert batcher.default_timeout_ms == 0.0
+        req = batcher.submit_async([_x(1)])
+        assert req.deadline is None
+        assert req.result(10) is not None
+    finally:
+        batcher.close()
+
+
+# ------------------------------------------------------------- watchdog
+def test_watchdog_restarts_hung_worker_and_recovers():
+    """The hang drill: a wedged dispatch is detected, its riders fail
+    with RequestAborted, the worker restarts on a fresh generation, the
+    breaker trips; after cooldown the model recovers to SERVING without
+    a process restart."""
+    eng = _engine(name="hangy")
+    fault.install_plan("serving.infer:hang:2@1")
+    batcher = DynamicBatcher(
+        eng, max_delay_ms=1, name="hangy",
+        breaker=CircuitBreaker("hangy", threshold=5,
+                               cooldown_seconds=0.2))
+    try:
+        victim = batcher.submit_async([_x(1)])
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        time.sleep(0.25)
+        assert batcher.check_worker(hang_seconds=0.2) == "hung"
+        with pytest.raises(lifecycle.RequestAborted):
+            victim.result(5)
+        assert batcher.restarts == 1
+        assert batcher.breaker.state == lifecycle.OPEN
+        assert batcher.state == lifecycle.UNHEALTHY
+        with pytest.raises(lifecycle.BreakerOpen):
+            batcher.submit([_x(1)])
+        time.sleep(0.25)                 # cooldown; hang rule was @1
+        out = batcher.submit([_x(1)], timeout=10)       # probe, new worker
+        assert out is not None
+        assert batcher.state == lifecycle.SERVING
+        assert batcher.restarts == 1     # no further restarts
+    finally:
+        batcher.close(timeout=5)
+
+
+def test_watchdog_thread_sweeps():
+    eng = _engine(name="swept")
+    fault.install_plan("serving.infer:hang:2@1")
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="swept")
+    dog = Watchdog(hang_seconds=0.15, interval=0.05)
+    dog.watch(batcher)
+    dog.start()
+    try:
+        batcher.submit_async([_x(1)])
+        assert _wait_for(lambda: batcher.restarts >= 1, timeout=5)
+    finally:
+        dog.stop()
+        batcher.close(timeout=5)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_detects_dead_worker():
+    eng = _engine(name="deady")
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="deady")
+    try:
+        # kill the worker outright: SystemExit is not an Exception, so
+        # the _run_group guard lets it escape and the thread dies
+        eng.predict = lambda arrays: (_ for _ in ()).throw(SystemExit)
+        batcher.submit_async([_x(1)])
+        assert _wait_for(lambda: not batcher._thread.is_alive())
+        assert batcher.state == lifecycle.UNHEALTHY
+        assert batcher.check_worker(hang_seconds=0) == "died"
+        assert batcher.restarts == 1
+        assert batcher._thread.is_alive()
+    finally:
+        batcher.close(timeout=2)
+
+
+# ----------------------------------------------------------------- drain
+def test_close_join_timeout_fails_stranded_requests():
+    """The drain budget blows on a wedged dispatch: every still-pending
+    request gets a clear error instead of blocking forever."""
+    eng = _engine(name="wedge")
+    release = threading.Event()
+    orig = eng.predict
+    eng.predict = lambda arrays: (release.wait(10), orig(arrays))[1]
+    batcher = DynamicBatcher(eng, max_delay_ms=1, name="wedge")
+    try:
+        stuck = batcher.submit_async([_x(1)])
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        queued = batcher.submit_async([_x(1)])
+        batcher.close(drain=True, timeout=0.3)
+        for r in (stuck, queued):
+            with pytest.raises(lifecycle.RequestAborted):
+                r.result(1)
+    finally:
+        release.set()
+
+
+def test_drain_under_load_every_request_resolves():
+    """Clients hammering the batcher race close(drain=True): every
+    submit either returns a result or raises — nobody blocks."""
+    eng = _engine(name="race")
+    batcher = DynamicBatcher(eng, max_delay_ms=2, name="race")
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(i):
+        for j in range(20):
+            try:
+                out = batcher.submit([_x(1, seed=i * 100 + j)], timeout=10)
+                ok = out is not None
+            except MXNetError:
+                ok = True                # clean rejection is a resolution
+            except Exception:
+                ok = False
+            with lock:
+                outcomes.append(ok)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    [t.start() for t in threads]
+    time.sleep(0.05)
+    batcher.close(drain=True)
+    [t.join(timeout=30) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    assert outcomes and all(outcomes)
+
+
+# -------------------------------------------------- server + readiness
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _post(url, payload):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_server_readiness_and_drain_http():
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", _engine(), warmup=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        code, body, _ = _get(url + "/readyz")
+        assert code == 200 and body["models"]["m"] == "SERVING"
+        code, _, _ = _get(url + "/healthz")
+        assert code == 200
+
+        srv.begin_drain()
+        code, body, hdrs = _get(url + "/readyz")
+        assert code == 503 and body["draining"]
+        assert "Retry-After" in hdrs
+        code, body, hdrs = _post(url + "/v1/models/m:predict",
+                                 {"inputs": [[[1, 2, 3, 4]]]})
+        assert code == 503 and "Retry-After" in hdrs
+        code, _, _ = _post(url + "/v1/models/late:load", {"prefix": "x"})
+        assert code == 503
+        # liveness is unaffected by draining
+        code, _, _ = _get(url + "/healthz")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_server_breaker_open_maps_to_503_retry_after():
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    batcher = srv.add_model("m", _engine(), warmup=True)
+    batcher.breaker.trip("test")
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        code, body, hdrs = _post(url + "/v1/models/m:predict",
+                                 {"inputs": [[[1, 2, 3, 4]]]})
+        assert code == 503
+        assert "Retry-After" in hdrs
+        assert "breaker" in body["error"]
+        code, body, _ = _get(url + "/readyz")
+        assert code == 503 and body["models"]["m"] == "UNHEALTHY"
+        assert body["blockers"] == ["m"]
+    finally:
+        srv.stop()
+
+
+def test_server_deadline_maps_to_504():
+    eng = _engine()
+    orig = eng.predict
+    eng.predict = lambda arrays: (time.sleep(0.5), orig(arrays))[1]
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", eng)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        code, body, _ = _post(url + "/v1/models/m:predict",
+                              {"inputs": [[[1, 2, 3, 4]]],
+                               "timeout_ms": 100})
+        assert code == 504
+        assert "deadline" in body["error"]
+    finally:
+        srv.stop()
+
+
+def test_async_warmup_gates_readiness():
+    eng = _engine()
+    gate = threading.Event()
+    eng.warmup = lambda: gate.wait(10)
+    srv = ModelServer(port=0, host="127.0.0.1")
+    srv.add_model("m", eng, warmup=True, async_warmup=True)
+    try:
+        assert srv.model_state("m") == lifecycle.STARTING
+        ready, body = srv.readiness()
+        assert not ready and body["blockers"] == ["m"]
+        gate.set()
+        assert _wait_for(lambda: srv.readiness()[0], timeout=5)
+        assert srv.model_state("m") == lifecycle.SERVING
+    finally:
+        srv.stop()
+
+
+def test_predict_json_honors_declared_dtypes():
+    """An int32 model served over HTTP gets int32 tensors — no silent
+    float32 cast (outputs round-trip as JSON integers)."""
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("ints", _engine(dim=3, dtype=np.int32, name="ints"))
+    try:
+        out = srv.predict_json("ints", {"inputs": [[[1, 2, 3]]]})
+        assert out["outputs"][0] == [[2, 4, 6]]
+        assert all(isinstance(v, int) for v in out["outputs"][0][0])
+    finally:
+        srv.stop()
+
+
+def test_registry_reads_are_locked_under_churn():
+    """add/remove churn racing readers must never corrupt the registry
+    or raise spuriously (the unlocked-read satellite)."""
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("keep", _engine(name="keep"))
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                srv.models()
+                srv.model_stats()
+                srv.get_model("keep")
+            except Exception as e:       # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    [t.start() for t in threads]
+    try:
+        for i in range(15):
+            srv.add_model(f"m{i}", _engine(name=f"m{i}"))
+            srv.remove_model(f"m{i}")
+    finally:
+        stop.set()
+        [t.join(timeout=10) for t in threads]
+        srv.stop()
+    assert not errors
+
+
+# ---------------------------------------------------- shutdown plumbing
+def test_shutdown_flag_and_callbacks():
+    seen = []
+    lifecycle.on_shutdown(lambda: seen.append("cb"))
+    assert not lifecycle.shutdown_requested()
+    lifecycle.request_shutdown()
+    assert lifecycle.shutdown_requested()
+    assert seen == ["cb"]
+    lifecycle.request_shutdown()         # idempotent: callbacks run once
+    assert seen == ["cb"]
+
+
+def test_run_until_shutdown_drains_server():
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", _engine(), warmup=True)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    threading.Timer(0.25, lifecycle.request_shutdown).start()
+    rc = lifecycle.run_until_shutdown(srv, drain_seconds=2,
+                                      poll_seconds=0.05)
+    assert rc == 0
+    assert srv.models() == []            # drained and stopped
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=1)

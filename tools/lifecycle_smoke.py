@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Serving/training lifecycle smoke (ci/run_tests.sh lifecycle_smoke).
+
+Three drills over the serving fault-domain plane (docs/robustness.md
+"Serving fault domains"):
+
+* ``serve`` — SIGTERM-under-load: a child ``ModelServer`` takes traffic
+  from 16 concurrent clients when the parent SIGTERMs it.  The
+  acceptance contract: ZERO dropped in-flight requests — every client
+  sees 200 or 503, never a reset connection — and ``/readyz`` flips to
+  503 BEFORE the port closes, so a balancer drains the replica cleanly.
+  (``serve-child`` is the child entrypoint.)
+* ``hang``  — a ``serving.infer:hang`` fault wedges the batcher worker;
+  the watchdog detects it (``MXNET_SERVE_HANG_SECONDS``), fails the
+  riders (503), restarts the worker and trips the breaker (503 +
+  ``Retry-After``); after the cooldown the half-open probe succeeds and
+  the model recovers to SERVING — all without a process restart.
+* ``train`` — SIGTERM-as-preemption: a training loop polls
+  ``lifecycle.shutdown_requested()`` at its step boundary and publishes
+  an emergency ``checkpoint.save_sync`` before exiting; a resumed run
+  continues to the end and its final params are BIT-IDENTICAL to an
+  uninterrupted golden run (losses continuous across the preemption).
+  (``train-golden`` / ``train-victim`` / ``train-resume`` are the
+  subprocess entrypoints.)
+
+Batches are a pure function of the step index, so a replay from step k
+sees exactly the data the uninterrupted run saw — any divergence is a
+checkpoint/restore bug, not noise.
+"""
+import argparse
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+TOTAL_STEPS = 30
+SIGTERM_AFTER_STEP = 6
+BATCH = 8
+FEATS = 3
+DIM = 4
+N_CLIENTS = 16
+
+
+# ---------------------------------------------------------------- shared
+def _double(in_vals, param_vals, aux_vals, key):
+    return [in_vals[0] * 2.0]
+
+
+def _build_server(max_delay_ms=2.0, **batcher_kw):
+    from incubator_mxnet_tpu.serving import InferenceEngine, ModelServer
+    eng = InferenceEngine(_double, ("data",), lambda: ((), ()),
+                          input_specs=[((DIM,), np.float32)],
+                          buckets=[1, 2, 4, 8], name="m")
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=max_delay_ms)
+    srv.add_model("m", eng, warmup=True, **batcher_kw)
+    srv.start()
+    return srv
+
+
+def _predict(port, timeout=10, payload=None):
+    """One POST; returns (status, body_dict).  HTTP errors are statuses,
+    transport errors raise."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/m:predict",
+        data=json.dumps(payload or {"inputs": [[[1.0, 2.0, 3.0, 4.0]]]}
+                        ).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path, timeout=5):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+# ------------------------------------------------------- drill 1: serve
+def run_serve_child():
+    """Child process: serve until SIGTERM, then drain and exit 0."""
+    from incubator_mxnet_tpu.serving import lifecycle
+    srv = _build_server()
+    print(f"PORT {srv.port}", flush=True)
+    sys.exit(lifecycle.run_until_shutdown(srv))
+
+
+def run_serve(out):
+    env = dict(os.environ, MXNET_DRAIN_SECONDS="3")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "serve-child",
+         "--out", out],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    line = child.stdout.readline().strip()
+    assert line.startswith("PORT "), f"serve: bad child handshake {line!r}"
+    port = int(line.split()[1])
+    deadline = time.monotonic() + 30
+    while _get(port, "/readyz") != 200:
+        assert time.monotonic() < deadline, "serve: child never ready"
+        time.sleep(0.05)
+
+    hard_failures = []          # reset connections — the contract breach
+    oks = [0] * N_CLIENTS
+    got_503 = [0] * N_CLIENTS
+    refused_at = []             # first ConnectionRefused (port closed)
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(i):
+        while not stop.is_set():
+            try:
+                code, _ = _predict(port)
+            except urllib.error.URLError as e:
+                if isinstance(e.reason, ConnectionRefusedError):
+                    with lock:      # port closed — clean, stop trying
+                        refused_at.append(time.monotonic())
+                    return
+                with lock:
+                    hard_failures.append(f"client{i}: {e!r}")
+                return
+            except (ConnectionResetError, http.client.BadStatusLine,
+                    http.client.IncompleteRead) as e:
+                with lock:
+                    hard_failures.append(f"client{i}: {e!r}")
+                return
+            if code == 200:
+                oks[i] += 1
+            elif code == 503:
+                got_503[i] += 1
+                return              # draining: a real client backs off
+            else:
+                with lock:
+                    hard_failures.append(f"client{i}: HTTP {code}")
+                return
+
+    readyz_503_at = []
+
+    def readyz_watch():
+        while not stop.is_set():
+            try:
+                if _get(port, "/readyz", timeout=2) == 503:
+                    readyz_503_at.append(time.monotonic())
+                    return
+            except (urllib.error.URLError, ConnectionResetError,
+                    http.client.BadStatusLine):
+                readyz_503_at.append(None)      # port died pre-503: FAIL
+                return
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(N_CLIENTS)]
+    watcher = threading.Thread(target=readyz_watch)
+    [t.start() for t in threads]
+    watcher.start()
+    time.sleep(0.7)                     # traffic flowing
+    child.send_signal(signal.SIGTERM)
+    rc = child.wait(timeout=30)
+    stop.set()
+    [t.join(timeout=10) for t in threads]
+    watcher.join(timeout=10)
+
+    assert rc == 0, f"serve: child exited {rc}, expected clean 0"
+    assert not hard_failures, \
+        f"serve: dropped in-flight requests: {hard_failures[:5]}"
+    assert sum(oks) > 0, "serve: no client ever got a 200"
+    assert readyz_503_at and readyz_503_at[0] is not None, \
+        "serve: /readyz never flipped to 503 before the port closed"
+    if refused_at:
+        assert readyz_503_at[0] <= min(refused_at), \
+            "serve: port closed BEFORE /readyz flipped to 503"
+    print(f"serve ok: {sum(oks)} predicts from {N_CLIENTS} clients, "
+          f"{sum(got_503)} clean 503s, 0 resets; /readyz flipped "
+          f"before the port closed; child exit 0")
+
+
+# -------------------------------------------------------- drill 2: hang
+def run_hang(out):
+    os.environ["MXNET_SERVE_HANG_SECONDS"] = "0.4"
+    from incubator_mxnet_tpu import fault
+    from incubator_mxnet_tpu.serving import CircuitBreaker, lifecycle
+    fault.install_plan("serving.infer:hang:3@1")
+    srv = _build_server(
+        max_delay_ms=1.0,
+        breaker=CircuitBreaker("m", threshold=5, cooldown_seconds=0.6))
+    port = srv.port
+    try:
+        # the wedged dispatch: the watchdog fails it and restarts the
+        # worker (503 RequestAborted), well before the 3s hang ends
+        t0 = time.monotonic()
+        code, body = _predict(port, timeout=10)
+        dt = time.monotonic() - t0
+        assert code == 503, f"hang: victim got {code}: {body}"
+        assert dt < 2.5, f"hang: watchdog too slow ({dt:.2f}s)"
+        batcher = srv.get_model("m")
+        assert batcher.restarts == 1, batcher.restarts
+        assert batcher.breaker.state == lifecycle.OPEN
+        # breaker OPEN: fast-fail, not-ready
+        code, body = _predict(port, timeout=5)
+        assert code == 503, f"hang: breaker let {code} through: {body}"
+        assert _get(port, "/readyz") == 503
+        # cooldown elapses -> half-open probe succeeds -> SERVING again,
+        # same process, same worker generation discipline
+        time.sleep(0.8)
+        code, body = _predict(port, timeout=10)
+        assert code == 200, f"hang: probe failed {code}: {body}"
+        assert batcher.breaker.state == lifecycle.CLOSED
+        assert batcher.state == lifecycle.SERVING
+        assert _get(port, "/readyz") == 200
+        print(f"hang ok: watchdog restarted the worker in {dt:.2f}s, "
+              "breaker OPEN -> probe -> SERVING, no process restart")
+    finally:
+        fault.clear_plan()
+        srv.stop()
+
+
+# ------------------------------------------------------- drill 3: train
+def _batch_for(step):
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((BATCH, FEATS)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def _build_trainer():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    mx.random.seed(42)
+    net = nn.Dense(1, prefix="net_")    # fixed prefix: names match
+    net.initialize()                    # across processes
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05},
+                      kvstore="device", update_on_kvstore=True)
+    return net, trainer
+
+
+def _train_steps(net, trainer, first, last, losses, on_step=None):
+    from incubator_mxnet_tpu import autograd as ag
+    for step in range(first, last + 1):
+        x, y = _batch_for(step)
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(BATCH)
+        losses[step] = float(loss.asscalar())
+        if on_step is not None and on_step(step):
+            return step
+    return last
+
+
+def _dump(out, mode, losses, net):
+    with open(os.path.join(out, f"losses_{mode}.json"), "w") as f:
+        json.dump({str(k): v for k, v in losses.items()}, f)
+    np.savez(os.path.join(out, f"params_{mode}.npz"),
+             **{k: p.data().asnumpy()
+                for k, p in net.collect_params().items()})
+
+
+def run_train_golden(out):
+    net, trainer = _build_trainer()
+    losses = {}
+    _train_steps(net, trainer, 1, TOTAL_STEPS, losses)
+    _dump(out, "golden", losses, net)
+    print(f"golden: {TOTAL_STEPS} steps, final {losses[TOTAL_STEPS]:.6f}")
+
+
+def run_train_victim(out):
+    """Cooperative preemption: the SIGTERM handler only flips a flag;
+    THIS loop notices it at the step boundary and checkpoints a
+    consistent state synchronously before exiting."""
+    from incubator_mxnet_tpu.checkpoint import AsyncCheckpointer
+    from incubator_mxnet_tpu.serving import lifecycle
+    lifecycle.install_signal_handler()
+    net, trainer = _build_trainer()
+    ck = AsyncCheckpointer(os.path.join(out, "ckpt", "m"), keep=2)
+    losses = {}
+
+    def on_step(step):
+        print(f"STEP {step}", flush=True)
+        time.sleep(0.05)                # give the parent time to aim
+        if lifecycle.shutdown_requested():
+            ck.save_sync(step,
+                         {k: p.data() for k, p in
+                          net.collect_params().items()},
+                         trainer=trainer)
+            _dump(out, "victim", losses, net)
+            print(f"VICTIM checkpointed at step {step}", flush=True)
+            sys.exit(43)
+        return False
+
+    _train_steps(net, trainer, 1, TOTAL_STEPS, losses, on_step=on_step)
+    print("victim: never signaled", flush=True)
+    sys.exit(1)
+
+
+def run_train_resume(out):
+    from incubator_mxnet_tpu.checkpoint import AsyncCheckpointer
+    net, trainer = _build_trainer()
+    ck = AsyncCheckpointer(os.path.join(out, "ckpt", "m"), keep=2)
+    step = ck.restore_into(params=net.collect_params(), trainer=trainer)
+    assert step is not None, "resume: no complete checkpoint found"
+    losses = {}
+    _train_steps(net, trainer, step + 1, TOTAL_STEPS, losses)
+    _dump(out, "resume", losses, net)
+    print(f"resume: restored step {step}, replayed to {TOTAL_STEPS}")
+
+
+def run_train(out):
+    me = os.path.abspath(__file__)
+
+    def sub(mode, **popen_kw):
+        return subprocess.Popen([sys.executable, me, mode, "--out", out],
+                                text=True, **popen_kw)
+
+    rc = sub("train-golden").wait(timeout=300)
+    assert rc == 0, f"train: golden run failed ({rc})"
+
+    victim = sub("train-victim", stdout=subprocess.PIPE)
+    kill_step = None
+    for line in victim.stdout:
+        line = line.strip()
+        if line.startswith("STEP "):
+            n = int(line.split()[1])
+            if n >= SIGTERM_AFTER_STEP and kill_step is None:
+                kill_step = n
+                victim.send_signal(signal.SIGTERM)
+        elif line.startswith("VICTIM checkpointed"):
+            print(line)
+    rc = victim.wait(timeout=60)
+    assert rc == 43, f"train: victim exited {rc}, expected 43 " \
+                     "(emergency checkpoint path)"
+    assert kill_step is not None, "train: victim finished before SIGTERM"
+
+    rc = sub("train-resume").wait(timeout=300)
+    assert rc == 0, f"train: resume run failed ({rc})"
+
+    golden = np.load(os.path.join(out, "params_golden.npz"))
+    resume = np.load(os.path.join(out, "params_resume.npz"))
+    assert sorted(golden.files) == sorted(resume.files)
+    for name in golden.files:
+        assert np.array_equal(golden[name], resume[name]), \
+            f"train: param {name!r} differs between golden and resume"
+
+    def load(mode):
+        with open(os.path.join(out, f"losses_{mode}.json")) as f:
+            return {int(k): v for k, v in json.load(f).items()}
+
+    g, v, r = load("golden"), load("victim"), load("resume")
+    for step in sorted(v):
+        assert g[step] == v[step], \
+            f"train: loss diverged before the SIGTERM at step {step}"
+    for step in sorted(r):
+        assert g[step] == r[step], \
+            f"train: loss discontinuity after resume at step {step}"
+    assert min(r) == max(v) + 1, (min(r), max(v))
+    print(f"train ok: SIGTERM around step {kill_step}, emergency "
+          f"checkpoint at step {max(v)}, resume to {TOTAL_STEPS} "
+          f"bit-identical to golden ({len(golden.files)} params)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["serve", "serve-child", "hang",
+                                     "train", "train-golden",
+                                     "train-victim", "train-resume"])
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    {"serve": run_serve, "serve-child": lambda _o: run_serve_child(),
+     "hang": run_hang, "train": run_train,
+     "train-golden": run_train_golden, "train-victim": run_train_victim,
+     "train-resume": run_train_resume}[args.mode](args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
