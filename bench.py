@@ -579,6 +579,10 @@ def _bench_serve(on_accel, kind, dev):
 
     speedup = round(batched["requests_per_sec"]
                     / max(unbatched["requests_per_sec"], 1e-9), 3)
+    # steady-state SLO view of the batched run (every submit() outcome
+    # landed in the rolling window; serving/slo.py)
+    from incubator_mxnet_tpu.serving import slo as _slo
+    snap = _slo.tracker.model("bench-serve").snapshot()
     return {
         "model": f"mlp_{L}x{D}",
         "clients": clients,
@@ -593,6 +597,14 @@ def _bench_serve(on_accel, kind, dev):
         "speedup": speedup,
         "speedup_floor": 2.0,
         "floor_ok": bool(speedup >= 2.0),
+        "slo": {
+            "availability": round(snap["availability"], 6),
+            "p99_seconds": snap["p99_seconds"],
+            "burn_rate": round(snap["burn_rate"], 4),
+            "error_budget_remaining":
+                round(snap["error_budget_remaining"], 4),
+            "window": snap["window"],
+        },
     }
 
 

@@ -34,6 +34,13 @@ usage: ci/run_tests.sh <function>
                         HTTP clients; asserts batched dispatches << request
                         count, per-request outputs match the direct engine,
                         serve histograms on /metrics, and a clean drain
+  obs_smoke             observability drill: 16 traced clients against a
+                        server with a serving.infer:hang fault; asserts
+                        every response (200 and 5xx) echoed its
+                        x-request-id, the watchdog's flight-recorder dump
+                        names the hung requests' ids, /slo reports the
+                        budget burn, and mxtpu_slo_* series are on
+                        /metrics
   lifecycle_smoke       lifecycle drill (three parts): SIGTERM a serving
                         child under 16 concurrent clients — zero reset
                         connections, /readyz flips 503 before the port
@@ -293,6 +300,153 @@ print(f"serve_smoke ok: {int(n_req)} requests in {int(n_bat)} batches "
       f"(mean {n_req / n_bat:.1f} rows), "
       f"{engine.compiled_programs()} programs for "
       f"{len(engine.buckets)} buckets, clean shutdown")
+EOF
+}
+
+obs_smoke() {
+    local out=/tmp/mxtpu_obs_smoke
+    rm -rf "$out" && mkdir -p "$out"
+    MXNET_FAULT_PLAN="serving.infer:hang:30@1" \
+    MXNET_SERVE_HANG_SECONDS=0.5 \
+    MXNET_SERVE_BREAKER_COOLDOWN_SECONDS=0.3 \
+    MXNET_SERVE_SLO_P99_MS=250 \
+    MXNET_SERVE_SLO_AVAILABILITY=0.99 \
+    MXNET_FLIGHT_DUMP_DIR="$out" \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import glob
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.serving import InferenceEngine, ModelServer
+
+telemetry.start()
+mx.random.seed(0)
+net = nn.HybridSequential()
+for _ in range(2):
+    net.add(nn.Dense(32, in_units=32, activation="relu"))
+net.initialize(init=mx.init.Xavier())
+
+CLIENTS, REQS = 16, 3
+engine = InferenceEngine.from_block(net, [(32,)], name="obs",
+                                    max_batch_size=CLIENTS)
+srv = ModelServer(port=0, max_delay_ms=10.0)
+srv.add_model("obs", engine, warmup=True)
+srv.start()
+url = f"http://127.0.0.1:{srv.port}"
+
+rng = np.random.default_rng(0)
+xs = [rng.standard_normal((1, 32)).astype(np.float32)
+      for _ in range(CLIENTS)]
+
+# (sent_rid, status, echoed_header_rid) per response — including 5xx
+results = []
+res_lock = threading.Lock()
+
+def client(i):
+    body = json.dumps({"inputs": [xs[i].tolist()]}).encode()
+    for k in range(REQS):
+        rid = f"obs-{i}-{k}"
+        req = urllib.request.Request(
+            url + "/v1/models/obs:predict", data=body,
+            headers={"x-request-id": rid})
+        try:
+            r = urllib.request.urlopen(req, timeout=30)
+            status, echoed = r.status, r.headers.get("X-Request-Id")
+            r.read()
+        except urllib.error.HTTPError as e:
+            status, echoed = e.code, e.headers.get("X-Request-Id")
+            e.read()
+        with res_lock:
+            results.append((rid, status, echoed))
+        time.sleep(0.05)        # let the breaker cooldown recover
+
+threads = [threading.Thread(target=client, args=(i,))
+           for i in range(CLIENTS)]
+[t.start() for t in threads]
+[t.join() for t in threads]
+
+# recovery round: wait out the breaker cooldown, then probe until the
+# model serves again (proves the restart actually healed the worker)
+recovered = []
+deadline = time.monotonic() + 10.0
+k = 0
+while time.monotonic() < deadline and not recovered:
+    time.sleep(0.2)
+    rid = f"obs-recover-{k}"
+    k += 1
+    req = urllib.request.Request(
+        url + "/v1/models/obs:predict",
+        data=json.dumps({"inputs": [xs[0].tolist()]}).encode(),
+        headers={"x-request-id": rid})
+    try:
+        r = urllib.request.urlopen(req, timeout=30)
+        status, echoed = r.status, r.headers.get("X-Request-Id")
+        r.read()
+    except urllib.error.HTTPError as e:
+        status, echoed = e.code, e.headers.get("X-Request-Id")
+        e.read()
+    results.append((rid, status, echoed))
+    if status == 200:
+        recovered.append(rid)
+
+# 1. every response, 200 and 5xx alike, echoed its x-request-id
+assert len(results) >= CLIENTS * REQS
+bad_echo = [(rid, st, ech) for rid, st, ech in results if ech != rid]
+assert not bad_echo, f"obs_smoke: responses without echo: {bad_echo[:3]}"
+failed = [rid for rid, st, _ in results if st >= 500]
+ok = [rid for rid, st, _ in results if st == 200]
+assert failed, "obs_smoke: the hang fault produced no 5xx responses"
+assert recovered, "obs_smoke: nothing recovered after the watchdog restart"
+
+# 2. the watchdog wrote a flight dump naming the hung requests' ids
+dump_dir = os.environ["MXNET_FLIGHT_DUMP_DIR"]
+deadline = time.monotonic() + 10.0
+dumps = []
+while time.monotonic() < deadline:
+    dumps = glob.glob(os.path.join(dump_dir,
+                                   "flight_*_watchdog_restart.json"))
+    if dumps:
+        break
+    time.sleep(0.1)
+assert dumps, f"obs_smoke: no watchdog flight dump in {dump_dir}"
+dump = json.load(open(dumps[0]))
+wd = [e for e in dump["ring"]
+      if e["type"] == "fault" and e["event"] == "watchdog"]
+assert wd, "obs_smoke: no watchdog fault entry in the dump ring"
+hung = [r for e in wd for r in e.get("request_ids", ())]
+assert hung and set(hung) <= {rid for rid, _, _ in results}, \
+    f"obs_smoke: dump names unknown request ids: {hung[:3]}"
+assert set(hung) <= set(failed), \
+    "obs_smoke: a request the dump calls hung got a 200"
+assert "serving" in dump, "obs_smoke: dump lacks the serving provider"
+
+# 3. /slo reports the burn
+slo = json.load(urllib.request.urlopen(url + "/slo", timeout=10))
+m = slo["models"]["obs"]
+assert m["bad"] >= len(failed) and m["burn_rate"] > 0.0, \
+    f"obs_smoke: SLO window missed the failures: {m}"
+
+# 4. SLO series on /metrics
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+for series in ("mxtpu_slo_error_budget_remaining", "mxtpu_slo_burn_rate",
+               "mxtpu_slo_availability"):
+    assert series in prom, f"obs_smoke: {series} missing from /metrics"
+
+srv.stop()
+telemetry.stop()
+print(f"obs_smoke ok: {len(ok)}/{len(results)} ok, {len(failed)} failed "
+      f"with ids echoed, {len(hung)} hung ids in "
+      f"{os.path.basename(dumps[0])}, burn_rate={m['burn_rate']:.2f}, "
+      f"budget={m['error_budget_remaining']:.2f}")
 EOF
 }
 

@@ -41,7 +41,8 @@ def stats_main():
     the metrics afterwards::
 
         mxtpu-stats [--format prometheus|json] [--out PATH]
-                    [--serve [--port N]] script.py [args...]
+                    [--serve [--port N]] [--slo] [--flight-dump PATH]
+                    script.py [args...]
 
     The script runs in-process (as ``__main__``) with the telemetry
     collector started, so every layer (op dispatch, compile cache,
@@ -67,6 +68,13 @@ def stats_main():
     ap.add_argument("--port", type=int, default=9100,
                     help="HTTP exporter port for --serve (default 9100; "
                          "0 picks an ephemeral port)")
+    ap.add_argument("--slo", action="store_true",
+                    help="also print the per-model SLO state (burn "
+                         "rate, error budget) after the script")
+    ap.add_argument("--flight-dump", metavar="PATH", default=None,
+                    help="write a flight-recorder postmortem JSON to "
+                         "PATH after the script (always written, even "
+                         "on success — useful for inspecting the ring)")
     ap.add_argument("script", help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script")
@@ -104,6 +112,15 @@ def stats_main():
             f.write(text)
     else:
         sys.stdout.write(text)
+    if ns.slo:
+        import json
+        from . import telemetry_http
+        sys.stdout.write(json.dumps(telemetry_http.slo_body(), indent=2,
+                                    default=str) + "\n")
+    if ns.flight_dump:
+        from . import telemetry_ring
+        path = telemetry_ring.recorder.dump("cli", path=ns.flight_dump)
+        sys.stderr.write(f"mxtpu-stats: flight dump -> {path}\n")
     sys.exit(status)
 
 

@@ -201,26 +201,29 @@ def site_calls(site: str) -> int:
     return plan.calls(site) if plan is not None else 0
 
 
-def inject(site: str) -> None:
+def inject(site: str, **ctx) -> None:
     """Poll ``site`` against the plan: sleep for ``latency`` rules, raise
     :class:`FaultInjected` for ``ioerror`` rules.  A single attribute
-    check when no plan is installed — safe on hot paths."""
+    check when no plan is installed — safe on hot paths.  Extra ``ctx``
+    kwargs (``model=``, ``request_id=``, ...) ride along on the FAULT
+    event so an injected failure is attributable to the request that
+    hit it (docs/observability.md)."""
     plan = _plan
     if plan is None:
         return
     for r in plan.fire(site):
         if r.kind in ("latency", "hang"):
             _telemetry.FAULT.publish(site=site, event="injected",
-                                     kind=r.kind)
+                                     kind=r.kind, **ctx)
             _time.sleep(r.seconds)
         elif r.kind == "ioerror":
             _telemetry.FAULT.publish(site=site, event="injected",
-                                     kind=r.kind)
+                                     kind=r.kind, **ctx)
             raise FaultInjected(site, r)
         # 'nonfinite' rules are consumed via take() at numeric sites
 
 
-def take(site: str, kind: str) -> bool:
+def take(site: str, kind: str, **ctx) -> bool:
     """Poll ``site``; True when a rule of ``kind`` fires on this call.
     Used for faults the *caller* realizes (e.g. the trainer poisons a
     gradient when a ``nonfinite`` rule fires)."""
@@ -231,7 +234,7 @@ def take(site: str, kind: str) -> bool:
     for r in plan.fire(site):
         if r.kind == kind:
             _telemetry.FAULT.publish(site=site, event="injected",
-                                     kind=r.kind)
+                                     kind=r.kind, **ctx)
             hit = True
     return hit
 

@@ -9,9 +9,14 @@ so the two can't drift:
 
 * :class:`BaseJSONHandler` — a ``BaseHTTPRequestHandler`` with the
   common response helpers (``_send``/``send_json``/``read_json``),
-  silent request logging (training stdout stays clean), and a
+  silent request logging (training stdout stays clean), a
   swallow-all error guard so a handler bug degrades to a 500, never a
-  crash-looping accept thread.
+  crash-looping accept thread, and per-request id handling: every
+  response — 200s, 4xx/5xx error branches, even the guard's own
+  500 — carries an ``X-Request-Id`` header echoing the client's
+  ``x-request-id`` (sanitized) or a freshly generated id, so a client
+  can always correlate a response with server-side FAULT events, spans,
+  and flight-recorder dumps (docs/observability.md).
 * :func:`start_http_server` / :func:`stop_http_server` — daemon-thread
   lifecycle.  Port 0 binds an ephemeral port; the bound port is
   ``server.server_address[1]``.
@@ -19,7 +24,9 @@ so the two can't drift:
 from __future__ import annotations
 
 import json
+import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Type
 
@@ -35,10 +42,29 @@ class HTTPServerBase(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+# what survives of a client-supplied x-request-id: word chars, dot,
+# dash — anything else is stripped so ids are safe to grep, log, and
+# embed in filenames
+_REQUEST_ID_JUNK = re.compile(r"[^A-Za-z0-9._\-]")
+
+
 class BaseJSONHandler(BaseHTTPRequestHandler):
     """Response/request helpers shared by every embedded HTTP server."""
 
     server_version = "mxtpu-http/1.0"
+
+    def request_id(self) -> str:
+        """This request's id: the client's ``x-request-id`` header
+        (sanitized, capped at 64 chars) or a generated 16-hex-char id.
+        Stable for the duration of one request; ``_send`` echoes it on
+        the response and resets it for the next keep-alive request."""
+        rid = getattr(self, "_mxtpu_request_id", None)
+        if rid is None:
+            raw = (self.headers.get("x-request-id") or "").strip() \
+                if getattr(self, "headers", None) else ""
+            rid = _REQUEST_ID_JUNK.sub("", raw)[:64] or uuid.uuid4().hex[:16]
+            self._mxtpu_request_id = rid
+        return rid
 
     def _send(self, code: int, body: str, ctype: str,
               headers: Optional[dict] = None) -> None:
@@ -46,10 +72,12 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-Request-Id", self.request_id())
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
+        self._mxtpu_request_id = None   # keep-alive: next request, new id
 
     def send_text(self, code: int, body: str,
                   ctype: str = "text/plain; charset=utf-8",

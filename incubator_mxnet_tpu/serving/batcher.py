@@ -49,9 +49,22 @@ Operational behavior is wired into the runtime's existing planes:
 * **telemetry** — ``serve.request`` (submit-to-result) and
   ``serve.batch`` spans, queue-wait / batch-size / end-to-end latency
   histograms, per-model queue-depth gauge, breaker/watchdog series.
+* **request tracing** — every request carries a request id (client's
+  ``x-request-id`` via the HTTP front-end, else generated here) that is
+  stamped on its ``serve.request`` span, on every FAULT event it
+  triggers (deadline sheds, injected faults, watchdog aborts, worker
+  crashes), and on the ``serve.batch`` span's ``links`` attr, so one id
+  greps a failed request end to end — HTTP response header → span tree
+  → flight-recorder dump (docs/observability.md).  The caller's span
+  context is captured at submit and re-attached in the worker thread,
+  so the batch span nests under the request that headed the batch.
+* **SLO accounting** — every synchronous :meth:`submit` outcome lands
+  in ``serving.slo``'s per-model rolling window (good/bad + latency),
+  feeding the ``mxtpu_slo_*`` series and ``/slo`` burn-rate math.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -63,6 +76,7 @@ from .. import fault as _fault
 from .. import telemetry as _telemetry
 from . import lifecycle as _lc
 from . import metrics as _m
+from . import slo as _slo
 
 __all__ = ["DynamicBatcher", "QueueFullError"]
 
@@ -75,9 +89,11 @@ class _Request:
     """One submitted batch: arrays + a latch the caller waits on."""
 
     __slots__ = ("arrays", "n", "sig", "event", "outputs", "error",
-                 "t_submit", "deadline", "model")
+                 "t_submit", "deadline", "model", "request_id",
+                 "trace_ctx")
 
-    def __init__(self, arrays, n, sig, deadline=None, model="?"):
+    def __init__(self, arrays, n, sig, deadline=None, model="?",
+                 request_id=None, trace_ctx=None):
         self.arrays = arrays
         self.n = n
         self.sig = sig
@@ -87,6 +103,8 @@ class _Request:
         self.t_submit = time.monotonic()
         self.deadline = deadline        # absolute monotonic, or None
         self.model = model
+        self.request_id = request_id or _telemetry.new_request_id()
+        self.trace_ctx = trace_ctx      # submitter's span, for the worker
 
     def result(self, timeout: Optional[float] = None) -> List:
         """Block for the scattered outputs; re-raises dispatch errors.
@@ -101,8 +119,12 @@ class _Request:
             if self.deadline is not None \
                     and time.monotonic() >= self.deadline:
                 _m.DEADLINE_EXCEEDED.inc(model=self.model, stage="wait")
+                _telemetry.FAULT.publish(
+                    site="serving.deadline", event="deadline", kind="wait",
+                    model=self.model, request_id=self.request_id)
                 raise _lc.DeadlineExceeded(
-                    f"{self.model}: request deadline exceeded after "
+                    f"{self.model}: request {self.request_id} deadline "
+                    f"exceeded after "
                     f"{time.monotonic() - self.t_submit:.3f}s")
             raise TimeoutError("inference request timed out")
         if self.error is not None:
@@ -190,15 +212,20 @@ class DynamicBatcher:
         return batches_ahead * self._avg_batch_seconds
 
     def submit_async(self, arrays: Sequence,
-                     timeout_ms: Optional[float] = None) -> _Request:
+                     timeout_ms: Optional[float] = None,
+                     request_id: Optional[str] = None) -> _Request:
         """Enqueue one request batch; returns a latch whose
         ``result()`` blocks for the outputs.  Raises
         :class:`QueueFullError` under backpressure,
         ``lifecycle.BreakerOpen`` while the model's breaker is OPEN,
         ``lifecycle.DeadlineExceeded`` when the queue-wait estimate
         already busts the request's budget, and ``MXNetError`` after
-        :meth:`close`."""
-        _fault.inject("serving.queue")
+        :meth:`close`.  ``request_id`` (generated when absent) rides on
+        every FAULT event the request triggers."""
+        if request_id is None:
+            request_id = _telemetry.new_request_id()
+        _fault.inject("serving.queue", model=self.name,
+                      request_id=request_id)
         self.breaker.allow()
         arrays = list(arrays)
         n = int(arrays[0].shape[0])
@@ -206,7 +233,8 @@ class DynamicBatcher:
             timeout_ms = self.default_timeout_ms
         req = _Request(arrays, n, self._signature(arrays),
                        deadline=_lc.deadline_from_ms(timeout_ms),
-                       model=self.name)
+                       model=self.name, request_id=request_id,
+                       trace_ctx=_telemetry.tracer.current())
         with self._cv:
             if self._closed:
                 raise MXNetError(f"batcher {self.name!r} is closed")
@@ -220,9 +248,14 @@ class DynamicBatcher:
                 if time.monotonic() + est > req.deadline:
                     _m.DEADLINE_EXCEEDED.inc(model=self.name,
                                              stage="admission")
+                    _telemetry.FAULT.publish(
+                        site="serving.deadline", event="deadline",
+                        kind="admission", model=self.name,
+                        request_id=req.request_id)
                     raise _lc.DeadlineExceeded(
                         f"{self.name}: estimated queue wait {est:.3f}s "
-                        "already exceeds the request deadline")
+                        "already exceeds the deadline of request "
+                        f"{req.request_id}")
             self._queue.append(req)
             _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
             self._cv.notify_all()
@@ -231,16 +264,30 @@ class DynamicBatcher:
 
     def submit(self, arrays: Sequence,
                timeout: Optional[float] = None,
-               timeout_ms: Optional[float] = None) -> List:
+               timeout_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> List:
         """Synchronous request: enqueue, wait, return per-row outputs
         (jax arrays, sliced to this request's rows).  ``timeout_ms`` is
         the end-to-end deadline budget (defaults from
         ``MXNET_SERVE_TIMEOUT_MS``); ``timeout`` additionally bounds
-        just the wait."""
+        just the wait.  Every outcome (including rejections and
+        deadline busts) is recorded against the model's SLO window."""
+        if request_id is None:
+            request_id = _telemetry.new_request_id()
+        t0 = time.monotonic()
         with _telemetry.trace_span("serve.request", cat="serving",
-                                   model=self.name):
-            return self.submit_async(arrays,
-                                     timeout_ms=timeout_ms).result(timeout)
+                                   model=self.name,
+                                   request_id=request_id):
+            try:
+                out = self.submit_async(
+                    arrays, timeout_ms=timeout_ms,
+                    request_id=request_id).result(timeout)
+            except Exception:
+                _slo.tracker.record(self.name,
+                                    time.monotonic() - t0, ok=False)
+                raise
+            _slo.tracker.record(self.name, time.monotonic() - t0, ok=True)
+            return out
 
     # -- worker ---------------------------------------------------------
     def _current_gen(self) -> int:
@@ -269,9 +316,12 @@ class DynamicBatcher:
         event.set() under the lock is fine — waiters wake after we
         release)."""
         _m.DEADLINE_EXCEEDED.inc(model=self.name, stage="queue")
+        _telemetry.FAULT.publish(site="serving.deadline", event="deadline",
+                                 kind="queue", model=self.name,
+                                 request_id=req.request_id)
         req.error = _lc.DeadlineExceeded(
-            f"{self.name}: request expired in queue after "
-            f"{time.monotonic() - req.t_submit:.3f}s")
+            f"{self.name}: request {req.request_id} expired in queue "
+            f"after {time.monotonic() - req.t_submit:.3f}s")
         req.event.set()
 
     def _gather(self, gen: int):
@@ -327,9 +377,18 @@ class DynamicBatcher:
         total = sum(r.n for r in group)
         _m.BATCH_SIZE.observe(total)
         _m.BATCHES.inc(model=self.name)
-        with _telemetry.trace_span("serve.batch", cat="serving",
-                                   model=self.name,
-                                   requests=len(group), rows=total):
+        rids = [r.request_id for r in group]
+        # nest the batch span under the span of the request that headed
+        # the batch (cross-thread attach); `links` carries EVERY rider's
+        # request id so one grep finds the dispatch a request rode on
+        head_ctx = group[0].trace_ctx
+        attach = _telemetry.tracer.attach(head_ctx) \
+            if head_ctx is not None else contextlib.nullcontext()
+        with attach, \
+                _telemetry.trace_span("serve.batch", cat="serving",
+                                      model=self.name,
+                                      requests=len(group), rows=total,
+                                      links=rids):
             try:
                 def _val(a):
                     return a._data if isinstance(a, NDArray) \
@@ -342,7 +401,8 @@ class DynamicBatcher:
                         for i in range(len(group[0].arrays))]
 
                 def run():
-                    _fault.inject("serving.infer")
+                    _fault.inject("serving.infer", model=self.name,
+                                  request_ids=rids)
                     return self.engine.predict(ins)
 
                 try:
@@ -362,6 +422,10 @@ class DynamicBatcher:
                 self._degraded = False
                 self.breaker.record_success()
             except Exception as e:      # worker must survive anything
+                _telemetry.FAULT.publish(
+                    site="serving.worker", event="crash",
+                    kind=type(e).__name__, model=self.name,
+                    request_ids=rids)
                 for r in group:
                     r.error = e
             finally:
@@ -381,7 +445,9 @@ class DynamicBatcher:
         circuit breaker (enough of these in a row trip it OPEN)."""
         _telemetry.FAULT.publish(site="serving.infer", event="fallback",
                                  kind=type(err).__name__,
-                                 requests=len(group))
+                                 requests=len(group), model=self.name,
+                                 request_ids=[r.request_id
+                                              for r in group])
         _m.FALLBACKS.inc(model=self.name)
         self.breaker.record_failure(f"batch dispatch failed: "
                                     f"{type(err).__name__}")
@@ -427,13 +493,19 @@ class DynamicBatcher:
             if not r.event.is_set():
                 r.error = _lc.RequestAborted(
                     f"{self.name}: batcher worker {reason}; request "
-                    "failed by the watchdog — retry on another replica")
+                    f"{r.request_id} failed by the watchdog — retry on "
+                    "another replica")
                 r.event.set()
-        self.breaker.trip(f"worker {reason}")
+        # the watchdog event goes out BEFORE the breaker trip: the
+        # flight recorder dumps on both, and the restart (with its rider
+        # request ids) is the primary artifact of this incident
         _m.WATCHDOG_RESTARTS.inc(model=self.name)
         _telemetry.FAULT.publish(site="serving.worker", event="watchdog",
                                  kind=reason, model=self.name,
-                                 riders=len(failed))
+                                 riders=len(failed),
+                                 request_ids=[r.request_id
+                                              for r in failed])
+        self.breaker.trip(f"worker {reason}")
         return reason
 
     @property
@@ -467,6 +539,14 @@ class DynamicBatcher:
     def idle(self) -> bool:
         with self._cv:
             return not self._queue and self._busy_since is None
+
+    def active_request_ids(self) -> dict:
+        """Request ids currently queued / riding the in-flight dispatch
+        (the flight recorder's "active requests" dump section)."""
+        with self._cv:
+            return {"queued": [r.request_id for r in self._queue],
+                    "inflight": [r.request_id
+                                 for r in (self._inflight or ())]}
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop intake.  ``drain=True`` (default) lets the worker finish
@@ -502,7 +582,7 @@ class DynamicBatcher:
                 if not r.event.is_set():
                     r.error = _lc.RequestAborted(
                         f"batcher {self.name!r}: drain timed out after "
-                        f"{timeout}s; request abandoned")
+                        f"{timeout}s; request {r.request_id} abandoned")
                     r.event.set()
         with self._cv:
             _m.QUEUE_DEPTH.set(0, model=self.name)

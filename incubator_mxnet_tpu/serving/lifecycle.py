@@ -53,6 +53,7 @@ from typing import Callable, Iterable, Optional
 
 from ..base import MXNetError, getenv
 from .. import telemetry as _telemetry
+from .. import telemetry_ring as _ring
 from . import metrics as _m
 
 __all__ = [
@@ -336,6 +337,10 @@ class Watchdog:
     def _run(self):
         while not self._stop.wait(self.interval):
             self.sweep()
+            # the watchdog tick doubles as the flight recorder's metrics
+            # sampler: the ring gets a coarse counter-delta timeline
+            # (rate-limited inside note_metrics) for free
+            _ring.recorder.note_metrics()
 
 
 # -- SIGTERM-safe shutdown plumbing -----------------------------------------

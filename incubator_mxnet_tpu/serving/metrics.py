@@ -35,6 +35,10 @@ WATCHDOG_RESTARTS = _telemetry.registry.counter(
 BREAKER_TRIPS = _telemetry.registry.counter(
     "mxtpu_serve_breaker_trips",
     "per-model circuit breaker CLOSED/HALF_OPEN -> OPEN transitions")
+SLO_BAD = _telemetry.registry.counter(
+    "mxtpu_slo_bad_requests",
+    "requests that burned error budget (any failure surfaced to the "
+    "caller: backpressure, breaker, deadline, abort, dispatch error)")
 
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(
@@ -61,3 +65,19 @@ MODEL_STATE = _telemetry.registry.gauge(
     "mxtpu_serve_model_state",
     "per-model serving state (0 SERVING, 1 STARTING, 2 DEGRADED, "
     "3 UNHEALTHY, 4 DRAINING)")
+
+# SLO plane (serving/slo.py; docs/observability.md) -------------------------
+SLO_AVAILABILITY = _telemetry.registry.gauge(
+    "mxtpu_slo_availability",
+    "rolling-window availability SLI, per model")
+SLO_P99 = _telemetry.registry.gauge(
+    "mxtpu_slo_p99_seconds",
+    "rolling-window p99 end-to-end latency SLI, per model")
+SLO_BURN = _telemetry.registry.gauge(
+    "mxtpu_slo_burn_rate",
+    "error-budget burn rate (1.0 = spending exactly the budget the "
+    "objective allows), per model")
+SLO_BUDGET = _telemetry.registry.gauge(
+    "mxtpu_slo_error_budget_remaining",
+    "fraction of the error budget left in the rolling window "
+    "(0 = exhausted -> readiness blocker), per model")

@@ -32,8 +32,20 @@ Routes (JSON tensors everywhere):
   503 otherwise, so a load balancer / rollout controller pulls the
   replica without killing it.
 * ``GET /metrics`` — the SHARED telemetry registry in Prometheus text
-  form; ``mxtpu_serve_*`` series ride along with every other runtime
-  metric, no extra wiring.
+  form; ``mxtpu_serve_*`` and ``mxtpu_slo_*`` series ride along with
+  every other runtime metric, no extra wiring.
+* ``GET /slo`` — per-model SLIs, burn rate, and error-budget state
+  (serving/slo.py); an exhausted budget also surfaces as a
+  ``slo:<model>`` blocker on ``/readyz``.
+* ``GET /trace`` — the span tree, bounded (``?limit=``/``?since=``)
+  with per-request lookup (``?request_id=``); same contract as the
+  telemetry exporter's route (shared via ``telemetry_http.trace_body``).
+
+Every response carries an ``X-Request-Id`` header (client-supplied
+``x-request-id`` or generated — ``http_util.BaseJSONHandler``); predict
+errors additionally carry ``"request_id"`` in the JSON body, and the
+same id is stamped on the request's span and FAULT events, so one grep
+follows a failed request end to end (docs/observability.md).
 
 Shutdown: ``stop()`` is the immediate programmatic teardown;
 ``shutdown()`` is the SIGTERM-safe sequence (flip to DRAINING → 503 on
@@ -53,10 +65,12 @@ import numpy as _np
 from ..base import MXNetError, getenv_int
 from ..http_util import BaseJSONHandler, HTTPServerBase, \
     start_http_server, stop_http_server
+from .. import telemetry_ring as _ring
 from .batcher import DynamicBatcher, QueueFullError
 from .engine import InferenceEngine
 from . import lifecycle as _lc
 from . import metrics as _m
+from . import slo as _slo
 
 __all__ = ["ModelServer"]
 
@@ -79,8 +93,11 @@ class _Handler(BaseJSONHandler):
         self.guard(self._post)
 
     def _get(self):
+        from urllib.parse import parse_qs, urlsplit
         ms = self.server.model_server
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        params = parse_qs(split.query)
+        path = split.path.rstrip("/") or "/"
         if path == "/healthz":
             # liveness ONLY: answering at all is the signal
             self.send_json(200, {"status": "ok",
@@ -92,13 +109,18 @@ class _Handler(BaseJSONHandler):
                            else _retry_after_header(1.0))
         elif path == "/v1/models":
             self.send_json(200, {"models": ms.model_stats()})
+        elif path == "/slo":
+            self.send_json(200, _slo.tracker.snapshot())
+        elif path == "/trace":
+            from .. import telemetry_http
+            self.send_json(200, telemetry_http.trace_body(params))
         elif path in ("/metrics", "/"):
             from .. import telemetry
             self._send(200, telemetry.render_prometheus(),
                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
-                                "/readyz /metrics\n")
+                                "/readyz /metrics /slo /trace\n")
 
     def _post(self):
         ms = self.server.model_server
@@ -108,16 +130,22 @@ class _Handler(BaseJSONHandler):
                            "not found: POST /v1/models/<name>:predict\n")
             return
         name, _, verb = path[len("/v1/models/"):].rpartition(":")
+        rid = self.request_id()
+
+        def err(code, body, headers=None):
+            body["request_id"] = rid
+            self.send_json(code, body, headers=headers)
+
         try:
             payload = self.read_json()
         except ValueError as e:
-            self.send_json(400, {"error": str(e)})
+            err(400, {"error": str(e)})
             return
         try:
             if verb == "predict":
                 ms._http_enter()
                 try:
-                    out = ms.predict_json(name, payload)
+                    out = ms.predict_json(name, payload, request_id=rid)
                 finally:
                     ms._http_exit()
                 self.send_json(200, out)
@@ -128,29 +156,29 @@ class _Handler(BaseJSONHandler):
                 ms.remove_model(name)
                 self.send_json(200, {"unloaded": name})
             else:
-                self.send_json(404, {"error": f"unknown verb {verb!r}; "
-                                     "try :predict :load :unload"})
+                err(404, {"error": f"unknown verb {verb!r}; "
+                          "try :predict :load :unload"})
         except KeyError:
-            self.send_json(404, {"error": f"model {name!r} is not "
-                                 "loaded", "models": sorted(ms.models())})
+            err(404, {"error": f"model {name!r} is not "
+                      "loaded", "models": sorted(ms.models())})
         except QueueFullError as e:
-            self.send_json(429, {"error": str(e)})
+            err(429, {"error": str(e)})
         except _lc.DeadlineExceeded as e:
-            self.send_json(504, {"error": str(e)})
+            err(504, {"error": str(e)})
         except TimeoutError as e:
             # a bare result() timeout (no deadline set) is still the
             # server failing to answer in time, not a client error
-            self.send_json(504, {"error": str(e) or
-                                 "inference request timed out"})
+            err(504, {"error": str(e) or
+                      "inference request timed out"})
         except _lc.BreakerOpen as e:
-            self.send_json(503, {"error": str(e),
-                                 "retry_after": e.retry_after},
-                           headers=_retry_after_header(e.retry_after))
+            err(503, {"error": str(e),
+                      "retry_after": e.retry_after},
+                headers=_retry_after_header(e.retry_after))
         except (_lc.Draining, _lc.RequestAborted) as e:
-            self.send_json(503, {"error": str(e)},
-                           headers=_retry_after_header(e.retry_after))
+            err(503, {"error": str(e)},
+                headers=_retry_after_header(e.retry_after))
         except (ValueError, TypeError, MXNetError) as e:
-            self.send_json(400, {"error": str(e)})
+            err(400, {"error": str(e)})
 
 
 class ModelServer:
@@ -296,8 +324,13 @@ class ModelServer:
             except KeyError:            # unloaded while we looked
                 continue
             _m.MODEL_STATE.set(_lc.STATE_CODE[states[n]], model=n)
-        blockers = sorted(n for n, s in states.items()
-                          if s not in (_lc.SERVING, _lc.DEGRADED))
+        blockers = [n for n, s in states.items()
+                    if s not in (_lc.SERVING, _lc.DEGRADED)]
+        # an exhausted error budget pulls the replica from rotation even
+        # while the model itself still answers (serving/slo.py)
+        blockers += [f"slo:{n}" for n in _slo.tracker.exhausted()
+                     if n in states]
+        blockers = sorted(blockers)
         ready = not draining and not blockers
         body = {"status": "ready" if ready else
                 ("draining" if draining else "unready"),
@@ -311,13 +344,16 @@ class ModelServer:
         return self._draining
 
     # -- inference ------------------------------------------------------
-    def predict_json(self, name: str, payload: dict) -> dict:
+    def predict_json(self, name: str, payload: dict,
+                     request_id: Optional[str] = None) -> dict:
         """Decode JSON tensors, run them through the model's batcher,
         re-encode the per-request outputs.  Inputs decode at the
         engine's DECLARED dtypes when it has input specs (an int32
         model served over HTTP gets int32 tensors, not a silent
         float32 cast); ``timeout_ms`` in the payload sets the
-        end-to-end deadline."""
+        end-to-end deadline; ``request_id`` (the HTTP front-end passes
+        the echoed ``x-request-id``) tags the request's span and any
+        FAULT events it triggers."""
         if self._draining:
             raise _lc.Draining(f"server is draining; model {name!r} is "
                                "not accepting new work")
@@ -347,7 +383,8 @@ class ModelServer:
         for a in arrays:
             if a.ndim == 0:
                 raise ValueError("each input needs a leading batch dim")
-        outs = batcher.submit(arrays, timeout_ms=timeout_ms)
+        outs = batcher.submit(arrays, timeout_ms=timeout_ms,
+                              request_id=request_id)
         outs = [_np.asarray(o) for o in outs]
         return {"outputs": [o.tolist() for o in outs],
                 "shapes": [list(o.shape) for o in outs]}
@@ -377,7 +414,31 @@ class ModelServer:
         if self._watchdog is None:
             self._watchdog = _lc.Watchdog(supplier=self._batchers)
         self._watchdog.start()
+        # flight recorder: hold a reference for the server's lifetime
+        # (postmortems even when full telemetry is off) and contribute
+        # the serving section of every dump
+        _ring.recorder.start()
+        _ring.recorder.register_provider("serving", self._flight_state)
         return self
+
+    def _flight_state(self) -> dict:
+        """Flight-dump provider: per-model lifecycle/breaker states and
+        the request ids currently queued or in flight."""
+        with self._lock:
+            batchers = dict(self._models)
+            draining = self._draining
+        out = {"draining": draining, "models": {}}
+        for n, b in sorted(batchers.items()):
+            try:
+                out["models"][n] = {
+                    "state": self.model_state(n),
+                    "breaker": b.breaker.state,
+                    "restarts": b.restarts,
+                    "requests": b.active_request_ids(),
+                }
+            except Exception as e:      # a sick model is itself data
+                out["models"][n] = {"error": repr(e)}
+        return out
 
     def _batchers(self):
         with self._lock:
@@ -421,6 +482,9 @@ class ModelServer:
         (``drain=True`` finishes queued work first)."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self._http is not None:
+            _ring.recorder.unregister_provider("serving")
+            _ring.recorder.stop()
         stop_http_server(self._http)
         self._http = None
         with self._lock:

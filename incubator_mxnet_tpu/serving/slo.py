@@ -1,0 +1,195 @@
+"""Per-model SLO accounting — rolling-window SLIs, error budget, and
+burn rate for the serving plane (docs/observability.md).
+
+Counters say how many requests failed; an operator paging decision
+needs *rates against an objective*.  This module keeps, per model, a
+bounded rolling window of request outcomes (ok/failed + end-to-end
+latency, recorded by ``DynamicBatcher.submit``) and derives the two
+SLIs the serving plane promises:
+
+* **availability** — fraction of requests in the window that returned
+  a result (anything raised — 429 backpressure, 503 breaker/abort,
+  504 deadline, 500 dispatch errors — counts against it; 4xx client
+  errors never reach the batcher, so they never burn budget).
+* **latency** — the window's p99 versus the objective
+  ``MXNET_SERVE_SLO_P99_MS``.
+
+Each SLI yields a **burn rate** — how fast the error budget is being
+spent, where 1.0 means "exactly consuming the budget the objective
+allows" (the Google SRE workbook convention):
+
+* availability burn = (bad/total) / (1 − availability_objective)
+* latency burn = fraction of requests slower than the p99 objective
+  / 0.01 (an SLO of "p99 under X" budgets 1% of requests over X)
+
+``burn_rate`` is the worst of the applicable burns;
+``error_budget_remaining = clamp(1 − burn_rate, 0, 1)``; the budget is
+*exhausted* once burn ≥ 1 with at least ``MXNET_SERVE_SLO_MIN_REQUESTS``
+requests observed (a floor so one failed canary request cannot flip
+``/readyz``).  Exhaustion shows up as a ``slo:<model>`` blocker in
+``ModelServer.readiness()`` → ``/readyz`` 503, taking the replica out
+of the balancer rotation until the window recovers.
+
+Exported: ``mxtpu_slo_availability``, ``mxtpu_slo_p99_seconds``,
+``mxtpu_slo_burn_rate``, ``mxtpu_slo_error_budget_remaining`` gauges
+(per model) plus the ``mxtpu_slo_bad_requests`` counter; the full
+JSON view is ``GET /slo`` and ``mxtpu-stats --slo``.
+
+Knobs (docs/env_var.md): ``MXNET_SERVE_SLO_AVAILABILITY`` (objective,
+default 0.999), ``MXNET_SERVE_SLO_P99_MS`` (latency objective in ms,
+default 0 → latency SLO off), ``MXNET_SERVE_SLO_WINDOW`` (window size
+in requests, default 512), ``MXNET_SERVE_SLO_MIN_REQUESTS`` (readiness
+floor, default 10).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from ..base import getenv, getenv_int
+from . import metrics as _m
+
+__all__ = ["ModelSLO", "SLOTracker", "tracker",
+           "objective_availability", "objective_p99_ms",
+           "default_window", "min_requests"]
+
+
+def objective_availability() -> float:
+    """``MXNET_SERVE_SLO_AVAILABILITY``: availability objective in
+    [0, 1) — e.g. 0.999 budgets 0.1% failed requests."""
+    return float(getenv("MXNET_SERVE_SLO_AVAILABILITY", 0.999))
+
+
+def objective_p99_ms() -> float:
+    """``MXNET_SERVE_SLO_P99_MS``: p99 latency objective in
+    milliseconds; 0 disables the latency SLI."""
+    return float(getenv("MXNET_SERVE_SLO_P99_MS", 0.0))
+
+
+def default_window() -> int:
+    """``MXNET_SERVE_SLO_WINDOW``: rolling window size in requests."""
+    return getenv_int("MXNET_SERVE_SLO_WINDOW", 512)
+
+
+def min_requests() -> int:
+    """``MXNET_SERVE_SLO_MIN_REQUESTS``: observations required before
+    an exhausted budget may block readiness."""
+    return getenv_int("MXNET_SERVE_SLO_MIN_REQUESTS", 10)
+
+
+class ModelSLO:
+    """Rolling window of (ok, latency) outcomes for one model."""
+
+    def __init__(self, model: str, window: Optional[int] = None):
+        self.model = str(model)
+        self._window = deque(maxlen=max(1, int(window or default_window())))
+        self._lock = threading.Lock()
+
+    def record(self, latency_seconds: float, ok: bool) -> None:
+        """Fold one request outcome into the window and refresh the
+        ``mxtpu_slo_*`` gauges (a sort of ≤ window samples — cheap next
+        to a batched dispatch)."""
+        with self._lock:
+            self._window.append((bool(ok), float(latency_seconds)))
+        if not ok:
+            _m.SLO_BAD.inc(model=self.model)
+        snap = self.snapshot()
+        _m.SLO_AVAILABILITY.set(snap["availability"], model=self.model)
+        if snap["p99_seconds"] is not None:
+            _m.SLO_P99.set(snap["p99_seconds"], model=self.model)
+        _m.SLO_BURN.set(snap["burn_rate"], model=self.model)
+        _m.SLO_BUDGET.set(snap["error_budget_remaining"], model=self.model)
+
+    def snapshot(self) -> dict:
+        """JSON-ready SLI/burn/budget view of the current window."""
+        with self._lock:
+            window = list(self._window)
+        total = len(window)
+        bad = sum(1 for ok, _ in window if not ok)
+        avail_obj = min(1.0, max(0.0, objective_availability()))
+        p99_obj_s = max(0.0, objective_p99_ms()) / 1000.0
+        out = {
+            "model": self.model,
+            "window": total,
+            "bad": bad,
+            "availability": 1.0 if total == 0 else (total - bad) / total,
+            "availability_objective": avail_obj,
+            "p99_seconds": None,
+            "p99_objective_seconds": p99_obj_s or None,
+            "burn_rate": 0.0,
+            "error_budget_remaining": 1.0,
+            "exhausted": False,
+        }
+        if total == 0:
+            return out
+        lats = sorted(lat for _, lat in window)
+        # same nearest-rank convention as telemetry.Histogram.stats()
+        out["p99_seconds"] = lats[min(total - 1,
+                                      max(0, int(round(0.99 * (total - 1)))))]
+        burns = []
+        if avail_obj < 1.0:
+            burns.append((bad / total) / (1.0 - avail_obj))
+        if p99_obj_s > 0.0:
+            slow = sum(1 for _, lat in window if lat > p99_obj_s)
+            burns.append((slow / total) / 0.01)
+        burn = max(burns) if burns else 0.0
+        out["burn_rate"] = burn
+        out["error_budget_remaining"] = min(1.0, max(0.0, 1.0 - burn))
+        out["exhausted"] = burn >= 1.0 and total >= min_requests()
+        return out
+
+
+class SLOTracker:
+    """Registry of :class:`ModelSLO` windows (one process-wide
+    instance: :data:`tracker`)."""
+
+    def __init__(self):
+        self._models: Dict[str, ModelSLO] = {}
+        self._lock = threading.Lock()
+
+    def model(self, name: str) -> ModelSLO:
+        name = str(name)
+        m = self._models.get(name)
+        if m is None:
+            with self._lock:
+                m = self._models.setdefault(name, ModelSLO(name))
+        return m
+
+    def record(self, name: str, latency_seconds: float, ok: bool) -> None:
+        self.model(name).record(latency_seconds, ok)
+
+    def snapshot(self) -> dict:
+        """``GET /slo`` body: every model's SLI/burn/budget view plus
+        the shared objectives."""
+        with self._lock:
+            models = dict(self._models)
+        return {
+            "objectives": {
+                "availability": objective_availability(),
+                "p99_ms": objective_p99_ms() or None,
+                "window": default_window(),
+                "min_requests": min_requests(),
+            },
+            "models": {name: m.snapshot() for name, m in models.items()},
+        }
+
+    def exhausted(self) -> Dict[str, dict]:
+        """Models whose error budget is exhausted (→ readiness
+        blockers)."""
+        with self._lock:
+            models = dict(self._models)
+        out = {}
+        for name, m in models.items():
+            snap = m.snapshot()
+            if snap["exhausted"]:
+                out[name] = snap
+        return out
+
+    def reset(self) -> None:
+        """Drop every window (test hygiene)."""
+        with self._lock:
+            self._models.clear()
+
+
+tracker = SLOTracker()

@@ -13,8 +13,8 @@ Two cooperating pieces:
   truthiness check — the instrumented hot paths stay effectively free
   when nothing is listening.
 * **Metrics registry** — process-wide :class:`Counter` / :class:`Gauge` /
-  :class:`Histogram` (bounded reservoir with p50/p95/max), exported three
-  ways: :func:`render_prometheus` (text exposition format),
+  :class:`Histogram` (bounded reservoir with p50/p95/p99/max), exported
+  three ways: :func:`render_prometheus` (text exposition format),
   :func:`snapshot` (JSON-ready dict, merged into ``bench.py``'s output
   line), and counter samples woven into the profiler's chrome-trace
   ``dump()`` as ``ph:"C"`` events.
@@ -53,7 +53,13 @@ Three further planes layered on the same spine (this file + satellites):
   the ``mxtpu_mfu`` gauge and the ``mxtpu_step_seconds`` histogram.
 * **HTTP exporter** (``telemetry_http.py``) — stdlib ``http.server``
   background thread serving ``/metrics`` (Prometheus text), ``/healthz``
-  and ``/trace`` (live span tree as JSON).
+  and ``/trace`` (live span tree as JSON, bounded by ``?limit=`` /
+  ``?since=`` and searchable by ``?request_id=``).
+* **Flight recorder** (``telemetry_ring.py``) — a lock-cheap bounded
+  ring continuously recording recent FAULT events, finished spans and
+  metric deltas; it auto-dumps a postmortem JSON on watchdog restarts,
+  breaker trips, non-finite-guard skips, SIGTERM drain and worker
+  crashes.  :func:`start`/:func:`stop` hold one reference on it.
 
 Control plane: ``MXNET_TELEMETRY=1`` starts collection at import;
 ``MXNET_TELEMETRY_DUMP=/path`` additionally writes a dump at process exit
@@ -81,6 +87,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram",
     "Span", "Tracer", "tracer", "trace_span", "traced", "current_span",
+    "new_request_id",
     "start", "stop", "enabled", "reset",
     "snapshot", "render_prometheus", "counters_flat", "dump",
     "instrument_jit", "sample_device_memory",
@@ -322,11 +329,16 @@ class Histogram:
     """Bounded-reservoir histogram: keeps the last ``max_samples``
     observations for percentiles plus exact count/sum/max over the full
     stream.  Exported in Prometheus summary form (quantile series +
-    ``_count``/``_sum``) with an extra ``_max`` series."""
+    ``_count``/``_sum``) with an extra ``_max`` series.
+
+    The default reservoir holds 4096 samples so the p99 estimate rests
+    on the ~41 largest observations of the window instead of the ~20 a
+    2048-deep reservoir would give it — stable enough for the SLO
+    engine (serving/slo.py) to alarm on."""
 
     kind = "histogram"
 
-    def __init__(self, name: str, help: str = "", max_samples: int = 2048):
+    def __init__(self, name: str, help: str = "", max_samples: int = 4096):
         self.name = name
         self.help = help
         self._lock = threading.Lock()
@@ -362,13 +374,13 @@ class Histogram:
             count, total, mx = self._count, self._sum, self._max
         if not data:
             return {"count": 0, "sum": 0.0, "p50": None, "p95": None,
-                    "max": None}
+                    "p99": None, "max": None}
 
         def pct(q):
             return data[min(len(data) - 1,
                             max(0, int(round(q * (len(data) - 1)))))]
         return {"count": count, "sum": total, "p50": pct(0.5),
-                "p95": pct(0.95), "max": mx}
+                "p95": pct(0.95), "p99": pct(0.99), "max": mx}
 
     def sample(self):
         return self.stats()
@@ -409,7 +421,7 @@ class MetricsRegistry:
         return self._get(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  max_samples: int = 2048) -> Histogram:
+                  max_samples: int = 4096) -> Histogram:
         return self._get(Histogram, name, help, max_samples=max_samples)
 
     def get(self, name: str):
@@ -454,7 +466,8 @@ class MetricsRegistry:
             else:
                 lines.append(f"# TYPE {m.name} summary")
                 s = m.stats()
-                for q, k in (("0.5", "p50"), ("0.95", "p95")):
+                for q, k in (("0.5", "p50"), ("0.95", "p95"),
+                             ("0.99", "p99")):
                     if s[k] is not None:
                         lines.append(
                             f'{m.name}{{quantile="{q}"}} {repr(s[k])}')
@@ -477,22 +490,34 @@ def gauge(name: str, help: str = "") -> Gauge:
 
 
 def histogram(name: str, help: str = "",
-              max_samples: int = 2048) -> Histogram:
+              max_samples: int = 4096) -> Histogram:
     return registry.histogram(name, help, max_samples=max_samples)
 
 
 # ---------------------------------------------------------------------------
 # Span tracer
 # ---------------------------------------------------------------------------
+def new_request_id() -> str:
+    """A fresh 16-hex request/trace id (the server-generated fallback
+    when a client did not supply ``x-request-id``)."""
+    import uuid
+    return uuid.uuid4().hex[:16]
+
+
+_span_seq = __import__("itertools").count(1)
+
+
 class Span:
     """One timed region of the program: name, category, wall window
     (``time.perf_counter`` floats), free-form attrs, child spans, and the
     ident of the thread that opened it.  Spans form trees: a span opened
     while another is current on the same thread (or under an explicit
-    ``parent=``) becomes its child."""
+    ``parent=``) becomes its child.  ``sid`` is a process-unique hex id
+    so a span can be referenced from outside its tree (batch-span links,
+    ``/trace`` lookups)."""
 
     __slots__ = ("name", "cat", "t0", "t1", "attrs", "children", "tid",
-                 "parent")
+                 "parent", "sid")
 
     def __init__(self, name: str, cat: str = "span", attrs: dict = None):
         self.name = name
@@ -501,6 +526,7 @@ class Span:
         self.t0 = None
         self.t1 = None
         self.tid = 0
+        self.sid = f"{next(_span_seq):08x}"
         self.parent: Optional["Span"] = None
         self.children: List["Span"] = []
 
@@ -511,7 +537,7 @@ class Span:
         return self.t1 - self.t0
 
     def to_dict(self, epoch: float = 0.0, now: float = None) -> dict:
-        d = {"name": self.name, "cat": self.cat,
+        d = {"name": self.name, "cat": self.cat, "id": self.sid,
              "start_s": None if self.t0 is None
              else round(self.t0 - epoch, 6)}
         if self.t1 is not None:
@@ -668,19 +694,52 @@ class Tracer:
         with self._lock:
             return list(self._finished) + list(self._live.values())
 
-    def tree(self, max_finished: int = 64) -> dict:
+    def tree(self, max_finished: int = 64,
+             since: Optional[float] = None) -> dict:
         """JSON-ready view for the HTTP ``/trace`` endpoint: currently
         open root spans plus the most recent finished ones.  Times are
-        seconds since tracer creation."""
+        seconds since tracer creation; ``since`` (same clock) drops
+        roots that started before it, so a long-running server can be
+        polled incrementally instead of re-serialized whole."""
         now = time.perf_counter()
         with self._lock:
             live = list(self._live.values())
-            fin = list(self._finished)[-max_finished:]
+            fin = list(self._finished)
+        if since is not None:
+            cutoff = self._epoch + float(since)
+            live = [s for s in live if s.t0 is None or s.t0 >= cutoff]
+            fin = [s for s in fin if s.t0 is None or s.t0 >= cutoff]
+        fin = fin[-max(0, int(max_finished)):]
         return {
             "epoch_perf_counter": self._epoch,
             "live": [s.to_dict(self._epoch, now) for s in live],
             "finished": [s.to_dict(self._epoch) for s in fin],
         }
+
+    def find_spans(self, attr: str, value, limit: int = 32) -> List[dict]:
+        """Bounded lookup: spans (any depth, newest roots first) whose
+        ``attrs[attr] == value``, as JSON-ready subtrees.  The per-request
+        ``/trace?request_id=`` view — cost is one walk over the bounded
+        finished/live roots, never the whole history."""
+        now = time.perf_counter()
+        with self._lock:
+            roots = list(self._live.values()) + list(self._finished)[::-1]
+        out: List[dict] = []
+
+        def walk(sp: Span):
+            if len(out) >= limit:
+                return
+            if sp.attrs and sp.attrs.get(attr) == value:
+                out.append(sp.to_dict(self._epoch, now))
+                return                  # the subtree already rides along
+            for ch in list(sp.children):
+                walk(ch)
+
+        for root in roots:
+            if len(out) >= limit:
+                break
+            walk(root)
+        return out
 
     def chrome_events(self, t0: float) -> List[dict]:
         """Finished spans (any depth) overlapping [t0, now) as chrome
@@ -1185,6 +1244,10 @@ def start() -> None:
         topic.subscribe(fn, passive=topic is OP_TIMED)
     tracer.enable()
     _started = True
+    # the black-box flight recorder rides whenever the collector does
+    # (late import: telemetry_ring imports this module)
+    from . import telemetry_ring
+    telemetry_ring.recorder.start()
 
 
 def stop() -> None:
@@ -1194,6 +1257,8 @@ def stop() -> None:
         topic.unsubscribe(fn)
     if _started:
         tracer.disable()
+        from . import telemetry_ring
+        telemetry_ring.recorder.stop()
     _started = False
 
 

@@ -8,7 +8,16 @@ while it runs:
   moment ``serving`` is imported — the registry is shared, no wiring.
 * ``GET /healthz``  — liveness probe; JSON with collector state + uptime.
 * ``GET /trace``    — the span tracer's current tree (open roots with
-  running durations + recent finished roots) as JSON.
+  running durations + recent finished roots) as JSON — BOUNDED:
+  ``?limit=N`` caps finished roots (default 32, max 256), ``?since=S``
+  keeps only roots that started in the last S seconds, and
+  ``?request_id=RID`` looks up the spans carrying that request id (the
+  per-request lookup behind the serving plane's request tracing;
+  docs/observability.md).  A long-running server can no longer emit a
+  multi-MB tree by default.
+* ``GET /slo``      — per-model SLO state when the serving plane is
+  loaded (``{"models": {}}`` otherwise; the route never *imports*
+  serving — a telemetry scrape must not drag jax/engine code in).
 
 Start it with ``MXNET_TELEMETRY_PORT=<port>`` (telemetry import tail),
 ``mxtpu-stats --serve`` (CLI), or :func:`start_server` directly.  Port 0
@@ -25,6 +34,7 @@ acyclic at import time.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from http.server import ThreadingHTTPServer
@@ -32,7 +42,55 @@ from typing import Optional
 
 from .http_util import BaseJSONHandler, start_http_server, stop_http_server
 
-__all__ = ["start_server", "stop_server", "server"]
+__all__ = ["start_server", "stop_server", "server", "trace_body",
+           "slo_body"]
+
+#: ``/trace`` bounds: default and hard cap for ``?limit=``
+TRACE_DEFAULT_LIMIT = 32
+TRACE_MAX_LIMIT = 256
+
+
+def _param(params: dict, key: str) -> Optional[str]:
+    vals = params.get(key)
+    return vals[-1] if vals else None
+
+
+def trace_body(params: dict) -> dict:
+    """The bounded ``/trace`` response body, shared by this exporter and
+    the model server's route.  ``params`` is a ``parse_qs`` dict;
+    recognized: ``limit`` (finished roots, default 32, clamped to
+    [0, 256]), ``since`` (seconds of lookback), ``request_id`` (span
+    lookup by the ``request_id`` attr — returns the matching spans'
+    subtrees instead of the whole forest)."""
+    from . import telemetry
+    rid = _param(params, "request_id")
+    try:
+        limit = int(_param(params, "limit") or TRACE_DEFAULT_LIMIT)
+    except ValueError:
+        limit = TRACE_DEFAULT_LIMIT
+    limit = max(0, min(limit, TRACE_MAX_LIMIT))
+    if rid:
+        return {"request_id": rid,
+                "spans": telemetry.tracer.find_spans(
+                    "request_id", rid, limit=limit or TRACE_DEFAULT_LIMIT)}
+    since = None
+    raw_since = _param(params, "since")
+    if raw_since:
+        try:
+            since = float(raw_since)
+        except ValueError:
+            since = None
+    return telemetry.tracer.tree(max_finished=limit, since=since)
+
+
+def slo_body() -> dict:
+    """The ``/slo`` response body.  Reads the tracker only when the
+    serving plane is already in ``sys.modules`` — a metrics exporter
+    must never be the thing that imports jax/engine code."""
+    slo = sys.modules.get("incubator_mxnet_tpu.serving.slo")
+    if slo is None:
+        return {"objectives": {}, "models": {}}
+    return slo.tracker.snapshot()
 
 _server: Optional[ThreadingHTTPServer] = None
 _t_start: Optional[float] = None
@@ -46,8 +104,11 @@ class _Handler(BaseJSONHandler):
         self.guard(self._route)
 
     def _route(self):
+        from urllib.parse import parse_qs, urlsplit
         from . import telemetry
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        params = parse_qs(split.query)
+        path = split.path.rstrip("/") or "/"
         if path in ("/metrics", "/"):
             self._send(200, telemetry.render_prometheus(),
                        "text/plain; version=0.0.4; charset=utf-8")
@@ -61,11 +122,16 @@ class _Handler(BaseJSONHandler):
             }) + "\n", "application/json")
         elif path == "/trace":
             self._send(200,
-                       json.dumps(telemetry.tracer.tree(), indent=2,
+                       json.dumps(trace_body(params), indent=2,
                                   default=str) + "\n",
                        "application/json")
+        elif path == "/slo":
+            self._send(200,
+                       json.dumps(slo_body(), default=str) + "\n",
+                       "application/json")
         else:
-            self._send(404, "not found: try /metrics /healthz /trace\n",
+            self._send(404, "not found: try /metrics /healthz /trace "
+                            "/slo\n",
                        "text/plain; charset=utf-8")
 
 

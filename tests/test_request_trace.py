@@ -1,0 +1,414 @@
+"""Request-scoped observability tests (docs/observability.md):
+request ids must survive the batcher's worker-thread boundary (the
+``serve.batch`` span nests under the head rider's ``serve.request``
+and ``links`` every rider), every HTTP response — including error
+paths — must echo ``X-Request-Id``, the flight recorder must ring and
+auto-dump on incident triggers with the affected request ids in the
+artifact, and the SLO math must match hand-computed burn rates."""
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import fault, telemetry, telemetry_ring
+from incubator_mxnet_tpu.serving import (CircuitBreaker, DynamicBatcher,
+                                         InferenceEngine, ModelServer,
+                                         lifecycle)
+from incubator_mxnet_tpu.serving import slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    lifecycle.reset_shutdown_state()
+    slo.tracker.reset()
+    telemetry_ring.recorder.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    lifecycle.reset_shutdown_state()
+    slo.tracker.reset()
+    telemetry_ring.recorder.reset()
+
+
+def _double(in_vals, param_vals, aux_vals, key):
+    return [in_vals[0] * 2]
+
+
+def _engine(dim=4, buckets=(1, 2, 4), name="m"):
+    return InferenceEngine(_double, ("data",), lambda: ((), ()),
+                           input_specs=[((dim,), np.float32)],
+                           buckets=buckets, name=name)
+
+
+def _x(n, dim=4, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, dim)).astype(np.float32)
+
+
+def _wait_for(cond, timeout=5.0, interval=0.02):
+    """Poll ``cond`` until truthy (returning its value) or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    return cond()
+
+
+def _request(url, payload=None, headers=None, timeout=10):
+    """(status, headers, json body) for GET (payload None) or POST —
+    HTTP errors return their response instead of raising."""
+    data = None if payload is None else json.dumps(payload).encode()
+    hdrs = dict(headers or {})
+    if data is not None:
+        hdrs.setdefault("Content-Type", "application/json")
+    req = urllib.request.Request(url, data=data, headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+# ------------------------------------------------- span propagation
+def test_request_span_adopts_batch_span_across_worker():
+    """The worker-thread ``serve.batch`` span nests under the
+    submitting request's ``serve.request`` span (cross-thread attach)
+    and carries the rider's id in ``links``."""
+    telemetry.start()
+    batcher = DynamicBatcher(_engine(), max_delay_ms=1, name="trace")
+    try:
+        batcher.submit([_x(2)], request_id="rid-head")
+    finally:
+        batcher.close(timeout=5)
+    spans = telemetry.tracer.find_spans("request_id", "rid-head")
+    assert len(spans) == 1
+    root = spans[0]
+    assert root["name"] == "serve.request"
+    assert root["attrs"]["model"] == "trace"
+    batch = [c for c in root.get("children", ())
+             if c["name"] == "serve.batch"]
+    assert batch, "serve.batch did not nest under serve.request"
+    assert "rid-head" in batch[0]["attrs"]["links"]
+
+
+def test_batch_span_links_every_rider():
+    """Concurrent riders coalesce; each keeps its own ``serve.request``
+    root and every id appears in some batch span's ``links``."""
+    telemetry.start()
+    batcher = DynamicBatcher(_engine(), max_delay_ms=25, name="riders")
+    rids = [f"rider-{i}" for i in range(4)]
+    try:
+        threads = [threading.Thread(
+            target=batcher.submit, args=([_x(1, seed=i)],),
+            kwargs={"request_id": rids[i]}) for i in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+    finally:
+        batcher.close(timeout=5)
+    for rid in rids:
+        found = telemetry.tracer.find_spans("request_id", rid)
+        assert found and found[0]["name"] == "serve.request"
+    linked = set()
+
+    def walk(nodes):
+        for n in nodes:
+            if n["name"] == "serve.batch":
+                linked.update(n["attrs"]["links"])
+            walk(n.get("children", ()))
+
+    tree = telemetry.tracer.tree(max_finished=256)
+    walk(tree["finished"] + tree["live"])
+    assert linked >= set(rids)
+
+
+def test_shed_request_id_stamped_on_fault_events():
+    """A request shed by its deadline (never dispatched) still leaves
+    its id on the FAULT stream."""
+    telemetry.start()
+    events = []
+
+    def on_fault(**kw):
+        events.append(kw)
+
+    telemetry.FAULT.subscribe(on_fault, passive=True)
+    fault.install_plan("serving.infer:hang:0.8@1")
+    batcher = DynamicBatcher(_engine(), max_delay_ms=1, name="shed")
+    try:
+        hung = threading.Thread(
+            target=lambda: batcher.submit([_x(1)], request_id="hang-0",
+                                          timeout=10))
+        hung.start()
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        with pytest.raises(lifecycle.DeadlineExceeded):
+            batcher.submit([_x(1, seed=1)], timeout_ms=100,
+                           request_id="shed-1")
+        hung.join()
+    finally:
+        batcher.close(timeout=5)
+        telemetry.FAULT.unsubscribe(on_fault)
+    shed = [e for e in events if e.get("request_id") == "shed-1"
+            and e.get("event") == "deadline"]
+    assert shed and shed[0]["kind"] in ("wait", "queue", "admission")
+
+
+# ------------------------------------------------- HTTP request ids
+def test_http_echoes_request_id_on_every_path():
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", _engine(), warmup=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        # 200: client-supplied id echoed
+        st, h, body = _request(url + "/v1/models/m:predict",
+                               {"inputs": [_x(1).tolist()]},
+                               headers={"x-request-id": "client-ok-1"})
+        assert st == 200 and h["X-Request-Id"] == "client-ok-1"
+        # 404 unknown model: header AND body carry the id
+        st, h, body = _request(url + "/v1/models/nope:predict",
+                               {"inputs": [_x(1).tolist()]},
+                               headers={"x-request-id": "client-404"})
+        assert st == 404 and h["X-Request-Id"] == "client-404"
+        assert body["request_id"] == "client-404"
+        # 400 malformed JSON still echoes
+        req = urllib.request.Request(
+            url + "/v1/models/m:predict", data=b"{not json",
+            headers={"Content-Type": "application/json",
+                     "x-request-id": "client-400"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert ei.value.headers["X-Request-Id"] == "client-400"
+        assert json.loads(ei.value.read())["request_id"] == "client-400"
+        # no client id: a 16-hex id is generated
+        st, h, _ = _request(url + "/healthz")
+        assert st == 200
+        assert re.fullmatch(r"[0-9a-f]{16}", h["X-Request-Id"])
+        # junk is sanitized, length capped at 64
+        st, h, _ = _request(url + "/healthz",
+                            headers={"x-request-id":
+                                     "a bad/id!" + "x" * 100})
+        assert h["X-Request-Id"] == ("abadid" + "x" * 100)[:64]
+        # 503 while draining: error body repeats the id
+        srv.begin_drain()
+        st, h, body = _request(url + "/v1/models/m:predict",
+                               {"inputs": [_x(1).tolist()]},
+                               headers={"x-request-id": "client-drain"})
+        assert st == 503 and h["X-Request-Id"] == "client-drain"
+        assert body["request_id"] == "client-drain"
+    finally:
+        srv.stop()
+
+
+def test_trace_endpoint_bounded_and_request_lookup():
+    telemetry.start()
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", _engine(), warmup=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        for i in range(6):
+            st, _, _ = _request(url + "/v1/models/m:predict",
+                                {"inputs": [_x(1, seed=i).tolist()]},
+                                headers={"x-request-id": f"t-{i}"})
+            assert st == 200
+        st, _, body = _request(url + "/trace?limit=2")
+        assert st == 200 and len(body["finished"]) <= 2
+        st, _, body = _request(url + "/trace?request_id=t-3")
+        assert st == 200 and body["request_id"] == "t-3"
+        assert body["spans"], "per-request lookup found nothing"
+        assert body["spans"][0]["name"] == "serve.request"
+        assert body["spans"][0]["attrs"]["request_id"] == "t-3"
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- flight recorder
+def test_flight_recorder_rings_faults_spans_metrics(tmp_path):
+    telemetry.start()
+    rec = telemetry_ring.FlightRecorder(size=32)
+    rec.start()
+    try:
+        telemetry.FAULT.publish(site="x", event="retry", kind="ioerror",
+                                request_id="r-1")
+        faults = [e for e in rec.entries() if e["type"] == "fault"]
+        assert faults and faults[-1]["kind"] == "ioerror"
+        assert faults[-1]["request_id"] == "r-1"
+        with telemetry.trace_span("unit.root", cat="test",
+                                  request_id="s-1"):
+            pass
+        spans = [e for e in rec.entries() if e["type"] == "span"]
+        assert spans and spans[-1]["name"] == "unit.root"
+        assert spans[-1]["attrs"]["request_id"] == "s-1"
+        telemetry.registry.counter("flight_test_total").inc(3)
+        rec.note_metrics(force=True)
+        mets = [e for e in rec.entries() if e["type"] == "metrics"]
+        assert mets
+        assert mets[-1]["delta"].get("flight_test_total") == 3.0
+        # a retry is NOT an incident trigger: no auto dump
+        assert rec.last_dump_path is None
+        # manual dump carries ring + metrics
+        out = tmp_path / "manual.json"
+        rec.dump("manual", path=str(out))
+        data = json.loads(out.read_text())
+        assert data["reason"] == "manual"
+        assert any(e.get("request_id") == "r-1" for e in data["ring"])
+        assert "metrics" in data
+    finally:
+        rec.stop()
+
+
+def test_flight_recorder_disabled_by_zero_ring():
+    rec = telemetry_ring.FlightRecorder(size=0)
+    rec.start()
+    try:
+        telemetry.FAULT.publish(site="x", event="retry")
+        assert rec.entries() == []
+    finally:
+        rec.stop()
+
+
+def test_flight_recorder_triggers_and_per_reason_debounce(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_FLIGHT_DUMP_DIR", str(tmp_path))
+    rec = telemetry_ring.FlightRecorder(size=16)
+    rec.start()
+    try:
+        telemetry.FAULT.publish(site="s", event="watchdog", kind="hung",
+                                request_ids=["h-1", "h-2"])
+        telemetry.FAULT.publish(site="s", event="breaker", kind="OPEN")
+        # same reason inside the debounce window: swallowed
+        telemetry.FAULT.publish(site="s", event="watchdog", kind="hung")
+        # a non-OPEN breaker transition is not a trigger
+        telemetry.FAULT.publish(site="s", event="breaker", kind="CLOSED")
+        dumps = _wait_for(
+            lambda: (len(list(tmp_path.glob("flight_*.json"))) >= 2
+                     and sorted(tmp_path.glob("flight_*.json"))))
+        names = [p.name for p in dumps]
+        assert sum("watchdog_restart" in n for n in names) == 1
+        assert sum("breaker_trip" in n for n in names) == 1
+        assert len(names) == 2
+        wd = next(p for p in dumps if "watchdog_restart" in p.name)
+        data = json.loads(wd.read_text())
+        hung = [e for e in data["ring"] if e["type"] == "fault"
+                and e.get("event") == "watchdog"]
+        assert hung and hung[0]["request_ids"] == ["h-1", "h-2"]
+    finally:
+        rec.stop()
+
+
+def test_watchdog_restart_dump_names_hung_request_ids(
+        tmp_path, monkeypatch):
+    """End to end: a hung worker's watchdog abort auto-dumps a flight
+    recording whose ring names the rider's request id."""
+    monkeypatch.setenv("MXNET_FLIGHT_DUMP_DIR", str(tmp_path))
+    rec = telemetry_ring.FlightRecorder(size=64)
+    rec.start()
+    fault.install_plan("serving.infer:hang:2@1")
+    batcher = DynamicBatcher(
+        _engine(name="hangdump"), max_delay_ms=1, name="hangdump",
+        breaker=CircuitBreaker("hangdump", threshold=5,
+                               cooldown_seconds=0.2))
+    try:
+        victim = batcher.submit_async([_x(1)], request_id="hung-1")
+        assert _wait_for(lambda: batcher._busy_since is not None)
+        time.sleep(0.25)
+        assert batcher.check_worker(hang_seconds=0.2) == "hung"
+        with pytest.raises(lifecycle.RequestAborted):
+            victim.result(5)
+        dumps = _wait_for(lambda: list(
+            tmp_path.glob("flight_*_watchdog_restart.json")))
+        assert dumps, "no watchdog flight dump appeared"
+        data = json.loads(dumps[0].read_text())
+        assert data["reason"] == "watchdog_restart"
+        hung = [e for e in data["ring"] if e["type"] == "fault"
+                and e.get("event") == "watchdog"]
+        assert hung and "hung-1" in hung[0]["request_ids"]
+    finally:
+        batcher.close(timeout=5)
+        rec.stop()
+
+
+# --------------------------------------------------------- SLO math
+def test_slo_availability_burn_math(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_SLO_AVAILABILITY", "0.9")
+    monkeypatch.delenv("MXNET_SERVE_SLO_P99_MS", raising=False)
+    m = slo.ModelSLO("m", window=64)
+    for _ in range(18):
+        m.record(0.01, ok=True)
+    for _ in range(2):
+        m.record(0.01, ok=False)
+    s = m.snapshot()
+    assert s["window"] == 20 and s["bad"] == 2
+    assert s["availability"] == pytest.approx(0.9)
+    # burn = (bad/total) / (1 - objective) = 0.1 / 0.1
+    assert s["burn_rate"] == pytest.approx(1.0)
+    assert s["error_budget_remaining"] == pytest.approx(0.0)
+    assert s["exhausted"] is True
+
+
+def test_slo_latency_burn_math(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_SLO_AVAILABILITY", "1.0")
+    monkeypatch.setenv("MXNET_SERVE_SLO_P99_MS", "100")
+    m = slo.ModelSLO("m", window=64)
+    for _ in range(46):
+        m.record(0.01, ok=True)
+    for _ in range(4):
+        m.record(0.5, ok=True)
+    s = m.snapshot()
+    assert s["p99_objective_seconds"] == pytest.approx(0.1)
+    assert s["p99_seconds"] == pytest.approx(0.5)
+    # 8% of requests over the objective against a 1% budget
+    assert s["burn_rate"] == pytest.approx(8.0)
+    assert s["error_budget_remaining"] == 0.0
+
+
+def test_slo_empty_window_and_min_requests_floor(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_SLO_AVAILABILITY", "0.999")
+    m = slo.ModelSLO("empty")
+    s = m.snapshot()
+    assert s["window"] == 0 and s["availability"] == 1.0
+    assert s["burn_rate"] == 0.0 and s["exhausted"] is False
+    # one failed canary: enormous burn, but below the readiness floor
+    m2 = slo.ModelSLO("canary")
+    m2.record(0.01, ok=False)
+    s2 = m2.snapshot()
+    assert s2["burn_rate"] > 1.0 and s2["exhausted"] is False
+
+
+def test_slo_exhaustion_blocks_readiness(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_SLO_AVAILABILITY", "0.999")
+    srv = ModelServer(port=0, host="127.0.0.1", max_delay_ms=1.0)
+    srv.add_model("m", _engine(), warmup=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        st, _, _ = _request(url + "/readyz")
+        assert st == 200
+        for _ in range(9):
+            slo.tracker.record("m", 0.01, ok=True)
+        for _ in range(3):
+            slo.tracker.record("m", 0.01, ok=False)
+        st, _, body = _request(url + "/readyz")
+        assert st == 503
+        assert "slo:m" in body.get("blockers", [])
+        st, _, sbody = _request(url + "/slo")
+        assert st == 200
+        assert sbody["models"]["m"]["exhausted"] is True
+        assert sbody["models"]["m"]["burn_rate"] > 1.0
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "mxtpu_slo_error_budget_remaining" in prom
+        assert "mxtpu_slo_burn_rate" in prom
+    finally:
+        srv.stop()

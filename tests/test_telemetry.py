@@ -120,14 +120,14 @@ def test_histogram_empty():
     h = telemetry.Histogram("h_empty")
     assert h.percentile(0.5) is None
     assert h.stats() == {"count": 0, "sum": 0.0, "p50": None, "p95": None,
-                         "max": None}
+                         "p99": None, "max": None}
 
 
 def test_histogram_single_sample():
     h = telemetry.Histogram("h_one")
     h.observe(3.5)
     assert h.stats() == {"count": 1, "sum": 3.5, "p50": 3.5, "p95": 3.5,
-                         "max": 3.5}
+                         "p99": 3.5, "max": 3.5}
     assert h.percentile(0.0) == h.percentile(1.0) == 3.5
 
 
@@ -144,6 +144,22 @@ def test_histogram_reservoir_overflow():
     assert h.percentile(0.0) == 92.0
     assert h.percentile(1.0) == 99.0
     assert 92.0 <= s["p50"] <= 99.0
+
+
+def test_histogram_p99_known_distribution():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("h_p99_seconds")
+    for v in range(1000):                   # 0..999 fits the 4096 reservoir
+        h.observe(float(v))
+    s = h.stats()
+    assert s["count"] == 1000 and s["max"] == 999.0
+    # nearest-rank on the sorted reservoir: index = round(q * (n - 1))
+    assert s["p50"] == 500.0
+    assert s["p95"] == 949.0
+    assert s["p99"] == 989.0
+    prom = reg.render_prometheus()
+    assert 'h_p99_seconds{quantile="0.99"} 989.0' in prom
+    assert 'h_p99_seconds{quantile="0.5"} 500.0' in prom
 
 
 # ------------------------------------------------------------ span tracer
