@@ -41,6 +41,15 @@ usage: ci/run_tests.sh <function>
                         names the hung requests' ids, /slo reports the
                         budget burn, and mxtpu_slo_* series are on
                         /metrics
+  generate_smoke        continuous-batching drill: staggered streaming
+                        clients against a GenerationEngine model; asserts
+                        the late request emits tokens BEFORE the first
+                        finishes (mid-flight join), streamed outputs are
+                        token-identical to solo decode, X-Request-Id
+                        rides the SSE headers, a serving.infer:hang
+                        during decode fails the rider (id on the error
+                        event) and recovers via the watchdog, and
+                        mxtpu_generate_* series are on /metrics
   lifecycle_smoke       lifecycle drill (three parts): SIGTERM a serving
                         child under 16 concurrent clients — zero reset
                         connections, /readyz flips 503 before the port
@@ -447,6 +456,137 @@ print(f"obs_smoke ok: {len(ok)}/{len(results)} ok, {len(failed)} failed "
       f"with ids echoed, {len(hung)} hung ids in "
       f"{os.path.basename(dumps[0])}, burn_rate={m['burn_rate']:.2f}, "
       f"budget={m['error_budget_remaining']:.2f}")
+EOF
+}
+
+generate_smoke() {
+    MXNET_SERVE_HANG_SECONDS=0.5 \
+    MXNET_SERVE_BREAKER_COOLDOWN_SECONDS=0.3 \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import GenerationEngine, ModelServer
+
+telemetry.start()
+mx.random.seed(3)
+net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+               num_heads=2, max_length=256, dropout=0.0)
+net.initialize(init=mx.init.Normal(0.6))
+net(mx.nd.array(np.zeros((1, 2), np.int32)))
+
+engine = GenerationEngine(net, name="gen", max_slots=4, max_len=256)
+LONG, LATE = [9, 9, 4, 1], [3, 7, 11]
+solo_long = engine.generate(LONG, max_new_tokens=200)
+solo_late = engine.generate(LATE, max_new_tokens=5)
+engine.reset()
+
+srv = ModelServer(port=0)
+srv.add_model("gen", engine, warmup=True)
+srv.start()
+url = f"http://127.0.0.1:{srv.port}"
+
+def stream(prompt, n, rid):
+    """POST :generate with stream=true; returns (tokens-with-times,
+    final events, echoed X-Request-Id header)."""
+    req = urllib.request.Request(
+        url + "/v1/models/gen:generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": n,
+                         "stream": True}).encode(),
+        headers={"x-request-id": rid})
+    r = urllib.request.urlopen(req, timeout=60)
+    toks, finals = [], []
+    for line in r:
+        line = line.strip()
+        if line.startswith(b"data:"):
+            d = json.loads(line.split(b":", 1)[1])
+            if "token" in d:
+                toks.append((d["token"], time.monotonic()))
+            else:
+                finals.append(d)
+    return toks, finals, r.headers.get("X-Request-Id")
+
+# -- 1. staggered streaming clients: the late request must emit tokens
+#       while the first is STILL decoding (continuous admission) ------
+results = {}
+def run(key, prompt, n, rid):
+    results[key] = stream(prompt, n, rid)
+
+t1 = threading.Thread(target=run, args=("long", LONG, 200, "gen-long"))
+t1.start()
+time.sleep(0.08)
+t2 = threading.Thread(target=run, args=("late", LATE, 5, "gen-late"))
+t2.start()
+t1.join(); t2.join()
+
+toks_long, _, rid_long = results["long"]
+toks_late, finals_late, rid_late = results["late"]
+assert rid_long == "gen-long" and rid_late == "gen-late", \
+    f"generate_smoke: streamed X-Request-Id not echoed: " \
+    f"{rid_long!r}/{rid_late!r}"
+assert [t for t, _ in toks_long] == solo_long, \
+    "generate_smoke: interleaved long output != solo"
+assert [t for t, _ in toks_late] == solo_late, \
+    "generate_smoke: interleaved late output != solo"
+assert finals_late and finals_late[-1]["request_id"] == "gen-late"
+lead = toks_long[-1][1] - toks_late[0][1]
+assert lead > 0, \
+    "generate_smoke: late request emitted nothing before the first " \
+    "request finished — no mid-flight join"
+
+# -- 2. watchdog drill: hang the 5th decode dispatch mid-stream; the
+#       rider must fail with its id on the stream, then the model
+#       must recover after the restart + breaker cooldown -------------
+fault.install_plan("serving.infer:hang:30@5")
+toks_h, finals_h, rid_h = stream(LONG, 100, "gen-hang")
+assert rid_h == "gen-hang"
+assert 0 < len(toks_h) < 100, \
+    f"generate_smoke: hang drill emitted {len(toks_h)} tokens"
+assert finals_h and "error" in finals_h[-1], \
+    f"generate_smoke: no terminal error event: {finals_h}"
+assert finals_h[-1]["request_id"] == "gen-hang"
+fault.clear_plan()
+
+recovered = None
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline and recovered is None:
+    time.sleep(0.2)
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            url + "/v1/models/gen:generate",
+            data=json.dumps({"tokens": LATE,
+                             "max_new_tokens": 5}).encode()), timeout=30)
+        recovered = json.loads(r.read())["tokens"]
+    except urllib.error.HTTPError as e:
+        e.read()                # 503 while the breaker cools down
+assert recovered == solo_late, \
+    f"generate_smoke: post-restart output {recovered} != solo"
+
+# -- 3. generation series on /metrics ---------------------------------
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+for series in ("mxtpu_generate_tokens", "mxtpu_serve_cache_slots_in_use",
+               "mxtpu_generate_token_seconds",
+               "mxtpu_generate_decode_step_seconds"):
+    assert series in prom, f"generate_smoke: {series} missing from /metrics"
+
+stats = json.load(urllib.request.urlopen(url + "/v1/models",
+                                         timeout=10))["models"]["gen"]
+assert stats["kind"] == "generation" and stats["watchdog_restarts"] == 1, stats
+srv.stop()
+telemetry.stop()
+print(f"generate_smoke ok: late first-token led long last-token by "
+      f"{lead:.3f}s, hang drill failed rider 'gen-hang' after "
+      f"{len(toks_h)} tokens and recovered, "
+      f"{stats['tokens_emitted']} tokens in {stats['decode_steps']} "
+      f"decode steps")
 EOF
 }
 

@@ -124,15 +124,57 @@ def stats_main():
     sys.exit(status)
 
 
+def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None):
+    """Build a :class:`serving.GenerationEngine` from a ``--gen-model``
+    JSON config: architecture kwargs for ``models.gpt.GPTModel`` plus a
+    ``"params"`` weights file (``Block.save_parameters`` format,
+    resolved relative to the config) and optional ``"max_slots"`` /
+    ``"max_len"`` engine knobs.  Omitting ``"params"`` serves random
+    weights — useful for smoke tests and load drills."""
+    import json
+
+    import numpy as np
+
+    from . import initializer as init
+    from . import ndarray as nd
+    from .models.gpt import GPTModel
+    from .serving import GenerationEngine
+
+    with open(cfg_path) as f:
+        cfg = dict(json.load(f))
+    if "vocab_size" not in cfg:
+        raise SystemExit(
+            f"mxtpu-serve: {cfg_path}: generation config needs at "
+            'least {"vocab_size": N}')
+    params = cfg.pop("params", None)
+    cfg_slots = cfg.pop("max_slots", None)
+    cfg_len = cfg.pop("max_len", None)
+    max_slots = cfg_slots if max_slots is None else max_slots
+    max_len = cfg_len if max_len is None else max_len
+    cfg.setdefault("dropout", 0.0)      # serving never trains
+    net = GPTModel(**cfg)
+    net.initialize(init.Normal(0.02))
+    net(nd.array(np.zeros((1, 2), np.int32)))   # settle deferred shapes
+    if params is not None:
+        if not os.path.isabs(params):
+            params = os.path.join(os.path.dirname(
+                os.path.abspath(cfg_path)), params)
+        net.load_parameters(params)
+    return GenerationEngine(net, name=name, max_slots=max_slots,
+                            max_len=max_len)
+
+
 def serve_main():
     """``mxtpu-serve`` — dynamic-batching inference server over exported
     model artifacts (see docs/serving.md)::
 
         mxtpu-serve --model mnist=/models/mnist:7 \\
                     --model small=/models/small \\
+                    [--gen-model gpt=/models/gpt.json] \\
                     [--port N] [--max-batch N] [--max-delay-ms F]
                     [--queue N] [--input-names data]
                     [--input-specs 784] [--warmup]
+                    [--gen-slots N] [--gen-max-len N]
 
     Each ``--model`` is ``NAME=PREFIX[:EPOCH]`` naming a
     ``HybridBlock.export`` / ``model.save_checkpoint`` pair
@@ -142,7 +184,18 @@ def serve_main():
     ``/readyz`` flips to 503, in-flight requests finish (within
     ``MXNET_DRAIN_SECONDS``), and the port closes cleanly — no reset
     connections.  Knobs default from ``MXNET_SERVE_*``
-    (docs/env_var.md)."""
+    (docs/env_var.md).
+
+    Each ``--gen-model`` is ``NAME=CONFIG.json`` describing a GPT-style
+    generation model: the JSON carries the architecture kwargs
+    (``vocab_size``, ``units``, ``num_layers``, ...) plus ``"params"``
+    — a ``Block.save_parameters`` weights file, resolved relative to
+    the config — and optional ``"max_slots"``/``"max_len"`` engine
+    knobs.  Generation models serve token streams at
+    ``/v1/models/<NAME>:generate`` behind continuous batching
+    (docs/serving.md); ``--gen-slots`` / ``--gen-max-len`` override the
+    config and the ``MXNET_GEN_MAX_SLOTS`` / ``MXNET_GEN_MAX_LEN``
+    env defaults."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -176,10 +229,23 @@ def serve_main():
                          "required for --warmup")
     ap.add_argument("--warmup", action="store_true",
                     help="AOT-compile every bucket before serving "
-                         "(needs --input-specs)")
+                         "(needs --input-specs; generation models warm "
+                         "their prefill buckets and decode program)")
+    ap.add_argument("--gen-model", action="append", default=[],
+                    metavar="NAME=CONFIG.json",
+                    help="register a generation model from a JSON "
+                         "config (architecture kwargs + 'params' "
+                         "weights path); repeatable")
+    ap.add_argument("--gen-slots", type=int, default=None,
+                    help="KV-cache slots per generation model (default "
+                         "config or MXNET_GEN_MAX_SLOTS or 8)")
+    ap.add_argument("--gen-max-len", type=int, default=None,
+                    help="KV-cache sequence capacity (default config or "
+                         "MXNET_GEN_MAX_LEN or the model's max_length)")
     ns = ap.parse_args()
-    if not ns.model:
-        ap.error("at least one --model NAME=PREFIX[:EPOCH] is required")
+    if not ns.model and not ns.gen_model:
+        ap.error("at least one --model NAME=PREFIX[:EPOCH] or "
+                 "--gen-model NAME=CONFIG.json is required")
     input_specs = None
     if ns.input_specs is not None:
         input_specs = [tuple(int(d) for d in part.split(",") if d)
@@ -217,6 +283,19 @@ def serve_main():
         sys.stderr.write(f"mxtpu-serve: loaded {name} from {prefix} "
                          f"(epoch {int(epoch)}, buckets "
                          f"{list(engine.buckets)})\n")
+    for spec in ns.gen_model:
+        name, _, cfg_path = spec.partition("=")
+        if not name or not cfg_path:
+            ap.error(f"--gen-model wants NAME=CONFIG.json, got {spec!r}")
+        engine = _load_generation_engine(
+            name, cfg_path, max_slots=ns.gen_slots,
+            max_len=ns.gen_max_len)
+        srv.add_model(name, engine, warmup=ns.warmup)
+        sys.stderr.write(
+            f"mxtpu-serve: loaded generation model {name} from "
+            f"{cfg_path} (slots {engine.max_slots}, max_len "
+            f"{engine.max_len}, prefill buckets "
+            f"{list(engine.prefill_buckets)})\n")
     srv.start()
     sys.stderr.write(f"mxtpu-serve: listening on "
                      f"http://{ns.host}:{srv.port} "

@@ -52,6 +52,9 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
     """Response/request helpers shared by every embedded HTTP server."""
 
     server_version = "mxtpu-http/1.0"
+    # chunked streaming (start_stream) requires HTTP/1.1 framing; every
+    # non-streamed response carries Content-Length, so keep-alive is safe
+    protocol_version = "HTTP/1.1"
 
     def request_id(self) -> str:
         """This request's id: the client's ``x-request-id`` header
@@ -100,6 +103,45 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
             return json.loads(raw.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
             raise ValueError(f"request body is not valid JSON: {e}")
+
+    # -- streaming (SSE over chunked transfer) --------------------------
+    def start_stream(self, code: int = 200,
+                     ctype: str = "text/event-stream",
+                     headers: Optional[dict] = None) -> None:
+        """Open a chunked streaming response (no ``Content-Length``).
+        The ``X-Request-Id`` header rides the stream headers like any
+        other response, so streamed requests stay correlatable with
+        server-side spans/FAULT events.  Follow with
+        :meth:`send_event` calls and finish with :meth:`end_stream`."""
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Request-Id", self.request_id())
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def send_event(self, obj, event: Optional[str] = None) -> None:
+        """One SSE event carrying a JSON payload.  Raises
+        ``BrokenPipeError``/``ConnectionError`` when the client has gone
+        away — callers treat that as a cancel signal."""
+        prefix = f"event: {event}\n" if event else ""
+        self._write_chunk(
+            (prefix + "data: " + json.dumps(obj, default=str)
+             + "\n\n").encode("utf-8"))
+
+    def end_stream(self) -> None:
+        """Terminate the chunked response (zero-length chunk)."""
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        finally:
+            self._mxtpu_request_id = None
 
     def guard(self, fn) -> None:
         """Run a route handler; an exporter/server bug must not

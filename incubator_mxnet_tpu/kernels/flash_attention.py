@@ -40,7 +40,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "flash_attention_lse"]
+__all__ = ["flash_attention", "flash_attention_lse", "decode_attention"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -524,3 +524,133 @@ def flash_attention(q, k, v, scale=None, causal=False, mask=None):
     keep >= 1 valid key.  Falls back to the XLA implementation when shapes
     don't fit the kernel contract (T not divisible by the block size)."""
     return _dispatch(q, k, v, scale, causal, mask, with_lse=False)
+
+
+# ---------------------------------------------------------------------------
+# decode-shaped attention: one query position per slot over a
+# preallocated KV cache (the GenerationEngine's per-step attention).
+# ---------------------------------------------------------------------------
+
+def _xla_decode_attention(q, k, v, positions, scale):
+    """(S, H, D) single-position attention over (S, H, T, D) caches.
+    Per-slot ``positions`` mask out cache entries beyond each slot's
+    write head (entries > position are stale/garbage by contract)."""
+    T = k.shape[2]
+    s = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    live = jnp.arange(T, dtype=jnp.int32)[None, None, :] \
+        <= positions[:, None, None]
+    s = jnp.where(live, s, -1e30)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("sht,shtd->shd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, block_k, n_kb):
+    """Grid (S, H, n_kb): one query row (1, D) against K/V blocks
+    (block_k, D) of its slot+head, online softmax across the kb axis.
+    Scratch persists along the innermost (kb) grid dim."""
+    from jax.experimental import pallas as pl
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[0]
+    D = q_ref.shape[-1]
+    q = q_ref[...].reshape(1, D).astype(jnp.float32)
+    k = k_ref[...].reshape(block_k, D).astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # (1, block_k)
+    idx = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    s = jnp.where(idx <= pos, s, -1e30)
+    m_prev, l_prev = m_ref[:], l_ref[:]               # (1, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                            # (1, block_k)
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    v_blk = v_ref[...].reshape(block_k, D).astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (1, D)
+
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        o_ref[...] = (acc_ref[:] / l_ref[:]).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, positions, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, H, T, D = k.shape
+    block_k = min(_BLOCK_K, T)
+    n_kb = T // block_k
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               block_k=block_k, n_kb=n_kb)
+    return pl.pallas_call(
+        kernel,
+        grid=(S, H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1,), lambda s, h, kb: (s,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, D), lambda s, h, kb: (s, h, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, kb: (s, h, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, kb: (s, h, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda s, h, kb: (s, h, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(positions.astype(jnp.int32), q, k, v)
+
+
+def decode_attention(q, k, v, positions, scale=None):
+    """Per-slot single-position attention over a preallocated KV cache.
+
+    ``q`` (S, H, D): this step's query, one position per slot; ``k``/``v``
+    (S, H, T, D): the cache, already holding this position's K/V at index
+    ``positions[s]``; ``positions`` (S,) int32: each slot's current write
+    head.  Attends over cache entries ``<= positions[s]`` (later entries
+    are stale garbage by the continuous-batching contract) and returns
+    (S, H, D).
+
+    Dispatch mirrors :func:`flash_attention`: a Pallas online-softmax
+    kernel when T is tile-aligned and K+V fit the VMEM budget, otherwise
+    the lax fallback.  On CPU the lax path is the default — decode runs
+    once per generated token, and interpret-mode emulation is a parity
+    tool, not a serving path (``MXNET_FA_DECODE_FORCE_PALLAS=1`` forces
+    the interpreted kernel for tests)."""
+    from ..base import getenv_bool
+    S, H, T, D = k.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    force = getenv_bool("MXNET_FA_DECODE_FORCE_PALLAS")
+    kv_bytes = 2 * T * D * q.dtype.itemsize
+    aligned = T % _BLOCK_K == 0 and kv_bytes <= 8 * 2 ** 20
+    if force and aligned:
+        return _decode_pallas(q, k, v, positions, scale,
+                              interpret=platform == "cpu")
+    if platform == "cpu" or not aligned:
+        return _xla_decode_attention(q, k, v, positions, scale)
+    return _decode_pallas(q, k, v, positions, scale, interpret=False)

@@ -21,6 +21,12 @@ Four layers, importable à la carte:
   ``/readyz``, ``/metrics``) sharing plumbing with the telemetry
   exporter.  CLI: ``mxtpu-serve``.
 
+Generation serving rides the same layers: :class:`GenerationEngine`
+(preallocated KV cache, prefill/decode split) behind a
+:class:`ContinuousBatcher` (per-slot join/leave, one decode dispatch
+per step over all live requests) behind
+``POST /v1/models/<name>:generate`` with SSE streaming.
+
 Importing this package registers the ``mxtpu_serve_*`` metrics on the
 shared telemetry registry, so they appear on every exporter
 automatically.
@@ -29,14 +35,18 @@ from . import metrics
 from . import lifecycle
 from .lifecycle import (
     CircuitBreaker, Watchdog, DeadlineExceeded, BreakerOpen, Draining,
-    RequestAborted, SERVING, STARTING, DEGRADED, UNHEALTHY, DRAINING,
+    RequestAborted, Cancelled, SERVING, STARTING, DEGRADED, UNHEALTHY,
+    DRAINING,
 )
-from .engine import InferenceEngine, derive_buckets
-from .batcher import DynamicBatcher, QueueFullError
+from .engine import InferenceEngine, GenerationEngine, derive_buckets, \
+    derive_prefill_buckets
+from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .server import ModelServer
 
-__all__ = ["InferenceEngine", "derive_buckets", "DynamicBatcher",
-           "QueueFullError", "ModelServer", "metrics", "lifecycle",
+__all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
+           "derive_prefill_buckets", "DynamicBatcher",
+           "ContinuousBatcher", "QueueFullError", "ModelServer",
+           "metrics", "lifecycle",
            "CircuitBreaker", "Watchdog", "DeadlineExceeded",
-           "BreakerOpen", "Draining", "RequestAborted",
+           "BreakerOpen", "Draining", "RequestAborted", "Cancelled",
            "SERVING", "STARTING", "DEGRADED", "UNHEALTHY", "DRAINING"]

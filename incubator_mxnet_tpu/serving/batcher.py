@@ -78,7 +78,7 @@ from . import lifecycle as _lc
 from . import metrics as _m
 from . import slo as _slo
 
-__all__ = ["DynamicBatcher", "QueueFullError"]
+__all__ = ["DynamicBatcher", "ContinuousBatcher", "QueueFullError"]
 
 
 class QueueFullError(MXNetError):
@@ -105,6 +105,17 @@ class _Request:
         self.model = model
         self.request_id = request_id or _telemetry.new_request_id()
         self.trace_ctx = trace_ctx      # submitter's span, for the worker
+
+    def fail(self, err: Exception) -> None:
+        """Finish this request with ``err`` (idempotent).  The ONE
+        protocol the batcher/watchdog/drain paths use to fail a request
+        — subclasses with richer consumer channels (the generation
+        request's token queue) override it so every waiter wakes, not
+        just ``result()``."""
+        if self.event.is_set():
+            return
+        self.error = err
+        self.event.set()
 
     def result(self, timeout: Optional[float] = None) -> List:
         """Block for the scattered outputs; re-raises dispatch errors.
@@ -319,10 +330,9 @@ class DynamicBatcher:
         _telemetry.FAULT.publish(site="serving.deadline", event="deadline",
                                  kind="queue", model=self.name,
                                  request_id=req.request_id)
-        req.error = _lc.DeadlineExceeded(
+        req.fail(_lc.DeadlineExceeded(
             f"{self.name}: request {req.request_id} expired in queue "
-            f"after {time.monotonic() - req.t_submit:.3f}s")
-        req.event.set()
+            f"after {time.monotonic() - req.t_submit:.3f}s"))
 
     def _gather(self, gen: int):
         """Block for the head request, then coalesce until the batch is
@@ -490,12 +500,10 @@ class DynamicBatcher:
             self._thread = self._start_worker()
             self._cv.notify_all()
         for r in failed:
-            if not r.event.is_set():
-                r.error = _lc.RequestAborted(
-                    f"{self.name}: batcher worker {reason}; request "
-                    f"{r.request_id} failed by the watchdog — retry on "
-                    "another replica")
-                r.event.set()
+            r.fail(_lc.RequestAborted(
+                f"{self.name}: batcher worker {reason}; request "
+                f"{r.request_id} failed by the watchdog — retry on "
+                "another replica"))
         # the watchdog event goes out BEFORE the breaker trip: the
         # flight recorder dumps on both, and the restart (with its rider
         # request ids) is the primary artifact of this incident
@@ -563,9 +571,7 @@ class DynamicBatcher:
                 self._queue.clear()
             self._cv.notify_all()
         for r in dropped:
-            if not r.event.is_set():
-                r.error = MXNetError(f"batcher {self.name!r} closed")
-                r.event.set()
+            r.fail(MXNetError(f"batcher {self.name!r} closed"))
         self._thread.join(timeout=timeout)
         if self._thread.is_alive():
             # drain budget blown: the worker is wedged in a dispatch.
@@ -579,11 +585,9 @@ class DynamicBatcher:
                 self._inflight = None
                 self._busy_since = None
             for r in stranded:
-                if not r.event.is_set():
-                    r.error = _lc.RequestAborted(
-                        f"batcher {self.name!r}: drain timed out after "
-                        f"{timeout}s; request {r.request_id} abandoned")
-                    r.event.set()
+                r.fail(_lc.RequestAborted(
+                    f"batcher {self.name!r}: drain timed out after "
+                    f"{timeout}s; request {r.request_id} abandoned"))
         with self._cv:
             _m.QUEUE_DEPTH.set(0, model=self.name)
 
@@ -606,3 +610,543 @@ class DynamicBatcher:
                 "watchdog_restarts": restarts,
                 "buckets": list(self.engine.buckets),
                 "compiled_programs": self.engine.compiled_programs()}
+
+
+# ===========================================================================
+# ContinuousBatcher — per-slot join/leave generation serving
+# ===========================================================================
+
+class _GenRequest:
+    """One generation request: a prompt, a token budget, and a stream of
+    emitted tokens.  Unlike :class:`_Request` (one dispatch, one latch),
+    a generation request spans MANY dispatches: tokens arrive one per
+    decode step on ``_q`` and accumulate in ``tokens_out``; ``event``
+    fires once, at finish (done / error / cancel)."""
+
+    __slots__ = ("tokens", "n", "budget", "eos_id", "event", "error",
+                 "tokens_out", "t_submit", "t_first", "t_emit",
+                 "deadline", "model", "request_id", "trace_ctx",
+                 "slot", "_q", "_cancelled")
+
+    def __init__(self, tokens, budget, eos_id=None, deadline=None,
+                 model="?", request_id=None, trace_ctx=None):
+        import queue as _pyqueue
+        self.tokens = tokens            # prompt, np int32 1-D
+        self.n = int(tokens.shape[0])
+        self.budget = int(budget)       # max tokens to emit
+        self.eos_id = eos_id
+        self.event = threading.Event()
+        self.error = None
+        self.tokens_out: List[int] = []
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_emit = self.t_submit     # last emission (token latency)
+        self.deadline = deadline
+        self.model = model
+        self.request_id = request_id or _telemetry.new_request_id()
+        self.trace_ctx = trace_ctx
+        self.slot: Optional[int] = None
+        self._q = _pyqueue.Queue()
+        self._cancelled = False
+
+    # -- producer side (worker thread) ----------------------------------
+    def _emit(self, tok: int) -> float:
+        now = time.monotonic()
+        if self.t_first is None:
+            self.t_first = now
+        gap = now - self.t_emit
+        _m.TOKEN_LATENCY.observe(gap)
+        self.t_emit = now
+        self.tokens_out.append(int(tok))
+        self._q.put(("tok", int(tok)))
+        return gap
+
+    def _finish(self, error=None) -> None:
+        if self.event.is_set():
+            return
+        self.error = error
+        self.event.set()
+        self._q.put(("end", error))
+
+    def fail(self, err: Exception) -> None:
+        self._finish(err)
+
+    # -- consumer side --------------------------------------------------
+    def cancel(self) -> None:
+        """Ask the worker to free this request's slot at the next decode
+        step boundary.  Safe from any thread; idempotent."""
+        self._cancelled = True
+
+    @property
+    def done(self) -> bool:
+        return self.event.is_set()
+
+    def _bounded_wait(self, timeout):
+        wait = timeout
+        if self.deadline is not None:
+            # small grace so the worker's own boundary check (which
+            # frees the slot and stamps stage="decode") wins the race
+            remaining = max(0.0, self.deadline - time.monotonic()) + 0.25
+            wait = remaining if timeout is None else min(timeout,
+                                                         remaining)
+        return wait
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until generation finishes; returns ALL emitted tokens.
+        Re-raises worker-side errors (deadline, abort, dispatch
+        failure); a bare ``timeout`` raises ``TimeoutError``."""
+        if not self.event.wait(self._bounded_wait(timeout)):
+            if self.deadline is not None \
+                    and time.monotonic() >= self.deadline:
+                raise _lc.DeadlineExceeded(
+                    f"{self.model}: generation request {self.request_id} "
+                    "deadline exceeded")
+            raise TimeoutError("generation request timed out")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens_out)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as the worker emits them.  Closing the generator
+        before the end (client disconnect) cancels the request — the
+        slot frees on the next step boundary.  Worker-side errors
+        re-raise here; ``lifecycle.Cancelled`` is swallowed (the
+        consumer asked for it)."""
+        import queue as _pyqueue
+        try:
+            while True:
+                try:
+                    kind, val = self._q.get(
+                        timeout=self._bounded_wait(timeout))
+                except _pyqueue.Empty:
+                    if self.deadline is not None \
+                            and time.monotonic() >= self.deadline:
+                        raise _lc.DeadlineExceeded(
+                            f"{self.model}: generation request "
+                            f"{self.request_id} deadline exceeded")
+                    raise TimeoutError("generation stream timed out")
+                if kind == "tok":
+                    yield val
+                    continue
+                if val is not None and not isinstance(val, _lc.Cancelled):
+                    raise val
+                return
+        finally:
+            if not self.event.is_set():
+                self.cancel()
+
+
+class ContinuousBatcher(DynamicBatcher):
+    """Continuous-batching front-end over one
+    :class:`serving.engine.GenerationEngine`.
+
+    The parent's core invariant — gather a FIFO group, dispatch ONCE,
+    scatter — cannot serve autoregressive decode: requests finish at
+    different times and new ones must not wait for the batch to drain.
+    This subclass replaces the worker loop with per-slot join/leave over
+    the engine's preallocated KV cache:
+
+    * each iteration is one STEP BOUNDARY: free every slot whose request
+      finished, was cancelled, or crossed its deadline
+      (``mxtpu_serve_deadline_exceeded{stage="decode"}``); admit queued
+      requests into the freed slots (one ``prefill`` dispatch each,
+      emitting the first token); then advance ALL live slots one token
+      with a single ``decode`` dispatch;
+    * tokens stream back per-request as they are produced
+      (:meth:`_GenRequest.stream`), so a late-arriving request emits its
+      first token while earlier requests are still decoding — the
+      continuous-admission property ``generate_smoke`` asserts;
+    * everything the one-shot path had keeps working: backpressure,
+      breaker, ``serving.queue``/``serving.infer`` fault sites (a
+      ``hang`` during decode drills the watchdog; the restarted worker
+      RESETS the cache — donated buffers a dying dispatch consumed are
+      not trusted), request ids on every event, SLO accounting per
+      finished generation, and ``serve.batch`` spans per decode step
+      with ``slot.join``/``slot.leave`` child events so ``/trace``
+      shows a request's whole decode lifetime.
+    """
+
+    def __init__(self, engine, **kw):
+        kw.setdefault("max_batch_size", engine.max_slots)
+        self._slots: List[Optional[_GenRequest]] = \
+            [None] * int(engine.max_slots)
+        self._step = 0
+        self._tokens_emitted = 0
+        super().__init__(engine, **kw)
+
+    # admission control: the parent's rows//max_batch estimate is
+    # meaningless for multi-dispatch requests — deadlines are enforced
+    # at queue-shed and at every decode boundary instead
+    def _estimate_wait_locked(self) -> float:
+        return 0.0
+
+    # -- submit ---------------------------------------------------------
+    def submit_async(self, tokens, max_new_tokens: int = 32,
+                     timeout_ms: Optional[float] = None,
+                     request_id: Optional[str] = None,
+                     eos_id: Optional[int] = None) -> _GenRequest:
+        """Enqueue one generation request; returns a handle whose
+        ``stream()`` yields tokens as they are produced and whose
+        ``result()`` blocks for the full list.  Raises
+        :class:`QueueFullError` under backpressure, ``BreakerOpen``
+        while the breaker is OPEN, ``ValueError`` for an unservable
+        prompt/budget."""
+        import numpy as _np
+        if request_id is None:
+            request_id = _telemetry.new_request_id()
+        _fault.inject("serving.queue", model=self.name,
+                      request_id=request_id)
+        self.breaker.allow()
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        n = int(toks.shape[0])
+        max_len = int(self.engine.max_len)
+        if n < 1:
+            raise ValueError(f"{self.name}: empty prompt")
+        if n > max_len - 1:
+            raise ValueError(
+                f"{self.name}: prompt length {n} leaves no room to "
+                f"generate (max_len {max_len})")
+        budget = min(int(max_new_tokens), max_len - n)
+        if budget < 1:
+            raise ValueError(
+                f"{self.name}: max_new_tokens must be >= 1")
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        req = _GenRequest(toks, budget, eos_id=eos_id,
+                          deadline=_lc.deadline_from_ms(timeout_ms),
+                          model=self.name, request_id=request_id,
+                          trace_ctx=_telemetry.tracer.current())
+        with self._cv:
+            if self._closed:
+                raise MXNetError(f"batcher {self.name!r} is closed")
+            if len(self._queue) >= self.queue_size:
+                _m.REJECTED.inc(model=self.name)
+                raise QueueFullError(
+                    f"{self.name}: queue full ({self.queue_size} "
+                    "pending) — backpressure")
+            self._queue.append(req)
+            _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
+            self._cv.notify_all()
+        _m.REQUESTS.inc(model=self.name)
+        return req
+
+    def submit(self, tokens, max_new_tokens: int = 32,
+               timeout: Optional[float] = None,
+               timeout_ms: Optional[float] = None,
+               request_id: Optional[str] = None,
+               eos_id: Optional[int] = None) -> List[int]:
+        """Synchronous generation: enqueue, wait, return all emitted
+        tokens.  (SLO accounting happens worker-side at finish, for the
+        streaming and sync paths alike; admission failures are recorded
+        here.)"""
+        if request_id is None:
+            request_id = _telemetry.new_request_id()
+        with _telemetry.trace_span("serve.request", cat="serving",
+                                   model=self.name,
+                                   request_id=request_id):
+            try:
+                req = self.submit_async(
+                    tokens, max_new_tokens, timeout_ms=timeout_ms,
+                    request_id=request_id, eos_id=eos_id)
+            except Exception:
+                _slo.tracker.record(self.name, 0.0, ok=False)
+                raise
+            return req.result(timeout)
+
+    # -- worker: the continuous loop ------------------------------------
+    def _worker(self, gen: int):
+        # a replaced worker's slots (and the donated cache a dying
+        # dispatch may have consumed) are not trusted: start clean
+        with self._cv:
+            stale = [r for r in self._slots if r is not None]
+            self._slots = [None] * int(self.engine.max_slots)
+        if stale or gen > 0:
+            self.engine.reset()
+        for r in stale:     # watchdog already failed inflight riders
+            r._finish(_lc.RequestAborted(
+                f"{self.name}: worker replaced; request {r.request_id} "
+                "aborted"))
+        while True:
+            leavers, joins, live = self._boundary(gen)
+            if leavers is None:
+                return
+            if not (leavers or joins or live):
+                continue    # woke empty; next wait happens in _boundary
+            self._run_step(gen, leavers, joins)
+            with self._cv:
+                if gen == self._worker_gen:
+                    self._busy_since = None
+                    self._inflight = None
+
+    def _boundary(self, gen: int):
+        """One step boundary, under ``_cv``: collect slots to free
+        (finished requests were freed eagerly in ``_run_step``; here we
+        catch cancels and deadline expiries), admit queued requests into
+        free slots, and decide whether there is work.  Returns
+        ``(leavers, joins, live)`` — or ``(None, None, None)`` when this
+        worker generation is done (closed+drained or replaced)."""
+        with self._cv:
+            while True:
+                if gen != self._worker_gen:
+                    return None, None, None
+                now = time.monotonic()
+                self._heartbeat = now
+                leavers = []
+                for s, r in enumerate(self._slots):
+                    if r is None:
+                        continue
+                    if r._cancelled:
+                        leavers.append((s, r, "cancelled"))
+                        self._slots[s] = None
+                    elif r.deadline is not None and r.deadline <= now:
+                        leavers.append((s, r, "deadline"))
+                        self._slots[s] = None
+                while self._queue \
+                        and self._queue[0].deadline is not None \
+                        and self._queue[0].deadline <= now:
+                    self._expire_locked(self._queue.popleft())
+                joins = []
+                free = [s for s, r in enumerate(self._slots)
+                        if r is None]
+                while self._queue and free:
+                    req = self._queue.popleft()
+                    slot = free.pop(0)
+                    req.slot = slot
+                    self._slots[slot] = req
+                    joins.append((slot, req))
+                live = [(s, r) for s, r in enumerate(self._slots)
+                        if r is not None]
+                _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
+                _m.SLOTS_IN_USE.set(len(live), model=self.name)
+                if leavers or joins or live:
+                    self._busy_since = now
+                    self._inflight = [r for _, r in live]
+                    return leavers, joins, live
+                if self._closed and not self._queue:
+                    return None, None, None
+                self._cv.wait(0.05)
+
+    def _run_step(self, gen: int, leavers, joins):
+        """One continuous-batching step OUTSIDE the lock: emit
+        ``slot.leave`` events for boundary leavers, prefill the joins
+        (first token each), then ONE decode dispatch advancing every
+        live slot.  The ``serve.batch`` span wraps the whole step; its
+        ``links`` carry every live request id."""
+        self._step += 1
+        with self._cv:
+            live = [(s, r) for s, r in enumerate(self._slots)
+                    if r is not None]
+        rids = [r.request_id for _, r in live]
+        head_ctx = live[0][1].trace_ctx if live else None
+        attach = _telemetry.tracer.attach(head_ctx) \
+            if head_ctx is not None else contextlib.nullcontext()
+        with attach, \
+                _telemetry.trace_span("serve.batch", cat="serving",
+                                      model=self.name, step=self._step,
+                                      slots=len(live), links=rids):
+            for slot, req, reason in leavers:
+                self._leave(slot, req, reason)
+            for slot, req in joins:
+                self._join(slot, req, gen)
+            with self._cv:
+                live = [(s, r) for s, r in enumerate(self._slots)
+                        if r is not None]
+            if live:
+                self._decode_once(gen, live)
+
+    def _join(self, slot: int, req: _GenRequest, gen: int):
+        """Admit one request mid-flight: its prefill dispatch runs
+        between decode steps and emits the first token."""
+        with _telemetry.trace_span("slot.join", cat="serving",
+                                   model=self.name, slot=slot,
+                                   request_id=req.request_id,
+                                   prompt_tokens=req.n):
+            try:
+                first = self.engine.prefill(req.tokens, slot)
+            except Exception as e:
+                with self._cv:
+                    if self._slots[slot] is req:
+                        self._slots[slot] = None
+                self._fail(req, e)
+                return
+        self._emit(req, first)
+        if self._maybe_finished(req):
+            self._free_slot(slot, req, "finished")
+
+    def _decode_once(self, gen: int, live):
+        """ONE decode dispatch for every slot (free slots ride along at
+        position 0); emit each live slot's token and free finished slots
+        immediately."""
+        import numpy as _np
+        S = int(self.engine.max_slots)
+        last = _np.zeros(S, _np.int32)
+        pos = _np.zeros(S, _np.int32)
+        for s, r in live:
+            last[s] = r.tokens_out[-1]
+            pos[s] = r.n + len(r.tokens_out) - 1
+        rids = [r.request_id for _, r in live]
+        _m.BATCHES.inc(model=self.name)
+        _m.BATCH_SIZE.observe(len(live))
+
+        def run():
+            _fault.inject("serving.infer", model=self.name,
+                          request_ids=rids)
+            if self._current_gen() != gen:
+                raise _lc.RequestAborted(
+                    f"{self.name}: stale worker generation")
+            return self.engine.decode(last, pos)
+
+        t0 = time.monotonic()
+        try:
+            nxt = _fault.retry_call(run, site="serving.infer",
+                                    policy=self.retry_policy)
+        except Exception as e:
+            self._decode_failed(gen, live, e)
+            return
+        dt = time.monotonic() - t0
+        _m.DECODE_STEP.observe(dt)
+        self._avg_batch_seconds = dt if self._avg_batch_seconds <= 0.0 \
+            else 0.8 * self._avg_batch_seconds + 0.2 * dt
+        self._degraded = False
+        self.breaker.record_success()
+        for s, r in live:
+            self._emit(r, int(nxt[s]))
+            if self._maybe_finished(r):
+                self._free_slot(s, r, "finished")
+
+    # -- step-boundary helpers ------------------------------------------
+    def _emit(self, req: _GenRequest, tok: int):
+        gap = req._emit(tok)
+        self._tokens_emitted += 1
+        _m.GENERATE_TOKENS.inc(model=self.name)
+        # feed the token-latency SLI (MXNET_SERVE_SLO_TOKEN_P99_MS)
+        _slo.tracker.record_token(self.name, gap)
+
+    def _maybe_finished(self, req: _GenRequest) -> bool:
+        if len(req.tokens_out) >= req.budget:
+            return True
+        return req.eos_id is not None \
+            and req.tokens_out[-1] == int(req.eos_id)
+
+    def _free_slot(self, slot: int, req: _GenRequest, reason: str):
+        with self._cv:
+            if self._slots[slot] is req:
+                self._slots[slot] = None
+            _m.SLOTS_IN_USE.set(
+                sum(1 for r in self._slots if r is not None),
+                model=self.name)
+        self._leave(slot, req, reason)
+
+    def _leave(self, slot: int, req: _GenRequest, reason: str):
+        """Emit the ``slot.leave`` event and settle the request: ok for
+        ``finished``, ``Cancelled`` for a client that went away,
+        ``DeadlineExceeded`` (stage=decode) for a budget bust."""
+        with _telemetry.trace_span("slot.leave", cat="serving",
+                                   model=self.name, slot=slot,
+                                   request_id=req.request_id,
+                                   reason=reason,
+                                   tokens=len(req.tokens_out)):
+            pass
+        dt = time.monotonic() - req.t_submit
+        if reason == "finished":
+            _m.LATENCY.observe(dt)
+            _slo.tracker.record(self.name, dt, ok=True)
+            req._finish(None)
+        elif reason == "cancelled":
+            _m.CANCELLED.inc(model=self.name)
+            _telemetry.FAULT.publish(
+                site="serving.generate", event="cancelled",
+                model=self.name, request_id=req.request_id,
+                tokens=len(req.tokens_out))
+            # a cancel is the client's choice, not an SLO burn
+            req._finish(_lc.Cancelled(
+                f"{self.name}: request {req.request_id} cancelled after "
+                f"{len(req.tokens_out)} tokens"))
+        elif reason == "deadline":
+            _m.DEADLINE_EXCEEDED.inc(model=self.name, stage="decode")
+            _telemetry.FAULT.publish(
+                site="serving.deadline", event="deadline", kind="decode",
+                model=self.name, request_id=req.request_id,
+                tokens=len(req.tokens_out))
+            _slo.tracker.record(self.name, dt, ok=False)
+            req._finish(_lc.DeadlineExceeded(
+                f"{self.name}: request {req.request_id} deadline "
+                f"exceeded mid-decode after {len(req.tokens_out)} "
+                "tokens"))
+        else:
+            _slo.tracker.record(self.name, dt, ok=False)
+            req._finish(_lc.RequestAborted(
+                f"{self.name}: request {req.request_id} aborted "
+                f"({reason})"))
+
+    def _fail(self, req: _GenRequest, err: Exception):
+        _slo.tracker.record(self.name,
+                            time.monotonic() - req.t_submit, ok=False)
+        _telemetry.FAULT.publish(
+            site="serving.generate", event="error",
+            kind=type(err).__name__, model=self.name,
+            request_id=req.request_id)
+        req._finish(err)
+
+    def _decode_failed(self, gen: int, live, err: Exception):
+        """A decode dispatch failed after retries.  There is no per-slot
+        fallback — the cache is shared and may have been consumed by
+        donation — so fail every rider, free all slots, and reset the
+        cache so the next admission starts clean."""
+        _telemetry.FAULT.publish(
+            site="serving.infer", event="fallback",
+            kind=type(err).__name__, model=self.name,
+            requests=len(live),
+            request_ids=[r.request_id for _, r in live])
+        _m.FALLBACKS.inc(model=self.name)
+        self.breaker.record_failure(
+            f"decode dispatch failed: {type(err).__name__}")
+        with self._cv:
+            for s, r in live:
+                if self._slots[s] is r:
+                    self._slots[s] = None
+            _m.SLOTS_IN_USE.set(0, model=self.name)
+            if gen == self._worker_gen:
+                self.engine.reset()
+        for _, r in live:
+            self._fail(r, err)
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        with self._cv:
+            return not self._queue \
+                and all(r is None for r in self._slots)
+
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._queue) \
+                + sum(1 for r in self._slots if r is not None)
+
+    def active_request_ids(self) -> dict:
+        with self._cv:
+            return {"queued": [r.request_id for r in self._queue],
+                    "inflight": [r.request_id for r in self._slots
+                                 if r is not None]}
+
+    def slots_in_use(self) -> int:
+        with self._cv:
+            return sum(1 for r in self._slots if r is not None)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._cv:
+            out.update({
+                "kind": "generation",
+                "max_slots": int(self.engine.max_slots),
+                "max_len": int(self.engine.max_len),
+                "slots_in_use": sum(1 for r in self._slots
+                                    if r is not None),
+                "decode_steps": self._step,
+                "tokens_emitted": self._tokens_emitted,
+                "prefill_buckets": list(self.engine.prefill_buckets),
+                "kv_cache_bytes": int(self.engine.cache_bytes),
+            })
+        out.pop("max_delay_ms", None)
+        return out

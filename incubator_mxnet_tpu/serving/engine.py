@@ -36,7 +36,8 @@ from ..context import current_context
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telemetry
 
-__all__ = ["InferenceEngine", "derive_buckets"]
+__all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
+           "derive_prefill_buckets"]
 
 
 def derive_buckets(max_batch_size: int) -> Tuple[int, ...]:
@@ -377,3 +378,367 @@ class InferenceEngine:
         kw.setdefault("name", os.path.basename(str(prefix)) or "export")
         return cls.from_symbol(sym, arg_params, aux_params, input_names,
                                **kw)
+
+
+# ===========================================================================
+# GenerationEngine — continuous-batching autoregressive decode
+# ===========================================================================
+
+def derive_prefill_buckets(max_len: int, smallest: int = 8):
+    """Prompt-length buckets: powers of two from ``smallest`` up to (and
+    always including) ``max_len`` — ``derive_prefill_buckets(128) ==
+    (8, 16, 32, 64, 128)``.  One compiled prefill program per bucket."""
+    m = int(max_len)
+    if m < 1:
+        raise MXNetError(f"max_len must be >= 1, got {m}")
+    out, b = [], min(int(smallest), m)
+    while b < m:
+        out.append(b)
+        b *= 2
+    out.append(m)
+    return tuple(out)
+
+
+class GenerationEngine:
+    """Autoregressive generation as a closed set of compiled programs
+    over a PREALLOCATED per-layer KV cache ``[slots, heads, max_len,
+    head_dim]``.
+
+    The naive serving path re-runs prefill over the whole growing
+    context every token — O(n^2) work and one fresh dispatch per request
+    per token.  This engine splits the work once:
+
+    * ``prefill(tokens, slot)`` — full-prefix forward at the request's
+      prompt-length bucket, writing the slot's K/V rows and returning
+      the first generated token.  One compiled program per prefill
+      bucket (:func:`derive_prefill_buckets`).
+    * ``decode(last_tokens, positions)`` — ONE fixed-shape dispatch
+      advancing every slot one token: embeds each slot's last token at
+      its own position, appends K/V at that position, and attends over
+      its live prefix via :func:`kernels.flash_attention.decode_attention`.
+      Exactly one compiled program, regardless of how many requests are
+      in flight or how long they run.
+
+    Both programs take the whole cache DONATED (the engine owns it and
+    rebinds the returned buffers), so XLA updates the cache in place.
+    The cache is single-writer by contract: only the continuous
+    batcher's worker thread dispatches.  Free slots still flow through
+    ``decode`` (their writes land in their own rows at position 0 and
+    are overwritten by the next prefill), which is what keeps the
+    program count at one.
+
+    Decoding is greedy (argmax) — the serving contract is determinism:
+    cached decode must match the full re-forward token-for-token.
+    """
+
+    def __init__(self, block, *, name: Optional[str] = None,
+                 max_slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 ctx=None):
+        import jax
+        from ..base import getenv_int
+        for attr in ("embed", "pos_embed", "cells", "ln_f", "_units",
+                     "_max_length"):
+            if not hasattr(block, attr):
+                raise MXNetError(
+                    "GenerationEngine needs a GPT-style block (embed/"
+                    f"pos_embed/cells/ln_f); {type(block).__name__} has "
+                    f"no {attr!r}")
+        self.block = block
+        self.name = str(name or getattr(block, "name", "gpt"))
+        self._ctx = ctx if ctx is not None else current_context()
+        self.max_slots = int(max_slots
+                             or getenv_int("MXNET_GEN_MAX_SLOTS", 8))
+        if self.max_slots < 1:
+            raise MXNetError(f"max_slots must be >= 1: {self.max_slots}")
+        blk_len = int(block._max_length)
+        self.max_len = min(int(max_len
+                               or getenv_int("MXNET_GEN_MAX_LEN", blk_len)),
+                           blk_len)
+        if self.max_len < 2:
+            raise MXNetError(f"max_len must be >= 2: {self.max_len}")
+        self._cells = list(block.cells._children.values())
+        self.num_layers = len(self._cells)
+        at = self._cells[0].attention
+        self.num_heads = int(at._num_heads)
+        self.head_dim = int(block._units) // self.num_heads
+        if prefill_buckets:
+            self.prefill_buckets = tuple(sorted(
+                {int(b) for b in prefill_buckets}))
+            if self.prefill_buckets[0] < 1 \
+                    or self.prefill_buckets[-1] > self.max_len:
+                raise MXNetError(
+                    f"prefill buckets must be in [1, {self.max_len}]: "
+                    f"{self.prefill_buckets}")
+        else:
+            self.prefill_buckets = derive_prefill_buckets(self.max_len)
+        self._settle_params()
+        self._prefill_jit = jax.jit(self._prefill_pure,
+                                    donate_argnums=(0,))
+        self._prefill = _telemetry.instrument_jit(
+            "serving:" + self.name + ":prefill", self._prefill_jit)
+        self._decode_jit = jax.jit(self._decode_pure, donate_argnums=(0,))
+        self._decode = _telemetry.instrument_jit(
+            "serving:" + self.name + ":decode", self._decode_jit)
+        self._warmup_done = False
+        self.reset()
+
+    # -- parameters -----------------------------------------------------
+    def _settle_params(self):
+        from .. import ndarray as nd
+        from .. import autograd as _ag
+        params = list(self.block.collect_params().values())
+        if any(p._deferred_init is not None or p._data is None
+               for p in params):
+            probe = nd.array(_np.zeros((1, 2), _np.int32), ctx=self._ctx)
+            with _ag.pause(train_mode=False):
+                self.block(probe)
+            params = list(self.block.collect_params().values())
+        self._trainable = [p for p in params if p.grad_req != "null"]
+        self._aux = [p for p in params if p.grad_req == "null"]
+
+    def _param_fn(self):
+        return (tuple(p._data._data for p in self._trainable),
+                tuple(p._data._data for p in self._aux))
+
+    def _with_params(self, param_vals, aux_vals, key, body):
+        """functional_call's substitution mechanics with a custom body:
+        swap jax values/tracers into the Parameters, run ``body`` in
+        inference mode under the traced RNG stream, restore."""
+        from .. import autograd as _ag
+        from .. import random as _random
+        all_params = self._trainable + self._aux
+        all_vals = list(param_vals) + list(aux_vals)
+        saved = [p._data._data for p in all_params]
+        try:
+            for p, v in zip(all_params, all_vals):
+                p._data._set_data(v)
+            with _ag.pause(train_mode=False), _random.trace_stream(key):
+                return body()
+        finally:
+            for p, v in zip(all_params, saved):
+                p._data._set_data(v)
+
+    # -- pure programs --------------------------------------------------
+    def _prefill_pure(self, cache, tokens, n_valid, slot,
+                      param_vals, aux_vals, key):
+        """tokens (1, Tb) int32 (zero-padded past ``n_valid``), scalar
+        ``slot``: run the full-prefix forward (causal, so the first
+        ``n_valid`` positions are exact regardless of padding), write the
+        slot's K/V rows for positions [0, Tb), return (cache', first
+        generated token)."""
+        import jax.numpy as jnp
+        from jax import lax
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        Tb = tokens.shape[1]
+
+        def body():
+            x = self.block._embed_at(NDArray(tokens))
+            ks, vs = [], []
+            for cell in self._cells:
+                x, k, v = cell.prime(x)
+                ks.append(k._data)
+                vs.append(v._data)
+            logits = self.block._project(self.block.ln_f(x))
+            return logits._data, ks, vs
+
+        logits, ks, vs = self._with_params(param_vals, aux_vals, key, body)
+        out = list(cache)
+        for l in range(L):
+            kh = ks[l].reshape(Tb, H, D).transpose(1, 0, 2)[None]
+            vh = vs[l].reshape(Tb, H, D).transpose(1, 0, 2)[None]
+            out[l] = lax.dynamic_update_slice(
+                out[l], kh.astype(out[l].dtype), (slot, 0, 0, 0))
+            out[L + l] = lax.dynamic_update_slice(
+                out[L + l], vh.astype(out[L + l].dtype), (slot, 0, 0, 0))
+        last = jnp.take(logits[0], n_valid - 1, axis=0)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return tuple(out), first
+
+    def _decode_pure(self, cache, last_tokens, positions,
+                     param_vals, aux_vals, key):
+        """One token for EVERY slot: last_tokens (S, 1) int32, positions
+        (S,) int32 (the index each slot writes this step).  Free slots
+        ride along writing into their own row at position 0 — harmless,
+        the next prefill overwrites.  Returns (cache', next (S,))."""
+        import jax.numpy as jnp
+        from ..kernels.flash_attention import decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S = last_tokens.shape[0]
+        C = H * D
+        caches = list(cache)
+        rows = jnp.arange(S)
+
+        def body():
+            pos_nd = NDArray(positions.reshape(S, 1))
+            x = self.block.embed(NDArray(last_tokens)) \
+                + self.block.pos_embed(pos_nd)
+            h = self.block.drop(x)
+            for l, cell in enumerate(self._cells):
+                at = cell.attention
+                hn = cell.ln1(h)
+                q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                qh = q._data.reshape(S, H, D)
+                knh = kn._data.reshape(S, H, D)
+                vnh = vn._data.reshape(S, H, D)
+                ck = caches[l].at[rows, :, positions].set(
+                    knh.astype(caches[l].dtype))
+                cv = caches[L + l].at[rows, :, positions].set(
+                    vnh.astype(caches[L + l].dtype))
+                caches[l], caches[L + l] = ck, cv
+                attn = decode_attention(qh, ck, cv, positions)
+                out_nd = NDArray(attn.reshape(S, 1, C).astype(h._data.dtype))
+                h = h + at.dropout(at.proj(out_nd))
+                h = h + cell._ffn_out(cell.ln2(h))
+            logits = self.block._project(self.block.ln_f(h))
+            return logits._data
+
+        logits = self._with_params(param_vals, aux_vals, key, body)
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return tuple(caches), nxt
+
+    # -- cache lifecycle ------------------------------------------------
+    def reset(self):
+        """(Re)allocate the cache: all slots free, all rows zero.  Called
+        at construction and by the continuous batcher after a watchdog
+        restart (a replaced worker must not trust donated buffers that a
+        dying dispatch may have consumed)."""
+        import jax.numpy as jnp
+        S, H, T, D = (self.max_slots, self.num_heads, self.max_len,
+                      self.head_dim)
+        self._cache = tuple(jnp.zeros((S, H, T, D), jnp.float32)
+                            for _ in range(2 * self.num_layers))
+
+    @property
+    def cache_bytes(self) -> int:
+        return sum(int(c.size) * c.dtype.itemsize for c in self._cache)
+
+    # DynamicBatcher compatibility: the slot count plays the role of the
+    # batch cap, the prefill buckets the role of the shape buckets
+    @property
+    def max_batch_size(self) -> int:
+        return self.max_slots
+
+    @property
+    def buckets(self):
+        return self.prefill_buckets
+
+    def prefill_bucket_for(self, n: int) -> Optional[int]:
+        for b in self.prefill_buckets:
+            if b >= int(n):
+                return b
+        return None
+
+    # -- host-side dispatch ---------------------------------------------
+    def _guarded(self, call, *args):
+        param_vals, aux_vals = self._param_fn()
+        from .. import random as _random
+        key = _random.new_key(self._ctx)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return call(self._cache, *args, param_vals, aux_vals, key)
+
+    def prefill(self, tokens, slot: int) -> int:
+        """Admit a prompt into ``slot``: pad to the prompt-length bucket,
+        dispatch the bucket's prefill program, return the FIRST generated
+        token.  After this the slot's write head is at ``len(tokens)``
+        (the returned token's K/V lands there on its first decode)."""
+        import jax.numpy as jnp
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        n = int(toks.shape[0])
+        if not 0 <= int(slot) < self.max_slots:
+            raise MXNetError(f"{self.name}: slot {slot} out of range "
+                             f"(max_slots {self.max_slots})")
+        if n < 1:
+            raise MXNetError(f"{self.name}: empty prompt")
+        if n > self.max_len - 1:
+            raise MXNetError(
+                f"{self.name}: prompt length {n} leaves no room to "
+                f"generate (max_len {self.max_len})")
+        bucket = self.prefill_bucket_for(n)
+        padded = _np.zeros((1, bucket), _np.int32)
+        padded[0, :n] = toks
+        with _telemetry.trace_span("serve.prefill", cat="serving",
+                                   model=self.name, slot=int(slot),
+                                   tokens=n, bucket=bucket):
+            cache, first = self._guarded(
+                self._prefill, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), jnp.asarray(int(slot),
+                                                       jnp.int32))
+        self._cache = cache
+        return int(first)
+
+    def decode(self, last_tokens, positions):
+        """Advance EVERY slot one position in one dispatch: last_tokens
+        (S,) int32 (free slots: 0), positions (S,) int32 (free slots: 0).
+        Returns the next token per slot as a host int32 array."""
+        import jax.numpy as jnp
+        lt = jnp.asarray(_np.asarray(last_tokens, _np.int32).reshape(
+            self.max_slots, 1))
+        pos = jnp.asarray(_np.asarray(positions, _np.int32).reshape(
+            self.max_slots))
+        cache, nxt = self._guarded(self._decode, lt, pos)
+        self._cache = cache
+        return _np.asarray(nxt)
+
+    # -- warmup / introspection -----------------------------------------
+    def warmup(self) -> int:
+        """AOT-compile every prefill bucket plus THE decode program, then
+        reset the cache (warmup traffic must not look like live slots).
+        Returns the number of programs warmed (len(buckets) + 1)."""
+        for b in self.prefill_buckets:
+            self.prefill(_np.zeros(max(1, min(b, self.max_len - 1)),
+                                   _np.int32), 0)
+        self.decode(_np.zeros(self.max_slots, _np.int32),
+                    _np.zeros(self.max_slots, _np.int32))
+        self.reset()
+        self._warmup_done = True
+        return len(self.prefill_buckets) + 1
+
+    def compiled_programs(self) -> int:
+        try:
+            return int(self._prefill_jit._cache_size()) \
+                + int(self._decode_jit._cache_size())
+        except Exception:
+            return 0
+
+    @property
+    def warm(self) -> bool:
+        if self._warmup_done:
+            return True
+        return self.compiled_programs() >= len(self.prefill_buckets) + 1
+
+    # -- reference path --------------------------------------------------
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None):
+        """Solo generation through the SERVING programs (slot 0) — the
+        engine-level convenience used by tests and the bench; the
+        continuous batcher drives the same programs for many slots."""
+        toks = list(_np.asarray(tokens, _np.int32).reshape(-1))
+        n = len(toks)
+        budget = min(int(max_new_tokens), self.max_len - n)
+        if budget < 1:
+            raise MXNetError(
+                f"{self.name}: no token budget (prompt {n}, max_len "
+                f"{self.max_len})")
+        out = [self.prefill(toks, 0)]
+        pos = n
+        lt = _np.zeros(self.max_slots, _np.int32)
+        pv = _np.zeros(self.max_slots, _np.int32)
+        while len(out) < budget and (eos_id is None
+                                     or out[-1] != int(eos_id)):
+            lt[0] = out[-1]
+            pv[0] = pos
+            nxt = self.decode(lt, pv)
+            out.append(int(nxt[0]))
+            pos += 1
+        return out
+
+    def __repr__(self):
+        return (f"<GenerationEngine {self.name!r}: slots={self.max_slots}, "
+                f"max_len={self.max_len}, layers={self.num_layers}, "
+                f"heads={self.num_heads}, "
+                f"prefill_buckets={list(self.prefill_buckets)}, "
+                f"programs={self.compiled_programs()}>")

@@ -61,6 +61,7 @@ __all__ = [
     "STARTING", "SERVING", "DEGRADED", "UNHEALTHY", "DRAINING",
     # errors
     "DeadlineExceeded", "BreakerOpen", "Draining", "RequestAborted",
+    "Cancelled",
     # pieces
     "CircuitBreaker", "Watchdog",
     # deadline helpers
@@ -110,6 +111,13 @@ class RequestAborted(MXNetError):
     client should retry elsewhere (HTTP 503)."""
 
     retry_after = 1.0
+
+
+class Cancelled(MXNetError):
+    """The request was cancelled by its own client (streaming disconnect
+    or explicit ``cancel()``) mid-generation — the slot frees on the
+    next decode-step boundary.  Never surfaces as an HTTP error: the
+    client that would receive it is gone."""
 
 
 # -- deadlines --------------------------------------------------------------

@@ -28,7 +28,14 @@ FALLBACKS = _telemetry.registry.counter(
 DEADLINE_EXCEEDED = _telemetry.registry.counter(
     "mxtpu_serve_deadline_exceeded",
     "requests shed because their end-to-end deadline expired "
-    "(stage=admission|queue|wait)")
+    "(stage=admission|queue|wait|decode)")
+GENERATE_TOKENS = _telemetry.registry.counter(
+    "mxtpu_generate_tokens",
+    "tokens emitted by the continuous-batching generation path")
+CANCELLED = _telemetry.registry.counter(
+    "mxtpu_serve_cancelled",
+    "generation requests cancelled mid-decode (client disconnect); the "
+    "slot frees on the next step boundary")
 WATCHDOG_RESTARTS = _telemetry.registry.counter(
     "mxtpu_serve_watchdog_restarts",
     "batcher workers restarted by the serving watchdog (dead or hung)")
@@ -50,11 +57,22 @@ QUEUE_WAIT = _telemetry.registry.histogram(
 LATENCY = _telemetry.registry.histogram(
     "mxtpu_serve_latency_seconds",
     "end-to-end seconds from submit to scattered result")
+TOKEN_LATENCY = _telemetry.registry.histogram(
+    "mxtpu_generate_token_seconds",
+    "seconds between consecutive emitted tokens of one generation "
+    "request (first sample: submit -> first token)")
+DECODE_STEP = _telemetry.registry.histogram(
+    "mxtpu_generate_decode_step_seconds",
+    "seconds per continuous-batching decode dispatch (all live slots "
+    "advance one token)")
 
 # gauges -------------------------------------------------------------------
 QUEUE_DEPTH = _telemetry.registry.gauge(
     "mxtpu_serve_queue_depth",
     "requests currently queued, per model")
+SLOTS_IN_USE = _telemetry.registry.gauge(
+    "mxtpu_serve_cache_slots_in_use",
+    "KV-cache slots occupied by live generation requests, per model")
 MODELS_LOADED = _telemetry.registry.gauge(
     "mxtpu_serve_models_loaded",
     "models registered on the ModelServer")

@@ -19,6 +19,17 @@ Routes (JSON tensors everywhere):
   expires anywhere in the pipeline, 503 + ``Retry-After`` when the
   model's circuit breaker is OPEN, the watchdog failed the request, or
   the server is draining.
+* ``POST /v1/models/<name>:generate`` — token generation against a
+  :class:`GenerationEngine`-backed model: ``{"tokens": [...],
+  "max_new_tokens": 32, "timeout_ms": ..., "eos_id": ...,
+  "stream": false}``.  Non-streaming responds ``{"tokens": [...],
+  "count": N, "request_id": ...}`` once generation finishes;
+  ``"stream": true`` answers with chunked SSE (``event: token`` per
+  emitted token as it leaves the decode loop, then ``event: done`` —
+  or a terminal ``event: error``).  The same error ladder applies at
+  admission; client disconnect mid-stream cancels the request and its
+  KV-cache slot frees at the next decode-step boundary
+  (docs/serving.md).
 * ``POST /v1/models/<name>:load`` — ``{"prefix": ..., "epoch": 0,
   "input_names": ["data"], "input_specs": [[784]]}`` loads an exported
   symbol+params artifact into the registry.
@@ -66,8 +77,8 @@ from ..base import MXNetError, getenv_int
 from ..http_util import BaseJSONHandler, HTTPServerBase, \
     start_http_server, stop_http_server
 from .. import telemetry_ring as _ring
-from .batcher import DynamicBatcher, QueueFullError
-from .engine import InferenceEngine
+from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
+from .engine import GenerationEngine, InferenceEngine
 from . import lifecycle as _lc
 from . import metrics as _m
 from . import slo as _slo
@@ -149,6 +160,12 @@ class _Handler(BaseJSONHandler):
                 finally:
                     ms._http_exit()
                 self.send_json(200, out)
+            elif verb == "generate":
+                ms._http_enter()
+                try:
+                    self._generate(ms, name, payload, rid)
+                finally:
+                    ms._http_exit()
             elif verb == "load":
                 ms.load_model(name, payload)
                 self.send_json(200, {"loaded": name})
@@ -157,7 +174,7 @@ class _Handler(BaseJSONHandler):
                 self.send_json(200, {"unloaded": name})
             else:
                 err(404, {"error": f"unknown verb {verb!r}; "
-                          "try :predict :load :unload"})
+                          "try :predict :generate :load :unload"})
         except KeyError:
             err(404, {"error": f"model {name!r} is not "
                       "loaded", "models": sorted(ms.models())})
@@ -179,6 +196,46 @@ class _Handler(BaseJSONHandler):
                 headers=_retry_after_header(e.retry_after))
         except (ValueError, TypeError, MXNetError) as e:
             err(400, {"error": str(e)})
+
+    def _generate(self, ms, name, payload, rid):
+        """``:generate`` body.  Admission errors raise out of here into
+        ``_post``'s error ladder — the status line has not been sent
+        yet.  Once the stream is open the status is on the wire, so
+        worker-side failures become terminal SSE ``error`` events
+        instead, and a broken pipe (client disconnect) cancels the
+        request so its slot frees at the next decode-step boundary."""
+        req = ms.generate_request(name, payload, request_id=rid)
+        stream = bool(payload.get("stream", False)) \
+            if isinstance(payload, dict) else False
+        if not stream:
+            toks = req.result()
+            self.send_json(200, {"tokens": toks, "count": len(toks),
+                                 "request_id": req.request_id})
+            return
+        self.start_stream(200)
+        try:
+            for i, tok in enumerate(req.stream()):
+                self.send_event({"token": int(tok), "index": i},
+                                event="token")
+            self.send_event({"tokens": list(req.tokens_out),
+                             "count": len(req.tokens_out),
+                             "request_id": req.request_id},
+                            event="done")
+        except (BrokenPipeError, ConnectionError, OSError):
+            req.cancel()                # client went away mid-stream
+            return
+        except Exception as e:
+            try:
+                self.send_event({"error": str(e),
+                                 "request_id": req.request_id},
+                                event="error")
+            except OSError:
+                req.cancel()
+                return
+        try:
+            self.end_stream()
+        except OSError:
+            pass
 
 
 class ModelServer:
@@ -230,7 +287,13 @@ class ModelServer:
             engine.warmup()
         kw = dict(self._batcher_defaults)
         kw.update(batcher_kw)
-        batcher = DynamicBatcher(engine, name=name, **kw)
+        if isinstance(engine, GenerationEngine):
+            # generation engines serve token streams, not one-shot
+            # batches: slot-based continuous batching instead of the
+            # gather→dispatch→scatter cycle
+            batcher = ContinuousBatcher(engine, name=name, **kw)
+        else:
+            batcher = DynamicBatcher(engine, name=name, **kw)
         with self._lock:
             if name in self._models:
                 batcher.close(drain=False)
@@ -388,6 +451,47 @@ class ModelServer:
         outs = [_np.asarray(o) for o in outs]
         return {"outputs": [o.tolist() for o in outs],
                 "shapes": [list(o.shape) for o in outs]}
+
+    def generate_request(self, name: str, payload: dict,
+                         request_id: Optional[str] = None):
+        """Parse a ``:generate`` payload and admit it into the model's
+        continuous batcher; returns the live request handle (the HTTP
+        front-end either waits on ``.result()`` or iterates
+        ``.stream()``).  Admission failures are recorded against the
+        model's SLO here because — unlike the blocking ``submit`` path —
+        the handler owns the request lifetime from this point on."""
+        if self._draining:
+            raise _lc.Draining(f"server is draining; model {name!r} is "
+                               "not accepting new work")
+        batcher = self.get_model(name)          # KeyError → HTTP 404
+        if not isinstance(batcher, ContinuousBatcher):
+            raise ValueError(
+                f"model {name!r} is not a generation model; "
+                "use :predict")
+        if not isinstance(payload, dict):
+            raise ValueError(':generate needs a JSON object body')
+        tokens = payload.get("tokens", payload.get("inputs"))
+        if isinstance(tokens, (list, tuple)) and len(tokens) == 1 \
+                and isinstance(tokens[0], (list, tuple)):
+            tokens = tokens[0]          # accept a [[...]] batch of one
+        if not isinstance(tokens, (list, tuple)) or not tokens:
+            raise ValueError('"tokens" must be a non-empty list of '
+                             "token ids")
+        tokens = [int(t) for t in tokens]       # ValueError → HTTP 400
+        max_new = int(payload.get("max_new_tokens", 32))
+        timeout_ms = payload.get("timeout_ms")
+        if timeout_ms is not None:
+            timeout_ms = float(timeout_ms)      # ValueError → HTTP 400
+        eos_id = payload.get("eos_id")
+        if eos_id is not None:
+            eos_id = int(eos_id)
+        try:
+            return batcher.submit_async(
+                tokens, max_new_tokens=max_new, timeout_ms=timeout_ms,
+                request_id=request_id, eos_id=eos_id)
+        except Exception:
+            _slo.tracker.record(name, 0.0, ok=False)
+            raise
 
     # -- drain bookkeeping (the HTTP handler reports in-flight work) ----
     def _http_enter(self) -> None:

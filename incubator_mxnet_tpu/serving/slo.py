@@ -13,6 +13,13 @@ SLIs the serving plane promises:
   errors never reach the batcher, so they never burn budget).
 * **latency** — the window's p99 versus the objective
   ``MXNET_SERVE_SLO_P99_MS``.
+* **token latency** (generation models) — p99 of per-token emission
+  gaps versus ``MXNET_SERVE_SLO_TOKEN_P99_MS``.  End-to-end latency is
+  the wrong SLI for a streamed response: a 200-token request that
+  stalls 5 s mid-stream can still post a fine total.  The continuous
+  batcher records every inter-token gap here, so decode-loop stalls
+  (slot contention, a wedged dispatch riding retry) burn budget even
+  when requests eventually finish.
 
 Each SLI yields a **burn rate** — how fast the error budget is being
 spent, where 1.0 means "exactly consuming the budget the objective
@@ -37,9 +44,10 @@ JSON view is ``GET /slo`` and ``mxtpu-stats --slo``.
 
 Knobs (docs/env_var.md): ``MXNET_SERVE_SLO_AVAILABILITY`` (objective,
 default 0.999), ``MXNET_SERVE_SLO_P99_MS`` (latency objective in ms,
-default 0 → latency SLO off), ``MXNET_SERVE_SLO_WINDOW`` (window size
-in requests, default 512), ``MXNET_SERVE_SLO_MIN_REQUESTS`` (readiness
-floor, default 10).
+default 0 → latency SLO off), ``MXNET_SERVE_SLO_TOKEN_P99_MS``
+(per-token gap objective in ms, default 0 → token SLO off),
+``MXNET_SERVE_SLO_WINDOW`` (window size in requests, default 512),
+``MXNET_SERVE_SLO_MIN_REQUESTS`` (readiness floor, default 10).
 """
 from __future__ import annotations
 
@@ -52,7 +60,7 @@ from . import metrics as _m
 
 __all__ = ["ModelSLO", "SLOTracker", "tracker",
            "objective_availability", "objective_p99_ms",
-           "default_window", "min_requests"]
+           "objective_token_p99_ms", "default_window", "min_requests"]
 
 
 def objective_availability() -> float:
@@ -65,6 +73,12 @@ def objective_p99_ms() -> float:
     """``MXNET_SERVE_SLO_P99_MS``: p99 latency objective in
     milliseconds; 0 disables the latency SLI."""
     return float(getenv("MXNET_SERVE_SLO_P99_MS", 0.0))
+
+
+def objective_token_p99_ms() -> float:
+    """``MXNET_SERVE_SLO_TOKEN_P99_MS``: p99 inter-token gap objective
+    in milliseconds for generation models; 0 disables the token SLI."""
+    return float(getenv("MXNET_SERVE_SLO_TOKEN_P99_MS", 0.0))
 
 
 def default_window() -> int:
@@ -83,8 +97,21 @@ class ModelSLO:
 
     def __init__(self, model: str, window: Optional[int] = None):
         self.model = str(model)
-        self._window = deque(maxlen=max(1, int(window or default_window())))
+        size = max(1, int(window or default_window()))
+        self._window = deque(maxlen=size)
+        # inter-token emission gaps (generation models); one request
+        # contributes many samples, so give gaps their own window
+        # rather than crowding request outcomes out of the budget math
+        self._token_window = deque(maxlen=size)
         self._lock = threading.Lock()
+
+    def record_token(self, gap_seconds: float) -> None:
+        """Fold one inter-token emission gap into the token window
+        (recorded by ``ContinuousBatcher`` per emitted token).  Gauges
+        refresh on the next :meth:`record` — per-token gauge updates
+        would cost a sort per decode step per slot."""
+        with self._lock:
+            self._token_window.append(float(gap_seconds))
 
     def record(self, latency_seconds: float, ok: bool) -> None:
         """Fold one request outcome into the window and refresh the
@@ -105,10 +132,12 @@ class ModelSLO:
         """JSON-ready SLI/burn/budget view of the current window."""
         with self._lock:
             window = list(self._window)
+            token_window = list(self._token_window)
         total = len(window)
         bad = sum(1 for ok, _ in window if not ok)
         avail_obj = min(1.0, max(0.0, objective_availability()))
         p99_obj_s = max(0.0, objective_p99_ms()) / 1000.0
+        tok_obj_s = max(0.0, objective_token_p99_ms()) / 1000.0
         out = {
             "model": self.model,
             "window": total,
@@ -117,17 +146,34 @@ class ModelSLO:
             "availability_objective": avail_obj,
             "p99_seconds": None,
             "p99_objective_seconds": p99_obj_s or None,
+            "token_window": len(token_window),
+            "token_p99_seconds": None,
+            "token_p99_objective_seconds": tok_obj_s or None,
             "burn_rate": 0.0,
             "error_budget_remaining": 1.0,
             "exhausted": False,
         }
-        if total == 0:
-            return out
-        lats = sorted(lat for _, lat in window)
-        # same nearest-rank convention as telemetry.Histogram.stats()
-        out["p99_seconds"] = lats[min(total - 1,
-                                      max(0, int(round(0.99 * (total - 1)))))]
+
+        def _p99(samples):
+            # same nearest-rank convention as telemetry.Histogram.stats()
+            n = len(samples)
+            return samples[min(n - 1, max(0, int(round(0.99 * (n - 1)))))]
+
         burns = []
+        if token_window:
+            gaps = sorted(token_window)
+            out["token_p99_seconds"] = _p99(gaps)
+            if tok_obj_s > 0.0:
+                slow = sum(1 for g in token_window if g > tok_obj_s)
+                burns.append((slow / len(token_window)) / 0.01)
+        if total == 0:
+            # token-gap burn alone can spend budget, but readiness only
+            # flips once enough whole requests have been observed
+            out["burn_rate"] = max(burns) if burns else 0.0
+            out["error_budget_remaining"] = \
+                min(1.0, max(0.0, 1.0 - out["burn_rate"]))
+            return out
+        out["p99_seconds"] = _p99(sorted(lat for _, lat in window))
         if avail_obj < 1.0:
             burns.append((bad / total) / (1.0 - avail_obj))
         if p99_obj_s > 0.0:
@@ -159,6 +205,9 @@ class SLOTracker:
     def record(self, name: str, latency_seconds: float, ok: bool) -> None:
         self.model(name).record(latency_seconds, ok)
 
+    def record_token(self, name: str, gap_seconds: float) -> None:
+        self.model(name).record_token(gap_seconds)
+
     def snapshot(self) -> dict:
         """``GET /slo`` body: every model's SLI/burn/budget view plus
         the shared objectives."""
@@ -168,6 +217,7 @@ class SLOTracker:
             "objectives": {
                 "availability": objective_availability(),
                 "p99_ms": objective_p99_ms() or None,
+                "token_p99_ms": objective_token_p99_ms() or None,
                 "window": default_window(),
                 "min_requests": min_requests(),
             },
