@@ -9,9 +9,10 @@ first-class, *testable* runtime concept instead:
 * **Fault plan** — an env/API-configurable schedule of injected faults at
   named sites (``MXNET_FAULT_PLAN``).  Sites are plain strings; the
   instrumented ones are ``kvstore.push`` / ``kvstore.pull`` /
-  ``kvstore.pushpull`` (transport), ``dataloader.fetch`` (input
-  pipeline), ``checkpoint.write`` (storage), ``trainer.grad``
-  (numerics), and the serving pair ``serving.queue`` /
+  ``kvstore.pushpull`` (transport), ``dataloader.fetch`` and
+  ``prefetch.h2d`` (input pipeline: upstream fetch and the prefetcher's
+  host-to-device staging), ``checkpoint.write`` (storage),
+  ``trainer.grad`` (numerics), and the serving pair ``serving.queue`` /
   ``serving.infer``.  Kinds: ``ioerror`` (raise a transient
   :class:`FaultInjected`), ``latency`` (sleep), ``nonfinite`` (poison a
   gradient — consumed by the trainer's guard via :func:`take`), and

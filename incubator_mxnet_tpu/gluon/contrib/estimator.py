@@ -7,7 +7,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from ...base import MXNetError
+from ...base import MXNetError, getenv_bool
 from ... import metric as metric_mod
 from .. import loss as loss_mod
 from ..trainer import Trainer
@@ -90,6 +90,11 @@ class Estimator:
         # a resume-aware CheckpointHandler sets this in train_begin; fit()
         # then starts the epoch loop there instead of at 0
         self.resume_from_epoch = 0
+        # set when fit() runs in compiled-loop mode (fit(compiled_loop=
+        # True) or MXNET_COMPILED_LOOP); handlers that touch the trainer
+        # (CheckpointHandler) retarget to it
+        self.compiled_loop = None
+        self._last_batch = None
 
     # ------------------------------------------------------------------
     def _batches(self, data):
@@ -119,10 +124,24 @@ class Estimator:
         return [(m.get()) for m in metrics]
 
     def fit(self, train_data, val_data=None, epochs=1,
-            event_handlers: Optional[List] = None, batches=None):
+            event_handlers: Optional[List] = None, batches=None,
+            compiled_loop=None, loop_steps=None):
         """Reference: Estimator.fit — epochs of forward/backward/step with
-        handler callbacks at train/epoch/batch boundaries."""
+        handler callbacks at train/epoch/batch boundaries.
+
+        ``compiled_loop=True`` (or ``MXNET_COMPILED_LOOP=1``) trains each
+        epoch through a :class:`parallel.CompiledLoop` instead of the
+        eager per-batch path: k-step chunks dispatch as one donated
+        program with device prefetch, the optimizer is the functional
+        twin of this estimator's Trainer optimizer, and params sync back
+        to the net at every epoch end (so validation, checkpointing and
+        eager use keep working).  Per-batch handler events and train
+        metrics are not fired in loop mode — there is no per-batch host
+        boundary to fire them at; ``loop_steps`` sets the chunk length
+        (default ``MXNET_LOOP_STEPS``)."""
         from ... import autograd as _ag
+        use_loop = bool(compiled_loop) if compiled_loop is not None \
+            else getenv_bool("MXNET_COMPILED_LOOP", False)
         handlers = list(event_handlers or [])
         handlers.append(_MetricUpdater())
         # validation must stamp fresh metrics BEFORE consumers (early
@@ -154,22 +173,28 @@ class Estimator:
                 if hasattr(train_data, "reset"):
                     train_data.reset()
                 fire("epoch_begin")
-                for x, y in self._batches(train_data):
-                    fire("batch_begin")
-                    with _ag.record():
-                        out = self.net(x)
-                        # per-sample loss vector + step(batch_size) is the
-                        # reference convention: backward sums, step divides
-                        loss = self.loss(out, y)
-                    loss.backward()
-                    self.trainer.step(x.shape[0])
-                    self.train_loss += float(loss.mean().asscalar())
-                    self.processed_samples += x.shape[0]
-                    self._last_batch = (y, out)
-                    nbatch += 1
-                    fire("batch_end")
-                    if batches is not None and nbatch >= batches:
-                        break
+                if use_loop:
+                    self._last_batch = None
+                    nbatch = self._run_epoch_loop(train_data, batches,
+                                                  loop_steps)
+                else:
+                    for x, y in self._batches(train_data):
+                        fire("batch_begin")
+                        with _ag.record():
+                            out = self.net(x)
+                            # per-sample loss vector + step(batch_size)
+                            # is the reference convention: backward sums,
+                            # step divides
+                            loss = self.loss(out, y)
+                        loss.backward()
+                        self.trainer.step(x.shape[0])
+                        self.train_loss += float(loss.mean().asscalar())
+                        self.processed_samples += x.shape[0]
+                        self._last_batch = (y, out)
+                        nbatch += 1
+                        fire("batch_end")
+                        if batches is not None and nbatch >= batches:
+                            break
                 self.train_loss /= max(nbatch, 1)
                 if val_data is not None:
                     self.val_metrics = self.evaluate(val_data)
@@ -182,9 +207,62 @@ class Estimator:
         fire("train_end")
         return self
 
+    # ------------------------------------------------------------------
+    # compiled-loop mode (parallel.CompiledLoop; docs/performance.md)
+    def _build_compiled_loop(self, loop_steps):
+        from ...optimizer.fused import functional_twin
+        from ...parallel import CompiledLoop, make_mesh
+        self.compiled_loop = CompiledLoop(
+            self.net, self.loss,
+            functional_twin(self.trainer._optimizer),
+            loop_steps=loop_steps,
+            skip_nonfinite=bool(getattr(self.trainer, "_skip_nonfinite",
+                                        False)),
+            mesh=make_mesh({"data": 1}))
+        return self.compiled_loop
+
+    def _run_epoch_loop(self, train_data, batches, loop_steps):
+        from ... import autograd as _ag
+        gen = self._batches(train_data)
+        first = next(gen, None)
+        if first is None:
+            return 0
+        if self.compiled_loop is None:
+            try:
+                self._build_compiled_loop(loop_steps)
+            except MXNetError:
+                # deferred shapes: settle with one paused forward, then
+                # build for real (any other config error re-raises below)
+                with _ag.pause():
+                    self.net(first[0])
+                self._build_compiled_loop(loop_steps)
+        loop = self.compiled_loop
+        sizes = []
+
+        def stream():
+            x, y = first
+            while True:
+                sizes.append(int(x.shape[0]))
+                yield (x, y)
+                nxt = next(gen, None)
+                if nxt is None:
+                    return
+                x, y = nxt
+
+        losses = loop.run(stream(), steps=batches)
+        n = int(losses.shape[0])
+        self.processed_samples += sum(sizes[:n])
+        # sum of per-step mean losses: fit() divides by nbatch, matching
+        # the eager path's mean-of-batch-means
+        self.train_loss = float(losses.sum())
+        loop.sync_to_block()
+        return n
+
 
 class _MetricUpdater(BatchEnd):
     def batch_end(self, estimator):
+        if getattr(estimator, "_last_batch", None) is None:
+            return    # compiled-loop mode: no per-batch host boundary
         y, out = estimator._last_batch
         for m in estimator.train_metrics:
             m.update([y], [out])
@@ -243,22 +321,36 @@ class CheckpointHandler(TrainBegin, EpochEnd):
         if not self._resume:
             return
         scaler = getattr(estimator.trainer, "_amp_loss_scaler", None)
+        # in compiled-loop mode the loop owns optimizer state + step
+        # counter; its states were what epoch_end saved
+        loop = getattr(estimator, "compiled_loop", None)
         step = self._ckpt.restore_into(
             params=estimator.net.collect_params(),
-            trainer=estimator.trainer,
+            trainer=loop or estimator.trainer,
             scaler=scaler)
         if step is not None:
             # checkpoints are stamped with the epoch they finished —
             # resume at the next one
             estimator.resume_from_epoch = step + 1
+            if loop is not None:
+                loop.reload_params()
 
     def epoch_end(self, estimator):
-        params = {k: p.data() for k, p in
-                  estimator.net.collect_params().items()}
+        loop = getattr(estimator, "compiled_loop", None)
+        if loop is not None:
+            # loop mode: current values live on the loop (sync_to_block
+            # already mirrored them to the net); save its states so the
+            # in-scan step counter + optimizer state resume exactly
+            params = dict(loop.params)
+            target = loop
+        else:
+            params = {k: p.data() for k, p in
+                      estimator.net.collect_params().items()}
+            target = estimator.trainer
         if self._save_states:
             self._ckpt.save(
                 estimator.current_epoch, params,
-                trainer=estimator.trainer,
+                trainer=target,
                 scaler=getattr(estimator.trainer, "_amp_loss_scaler", None),
                 epoch=estimator.current_epoch)
         else:

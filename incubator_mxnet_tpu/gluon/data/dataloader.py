@@ -139,6 +139,17 @@ class DataLoader:
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    def prefetch_to_device(self, buffers=None, placement=None):
+        """Wrap this loader in an :class:`io.prefetch.DevicePrefetcher`:
+        a background thread stages fetch AND h2d transfer ``buffers``
+        batches ahead (``MXNET_PREFETCH_BUFFERS``, default 2), so batch
+        i+1 lands on device while batch i computes.  ``placement`` maps
+        each array to its device form (e.g. a trainer's mesh sharding);
+        default plain ``jax.device_put``.  See docs/performance.md."""
+        from ...io.prefetch import DevicePrefetcher
+        return DevicePrefetcher(self, buffers=buffers,
+                                placement=placement)
+
     def __iter__(self):
         it = self._iter_impl()
         observe = bool(_telemetry.DATALOADER.subscribers)

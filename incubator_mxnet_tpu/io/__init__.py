@@ -7,9 +7,10 @@ from .recordio import (MXRecordIO, MXIndexedRecordIO, IndexedRecordIO,
                        IRHeader, pack, unpack, pack_img, unpack_img)
 from .image_iter import ImageRecordIter
 from .text_iters import CSVIter, LibSVMIter, MNISTIter
+from .prefetch import DevicePrefetcher
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter",
            "ResizeIter", "PrefetchingIter", "recordio", "MXRecordIO",
            "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader", "pack",
            "unpack", "pack_img", "unpack_img", "ImageRecordIter",
-           "CSVIter", "LibSVMIter", "MNISTIter"]
+           "CSVIter", "LibSVMIter", "MNISTIter", "DevicePrefetcher"]

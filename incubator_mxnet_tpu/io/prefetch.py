@@ -1,0 +1,240 @@
+"""Double-buffered device-side prefetch for the training loop.
+
+``DevicePrefetcher`` wraps any batch iterable (a gluon ``DataLoader``, a
+module-1 ``DataIter`` adapter, a generator) with a background thread
+that runs fetch AND the h2d transfer ahead of the consumer, so batch
+i+1 is already on device while batch i computes.  The depth (number of
+staged batches) defaults to ``MXNET_PREFETCH_BUFFERS`` (2 — classic
+double buffering).
+
+Fault semantics (``fault.py`` sites ``dataloader.fetch`` and
+``prefetch.h2d``): transient errors — injected or real — are absorbed by
+``retry_call``; when retries exhaust, the pipeline DEGRADES to blocking
+in-order fetch on the consumer thread instead of deadlocking or dropping
+a batch.  The invariant making that safe: the worker polls the
+fault-injection site BEFORE consuming from the upstream iterator, so a
+failed attempt never loses a batch, and the degrade marker rides the
+same FIFO queue as the data, so order is preserved to the batch.
+
+This is the reference's ``io.PrefetchingIter`` / dataloader pin_memory
+idea rebuilt for an accelerator runtime: what is staged ahead is not a
+host tensor but the DEVICE-resident (optionally mesh-sharded, via
+``placement=``) batch.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time as _time
+
+from ..base import getenv_int
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+
+__all__ = ["DevicePrefetcher"]
+
+_OK, _END, _ERR, _DEGRADE = 0, 1, 2, 3
+
+
+class _UpstreamError(Exception):
+    """An error raised from INSIDE the upstream iterator (as opposed to
+    the prefetcher's own injection poll).  Deliberately NOT transient: a
+    generator that raised is dead, so a retry would ``next()`` a dead
+    iterator and silently truncate the stream — propagate to the
+    consumer instead (the in-process DataLoader's documented
+    behavior)."""
+
+    def __init__(self, orig):
+        super().__init__(str(orig))
+        self.orig = orig
+
+
+def _unwrap(a):
+    from ..ndarray.ndarray import NDArray
+    return a._data if isinstance(a, NDArray) else a
+
+
+class DevicePrefetcher:
+    """Iterate ``source`` with fetch + h2d staged ``buffers`` deep.
+
+    ``placement`` is an optional callable applied to every array of a
+    batch (e.g. ``SPMDTrainer._shard_batch`` for mesh sharding); default
+    is a plain ``jax.device_put``.  Close (or exhaust) the iterator to
+    join the worker; it is also a context manager.
+    """
+
+    def __init__(self, source, placement=None, buffers=None,
+                 fetch_site="dataloader.fetch", h2d_site="prefetch.h2d"):
+        self._it = iter(source)
+        self._placement = placement
+        self._buffers = int(buffers) if buffers is not None \
+            else max(getenv_int("MXNET_PREFETCH_BUFFERS", 2), 1)
+        self._fetch_site = fetch_site
+        self._h2d_site = h2d_site
+        self._q = _queue.Queue(maxsize=self._buffers)
+        self._stop = threading.Event()
+        self._degraded = False
+        self._batches = 0
+        self._wait_seconds = 0.0
+        self._thread = threading.Thread(
+            target=self._worker, name="mxtpu-prefetch", daemon=True)
+        self._thread.start()
+
+    # -------------------------------------------------- worker side
+    def _fetch_upstream(self):
+        # poll the injection site BEFORE touching the iterator: a raised
+        # fault then costs a retry, never a batch
+        _fault.inject(self._fetch_site)
+        try:
+            return next(self._it)
+        except StopIteration:
+            raise
+        except Exception as e:
+            raise _UpstreamError(e) from e
+
+    def _place(self, batch):
+        single = not isinstance(batch, (tuple, list))
+        arrs = (batch,) if single else tuple(batch)
+        if self._placement is not None:
+            placed = tuple(self._placement(a) for a in arrs)
+        else:
+            import jax
+            placed = tuple(jax.device_put(_unwrap(a)) for a in arrs)
+        return placed[0] if single else placed
+
+    def _transfer(self, batch):
+        _fault.inject(self._h2d_site)
+        placed = self._place(batch)
+        if _telemetry.TRANSFER.subscribers:
+            arrs = placed if isinstance(placed, tuple) else (placed,)
+            nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrs)
+            _telemetry.TRANSFER.publish(direction="h2d", nbytes=nbytes)
+        return placed
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                batch = _fault.retry_call(self._fetch_upstream,
+                                          site=self._fetch_site)
+            except StopIteration:
+                self._put((_END, None))
+                return
+            except _UpstreamError as e:
+                self._put((_ERR, e.orig))
+                return
+            except _fault.TRANSIENT:
+                # retries exhausted: hand the iterator back to the
+                # consumer for blocking in-order fetch (no batch was
+                # consumed — inject precedes next())
+                self._put((_DEGRADE, None))
+                return
+            except Exception as e:          # real upstream bug
+                self._put((_ERR, e))
+                return
+            try:
+                placed = _fault.retry_call(self._transfer, batch,
+                                           site=self._h2d_site)
+            except _fault.TRANSIENT:
+                # the batch IS fetched but not transferred — ship it raw
+                # so the consumer places it synchronously, in order
+                self._put((_DEGRADE, batch))
+                return
+            except Exception as e:
+                self._put((_ERR, e))
+                return
+            if not self._put((_OK, placed)):
+                return
+
+    # -------------------------------------------------- consumer side
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._degraded:
+            return self._fetch_blocking()
+        t0 = _time.perf_counter()
+        with _telemetry.trace_span("prefetch.wait", cat="dataloader"):
+            while True:
+                try:
+                    tag, payload = self._q.get(timeout=0.5)
+                    break
+                except _queue.Empty:
+                    if self._thread is None or \
+                            not self._thread.is_alive():
+                        # worker died without a terminal marker —
+                        # degrade rather than deadlock
+                        tag, payload = _DEGRADE, None
+                        break
+        wait = _time.perf_counter() - t0
+        self._wait_seconds += wait
+        if _telemetry.DATALOADER.subscribers:
+            _telemetry.DATALOADER.publish(seconds=wait)
+        if tag == _OK:
+            self._batches += 1
+            return payload
+        if tag == _END:
+            self.close()
+            raise StopIteration
+        if tag == _ERR:
+            self.close()
+            raise payload
+        # _DEGRADE: continue synchronously on this thread, in order
+        self._degraded = True
+        _telemetry.FAULT.publish(
+            site=self._h2d_site if payload is not None
+            else self._fetch_site, event="fallback")
+        if payload is not None:
+            # the worker's fetched-but-untransferred batch: place it
+            # here so nothing is lost or reordered
+            self._batches += 1
+            return self._place(payload)
+        return self._fetch_blocking()
+
+    def _fetch_blocking(self):
+        try:
+            batch = _fault.retry_call(self._fetch_upstream,
+                                      site=self._fetch_site)
+        except _UpstreamError as e:
+            raise e.orig
+        placed = _fault.retry_call(self._transfer, batch,
+                                   site=self._h2d_site)
+        self._batches += 1
+        return placed
+
+    # -------------------------------------------------- lifecycle
+    def close(self):
+        """Stop the worker and join it; idempotent."""
+        self._stop.set()
+        # drain so a worker blocked on put() observes the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def stats(self):
+        """{'batches', 'wait_seconds', 'degraded', 'buffers'} — consumer
+        wait_seconds ≈ 0 means fetch+h2d fully overlapped compute."""
+        return {"batches": self._batches,
+                "wait_seconds": round(self._wait_seconds, 6),
+                "degraded": self._degraded,
+                "buffers": self._buffers}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -55,7 +55,7 @@ from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, Updater
 
-__all__ = ["FusedUpdater"]
+__all__ = ["FusedUpdater", "functional_twin"]
 
 # exact-type table: NAG subclasses SGD but has a different rule; LARS /
 # Signum / centered-RMSProp etc. are absent → per-param fallback
@@ -65,6 +65,59 @@ _RULES = {SGD: "sgd", NAG: "nag", Adam: "adam", AdamW: "adamw",
 # rules whose eager kernel folds wd into the gradient (prep_grad) only
 # when wd != 0; adamw/adagrad apply wd decoupled, unconditionally
 _FOLD_WD = ("sgd", "nag", "adam", "rmsprop")
+
+
+def functional_twin(optimizer):
+    """A ``parallel.optim`` FunctionalOptimizer matching an eager
+    optimizer instance — the bridge CompiledLoop / SPMDTrainer use to
+    take over a model configured for the eager ``Trainer``.
+
+    Raises :class:`MXNetError` when the eager configuration carries
+    host-side per-step behavior a pure traced update cannot reproduce
+    (lr_scheduler callbacks, rescale_grad, clip_gradient, centered /
+    clip_weights RMSProp) — callers should surface that and stay on the
+    per-step path rather than silently change numerics.  Note adam's
+    bias correction rounds differently between the tiers (host doubles
+    folded into lr here vs. traced f32 in the functional core), a
+    documented ~1-ulp-class divergence; sgd/nag are bit-exact.
+    """
+    from ..base import MXNetError
+    from ..parallel import optim as _fopt   # lazy: avoids import cycle
+
+    rule = _RULES.get(type(optimizer))
+    if rule is None:
+        raise MXNetError(
+            f"no functional twin for {type(optimizer).__name__} — "
+            "pass a parallel.optim optimizer explicitly")
+    if getattr(optimizer, "lr_scheduler", None) is not None:
+        raise MXNetError(
+            "functional_twin cannot capture a host-side lr_scheduler — "
+            "pass lr_schedule= (a traced step -> lr callable) to the "
+            "functional optimizer instead")
+    if float(optimizer.rescale_grad) != 1.0:
+        raise MXNetError(
+            "functional_twin: rescale_grad != 1 has no functional "
+            "equivalent (the SPMD/loss path already means over the "
+            "batch)")
+    if optimizer.clip_gradient:
+        raise MXNetError(
+            "functional_twin: clip_gradient is not traced by the "
+            "functional cores yet")
+    kw = dict(learning_rate=optimizer.lr, wd=optimizer.wd)
+    if rule in ("sgd", "nag"):
+        kw["momentum"] = optimizer.momentum
+    elif rule in ("adam", "adamw"):
+        kw.update(beta1=optimizer.beta1, beta2=optimizer.beta2,
+                  epsilon=optimizer.epsilon)
+    elif rule == "rmsprop":
+        if optimizer.centered or optimizer.clip_weights:
+            raise MXNetError(
+                "functional_twin: centered / clip_weights RMSProp is "
+                "outside the functional envelope")
+        kw.update(gamma1=optimizer.gamma1, epsilon=optimizer.epsilon)
+    else:                                   # adagrad
+        kw["epsilon"] = optimizer.float_stable_eps
+    return _fopt.create(rule, **kw)
 
 
 def _raw_state(s):

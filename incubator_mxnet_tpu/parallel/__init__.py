@@ -19,6 +19,7 @@ from .mesh import (make_mesh, local_mesh, current_mesh, mesh_scope,
                    device_put_sharded)
 from .spmd import (SPMDTrainer, shard_params, data_sharding,
                    exact_rule, fsdp_rules)
+from .loop import CompiledLoop
 from .ring import ring_attention, local_flash_attention
 from .ulysses import ulysses_attention
 from .pipeline import (gpipe, stack_stage_params, pipe_specs,
@@ -28,7 +29,8 @@ from . import distributed
 
 __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "replicated", "shard_spec", "named_sharding",
-           "device_put_sharded", "SPMDTrainer", "shard_params", "fsdp_rules",
+           "device_put_sharded", "SPMDTrainer", "CompiledLoop",
+           "shard_params", "fsdp_rules",
            "data_sharding", "exact_rule", "ring_attention",
            "local_flash_attention", "ulysses_attention", "gpipe",
            "stack_stage_params", "pipe_specs", "stack_block_stages",

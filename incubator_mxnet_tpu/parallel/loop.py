@@ -1,0 +1,282 @@
+"""CompiledLoop: k train steps captured as ONE donated XLA program.
+
+A per-step trainer (SPMDTrainer, or the eager Trainer with the fused
+optimizer) still pays several host round-trips per step: batch placement,
+forward/backward dispatch, optimizer dispatch, loss readback.  PyGraph
+(PAPERS.md, arXiv:2503.19779) shows that capturing the FULL iteration —
+not just its kernels — is where the remaining launch overhead goes.
+``CompiledLoop`` does that capture with ``lax.scan``:
+
+* loss + grad + functional optimizer update for ``k`` consecutive steps
+  trace into one jit program (``donate_argnums=(0, 1, 2)``), so a k-step
+  chunk is a SINGLE dispatch;
+* lr/wd schedules receive the traced per-inner-step counter, so warmup /
+  decay curves are exact inside the chunk, not frozen at its boundary;
+* the per-step host RNG keys are stacked into the scan's xs — a chunk
+  consumes the IDENTICAL ``random.new_key()`` stream as k separate
+  ``SPMDTrainer.step`` calls, which is what makes chunking invariant
+  (bit-identical params for any k) and mid-chunk resume possible;
+* with ``skip_nonfinite=True`` the non-finite guard (PR 3/4 semantics)
+  runs INSIDE the scan: a step whose gradients contain NaN/Inf leaves
+  params and optimizer state untouched, and a device-side skipped-step
+  counter is surfaced once per chunk — drained asynchronously, published
+  as FAULT ``skipped_step`` events, never a host sync on the hot path.
+
+Pair with :class:`~incubator_mxnet_tpu.io.prefetch.DevicePrefetcher`
+(``run(..., prefetch=True)`` does it for you) so fetch + h2d of batch
+i+1 overlap compute of batch i; the host then blocks only at epoch and
+checkpoint boundaries.
+
+Checkpoint/resume: ``get_states``/``set_states`` round-trip the step
+counter, skipped-step count and optimizer state through
+``AsyncCheckpointer`` exactly like the eager Trainer, and the manifest's
+RNG snapshot keeps the key stream aligned, so a run checkpointed
+mid-chunk (say step 6 of k=4 chunks) resumes bit-identically.
+"""
+from __future__ import annotations
+
+import pickle
+import time as _time
+
+from ..base import MXNetError, getenv_int
+from .. import telemetry as _telemetry
+from .spmd import SPMDTrainer, _fetch_full, _placed_copy
+
+__all__ = ["CompiledLoop"]
+
+
+class CompiledLoop(SPMDTrainer):
+    """Scan ``loop_steps`` train steps into one donated program.
+
+    Usage::
+
+        loop = CompiledLoop(net, loss_fn, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            loop_steps=8, mesh=mesh)
+        losses = loop.run(loader)       # prefetch + chunked dispatch
+        loop.sync_to_block()
+
+    Or drive chunks by hand with :meth:`step_chunk`.  ``step`` (inherited)
+    still works and stays bit-compatible: a k-chunk equals k single steps.
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 loop_steps=None, skip_nonfinite=False, **kwargs):
+        super().__init__(net, loss_fn, optimizer, optimizer_params,
+                         **kwargs)
+        if self._accum != 1:
+            raise MXNetError(
+                "CompiledLoop does not compose with accum_steps yet — "
+                "fold the accumulation into loop_steps instead")
+        self.loop_steps = int(loop_steps) if loop_steps is not None \
+            else getenv_int("MXNET_LOOP_STEPS", 8)
+        if self.loop_steps < 1:
+            raise MXNetError(
+                f"loop_steps={self.loop_steps} must be >= 1")
+        self._skip_nonfinite = bool(skip_nonfinite)
+        self._skipped_total = 0
+        # device-side int32 skip counters, one per guarded chunk, drained
+        # when ready (is_ready) — no host sync on the hot path
+        self._pending_skipped = []
+        self._chunk_cache = {}
+
+    # ------------------------------------------------------------------
+    def _build_chunk(self, kc, nb):
+        import jax
+        import jax.numpy as jnp
+        from ..contrib.amp.loss_scaler import all_finite_flag
+        opt = self._opt
+        grad_of = self._make_grad_fn()
+        guard = self._skip_nonfinite
+
+        def body(carry, x):
+            tr, aux, opt_state, step, skipped = carry
+            rng = x[0]
+            *xs, label = x[1:]
+            step = step + 1
+            loss, new_aux, grads = grad_of(tr, aux, rng, xs, label)
+            new_tr, new_opt = opt.update(tr, grads, opt_state, step)
+            new_aux = tuple(new_aux)
+            if guard:
+                # PR 3/4 guard semantics inside the scan: non-finite
+                # grads leave params/opt/aux untouched; the step counter
+                # still advances (documented fused-path behavior)
+                flag = all_finite_flag(jax.tree.leaves(grads))
+                if flag is not None:
+                    ok = flag
+                    keep = lambda new, old: jax.tree.map(
+                        lambda a, b: jnp.where(ok, a, b), new, old)
+                    new_tr = keep(new_tr, tr)
+                    new_opt = keep(new_opt, opt_state)
+                    new_aux = keep(new_aux, tuple(aux))
+                    skipped = skipped + jnp.where(ok, 0, 1).astype(
+                        jnp.int32)
+            return (new_tr, new_aux, new_opt, step, skipped), loss
+
+        def pure_chunk(tr_vals, aux_vals, opt_state, step0, rngs, *flat):
+            # stack the kc per-step batches step-major INSIDE the
+            # program: inputs arrive individually placed (so the data
+            # axis stays sharded) and the stack fuses into the scan
+            xs = tuple(
+                jnp.stack([flat[i * nb + j] for i in range(kc)])
+                for j in range(nb))
+            carry = (tr_vals, tuple(aux_vals), opt_state, step0,
+                     jnp.zeros((), jnp.int32))
+            (new_tr, new_aux, new_opt, _, skipped), losses = jax.lax.scan(
+                body, carry, (rngs,) + xs)
+            return losses, new_tr, new_aux, new_opt, skipped
+
+        donate = (0, 1, 2) if self._donate else ()
+        return _telemetry.instrument_jit("loop", jax.jit(
+            pure_chunk,
+            out_shardings=(None, self._tr_shardings, self._aux_shardings,
+                           self._opt_state_shardings, None),
+            donate_argnums=donate))
+
+    # ------------------------------------------------------------------
+    def step_chunk(self, batches):
+        """Run ``len(batches)`` consecutive train steps as ONE compiled
+        dispatch.  ``batches`` is a sequence of per-step batch tuples
+        (the same ``*batch`` arguments :meth:`step` takes, uniform
+        shapes).  Returns the [k]-shaped per-step loss array
+        (non-blocking — async dispatch)."""
+        from .. import random as _random
+        import jax.numpy as jnp
+        kc = len(batches)
+        if kc == 0:
+            raise MXNetError("step_chunk needs at least one batch")
+        nb = len(batches[0])
+        observe = bool(_telemetry.TRAINER.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
+        with _telemetry.trace_span("loop.chunk", cat="trainer"):
+            with _telemetry.trace_span("loop.place", cat="transfer"):
+                flat = tuple(self._shard_batch(b)
+                             for bt in batches for b in bt)
+            # one host key per inner step — the SAME stream k separate
+            # step() calls would consume (chunking invariance + resume)
+            rngs = jnp.stack([_random.new_key() for _ in range(kc)])
+            key = (kc, nb) + self._build_key(flat)
+            if key not in self._chunk_cache:
+                self._chunk_cache[key] = self._build_chunk(kc, nb)
+            step0 = jnp.asarray(self._step_count, jnp.int32)
+            losses, self._tr_vals, self._aux_vals, self._opt_state, \
+                skipped = self._chunk_cache[key](
+                    self._tr_vals, self._aux_vals, self._opt_state,
+                    step0, rngs, *flat)
+        self._step_count += kc
+        if self._skip_nonfinite:
+            self._pending_skipped.append(skipped)
+            self._drain_skipped(block=False)
+        if observe:
+            dt = _time.perf_counter() - t0
+            _telemetry.TRAINER.publish(phase="step", seconds=dt,
+                                       steps=kc)
+            _telemetry.TRAINER.publish(phase="chunk", seconds=dt,
+                                       steps=kc)
+        return losses
+
+    def run(self, data, steps=None, prefetch=True, buffers=None):
+        """Drive chunked training over an iterable of batch tuples.
+
+        ``data`` is any iterable yielding per-step batch tuples (a
+        DataLoader, a generator, a list, or an already-built
+        :class:`DevicePrefetcher`).  With ``prefetch=True`` (default) the
+        iterable is wrapped in a DevicePrefetcher so fetch + h2d of the
+        next batches overlap the current chunk's compute.  ``steps``
+        caps the number of train steps (None = until exhausted); a
+        short tail runs as a smaller chunk.  Returns the numpy array of
+        per-step losses (the ONLY host sync, at the very end)."""
+        import numpy as _np
+        from ..io.prefetch import DevicePrefetcher
+        owned = None
+        if prefetch and not isinstance(data, DevicePrefetcher):
+            owned = DevicePrefetcher(data, placement=self._shard_batch,
+                                     buffers=buffers)
+            source = iter(owned)
+        else:
+            source = iter(data)
+        losses = []
+        done = 0
+        try:
+            while steps is None or done < steps:
+                want = self.loop_steps if steps is None \
+                    else min(self.loop_steps, steps - done)
+                chunk = []
+                with _telemetry.trace_span("loop.next_batch",
+                                           cat="dataloader"):
+                    for _ in range(want):
+                        try:
+                            chunk.append(next(source))
+                        except StopIteration:
+                            break
+                if not chunk:
+                    break
+                losses.append(self.step_chunk(chunk))
+                done += len(chunk)
+        finally:
+            if owned is not None:
+                owned.close()
+        if self._skip_nonfinite:
+            self.sync_nonfinite_guard()
+        if not losses:
+            return _np.zeros((0,), _np.float32)
+        return _np.concatenate([_np.asarray(x) for x in losses])
+
+    # ------------------------------------------------------------------
+    # non-finite guard surfacing (chunk-boundary reductions, PR 3/4)
+    def _drain_skipped(self, block=False):
+        rest = []
+        for flag in self._pending_skipped:
+            if block or flag.is_ready():
+                n = int(flag)
+                if n:
+                    self._skipped_total += n
+                    for _ in range(n):
+                        _telemetry.FAULT.publish(site="loop.step",
+                                                 event="skipped_step")
+            else:
+                rest.append(flag)
+        self._pending_skipped = rest
+
+    def sync_nonfinite_guard(self):
+        """Block until every pending per-chunk skip counter is drained;
+        returns the total skipped steps so far."""
+        self._drain_skipped(block=True)
+        return self._skipped_total
+
+    @property
+    def skipped_steps(self):
+        """Skipped (non-finite) steps drained so far — exact after
+        :meth:`sync_nonfinite_guard`."""
+        return self._skipped_total
+
+    # ------------------------------------------------------------------
+    # checkpoint integration (AsyncCheckpointer trainer= protocol)
+    def get_states(self):
+        """Serialize loop progress + optimizer state for
+        ``AsyncCheckpointer.save(..., trainer=loop)``."""
+        import jax
+        self._drain_skipped(block=True)
+        tree = jax.tree.map(_fetch_full, self._opt_state)
+        return pickle.dumps({"loop": 1,
+                             "step": self._step_count,
+                             "skipped": self._skipped_total,
+                             "opt_state": tree})
+
+    def set_states(self, data):
+        """Restore loop progress + optimizer state (counterpart of
+        :meth:`get_states`; ``restore_into(..., trainer=loop)`` calls
+        this).  Pair with :meth:`reload_params` after the checkpoint
+        wrote the restored arrays into the net."""
+        import jax
+        st = pickle.loads(data)
+        if not isinstance(st, dict) or st.get("loop") != 1:
+            raise MXNetError(
+                "checkpoint trainer states are not a CompiledLoop blob "
+                "(saved from a different trainer type?)")
+        self._step_count = int(st["step"])
+        self._skipped_total = int(st.get("skipped", 0))
+        self._pending_skipped = []
+        self._opt_state = jax.tree.map(
+            lambda old, new: _placed_copy(new, old.sharding),
+            self._opt_state, st["opt_state"])

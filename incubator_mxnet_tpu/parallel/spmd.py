@@ -48,6 +48,17 @@ def _fetch_full(v):
     return _np.asarray(multihost_utils.process_allgather(v, tiled=True))
 
 
+def _placed_copy(x, s):
+    """Place ``x`` per sharding ``s`` as a FRESH buffer.  device_put may
+    ALIAS the input (even via a distinct Array object) when placement
+    already matches — a later donated step would then delete the source
+    array; always copy so the source stays usable (the copy is reclaimed
+    by donation on the first step)."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.copy(jax.device_put(x, s))
+
+
 def exact_rule(param, spec):
     """One exact-name sharding rule ``("^<name>$", spec)`` for a
     Parameter (or anything with ``.name``) — the building block every
@@ -223,21 +234,13 @@ class SPMDTrainer:
                                    for p in self._trainable)
         self._aux_shardings = tuple(shardings[p.name] for p in self._aux)
 
-        # place parameter values on the mesh per their shardings.
-        # device_put may ALIAS the input buffer (even via a distinct Array
-        # object) when placement already matches — a later donated step
-        # would then delete the Block's own parameter array; always copy
-        # so the Block stays usable (the copy is reclaimed by donation on
-        # the first step)
-        def placed_copy(x, s):
-            import jax.numpy as jnp
-            return jnp.copy(jax.device_put(x, s))
-
+        # place parameter values on the mesh per their shardings (see
+        # _placed_copy for why a fresh buffer is mandatory here)
         self._tr_vals = tuple(
-            placed_copy(p.data()._data, s)
+            _placed_copy(p.data()._data, s)
             for p, s in zip(self._trainable, self._tr_shardings))
         self._aux_vals = tuple(
-            placed_copy(p.data()._data, s)
+            _placed_copy(p.data()._data, s)
             for p, s in zip(self._aux, self._aux_shardings))
         # zeros_like inside opt.init makes each state leaf inherit its
         # param's sharding (XLA propagates NamedSharding through zeros_like)
@@ -301,13 +304,13 @@ class SPMDTrainer:
         return {p.name: v
                 for p, v in zip(self._trainable, self._tr_vals)}
 
-    def _build_step(self):
-        import jax
+    def _make_loss_of(self):
+        """The per-(micro)batch loss as a pure function of trainable and
+        aux values — the trace core shared by the per-step program, the
+        accumulation scan, and CompiledLoop's k-step chunk program."""
         import jax.numpy as jnp
-        net, loss_blk, opt = self._net, self._loss, self._opt
+        net, loss_blk = self._net, self._loss
         trainable, aux = self._trainable, self._aux
-
-        k = self._accum
 
         def loss_of(tr, aux_cur, rng_i, xs, label):
             nds = [NDArray(b) for b in xs]
@@ -323,8 +326,21 @@ class SPMDTrainer:
             loss = jnp.mean(loss_nd._data)
             return loss, tuple(new_aux)
 
-        def pure_step(tr_vals, aux_vals, opt_state, step, rng, *batch):
-            *xs, label = batch
+        return loss_of
+
+    def _make_grad_fn(self):
+        """loss+grad of one FULL batch (microbatch-accumulated when
+        accum_steps > 1) as a pure function
+        ``grad_of(tr_vals, aux_vals, rng, xs, label) ->
+        (loss, new_aux, grads)`` — everything in a train step except the
+        optimizer update, so per-step and k-step-chunk programs share one
+        definition."""
+        import jax
+        import jax.numpy as jnp
+        loss_of = self._make_loss_of()
+        k = self._accum
+
+        def grad_of(tr_vals, aux_vals, rng, xs, label):
             if k == 1:
                 (loss, new_aux), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(tr_vals, aux_vals, rng, xs,
@@ -361,6 +377,19 @@ class SPMDTrainer:
                     tuple(xs_mb) + (label_mb,))
                 grads = jax.tree.map(lambda g: g / k, g_sum)
                 loss = loss_sum / k
+            return loss, new_aux, grads
+
+        return grad_of
+
+    def _build_step(self):
+        import jax
+        opt = self._opt
+        grad_of = self._make_grad_fn()
+
+        def pure_step(tr_vals, aux_vals, opt_state, step, rng, *batch):
+            *xs, label = batch
+            loss, new_aux, grads = grad_of(tr_vals, aux_vals, rng, xs,
+                                           label)
             new_tr, new_opt = opt.update(tr_vals, grads, opt_state, step)
             return loss, new_tr, new_aux, new_opt
 
@@ -437,3 +466,16 @@ class SPMDTrainer:
         for p, v in zip(self._aux, self._aux_vals):
             dev = p.data().ctx.jax_device()
             p._data._set_data(jax.device_put(fetch(v), dev))
+
+    def reload_params(self):
+        """Re-place parameter/aux values from the Block's current
+        Parameters — the inverse of :meth:`sync_to_block`, used after a
+        checkpoint restore wrote fresh arrays into the net
+        (``AsyncCheckpointer.restore_into``) so the compiled step resumes
+        from the restored weights."""
+        self._tr_vals = tuple(
+            _placed_copy(p.data()._data, s)
+            for p, s in zip(self._trainable, self._tr_shardings))
+        self._aux_vals = tuple(
+            _placed_copy(p.data()._data, s)
+            for p, s in zip(self._aux, self._aux_shardings))

@@ -1110,6 +1110,14 @@ def _metrics_init():
                                   "optimizer-update dispatches in the "
                                   "last trainer step (1 = fused; "
                                   "num_params = per-param loop)")
+    _m["loop_chunks"] = c("mxtpu_loop_chunks",
+                          "CompiledLoop chunk dispatches (one donated "
+                          "scanned program per k-step chunk)")
+    _m["loop_chunk_seconds"] = h("mxtpu_loop_chunk_seconds",
+                                 "CompiledLoop chunk dispatch seconds")
+    _m["loop_steps_per_chunk"] = g("mxtpu_loop_steps_per_chunk",
+                                   "train steps folded into the last "
+                                   "CompiledLoop chunk")
 
 
 _op_keys: Dict[str, tuple] = {}   # op name -> label key, spares the hot
@@ -1175,17 +1183,22 @@ def _on_xla_cost(where="?", flops=0.0, nbytes=0.0):
         _m["xla_bytes"].inc(nbytes, site=where)
 
 
-def _on_trainer(phase="step", seconds=0.0):
+def _on_trainer(phase="step", seconds=0.0, steps=1):
     if phase == "step":
-        _m["steps"].inc()
-        _m["step_seconds"].observe(seconds)
+        # steps > 1: a CompiledLoop chunk — k inner steps behind ONE
+        # boundary.  Counters advance by k and per-step attribution
+        # divides the window evenly; MFU itself is a window ratio, so
+        # the formula is unchanged.
+        n = max(int(steps), 1)
+        _m["steps"].inc(n)
+        _m["step_seconds"].observe(seconds / n)
         now = time.perf_counter()
         last_t = _mfu["last_t"]
         if last_t is not None and now > last_t:
             wall = now - last_t
             dflops = _mfu["flops"] - _mfu["last_flops"]
-            _m["step_wall"].observe(wall)
-            _m["step_flops"].set(dflops)
+            _m["step_wall"].observe(wall / n)
+            _m["step_flops"].set(dflops / n)
             peak = _mfu["peak"]
             if peak is None:
                 peak = _mfu["peak"] = device_peak_flops() or 0.0
@@ -1194,6 +1207,10 @@ def _on_trainer(phase="step", seconds=0.0):
                 _m["mfu"].set(dflops / wall / peak)
         _mfu["last_t"] = now
         _mfu["last_flops"] = _mfu["flops"]
+    elif phase == "chunk":
+        _m["loop_chunks"].inc()
+        _m["loop_chunk_seconds"].observe(seconds)
+        _m["loop_steps_per_chunk"].set(max(int(steps), 1))
     else:
         _m["update_seconds"].observe(seconds)
 

@@ -1,0 +1,422 @@
+"""CompiledLoop (k-step lax.scan whole-step capture) + DevicePrefetcher.
+
+Covers the PR's contract: chunking invariance (bit-identical params for
+k in {1, 4} vs the per-step SPMD path), per-inner-step lr schedules,
+the in-scan non-finite guard (poisoned batch skipped exactly once),
+mid-chunk checkpoint/resume, prefetch order + fault degradation
+(latency / ioerror / retry-exhaustion at both the fetch and h2d sites),
+loop telemetry (one dispatch per chunk, MFU), and estimator loop mode."""
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, parallel, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.checkpoint import AsyncCheckpointer
+from incubator_mxnet_tpu.gluon import loss as gloss, nn
+from incubator_mxnet_tpu.io.prefetch import DevicePrefetcher
+from incubator_mxnet_tpu.parallel.loop import CompiledLoop
+
+OPT = {"learning_rate": 0.1, "momentum": 0.9}
+
+
+def _mesh():
+    import jax
+    return parallel.make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+
+def _net(prefix, seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8, activation="relu"))
+        net.add(nn.Dense(4, in_units=16))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def _train_batches(n, b=8):
+    rng = np.random.default_rng(0)
+    return [(rng.standard_normal((b, 8)).astype(np.float32),
+             rng.standard_normal((b, 4)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _params(trainer):
+    # strip the per-instance prefix so runs over distinct nets compare
+    return {n.split("_", 1)[1]: np.asarray(v)
+            for n, v in trainer.params.items()}
+
+
+# ---------------------------------------------------------------- parity
+def test_loop_matches_per_step_bitwise():
+    """k-chunked capture is invariant: k in {1, 4} both bit-match the
+    per-step SPMD dispatch after 8 steps."""
+    batches = _train_batches(8)
+    mesh = _mesh()
+    net = _net("ps_")
+    mx.random.seed(7)
+    tr = parallel.SPMDTrainer(net, gloss.L2Loss(), "sgd", OPT, mesh=mesh)
+    for x, y in batches:
+        tr.step(x, y)
+    ref = _params(tr)
+    for k in (1, 4):
+        netk = _net(f"pl{k}_")
+        mx.random.seed(7)
+        loop = CompiledLoop(netk, gloss.L2Loss(), "sgd", OPT,
+                            loop_steps=k, mesh=mesh)
+        losses = loop.run(batches, prefetch=False)
+        assert losses.shape == (8,) and np.isfinite(losses).all()
+        got = _params(loop)
+        for name in ref:
+            assert np.array_equal(ref[name], got[name]), (k, name)
+
+
+def test_loop_short_tail_and_prefetched_run():
+    """steps cap + a tail shorter than loop_steps + prefetch=True all
+    produce the same params as unchunked."""
+    batches = _train_batches(7)
+    mesh = _mesh()
+    neta = _net("ta_")
+    mx.random.seed(7)
+    a = CompiledLoop(neta, gloss.L2Loss(), "sgd", OPT, loop_steps=1,
+                     mesh=mesh)
+    a.run(batches, prefetch=False)
+    netb = _net("tb_")
+    mx.random.seed(7)
+    b = CompiledLoop(netb, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                     mesh=mesh)
+    losses = b.run(batches, steps=7, prefetch=True)   # chunks: 4 + 3
+    assert losses.shape == (7,)
+    pa, pb = _params(a), _params(b)
+    for name in pa:
+        assert np.array_equal(pa[name], pb[name]), name
+
+
+def test_lr_schedule_traced_per_inner_step():
+    """A schedule of the traced step counter varies INSIDE a chunk:
+    k=4 still bit-matches k=1 (each inner step saw its own lr)."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel import optim as fopt
+    batches = _train_batches(8)
+    mesh = _mesh()
+
+    def sched(step):
+        return 0.2 / step.astype(jnp.float32)
+
+    outs = {}
+    for k in (1, 4):
+        net = _net(f"lr{k}_")
+        mx.random.seed(7)
+        loop = CompiledLoop(net, gloss.L2Loss(),
+                            fopt.sgd(momentum=0.9, lr_schedule=sched),
+                            loop_steps=k, mesh=mesh)
+        loop.run(batches, prefetch=False)
+        outs[k] = _params(loop)
+    for name in outs[1]:
+        assert np.array_equal(outs[1][name], outs[4][name]), name
+
+
+# ----------------------------------------------------- non-finite guard
+def test_poisoned_batch_skipped_exactly_once():
+    batches = _train_batches(6)
+    xb = batches[2][0].copy()
+    xb[0, 0] = np.nan
+    batches[2] = (xb, batches[2][1])
+    mesh = _mesh()
+    res = {}
+    for k in (1, 4):
+        net = _net(f"gd{k}_")
+        mx.random.seed(7)
+        loop = CompiledLoop(net, gloss.L2Loss(), "sgd", OPT, loop_steps=k,
+                            skip_nonfinite=True, mesh=mesh)
+        losses = loop.run(batches, prefetch=False)
+        assert losses.shape == (6,)
+        assert loop.sync_nonfinite_guard() == 1
+        assert loop.skipped_steps == 1
+        vals = _params(loop)
+        for v in vals.values():
+            assert np.isfinite(v).all()
+        res[k] = vals
+    for name in res[1]:
+        assert np.array_equal(res[1][name], res[4][name]), name
+    # the step counter advances even on the skipped step (documented
+    # fused-path semantics): 6 batches -> 6 steps
+    assert loop._step_count == 6
+
+
+def test_guard_publishes_skipped_step_counter():
+    telemetry.reset()
+    telemetry.start()
+    try:
+        batches = _train_batches(4)
+        xb = batches[1][0].copy()
+        xb[:] = np.inf
+        batches[1] = (xb, batches[1][1])
+        net = _net("gt_")
+        mx.random.seed(7)
+        loop = CompiledLoop(net, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                            skip_nonfinite=True, mesh=_mesh())
+        loop.run(batches, prefetch=False)
+        assert telemetry.counters_flat().get(
+            "mxtpu_skipped_steps", 0) == 1
+    finally:
+        telemetry.stop()
+        telemetry.reset()
+
+
+# ------------------------------------------------- checkpoint / resume
+def test_checkpoint_resume_mid_chunk(tmp_path):
+    """Checkpoint at step 6 of a k=4 run (a mid-chunk boundary: chunks
+    ran 4+2) restores into a FRESH differently-initialized net and
+    finishes bit-identical to the uninterrupted run — params, optimizer
+    momentum, step counter, and RNG stream all round-trip."""
+    batches = _train_batches(10)
+    mesh = _mesh()
+    netA = _net("ck_", seed=0)
+    mx.random.seed(7)
+    loopA = CompiledLoop(netA, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                         mesh=mesh)
+    loopA.run(batches, prefetch=False)
+    golden = {n: np.asarray(v) for n, v in loopA.params.items()}
+
+    netB = _net("ck_", seed=0)       # explicit prefix => same param names
+    mx.random.seed(7)
+    loopB = CompiledLoop(netB, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                         mesh=mesh)
+    loopB.run(batches[:6], prefetch=False)
+    ck = AsyncCheckpointer(str(tmp_path / "ck"))
+    ck.save_sync(6, dict(loopB.params), trainer=loopB, epoch=0)
+
+    netC = _net("ck_", seed=3)       # different init — must not matter
+    mx.random.seed(99)               # wrong stream — restore fixes it
+    loopC = CompiledLoop(netC, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                         mesh=mesh)
+    assert ck.restore_into(params=netC.collect_params(),
+                           trainer=loopC) == 6
+    loopC.reload_params()
+    assert loopC._step_count == 6
+    loopC.run(batches[6:], prefetch=False)
+    final = {n: np.asarray(v) for n, v in loopC.params.items()}
+    for name in golden:
+        assert np.array_equal(golden[name], final[name]), name
+
+
+def test_set_states_rejects_foreign_blob():
+    net = _net("fs_")
+    loop = CompiledLoop(net, gloss.L2Loss(), "sgd", OPT, loop_steps=2,
+                        mesh=_mesh())
+    import pickle
+    with pytest.raises(MXNetError):
+        loop.set_states(pickle.dumps({"not": "a loop"}))
+
+
+# ------------------------------------------------------ functional twin
+def test_functional_twin_matches_and_guards():
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.optimizer.fused import functional_twin
+    tw = functional_twin(opt_mod.SGD(learning_rate=0.1, momentum=0.9))
+    assert callable(tw.update)
+    with pytest.raises(MXNetError):
+        functional_twin(opt_mod.SGD(rescale_grad=0.5))
+    with pytest.raises(MXNetError):
+        functional_twin(opt_mod.SGD(clip_gradient=1.0))
+    with pytest.raises(MXNetError):
+        functional_twin(opt_mod.RMSProp(centered=True))
+
+
+# -------------------------------------------------- prefetcher behavior
+def _tagged(n):
+    return [(np.full((2, 2), i, np.float32),) for i in range(n)]
+
+
+def _drain(pf):
+    return [int(np.asarray(b[0])[0, 0]) for b in pf]
+
+
+def test_prefetcher_preserves_order():
+    pf = DevicePrefetcher(iter(_tagged(20)))
+    try:
+        assert _drain(pf) == list(range(20))
+        st = pf.stats()
+        assert st["batches"] == 20 and not st["degraded"]
+    finally:
+        pf.close()
+
+
+def test_prefetcher_latency_plan_just_slows():
+    fault.install_plan("dataloader.fetch:latency:0.01@1-3")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(8)))
+        assert _drain(pf) == list(range(8))
+        assert not pf.stats()["degraded"]
+        pf.close()
+    finally:
+        fault.clear_plan()
+
+
+def test_prefetcher_ioerror_absorbed_by_retry():
+    telemetry.reset()
+    telemetry.start()
+    fault.install_plan("dataloader.fetch:ioerror@2")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(8)))
+        assert _drain(pf) == list(range(8))     # nothing lost, in order
+        assert not pf.stats()["degraded"]
+        pf.close()
+        assert telemetry.counters_flat().get("mxtpu_retries", 0) >= 1
+    finally:
+        fault.clear_plan()
+        telemetry.stop()
+        telemetry.reset()
+
+
+def test_prefetcher_fetch_giveup_degrades_to_blocking(monkeypatch):
+    """Retries exhausted at the fetch site: the worker hands the
+    iterator back; the consumer continues blocking + in-order — no
+    deadlock, no loss, no reorder."""
+    monkeypatch.setenv("MXNET_RETRY_BASE_SECONDS", "0.001")
+    fault.install_plan("dataloader.fetch:ioerror@2-8")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(10)))
+        assert _drain(pf) == list(range(10))
+        assert pf.stats()["degraded"]
+        pf.close()
+    finally:
+        fault.clear_plan()
+
+
+def test_prefetcher_h2d_giveup_keeps_fetched_batch(monkeypatch):
+    """Retries exhausted at prefetch.h2d AFTER the batch was fetched:
+    the raw batch rides the degrade marker and is placed by the
+    consumer — still no loss or reorder."""
+    monkeypatch.setenv("MXNET_RETRY_BASE_SECONDS", "0.001")
+    fault.install_plan("prefetch.h2d:ioerror@2-9")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(10)))
+        assert _drain(pf) == list(range(10))
+        assert pf.stats()["degraded"]
+        pf.close()
+    finally:
+        fault.clear_plan()
+
+
+def test_prefetcher_publishes_fallback_event(monkeypatch):
+    telemetry.reset()
+    telemetry.start()
+    monkeypatch.setenv("MXNET_RETRY_BASE_SECONDS", "0.001")
+    fault.install_plan("dataloader.fetch:ioerror@1-7")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(6)))
+        assert _drain(pf) == list(range(6))
+        pf.close()
+        flat = telemetry.counters_flat()
+        assert flat.get("mxtpu_dataloader_fallbacks", 0) >= 1
+    finally:
+        fault.clear_plan()
+        telemetry.stop()
+        telemetry.reset()
+
+
+def test_prefetcher_propagates_upstream_bug():
+    """A non-transient error raised INSIDE the iterator reaches the
+    consumer (a dead generator must not read as end-of-epoch)."""
+    def gen():
+        yield (np.zeros((2, 2), np.float32),)
+        raise ValueError("dataset bug")
+
+    pf = DevicePrefetcher(gen())
+    it = iter(pf)
+    next(it)
+    with pytest.raises(ValueError, match="dataset bug"):
+        next(it)
+    pf.close()
+
+
+def test_dataloader_prefetch_to_device():
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    xs = np.arange(32, dtype=np.float32).reshape(16, 2)
+    ys = np.arange(16, dtype=np.float32)
+    dl = DataLoader(ArrayDataset(xs, ys), batch_size=4)
+    with dl.prefetch_to_device() as pf:
+        got = [np.asarray(b[0]) for b in pf]
+    assert len(got) == 4
+    assert np.array_equal(np.concatenate(got), xs)
+
+
+# ----------------------------------------------------------- telemetry
+def test_loop_telemetry_one_dispatch_per_chunk_and_mfu():
+    telemetry.reset()
+    telemetry.start()
+    try:
+        batches = _train_batches(8)
+        net = _net("tm_")
+        mx.random.seed(7)
+        loop = CompiledLoop(net, gloss.L2Loss(), "sgd", OPT, loop_steps=4,
+                            mesh=_mesh())
+        loop.run(batches, prefetch=False)
+        flat = telemetry.counters_flat()
+        assert flat.get("mx_trainer_steps_total", 0) == 8
+        assert flat.get("mxtpu_loop_chunks", 0) == 2
+        key = (("site", "loop"),)
+        hits = telemetry.registry.get(
+            "mx_compile_cache_hits_total")._values.get(key, 0)
+        miss = telemetry.registry.get(
+            "mx_compile_cache_misses_total")._values.get(key, 0)
+        assert miss == 1 and hits + miss == 2     # ONE program, 2 chunks
+        snap = telemetry.snapshot(include_memory=False)
+        assert snap["gauges"].get("mxtpu_loop_steps_per_chunk") == 4
+        # MFU closed a window with per-inner-step FLOPs attribution
+        assert snap["gauges"].get("mxtpu_step_flops", 0) > 0
+        assert snap["gauges"].get("mxtpu_mfu", 0) > 0
+    finally:
+        telemetry.stop()
+        telemetry.reset()
+
+
+# ----------------------------------------------------------- estimator
+def test_estimator_compiled_loop_mode():
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+    net = _net("est_")
+    est = est_mod.Estimator(
+        net, gloss.L2Loss(),
+        trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                 dict(OPT)))
+    data = _train_batches(6)
+    est.fit(data, epochs=2, compiled_loop=True, loop_steps=2)
+    assert est.compiled_loop is not None
+    assert est.compiled_loop._step_count == 12      # 6 steps x 2 epochs
+    assert np.isfinite(est.train_loss)
+    assert est.processed_samples == 6 * 8 * 2
+    # sync_to_block mirrored trained values into the net
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()
+
+
+def test_estimator_loop_mode_checkpoints(tmp_path):
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+    net = _net("esc_")
+    est = est_mod.Estimator(
+        net, gloss.L2Loss(),
+        trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                 dict(OPT)))
+    h = est_mod.CheckpointHandler(str(tmp_path), save_states=True)
+    est.fit(_train_batches(4), epochs=1, event_handlers=[h],
+            compiled_loop=True, loop_steps=2)
+    h._ckpt.wait_until_finished()
+    # the saved blob restores into a fresh CompiledLoop
+    net2 = _net("esc_", seed=5)
+    loop2 = CompiledLoop(net2, gloss.L2Loss(), "sgd", OPT, loop_steps=2,
+                         mesh=_mesh())
+    step = h._ckpt.restore_into(params=net2.collect_params(),
+                                trainer=loop2)
+    assert step == 0                                # epoch stamp
+    loop2.reload_params()
+    assert loop2._step_count == 4
+    a = {n: np.asarray(v) for n, v in est.compiled_loop.params.items()}
+    b = {n.replace("esc_", "esc_", 1): np.asarray(v)
+         for n, v in loop2.params.items()}
+    for name in a:
+        assert np.array_equal(a[name], b[name]), name
