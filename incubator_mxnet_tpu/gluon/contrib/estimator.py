@@ -92,8 +92,14 @@ class Estimator:
         self.resume_from_epoch = 0
         # set when fit() runs in compiled-loop mode (fit(compiled_loop=
         # True) or MXNET_COMPILED_LOOP); handlers that touch the trainer
-        # (CheckpointHandler) retarget to it
+        # (CheckpointHandler) retarget to it.  _loop_requested is stamped
+        # by fit() BEFORE train_begin fires so a resuming handler knows
+        # loop mode is coming even though the loop itself is built
+        # lazily (a fresh process has compiled_loop=None at train_begin)
         self.compiled_loop = None
+        self._loop_requested = False
+        self._loop_steps_arg = None
+        self._loop_mesh_arg = None
         self._last_batch = None
 
     # ------------------------------------------------------------------
@@ -125,7 +131,7 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=1,
             event_handlers: Optional[List] = None, batches=None,
-            compiled_loop=None, loop_steps=None):
+            compiled_loop=None, loop_steps=None, loop_mesh=None):
         """Reference: Estimator.fit — epochs of forward/backward/step with
         handler callbacks at train/epoch/batch boundaries.
 
@@ -138,10 +144,19 @@ class Estimator:
         eager use keep working).  Per-batch handler events and train
         metrics are not fired in loop mode — there is no per-batch host
         boundary to fire them at; ``loop_steps`` sets the chunk length
-        (default ``MXNET_LOOP_STEPS``)."""
+        (default ``MXNET_LOOP_STEPS``).  The loop data-parallelizes over
+        every visible device by default (the global batch must divide by
+        ``jax.device_count()``); pass ``loop_mesh`` for a custom
+        topology, e.g. ``make_mesh({"data": 1})`` for strict parity
+        with the single-device eager Trainer."""
         from ... import autograd as _ag
         use_loop = bool(compiled_loop) if compiled_loop is not None \
             else getenv_bool("MXNET_COMPILED_LOOP", False)
+        # stamped before train_begin: a resuming CheckpointHandler must
+        # know loop mode is active while compiled_loop is still None
+        self._loop_requested = use_loop
+        self._loop_steps_arg = loop_steps
+        self._loop_mesh_arg = loop_mesh
         handlers = list(event_handlers or [])
         handlers.append(_MetricUpdater())
         # validation must stamp fresh metrics BEFORE consumers (early
@@ -175,8 +190,7 @@ class Estimator:
                 fire("epoch_begin")
                 if use_loop:
                     self._last_batch = None
-                    nbatch = self._run_epoch_loop(train_data, batches,
-                                                  loop_steps)
+                    nbatch = self._run_epoch_loop(train_data, batches)
                 else:
                     for x, y in self._batches(train_data):
                         fire("batch_begin")
@@ -209,19 +223,27 @@ class Estimator:
 
     # ------------------------------------------------------------------
     # compiled-loop mode (parallel.CompiledLoop; docs/performance.md)
-    def _build_compiled_loop(self, loop_steps):
+    def _build_compiled_loop(self):
+        import jax
         from ...optimizer.fused import functional_twin
         from ...parallel import CompiledLoop, make_mesh
+        mesh = self._loop_mesh_arg
+        if mesh is None:
+            # data-parallel over every visible device, like SPMDTrainer's
+            # documented default usage; the global batch must divide by
+            # the device count (fit(loop_mesh=make_mesh({"data": 1}))
+            # forces the single-device layout)
+            mesh = make_mesh({"data": jax.device_count()})
         self.compiled_loop = CompiledLoop(
             self.net, self.loss,
             functional_twin(self.trainer._optimizer),
-            loop_steps=loop_steps,
+            loop_steps=self._loop_steps_arg,
             skip_nonfinite=bool(getattr(self.trainer, "_skip_nonfinite",
                                         False)),
-            mesh=make_mesh({"data": 1}))
+            mesh=mesh)
         return self.compiled_loop
 
-    def _run_epoch_loop(self, train_data, batches, loop_steps):
+    def _run_epoch_loop(self, train_data, batches):
         from ... import autograd as _ag
         gen = self._batches(train_data)
         first = next(gen, None)
@@ -229,13 +251,13 @@ class Estimator:
             return 0
         if self.compiled_loop is None:
             try:
-                self._build_compiled_loop(loop_steps)
+                self._build_compiled_loop()
             except MXNetError:
                 # deferred shapes: settle with one paused forward, then
                 # build for real (any other config error re-raises below)
                 with _ag.pause():
                     self.net(first[0])
-                self._build_compiled_loop(loop_steps)
+                self._build_compiled_loop()
         loop = self.compiled_loop
         sizes = []
 
@@ -324,6 +346,22 @@ class CheckpointHandler(TrainBegin, EpochEnd):
         # in compiled-loop mode the loop owns optimizer state + step
         # counter; its states were what epoch_end saved
         loop = getattr(estimator, "compiled_loop", None)
+        if loop is None and getattr(estimator, "_loop_requested", False):
+            # fresh-process resume in loop mode: the loop is built
+            # lazily and does not exist yet, and routing its checkpoint
+            # blob into the eager Trainer would install foreign updater
+            # state (fresh optimizer state + step 0 under an advanced
+            # epoch counter).  Restore params FIRST — that also settles
+            # deferred shapes from the saved arrays — then build the
+            # loop from the restored net and hand it its own states.
+            step = self._ckpt.restore_into(
+                params=estimator.net.collect_params(), scaler=scaler)
+            if step is None:
+                return          # no checkpoint yet: start fresh
+            loop = estimator._build_compiled_loop()
+            self._ckpt.restore_into(trainer=loop, step=step)
+            estimator.resume_from_epoch = step + 1
+            return
         step = self._ckpt.restore_into(
             params=estimator.net.collect_params(),
             trainer=loop or estimator.trainer,
@@ -337,16 +375,15 @@ class CheckpointHandler(TrainBegin, EpochEnd):
 
     def epoch_end(self, estimator):
         loop = getattr(estimator, "compiled_loop", None)
-        if loop is not None:
-            # loop mode: current values live on the loop (sync_to_block
-            # already mirrored them to the net); save its states so the
-            # in-scan step counter + optimizer state resume exactly
-            params = dict(loop.params)
-            target = loop
-        else:
-            params = {k: p.data() for k, p in
-                      estimator.net.collect_params().items()}
-            target = estimator.trainer
+        # the full collect_params() snapshot is correct in BOTH modes:
+        # in loop mode _run_epoch_loop's sync_to_block already mirrored
+        # the loop's current values — including aux state like BatchNorm
+        # running stats, which loop.params (trainable only) would drop —
+        # into the net; the loop's states carry the in-scan step counter
+        # + optimizer state so resume is exact
+        params = {k: p.data() for k, p in
+                  estimator.net.collect_params().items()}
+        target = loop if loop is not None else estimator.trainer
         if self._save_states:
             self._ckpt.save(
                 estimator.current_epoch, params,

@@ -193,10 +193,15 @@ class DevicePrefetcher:
             site=self._h2d_site if payload is not None
             else self._fetch_site, event="fallback")
         if payload is not None:
-            # the worker's fetched-but-untransferred batch: place it
-            # here so nothing is lost or reordered
+            # the worker's fetched-but-untransferred batch: transfer it
+            # here — through the same fault site + TRANSFER telemetry as
+            # every other batch — so nothing is lost or reordered and
+            # h2d byte accounting stays exact for the batch that
+            # triggered the degrade
+            placed = _fault.retry_call(self._transfer, payload,
+                                       site=self._h2d_site)
             self._batches += 1
-            return self._place(payload)
+            return placed
         return self._fetch_blocking()
 
     def _fetch_blocking(self):

@@ -699,6 +699,15 @@ class Updater:
     def set_states(self, states):
         import pickle
         obj = pickle.loads(states)
+        if isinstance(obj, dict) and obj.get("loop") == 1:
+            # a parallel.CompiledLoop blob: installing it as per-index
+            # updater states would silently resume with fresh optimizer
+            # state — the mirror of CompiledLoop.set_states rejecting
+            # foreign blobs
+            raise MXNetError(
+                "checkpoint trainer states were saved from a "
+                "parallel.CompiledLoop — restore with trainer=<the "
+                "CompiledLoop>, not an eager Trainer")
         if isinstance(obj, tuple):
             self.states, self.optimizer = obj
         else:

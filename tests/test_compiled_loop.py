@@ -320,6 +320,29 @@ def test_prefetcher_publishes_fallback_event(monkeypatch):
         telemetry.reset()
 
 
+def test_prefetcher_h2d_degrade_batch_keeps_telemetry(monkeypatch):
+    """The batch that triggers h2d degradation still flows through the
+    prefetch.h2d fault site + TRANSFER accounting on the consumer side:
+    every batch's bytes are counted, none is placed out-of-band."""
+    telemetry.reset()
+    telemetry.start()
+    monkeypatch.setenv("MXNET_RETRY_BASE_SECONDS", "0.001")
+    fault.install_plan("prefetch.h2d:ioerror@2-9")
+    try:
+        pf = DevicePrefetcher(iter(_tagged(10)))
+        assert _drain(pf) == list(range(10))
+        assert pf.stats()["degraded"]
+        pf.close()
+        flat = telemetry.counters_flat()
+        # 10 batches x one (2, 2) float32 array = 160 bytes, INCLUDING
+        # the handed-back batch that triggered the degrade
+        assert flat.get("mx_transfer_h2d_bytes_total", 0) == 10 * 16
+    finally:
+        fault.clear_plan()
+        telemetry.stop()
+        telemetry.reset()
+
+
 def test_prefetcher_propagates_upstream_bug():
     """A non-transient error raised INSIDE the iterator reaches the
     consumer (a dead generator must not read as end-of-epoch)."""
@@ -420,3 +443,109 @@ def test_estimator_loop_mode_checkpoints(tmp_path):
          for n, v in loop2.params.items()}
     for name in a:
         assert np.array_equal(a[name], b[name]), name
+
+
+def _make_estimator(prefix, seed=0):
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+    net = _net(prefix, seed=seed)
+    return net, est_mod.Estimator(
+        net, gloss.L2Loss(),
+        trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                 dict(OPT)))
+
+
+def test_estimator_loop_mode_resume_fresh_process(tmp_path):
+    """A preempted loop-mode run resumes in a FRESH process — the loop
+    does not exist yet when CheckpointHandler.train_begin fires, so the
+    handler must build it and restore INTO it (not misroute the loop
+    blob into the eager Trainer): step counter, optimizer momentum and
+    RNG stream all continue, final params bit-match an uninterrupted
+    run."""
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+    data = _train_batches(4)
+
+    # golden: uninterrupted 3-epoch run
+    _, est_a = _make_estimator("rs_", seed=0)
+    mx.random.seed(7)
+    est_a.fit(data, epochs=3, compiled_loop=True, loop_steps=2)
+    golden = {n: np.asarray(v)
+              for n, v in est_a.compiled_loop.params.items()}
+
+    # interrupted: 1 epoch with checkpoints ...
+    _, est_b = _make_estimator("rs_", seed=0)
+    mx.random.seed(7)
+    h_b = est_mod.CheckpointHandler(str(tmp_path))
+    est_b.fit(data, epochs=1, event_handlers=[h_b],
+              compiled_loop=True, loop_steps=2)
+    h_b._ckpt.wait_until_finished()
+
+    # ... then a fresh process: new estimator, different init, wrong
+    # RNG stream — resume must fix all of it
+    _, est_c = _make_estimator("rs_", seed=9)
+    mx.random.seed(99)
+    h_c = est_mod.CheckpointHandler(str(tmp_path), resume=True)
+    est_c.fit(data, epochs=3, event_handlers=[h_c],
+              compiled_loop=True, loop_steps=2)
+    assert est_c.resume_from_epoch == 1
+    assert est_c.compiled_loop._step_count == 12   # 4 steps x 3 epochs
+    final = {n: np.asarray(v)
+             for n, v in est_c.compiled_loop.params.items()}
+    for name in golden:
+        assert np.array_equal(golden[name], final[name]), name
+
+
+def test_eager_trainer_rejects_loop_checkpoint(tmp_path):
+    """A loop-mode checkpoint restored into an eager Trainer fails
+    loudly instead of silently installing fresh optimizer state under
+    an advanced epoch counter."""
+    net = _net("mr_")
+    loop = CompiledLoop(net, gloss.L2Loss(), "sgd", OPT, loop_steps=2,
+                        mesh=_mesh())
+    loop.run(_train_batches(2), prefetch=False)
+    loop.sync_to_block()
+    ck = AsyncCheckpointer(str(tmp_path / "mr"))
+    ck.save_sync(0, dict(loop.params), trainer=loop, epoch=0)
+    net2 = _net("mr_", seed=1)
+    tr = mx.gluon.Trainer(net2.collect_params(), "sgd", dict(OPT))
+    with pytest.raises(MXNetError, match="CompiledLoop"):
+        ck.restore_into(params=net2.collect_params(), trainer=tr)
+
+
+def test_estimator_loop_checkpoint_includes_aux(tmp_path):
+    """Loop-mode checkpoints carry aux state (BatchNorm running stats),
+    not just the trainable set: a restore must not leave running_mean /
+    running_var at their init values."""
+    from incubator_mxnet_tpu.gluon.contrib import estimator as est_mod
+
+    def bn_net(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential(prefix="bn_")
+        with net.name_scope():
+            net.add(nn.Dense(16, in_units=8))
+            net.add(nn.BatchNorm(in_channels=16))
+            net.add(nn.Dense(4, in_units=16))
+        net.initialize(init=mx.init.Xavier())
+        return net
+
+    net = bn_net(0)
+    est = est_mod.Estimator(
+        net, gloss.L2Loss(),
+        trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                 dict(OPT)))
+    h = est_mod.CheckpointHandler(str(tmp_path))
+    est.fit(_train_batches(4), epochs=1, event_handlers=[h],
+            compiled_loop=True, loop_steps=2)
+    h._ckpt.wait_until_finished()
+
+    net2 = bn_net(5)
+    assert h._ckpt.restore_into(params=net2.collect_params()) == 0
+    want = {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+    got = {k: p.data().asnumpy()
+           for k, p in net2.collect_params().items()}
+    for k in want:
+        assert np.array_equal(want[k], got[k]), k
+    # the restored running stats actually moved off their zeros init —
+    # the checkpoint really carried the trained aux values
+    rm = [k for k in want if k.endswith("running_mean")]
+    assert rm and any(np.abs(want[k]).max() > 0 for k in rm)
