@@ -449,10 +449,10 @@ def _bench_optim(on_accel, kind, dev):
         (B, D)).astype(np.float32))
     telemetry.start()
 
-    def run(fused):
+    def run(fused, zero1=False):
         net = build()
         tr = Trainer(net.collect_params(), "adam",
-                     {"learning_rate": 1e-3}, fused=fused)
+                     {"learning_rate": 1e-3}, fused=fused, zero1=zero1)
         params = list(net.collect_params().values())
         n_elems = sum(int(np.prod(p.shape)) for p in params)
         with ag.record():
@@ -469,11 +469,14 @@ def _bench_optim(on_accel, kind, dev):
         g = telemetry.registry.get("mxtpu_optimizer_dispatches_per_step")
         dispatches = int(sum(g._values.values())) if g is not None \
             and g._values else len(params)
-        return rate, n_elems, dispatches, len(params)
+        flat = telemetry.counters_flat()
+        return (rate, n_elems, dispatches, len(params),
+                flat.get("mxtpu_optimizer_state_bytes", 0),
+                flat.get("mxtpu_zero1_allgather_bytes", 0))
 
-    loop_rate, n_elems, loop_disp, n_tensors = run(fused=False)
-    fused_rate, _, fused_disp, _ = run(fused=True)
-    return {
+    loop_rate, n_elems, loop_disp, n_tensors, _, _ = run(fused=False)
+    fused_rate, _, fused_disp, _, full_state_bytes, _ = run(fused=True)
+    rec = {
         "optimizer": "adam",
         "param_tensors": n_tensors,
         "param_elements": n_elems,
@@ -486,6 +489,29 @@ def _bench_optim(on_accel, kind, dev):
         "dispatch_reduction": round(loop_disp / max(fused_disp, 1), 1),
         "step_speedup": round(fused_rate / loop_rate, 3),
     }
+    # ZeRO-1 weight-update sharding: same update measured with the flat
+    # state + update partitioned across the data axis.  Needs >1 local
+    # device to mean anything; on a single-device run the measurement
+    # happens in a subprocess with 8 virtual CPU devices instead.
+    import jax
+    if len(jax.local_devices()) > 1:
+        z_rate, _, z_disp, _, z_bytes, z_ag = run(fused=True, zero1=True)
+        ratio = z_bytes / max(full_state_bytes, 1)
+        rec["zero1"] = {
+            "devices": len(jax.local_devices()),
+            "updates_per_sec": round(z_rate, 1),
+            "param_elements_per_sec": round(z_rate * n_elems),
+            "dispatches_per_step": z_disp,
+            "state_bytes_per_replica": int(z_bytes),
+            "state_bytes_replicated": int(full_state_bytes),
+            "state_ratio": round(ratio, 4),
+            "allgather_bytes_per_step": int(z_ag),
+            "floor": "state_ratio <= 0.25",
+            "floor_ok": bool(ratio <= 0.25),
+        }
+    else:
+        rec["zero1"] = _zero1_dryrun()
+    return rec
 
 
 def _bench_serve(on_accel, kind, dev):
@@ -888,12 +914,105 @@ def _bench_train_loop(on_accel, kind, dev):
     return rec
 
 
+_ZERO1_OPTIM_SCRIPT = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass    # older jax: XLA_FLAGS from the parent forces the 8 devices
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd as ag
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.gluon import Trainer, nn
+
+D, L, B = 256, 8, 8
+STEPS, WARM = 20, 3
+
+def run(zero1):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    for _ in range(L):
+        net.add(nn.Dense(D, in_units=D, activation="relu"))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (B, D)).astype(np.float32))
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3},
+                 fused=True, zero1=zero1)
+    with ag.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    for _ in range(WARM):
+        tr.step(B, ignore_stale_grad=True)
+    mx.nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        tr.step(B, ignore_stale_grad=True)
+    mx.nd.waitall()
+    rate = STEPS / (time.perf_counter() - t0)
+    n_elems = sum(int(np.prod(p.shape))
+                  for p in net.collect_params().values())
+    flat = telemetry.counters_flat()
+    g = telemetry.registry.get("mxtpu_optimizer_dispatches_per_step")
+    disp = int(sum(g._values.values()))
+    return (rate, n_elems, disp,
+            flat.get("mxtpu_optimizer_state_bytes", 0),
+            flat.get("mxtpu_zero1_allgather_bytes", 0))
+
+f_rate, n_elems, _, full_bytes, _ = run(zero1=False)
+z_rate, _, z_disp, z_bytes, z_ag = run(zero1=True)
+ratio = z_bytes / max(full_bytes, 1)
+print(json.dumps({
+    "devices": len(jax.local_devices()),
+    "fused_updates_per_sec": round(f_rate, 1),
+    "updates_per_sec": round(z_rate, 1),
+    "param_elements_per_sec": round(z_rate * n_elems),
+    "dispatches_per_step": z_disp,
+    "state_bytes_per_replica": int(z_bytes),
+    "state_bytes_replicated": int(full_bytes),
+    "state_ratio": round(ratio, 4),
+    "allgather_bytes_per_step": int(z_ag),
+    "floor": "state_ratio <= 0.25",
+    "floor_ok": bool(ratio <= 0.25)}))
+"""
+
+
+def _zero1_dryrun(timeout=600):
+    """ZeRO-1 optimizer measurement on the virtual 8-device CPU mesh (a
+    fresh process — the sharding needs devices the caller may not
+    have): fused-replicated vs zero1-sharded adam update throughput,
+    per-replica state bytes, and the all-gather volume the scheme pays
+    for the 1/N state."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _ZERO1_OPTIM_SCRIPT],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() \
+            else ""
+        rec = json.loads(line)
+        rec["devices"] = "8 virtual CPU (subprocess; caller had 1 device)"
+        return rec
+    except Exception as e:
+        return {"error": str(e)[:200]}
+
+
 _SCALING_SCRIPT = r"""
 import json, time
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass    # older jax: XLA_FLAGS from the parent forces the 8 devices
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import gluon, parallel
 from incubator_mxnet_tpu.gluon.model_zoo import vision as zoo
@@ -908,7 +1027,7 @@ class CE(gluon.HybridBlock):
     def hybrid_forward(self, F, scores, labels):
         return self.ce(scores, labels).mean()
 
-def step_time(n_dev, reps=3):
+def step_time(n_dev, reps=3, opt_params=None, zero1=None):
     mx.random.seed(0)
     net = zoo.resnet18_v1(classes=10)
     net.initialize(init=mx.init.Xavier())
@@ -916,8 +1035,11 @@ def step_time(n_dev, reps=3):
         net(mx.nd.array(np.zeros((2, 3, H, H), np.float32)))
     mesh = parallel.make_mesh({"data": n_dev},
                               devices=jax.devices()[:n_dev])
-    tr = parallel.SPMDTrainer(net, CE(), "sgd", {"learning_rate": 0.1},
-                              mesh=mesh, data_axis="data")
+    tr = parallel.SPMDTrainer(net, CE(), "sgd",
+                              opt_params or {"learning_rate": 0.1},
+                              mesh=mesh, data_axis="data",
+                              **({} if zero1 is None
+                                 else {"zero1": zero1}))
     rng = np.random.default_rng(0)
     B = PER_DEV_B * n_dev
     x = rng.standard_normal((B, 3, H, H)).astype(np.float32)
@@ -935,11 +1057,25 @@ def step_time(n_dev, reps=3):
             loss = tr.step(x, y)
         jax.block_until_ready(loss)
         times.append((time.perf_counter() - t0) / STEPS)
-    return times
+    return times, tr
 
-ts1, ts8 = step_time(1), step_time(8)
+ts1, _ = step_time(1)
+ts8, _ = step_time(8)
 t1, t8 = float(np.median(ts1)), float(np.median(ts8))
 spread = lambda ts: (max(ts) - min(ts)) / float(np.median(ts))
+# ZeRO-1 on the same 8-device mesh: a momentum run (plain sgd has no
+# state to shard) sharded vs replicated — the apples-to-apples pair for
+# the update-sharding overhead and the 1/N state-bytes floor.
+MOM = {"learning_rate": 0.1, "momentum": 0.9}
+tsm, _ = step_time(8, reps=2, opt_params=MOM)
+tsz, trz = step_time(8, reps=2, opt_params=MOM, zero1=True)
+tm, tz = float(np.median(tsm)), float(np.median(tsz))
+from incubator_mxnet_tpu.parallel import zero1 as z1mod
+shard_b = z1mod.per_replica_state_bytes(trz._opt_state)
+full_b = sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+             for l in jax.tree.leaves(trz._opt_state))
+ratio = shard_b / max(full_b, 1)
+ag_b = z1mod.zero1_allgather_bytes(trz._opt.spec)
 # All 8 virtual devices share this host's cores, so wall-clock speedup is
 # impossible; the honest number is the sharding-overhead ratio: the
 # 8-device program doing 8x the work vs 8x the 1-device time.  <= 1.0
@@ -950,7 +1086,17 @@ print(json.dumps({"t_step_1dev_s": round(t1, 4),
                   "runs": len(ts1),
                   "spread_1dev": round(spread(ts1), 3),
                   "spread_8dev": round(spread(ts8), 3),
-                  "sharding_overhead_ratio": round(t8 / (8 * t1), 3)}))
+                  "sharding_overhead_ratio": round(t8 / (8 * t1), 3),
+                  "zero1": {
+                      "t_step_8dev_s": round(tz, 4),
+                      "replicated_t_step_8dev_s": round(tm, 4),
+                      "overhead_ratio": round(tz / tm, 3),
+                      "state_bytes_per_replica": int(shard_b),
+                      "state_bytes_replicated": int(full_b),
+                      "state_ratio": round(ratio, 4),
+                      "allgather_bytes_per_step": int(ag_b),
+                      "floor": "state_ratio <= 0.25",
+                      "floor_ok": bool(ratio <= 0.25)}}))
 """
 
 
@@ -962,6 +1108,8 @@ def _scaling_dryrun(timeout=900):
     bandwidth — the honest limit of a single-chip environment."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
     try:
         out = subprocess.run(
             [sys.executable, "-c", _SCALING_SCRIPT], capture_output=True,

@@ -234,12 +234,20 @@ class Estimator:
             # the device count (fit(loop_mesh=make_mesh({"data": 1}))
             # forces the single-device layout)
             mesh = make_mesh({"data": jax.device_count()})
+        twin = functional_twin(self.trainer._optimizer)
+        # the Trainer already folded the MXNET_ZERO1 env default into its
+        # request flag — propagate it so eager and loop mode agree on the
+        # sharding tier; a non-elementwise rule (LAMB) silently degrades
+        # to the unsharded loop, mirroring the Trainer's fused fallback
+        z1 = bool(getattr(self.trainer, "_zero1_requested", False))
+        if z1 and not getattr(twin, "elementwise", True):
+            z1 = False
         self.compiled_loop = CompiledLoop(
-            self.net, self.loss,
-            functional_twin(self.trainer._optimizer),
+            self.net, self.loss, twin,
             loop_steps=self._loop_steps_arg,
             skip_nonfinite=bool(getattr(self.trainer, "_skip_nonfinite",
                                         False)),
+            zero1=z1,
             mesh=mesh)
         return self.compiled_loop
 

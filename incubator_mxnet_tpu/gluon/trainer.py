@@ -25,7 +25,7 @@ class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
                  update_on_kvstore=None, skip_nonfinite=None,
-                 fused=None):
+                 fused=None, zero1=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -65,6 +65,15 @@ class Trainer:
         # fused envelope does not cover
         self._fused_requested = getenv_bool("MXNET_FUSED_OPTIMIZER", True) \
             if fused is None else bool(fused)
+        # ZeRO-1 weight-update sharding (arXiv:2004.13336): the fused
+        # dispatch shards the flat update + optimizer state across the
+        # local devices and all-gathers the weights back, all inside the
+        # one donated jit call.  Implies the fused path; falls back with
+        # it (and to replicated fused for non-elementwise rules).
+        self._zero1_requested = getenv_bool("MXNET_ZERO1", False) \
+            if zero1 is None else bool(zero1)
+        if self._zero1_requested and fused is None:
+            self._fused_requested = True
         self._fused = None
         # True once the fused path was tried for the optimizer
         # application in flight — _update must not re-run the host-side
@@ -125,11 +134,18 @@ class Trainer:
             for _, p in self._updatable)
         multi_worker = (self._distributed
                         and getattr(self._kvstore, "num_workers", 1) > 1)
+        # zero1 lifts the multi-worker exclusion: with the gradient
+        # aggregation reduce-scatter-shaped (each replica owns its
+        # shard's reduction — _allreduce_grads below), the fused single
+        # dispatch and a distributed kvstore compose instead of being
+        # mutually exclusive tiers
         if (self._fused_requested and not self._contains_sparse
                 and not sparse_grads
-                and not self._update_on_kvstore and not multi_worker):
+                and not self._update_on_kvstore
+                and (not multi_worker or self._zero1_requested)):
             from ..optimizer.fused import FusedUpdater
-            self._fused = FusedUpdater(self._updaters)
+            self._fused = FusedUpdater(self._updaters,
+                                       zero1=self._zero1_requested)
         self._kv_initialized = True
         if self._states_to_load is not None:
             self.load_states(self._states_to_load)
@@ -256,10 +272,19 @@ class Trainer:
                 self._kvstore.pull(i, p.data())
         elif self._distributed and (self._kvstore.num_workers > 1
                                     or self._compress_active):
-            # single process without compression: the DCN sum is the
-            # identity — skip the two full-parameter copies per step
-            for i, p in self._updatable:
-                self._kvstore.pushpull(i, p.grad(), out=p.grad())
+            if self._zero1_requested and self._fused is not None \
+                    and not self._compress_active:
+                # zero1: allreduce decomposed as reduce-scatter (this
+                # worker owns the reduction of its contiguous slice) +
+                # all-gather — the arXiv:2004.13336 shape, same fault /
+                # retry sites as push/pull
+                for i, p in self._updatable:
+                    self._kvstore.pushpull_rs(i, p.grad(), out=p.grad())
+            else:
+                # single process without compression: the DCN sum is the
+                # identity — skip the two full-parameter copies per step
+                for i, p in self._updatable:
+                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
         observe = bool(_telemetry.TRAINER.subscribers)
@@ -296,6 +321,10 @@ class Trainer:
             self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             return self._kvstore._updater.get_states(dump_optimizer=True)
+        if self._fused is not None:
+            # zero1 keeps state as flat shards — materialize into the
+            # per-param dict so the blob stays format-compatible
+            self._fused.flush_states()
         return self._updaters.get_states(dump_optimizer=True)
 
     def set_states(self, states: bytes):
@@ -306,6 +335,9 @@ class Trainer:
             self._kvstore._updater.set_states(states)
             self._optimizer = self._kvstore._updater.optimizer
         else:
+            if self._fused is not None:
+                # the restored per-param dict is the truth now
+                self._fused.invalidate()
             self._updaters.set_states(states)
             self._optimizer = self._updaters.optimizer
 
@@ -315,6 +347,8 @@ class Trainer:
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
+            if self._fused is not None:
+                self._fused.flush_states()
             with open(fname, "wb") as f:
                 f.write(self._updaters.get_states(dump_optimizer=True))
 
@@ -325,6 +359,8 @@ class Trainer:
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._optimizer
         else:
+            if self._fused is not None:
+                self._fused.invalidate()
             with open(fname, "rb") as f:
                 self._updaters.set_states(f.read())
             self._optimizer = self._updaters.optimizer

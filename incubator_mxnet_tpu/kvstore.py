@@ -321,6 +321,82 @@ class KVStore:
                 op="pushpull", nbytes=0,
                 seconds=_time.perf_counter() - t0)
 
+    def pushpull_rs(self, key, value, out=None, priority=0):
+        """ZeRO-1-shaped allreduce: reduce-scatter + all-gather
+        (arXiv:2004.13336) instead of push-full / pull-full.
+
+        The flattened value splits into ``num_workers`` contiguous
+        slices (zero-padded to divide evenly); this worker owns the
+        REDUCTION of slice ``rank`` — the reduce-scatter phase, retried
+        under the ``kvstore.push`` fault site — and the owned summed
+        slices are then all-gathered back into the full aggregate (the
+        ``kvstore.pull`` site).  RS + AG is exactly an allreduce, so the
+        result matches :meth:`pushpull` bit for bit; the SHAPE is the
+        point — each replica's owned reduction is what a sharded weight
+        update consumes, and once the update is sharded the gather can
+        move after it (new weights instead of grads).  Single process:
+        both phases are identity.  Dense values only (callers already
+        gate zero1 on dense grads)."""
+        import jax.numpy as jnp
+        from .ndarray.sparse import BaseSparseNDArray
+        observe = bool(_telemetry.KVSTORE.subscribers)
+        t0 = _time.perf_counter() if observe else 0.0
+        nbytes = 0
+        with _telemetry.trace_span("kvstore.pushpull", cat="kvstore"):
+            _fault.retry_call(_fault.inject, "kvstore.pushpull",
+                              site="kvstore.pushpull")
+            _, keys, values = self._norm_keys(key, value)
+            _, _, outs = self._norm_keys(key, out)
+            for k, v, o in zip(keys, values, outs):
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} was not init()-ed")
+                agg = self._aggregate(v)
+                if isinstance(agg, BaseSparseNDArray):
+                    raise MXNetError(
+                        "pushpull_rs handles dense values only")
+                w = self._num_workers
+                flat = agg._data.reshape(-1)
+                total = int(flat.shape[0])
+                shard_sz = -(-total // w)
+                pad = shard_sz * w - total
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)])
+
+                def _rs(flat=flat, shard_sz=shard_sz, w=w):
+                    # reduce-scatter: the sum of MY slice over all
+                    # workers (site fires first — replays are idempotent)
+                    _fault.inject("kvstore.push")
+                    if w == 1:
+                        return flat
+                    from jax.experimental import multihost_utils
+                    gathered = multihost_utils.process_allgather(
+                        flat.reshape(w, shard_sz))
+                    return gathered[:, self._rank, :].sum(axis=0)
+                own = _fault.retry_call(_rs, site="kvstore.push")
+                nbytes += int(own.size) * own.dtype.itemsize
+
+                def _ag(own=own, w=w):
+                    _fault.inject("kvstore.pull")
+                    if w == 1:
+                        return own
+                    from jax.experimental import multihost_utils
+                    return multihost_utils.process_allgather(
+                        own).reshape(-1)
+                full = _fault.retry_call(_ag, site="kvstore.pull")
+                if pad:
+                    full = full[:total]
+                self._store[k] = NDArray(full.reshape(agg.shape),
+                                         ctx=agg.ctx)
+                if o is not None:
+                    targets = o if isinstance(o, (list, tuple)) else [o]
+                    _fault.retry_call(self._pull_one, self._store[k],
+                                      targets, site="kvstore.pull")
+        if observe:
+            _telemetry.KVSTORE.publish(
+                op="pushpull_rs", nbytes=nbytes,
+                seconds=_time.perf_counter() - t0)
+
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)
         if out is not None:

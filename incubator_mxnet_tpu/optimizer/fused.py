@@ -53,18 +53,24 @@ from typing import Dict, Optional, Tuple
 
 from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
-from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, Updater
+from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, LAMB, \
+    Updater
 
 __all__ = ["FusedUpdater", "functional_twin"]
 
 # exact-type table: NAG subclasses SGD but has a different rule; LARS /
 # Signum / centered-RMSProp etc. are absent → per-param fallback
 _RULES = {SGD: "sgd", NAG: "nag", Adam: "adam", AdamW: "adamw",
-          RMSProp: "rmsprop", AdaGrad: "adagrad"}
+          RMSProp: "rmsprop", AdaGrad: "adagrad", LAMB: "lamb"}
 
 # rules whose eager kernel folds wd into the gradient (prep_grad) only
-# when wd != 0; adamw/adagrad apply wd decoupled, unconditionally
+# when wd != 0; adamw/adagrad/lamb apply wd decoupled, unconditionally
 _FOLD_WD = ("sgd", "nag", "adam", "rmsprop")
+
+# rules whose update is purely elementwise given the prepped grad — the
+# ZeRO-1 flat-shard envelope.  LAMB's per-tensor trust ratio straddles
+# shard boundaries, so it runs fused but unsharded.
+_ZERO1_RULES = ("sgd", "nag", "adam", "adamw", "rmsprop", "adagrad")
 
 
 def functional_twin(optimizer):
@@ -74,12 +80,14 @@ def functional_twin(optimizer):
 
     Raises :class:`MXNetError` when the eager configuration carries
     host-side per-step behavior a pure traced update cannot reproduce
-    (lr_scheduler callbacks, rescale_grad, clip_gradient, centered /
-    clip_weights RMSProp) — callers should surface that and stay on the
-    per-step path rather than silently change numerics.  Note adam's
-    bias correction rounds differently between the tiers (host doubles
-    folded into lr here vs. traced f32 in the functional core), a
-    documented ~1-ulp-class divergence; sgd/nag are bit-exact.
+    (lr_scheduler callbacks, centered / clip_weights RMSProp, LAMB
+    bounds / bias_correction=False) — callers should surface that and
+    stay on the per-step path rather than silently change numerics.
+    ``rescale_grad`` and ``clip_gradient`` thread through as baked
+    scalars exactly like the fused eager path.  Note adam's bias
+    correction rounds differently between the tiers (host doubles folded
+    into lr here vs. traced f32 in the functional core), a documented
+    ~1-ulp-class divergence; sgd/nag are bit-exact.
     """
     from ..base import MXNetError
     from ..parallel import optim as _fopt   # lazy: avoids import cycle
@@ -94,19 +102,22 @@ def functional_twin(optimizer):
             "functional_twin cannot capture a host-side lr_scheduler — "
             "pass lr_schedule= (a traced step -> lr callable) to the "
             "functional optimizer instead")
-    if float(optimizer.rescale_grad) != 1.0:
-        raise MXNetError(
-            "functional_twin: rescale_grad != 1 has no functional "
-            "equivalent (the SPMD/loss path already means over the "
-            "batch)")
-    if optimizer.clip_gradient:
-        raise MXNetError(
-            "functional_twin: clip_gradient is not traced by the "
-            "functional cores yet")
-    kw = dict(learning_rate=optimizer.lr, wd=optimizer.wd)
+    kw = dict(learning_rate=optimizer.lr, wd=optimizer.wd,
+              rescale_grad=float(optimizer.rescale_grad),
+              clip_gradient=optimizer.clip_gradient or None)
     if rule in ("sgd", "nag"):
         kw["momentum"] = optimizer.momentum
     elif rule in ("adam", "adamw"):
+        kw.update(beta1=optimizer.beta1, beta2=optimizer.beta2,
+                  epsilon=optimizer.epsilon)
+    elif rule == "lamb":
+        if optimizer.lower_bound is not None or \
+                optimizer.upper_bound is not None or \
+                not optimizer.bias_correction:
+            raise MXNetError(
+                "functional_twin: LAMB trust-ratio bounds / "
+                "bias_correction=False are outside the functional "
+                "envelope")
         kw.update(beta1=optimizer.beta1, beta2=optimizer.beta2,
                   epsilon=optimizer.epsilon)
     elif rule == "rmsprop":
@@ -142,6 +153,28 @@ def _writeback_state(s, new):
         _writeback_state(a, b)
 
 
+def _seg_state_flats(seg, raw_by_pos, n_leaves):
+    """Flatten one segment's per-leaf optimizer states into flat padded
+    buffers, slot by slot (a "slot" is one leaf of the per-param state
+    structure — e.g. adam has two, m and v; multi-precision prepends the
+    fp32 master weight).  All leaves in a segment share rule and
+    mp-ness, so the slot structure is uniform.  Returns
+    ``(slot_structured_flats, treedef)``."""
+    import jax
+    from ..parallel import zero1 as _z1
+
+    treedef = jax.tree.structure(raw_by_pos[seg.idx[0]])
+    slots = [jax.tree.leaves(raw_by_pos[k]) for k in seg.idx]
+    flats = []
+    for j in range(treedef.num_leaves):
+        leaves = [None] * n_leaves
+        for pos, k in enumerate(seg.idx):
+            leaves[k] = slots[pos][j]
+        flats.append(_z1.flatten_segment(seg, leaves,
+                                         dtype=slots[0][j].dtype))
+    return jax.tree.unflatten(treedef, flats), treedef
+
+
 class FusedUpdater:
     """Whole-tree fused twin of :class:`optimizer.Updater`.
 
@@ -153,9 +186,24 @@ class FusedUpdater:
     optimizer or parameter set is outside the fused envelope.
     """
 
-    def __init__(self, updater: Updater):
+    def __init__(self, updater: Updater, zero1: bool = False):
         self._updater = updater
         self._cache: Dict[tuple, object] = {}
+        # ZeRO-1 (arXiv:2004.13336): shard the flat update + optimizer
+        # state across the local devices.  Pointless on one device —
+        # silently stay on the replicated fused path there.
+        self._z_mesh = None
+        if zero1:
+            import jax
+            if len(jax.local_devices()) > 1:
+                from ..parallel.mesh import make_mesh
+                self._z_mesh = make_mesh(
+                    {"data": len(jax.local_devices())})
+        self._z_key = None          # config key the flat cache matches
+        self._z_spec = None         # parallel.zero1.ShardSpec
+        self._z_state = None        # per-segment flat sharded state
+        self._z_defs = None         # per-segment state treedefs
+        self._z_params = None       # [(param_index, weight NDArray)]
 
     # -- per-step host side --------------------------------------------
     def step(self, updatable, guard: bool):
@@ -171,8 +219,13 @@ class FusedUpdater:
         opt = self._updater.optimizer
         rule = _RULES.get(type(opt))
         if rule is None:
+            # every (False, None) return materializes the zero1 flat
+            # shards first (no-op when inactive): the per-param loop the
+            # caller falls back to reads updater.states
+            self._flush_zero1()
             return False, None
         if rule == "rmsprop" and (opt.centered or opt.clip_weights):
+            self._flush_zero1()
             return False, None
         n = len(updatable)
         if n == 0:
@@ -182,24 +235,37 @@ class FusedUpdater:
         for _, p in updatable:
             if p.stype != "default" or \
                     getattr(p, "_grad_stype", "default") != "default":
+                self._flush_zero1()
                 return False, None
             ws_nd.append(p.data())
             gs_nd.append(p.grad())
 
-        states = self._updater.states
-        for (i, _), w in zip(updatable, ws_nd):
-            if i not in states:
-                states[i] = opt.create_state_multi_precision(i, w)
+        # ZeRO-1 handles the elementwise rules only (LAMB's trust ratio
+        # straddles flat-shard boundaries); anything else falls back to
+        # the replicated fused path — materialize first so the eager
+        # states dict is the source of truth again
+        use_z = self._z_mesh is not None and rule in _ZERO1_RULES
+        if self._z_state is not None and not use_z:
+            self._flush_zero1()
 
+        states = self._updater.states
         ws = tuple(w._data for w in ws_nd)
         gs = tuple(g._data for g in gs_nd)
-        sts = tuple(_raw_state(states[i]) for i, _ in updatable)
-        donated = list(ws) + jax.tree_util.tree_leaves(sts) + \
-            (list(gs) if guard else [])
+        if use_z:
+            sts = None
+            donated = list(ws) + (list(gs) if guard else [])
+        else:
+            for (i, _), w in zip(updatable, ws_nd):
+                if i not in states:
+                    states[i] = opt.create_state_multi_precision(i, w)
+            sts = tuple(_raw_state(states[i]) for i, _ in updatable)
+            donated = list(ws) + jax.tree_util.tree_leaves(sts) + \
+                (list(gs) if guard else [])
         if len({id(x) for x in donated}) != len(donated):
             # aliased buffers cannot be donated — bail BEFORE touching
             # update counts / lr bookkeeping, so the per-param fallback
             # (which advances them itself) sees them exactly once
+            self._flush_zero1()
             return False, None
 
         # host bookkeeping in eager order: every param's count advances
@@ -224,7 +290,7 @@ class FusedUpdater:
             wd_pattern.append(bool(wd))
             mp_pattern.append(bool(opt.multi_precision
                                    and ws_nd[k].dtype == np.float16))
-        if rule == "adamw":
+        if rule == "adamw" or (rule == "lamb" and opt.bias_correction):
             counts = [opt._index_update_count[i] for i, _ in updatable]
             extras = (np.array([1. - opt.beta1 ** t for t in counts],
                                np.float32),
@@ -239,10 +305,21 @@ class FusedUpdater:
             baked = (opt.momentum,)
         elif rule in ("adam", "adamw"):
             baked = (opt.beta1, opt.beta2, opt.epsilon)
+        elif rule == "lamb":
+            baked = (opt.beta1, opt.beta2, opt.epsilon,
+                     bool(opt.bias_correction),
+                     float(opt.lower_bound or -1.0),
+                     float(opt.upper_bound or -1.0))
         elif rule == "rmsprop":
             baked = (opt.gamma1, opt.epsilon)
         else:
             baked = (opt.float_stable_eps,)
+
+        if use_z:
+            return self._step_zero1(
+                updatable, ws_nd, gs_nd, ws, gs, lrs, wds, extras, rule,
+                baked, tuple(mp_pattern), tuple(wd_pattern), clip_on,
+                guard, opt)
 
         key = (rule, n, baked, tuple(mp_pattern), tuple(wd_pattern),
                clip_on, guard)
@@ -274,7 +351,249 @@ class FusedUpdater:
             "mxtpu_optimizer_dispatches_per_step",
             "optimizer-update dispatches in the last trainer step "
             "(1 = fused; num_params = per-param loop)").set(1)
+        from ..parallel import zero1 as _z1
+        _telemetry.gauge(
+            "mxtpu_optimizer_state_bytes",
+            "optimizer-state bytes ONE replica materializes "
+            "(replicated state: the full tree; zero1: its 1/N shard)"
+        ).set(_z1.per_replica_state_bytes(
+            tuple(_raw_state(states[i]) for i, _ in updatable)))
         return True, flag
+
+    # -- ZeRO-1 flat-sharded path --------------------------------------
+    def flush_states(self):
+        """Materialize the flat sharded optimizer state back into the
+        wrapped Updater's per-param ``states`` dict (checkpoint time /
+        fallback to an out-of-envelope rule).  No-op when ZeRO-1 is off
+        or not yet engaged."""
+        self._flush_zero1()
+
+    def invalidate(self):
+        """Drop the flat sharded state WITHOUT materializing — the
+        caller replaced ``updater.states`` wholesale (``set_states`` /
+        ``load_states``), making the eager dict the truth again."""
+        self._z_state = None
+        self._z_key = None
+        self._z_spec = None
+        self._z_defs = None
+        self._z_params = None
+
+    def _flush_zero1(self):
+        if self._z_state is None:
+            return
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        states = self._updater.states
+        opt = self._updater.optimizer
+        spec = self._z_spec
+        for i, w_nd in self._z_params:
+            if i not in states:
+                states[i] = opt.create_state_multi_precision(i, w_nd)
+        from ..parallel import zero1 as _z1
+        for seg, st_seg, treedef in zip(spec.segments, self._z_state,
+                                        self._z_defs):
+            flats = [np.asarray(x) for x in jax.tree.leaves(st_seg)]
+            per_leaf = {k: [] for k in seg.idx}
+            for flat in flats:
+                for k, arr in _z1.unflatten_segment(seg, flat):
+                    per_leaf[k].append(jnp.asarray(arr))
+            for k in seg.idx:
+                raw = jax.tree.unflatten(treedef, per_leaf[k])
+                _writeback_state(states[self._z_params[k][0]], raw)
+        self.invalidate()
+
+    def _step_zero1(self, updatable, ws_nd, gs_nd, ws, gs, lrs, wds,
+                    extras, rule, baked, mp_pattern, wd_pattern, clip_on,
+                    guard, opt):
+        import numpy as np
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel import zero1 as _z1
+
+        n = len(updatable)
+        shapes = tuple(tuple(map(int, w.shape)) for w in ws)
+        wdts = tuple(np.dtype(w.dtype).str for w in ws)
+        key = ("z1", rule, n, baked, mp_pattern, wd_pattern, clip_on,
+               guard, shapes, wdts)
+        if self._z_state is not None and self._z_key != key:
+            # param set / patterns changed under us — re-partition from
+            # the materialized truth
+            self._flush_zero1()
+        shard = NamedSharding(self._z_mesh, PartitionSpec("data"))
+        repl = NamedSharding(self._z_mesh, PartitionSpec())
+        if self._z_state is None:
+            states = self._updater.states
+            for (i, _), w in zip(updatable, ws_nd):
+                if i not in states:
+                    states[i] = opt.create_state_multi_precision(i, w)
+            seg_keys = [(wdts[k], mp_pattern[k],
+                         rule in _FOLD_WD and wd_pattern[k])
+                        for k in range(n)]
+            spec = _z1.build_shard_spec(
+                ws, int(self._z_mesh.shape["data"]), keys=seg_keys)
+            raw = [_raw_state(states[i]) for i, _ in updatable]
+            z_state, z_defs = [], []
+            for seg in spec.segments:
+                st, treedef = _seg_state_flats(seg, raw, n)
+                st = jax.tree.map(lambda v: jax.device_put(v, shard), st)
+                z_state.append(st)
+                z_defs.append(treedef)
+            self._z_state = tuple(z_state)
+            self._z_defs = tuple(z_defs)
+            self._z_spec = spec
+            self._z_key = key
+            self._z_params = [(i, w)
+                              for (i, _), w in zip(updatable, ws_nd)]
+            # the flat shards are now the only copy — per-replica memory
+            # actually drops N×
+            for i, _ in updatable:
+                states.pop(i, None)
+            _telemetry.gauge(
+                "mxtpu_zero1_allgather_bytes",
+                "per-step per-replica inbound all-gather volume the "
+                "zero1 weight-update sharding adds").set(
+                _z1.zero1_allgather_bytes(spec))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._cache[key] = self._build_zero1(key)
+        devs = [next(iter(w.devices())) for w in ws]
+        ws_m = tuple(jax.device_put(w, repl) for w in ws)
+        gs_m = tuple(jax.device_put(g, repl) for g in gs)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            new_ws, new_z, new_gs, flag = fn(
+                ws_m, gs_m, self._z_state, lrs, wds, extras,
+                np.float32(opt.rescale_grad),
+                np.float32(opt.clip_gradient if clip_on else 0.0))
+        self._z_state = new_z
+        # weights return to their eager (single-device) homes so the
+        # next forward pass is undisturbed; these copies are plain
+        # transfers, not dispatches — the update stayed ONE jit call
+        for k in range(n):
+            ws_nd[k]._set_data(jax.device_put(new_ws[k], devs[k]))
+            if new_gs is not None:
+                gs_nd[k]._set_data(jax.device_put(new_gs[k], devs[k]))
+        _telemetry.counter(
+            "mxtpu_optimizer_fused_updates",
+            "whole-tree fused optimizer dispatches "
+            "(one jit call updating every parameter)").inc(
+            site="zero1_update")
+        _telemetry.gauge(
+            "mxtpu_optimizer_dispatches_per_step",
+            "optimizer-update dispatches in the last trainer step "
+            "(1 = fused; num_params = per-param loop)").set(1)
+        _telemetry.gauge(
+            "mxtpu_optimizer_state_bytes",
+            "optimizer-state bytes ONE replica materializes "
+            "(replicated state: the full tree; zero1: its 1/N shard)"
+        ).set(_z1.per_replica_state_bytes(self._z_state))
+        return True, flag
+
+    def _build_zero1(self, key):
+        import jax
+        import jax.numpy as jnp
+        from jax.lax import with_sharding_constraint as wsc
+        from jax.sharding import NamedSharding, PartitionSpec
+        from . import cores
+        from ..contrib.amp.loss_scaler import all_finite_flag
+        from ..parallel import zero1 as _z1
+
+        (_, rule, n, baked, mp_pattern, wd_pattern, clip_on, guard,
+         shapes, wdts) = key
+        spec, treedefs = self._z_spec, self._z_defs
+        shard = NamedSharding(self._z_mesh, PartitionSpec("data"))
+        repl = NamedSharding(self._z_mesh, PartitionSpec())
+
+        def fn(ws, gs, zstates, lrs, wds, extras, rescale, clip):
+            allfin = all_finite_flag(gs) if guard else None
+            new_ws = [None] * n
+            new_z = []
+            for seg, st_seg, treedef in zip(spec.segments, zstates,
+                                            treedefs):
+                _, mp, wdfold = seg.key
+                cdt = jnp.float32 if mp else seg.dtype
+                leaves = jax.tree.leaves(st_seg)
+                g_flat = wsc(_z1.flatten_segment(seg, gs, dtype=cdt),
+                             shard)
+                if mp:
+                    tw, inner = leaves[0], leaves[1:]
+                else:
+                    tw = wsc(_z1.flatten_segment(seg, ws), shard)
+                    inner = leaves
+                lr = _z1.expand_per_leaf(seg, lrs, dtype=cdt)
+                wd = _z1.expand_per_leaf(seg, wds, dtype=cdt)
+                gp = cores.prep_grad(
+                    g_flat, rescale.astype(cdt),
+                    clip.astype(cdt) if clip_on else None,
+                    wd if wdfold else None, tw)
+                if rule in ("sgd", "nag"):
+                    momentum, = baked
+                    if not inner:
+                        nw, ninner = cores.sgd(tw, gp, lr), []
+                    elif rule == "sgd":
+                        nw, nm = cores.sgd_momentum(tw, gp, inner[0],
+                                                    lr, momentum)
+                        ninner = [nm]
+                    else:
+                        nw, nm = cores.nag_momentum(tw, gp, inner[0],
+                                                    lr, momentum)
+                        ninner = [nm]
+                elif rule == "adam":
+                    b1, b2, eps = baked
+                    nw, nm, nv = cores.adam(tw, gp, inner[0], inner[1],
+                                            lr, b1, b2, eps)
+                    ninner = [nm, nv]
+                elif rule == "adamw":
+                    b1, b2, eps = baked
+                    coef1 = _z1.expand_per_leaf(seg, extras[0],
+                                                dtype=cdt)
+                    coef2 = _z1.expand_per_leaf(seg, extras[1],
+                                                dtype=cdt)
+                    nw, nm, nv = cores.adamw(tw, gp, inner[0], inner[1],
+                                             lr, wd, b1, b2, eps,
+                                             coef1, coef2)
+                    ninner = [nm, nv]
+                elif rule == "rmsprop":
+                    g1, eps = baked
+                    nw, nn = cores.rmsprop(tw, gp, inner[0], lr, g1,
+                                           eps)
+                    ninner = [nn]
+                else:
+                    eps, = baked
+                    nw, nh = cores.adagrad(tw, gp, inner[0], lr, eps,
+                                           wd)
+                    ninner = [nh]
+                if guard:
+                    nw = jnp.where(allfin, nw, tw)
+                    ninner = [jnp.where(allfin, a, b)
+                              for a, b in zip(ninner, inner)]
+                nleaves = ([nw] + ninner) if mp else ninner
+                new_z.append(jax.tree.unflatten(
+                    treedef, [wsc(x, shard) for x in nleaves]))
+                # replicating the updated flat weights IS the
+                # all-gather — still inside this one donated dispatch.
+                # The barrier keeps the update arithmetic OUT of the
+                # all-gather's fusion cluster: fused into the gather,
+                # XLA re-contracts the multiply-add chain (different
+                # FMA placement) and the result drifts 1-2 ulp off the
+                # unsharded program — bit parity requires the kernel
+                # boundary here.
+                out_w = wsc(jax.lax.optimization_barrier(
+                    nw.astype(seg.dtype)), repl)
+                for k, arr in _z1.unflatten_segment(seg, out_w):
+                    new_ws[k] = arr
+            new_ws, new_z = tuple(new_ws), tuple(new_z)
+            if not guard:
+                return new_ws, new_z, None, None
+            return (new_ws, new_z,
+                    tuple(jnp.where(allfin, g, jnp.zeros_like(g))
+                          for g in gs),
+                    allfin)
+
+        jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if guard else (0, 2))
+        return _telemetry.instrument_jit("zero1_update", jitted)
 
     # -- compiled side -------------------------------------------------
     def _build(self, key):
@@ -333,6 +652,32 @@ class FusedUpdater:
                                              wd, b1, b2, eps,
                                              coef1s[k].astype(cdt),
                                              coef2s[k].astype(cdt))
+                    nst = (nm, nv)
+                elif rule == "lamb":
+                    b1, b2, eps, bias_corr, lo, up = baked
+                    # mirrors lamb_update_phase1/phase2 exactly: state
+                    # m/v are always f32 (create_state), so the bias
+                    # correction and trust-ratio math stay in f32 — lr
+                    # multiplies the f32 update (the eager Python float
+                    # weak-types to f32 there), hence no cdt cast on lr
+                    nm, nv = cores.moments(tst[0], tst[1], gp, b1, b2)
+                    nm = nm.astype(tst[0].dtype)
+                    nv = nv.astype(tst[1].dtype)
+                    if bias_corr:
+                        coef1s, coef2s = extras
+                        mhat = nm / coef1s[k]
+                        vhat = nv / coef2s[k]
+                    else:
+                        mhat, vhat = nm, nv
+                    upd = mhat / (jnp.sqrt(vhat) + eps) + wd * tw
+                    r1 = jnp.linalg.norm(tw.ravel())
+                    r2 = jnp.linalg.norm(upd.ravel())
+                    if lo > 0:
+                        r1 = jnp.maximum(r1, lo)
+                    if up > 0:
+                        r1 = jnp.minimum(r1, up)
+                    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+                    nw = tw - lrs[k] * ratio * upd
                     nst = (nm, nv)
                 elif rule == "rmsprop":
                     g1, eps = baked

@@ -8,6 +8,8 @@ shardings + one compiled step:
   SPMDTrainer                 whole train step (fwd+bwd+psum+opt) in one jit
   shard_params                regex→PartitionSpec tensor parallelism
   fsdp_rules                  ZeRO-3-class full parameter sharding over data
+  zero1 / Zero1Optimizer      ZeRO-1 weight-update sharding: flat dp-sharded
+                              optimizer state + in-program weight all-gather
   ring_attention              sequence parallelism over the mesh (beyond
                               reference parity)
   ulysses_attention           all-to-all sequence parallelism (DeepSpeed-
@@ -24,7 +26,10 @@ from .ring import ring_attention, local_flash_attention
 from .ulysses import ulysses_attention
 from .pipeline import (gpipe, stack_stage_params, pipe_specs,
                        stack_block_stages, PipelineTrainer)
+from .zero1 import (Zero1Optimizer, ShardSpec, build_shard_spec,
+                    per_replica_state_bytes)
 from . import optim
+from . import zero1
 from . import distributed
 
 __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
@@ -34,4 +39,6 @@ __all__ = ["make_mesh", "local_mesh", "current_mesh", "mesh_scope",
            "data_sharding", "exact_rule", "ring_attention",
            "local_flash_attention", "ulysses_attention", "gpipe",
            "stack_stage_params", "pipe_specs", "stack_block_stages",
-           "PipelineTrainer", "optim", "distributed"]
+           "PipelineTrainer", "Zero1Optimizer", "ShardSpec",
+           "build_shard_spec", "per_replica_state_bytes", "optim",
+           "zero1", "distributed"]

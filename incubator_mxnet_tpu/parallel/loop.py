@@ -130,7 +130,7 @@ class CompiledLoop(SPMDTrainer):
         return _telemetry.instrument_jit("loop", jax.jit(
             pure_chunk,
             out_shardings=(None, self._tr_shardings, self._aux_shardings,
-                           self._opt_state_shardings, None),
+                           self._state_out_shardings(), None),
             donate_argnums=donate))
 
     # ------------------------------------------------------------------
@@ -257,7 +257,16 @@ class CompiledLoop(SPMDTrainer):
         ``AsyncCheckpointer.save(..., trainer=loop)``."""
         import jax
         self._drain_skipped(block=True)
-        tree = jax.tree.map(_fetch_full, self._opt_state)
+        if self._zero1:
+            # save the PORTABLE (per-leaf, unpadded) layout, not the
+            # flat padded shards: the blob is then independent of the
+            # shard count (save at N=8, resume at N=4) and structurally
+            # identical to a non-zero1 loop's state — checkpoints
+            # interop in both directions
+            tree = self._opt.portable_state(self._opt_state,
+                                            fetch=_fetch_full)
+        else:
+            tree = jax.tree.map(_fetch_full, self._opt_state)
         return pickle.dumps({"loop": 1,
                              "step": self._step_count,
                              "skipped": self._skipped_total,
@@ -277,6 +286,11 @@ class CompiledLoop(SPMDTrainer):
         self._step_count = int(st["step"])
         self._skipped_total = int(st.get("skipped", 0))
         self._pending_skipped = []
-        self._opt_state = jax.tree.map(
-            lambda old, new: _placed_copy(new, old.sharding),
-            self._opt_state, st["opt_state"])
+        if self._zero1:
+            # blobs carry the portable per-leaf layout (see get_states);
+            # re-flatten and re-pad for THIS mesh's shard count
+            self._opt_state = self._opt.from_portable(st["opt_state"])
+        else:
+            self._opt_state = jax.tree.map(
+                lambda old, new: _placed_copy(new, old.sharding),
+                self._opt_state, st["opt_state"])

@@ -36,9 +36,25 @@ class FunctionalOptimizer(NamedTuple):
     init(params) -> state;
     update(params, grads, state, step) -> (new_params, new_state)
     where step is a traced int32 scalar (1-based).
+
+    ``elementwise`` marks rules whose update is a pure per-element map
+    (given prepped grads) — the precondition for ZeRO-1 flat sharding
+    (parallel/zero1.py): a contiguous slice of the flattened tree can be
+    updated alone.  LAMB's per-tensor trust ratio is the exception.
     """
     init: Any
     update: Any
+    elementwise: bool = True
+
+
+def _prep(c, g, w, rescale_grad, clip_gradient, wd):
+    """Shared grad prologue: rescale → clip → fold wd (each stage decided
+    statically, mirroring the fused eager path)."""
+    return c.prep_grad(
+        g,
+        rescale_grad if float(rescale_grad) != 1.0 else None,
+        clip_gradient if clip_gradient else None,
+        wd if wd else None, w)
 
 
 def _zeros_state(params):
@@ -46,7 +62,8 @@ def _zeros_state(params):
     return jax.tree.map(lambda p: _jnp().zeros_like(p), params)
 
 
-def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None):
+def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None,
+        rescale_grad=1.0, clip_gradient=None):
     import jax
     c = _cores()
 
@@ -59,7 +76,7 @@ def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
 
         def prep(g, w):
-            return c.prep_grad(g, wd=wd if wd else None, w=w)
+            return _prep(c, g, w, rescale_grad, clip_gradient, wd)
         if momentum == 0.0:
             new_p = jax.tree.map(
                 lambda w, g: c.sgd(w, prep(g, w), lr), params, grads)
@@ -73,7 +90,8 @@ def sgd(learning_rate=0.01, momentum=0.0, wd=0.0, lr_schedule=None):
     return FunctionalOptimizer(init, update)
 
 
-def nag(learning_rate=0.01, momentum=0.9, wd=0.0, lr_schedule=None):
+def nag(learning_rate=0.01, momentum=0.9, wd=0.0, lr_schedule=None,
+        rescale_grad=1.0, clip_gradient=None):
     """Nesterov momentum SGD (reference: nag_mom_update)."""
     import jax
     c = _cores()
@@ -85,7 +103,7 @@ def nag(learning_rate=0.01, momentum=0.9, wd=0.0, lr_schedule=None):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         pairs = jax.tree.map(
             lambda w, g, m: c.nag_momentum(
-                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                w, _prep(c, g, w, rescale_grad, clip_gradient, wd),
                 m, lr, momentum),
             params, grads, state["mom"])
         new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
@@ -95,7 +113,7 @@ def nag(learning_rate=0.01, momentum=0.9, wd=0.0, lr_schedule=None):
 
 
 def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
-         lr_schedule=None):
+         lr_schedule=None, rescale_grad=1.0, clip_gradient=None):
     import jax
     jnp = _jnp()
     c = _cores()
@@ -112,7 +130,7 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
         # bias correction folds into lr exactly like the eager Adam class
         triples = jax.tree.map(
             lambda w, g, m, v: c.adam(
-                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                w, _prep(c, g, w, rescale_grad, clip_gradient, wd),
                 m, v, lr * coef, beta1, beta2, epsilon),
             params, grads, state["m"], state["v"])
         new_p = jax.tree.map(lambda w, tr: tr[0], params, triples)
@@ -123,7 +141,7 @@ def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
 
 
 def adamw(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-          wd=0.0, lr_schedule=None):
+          wd=0.0, lr_schedule=None, rescale_grad=1.0, clip_gradient=None):
     """AdamW — decoupled weight decay (reference: contrib.adamw)."""
     import jax
     jnp = _jnp()
@@ -138,8 +156,9 @@ def adamw(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
         coef1 = 1.0 - beta1 ** t
         coef2 = 1.0 - beta2 ** t
         triples = jax.tree.map(
-            lambda w, g, m, v: c.adamw(w, g, m, v, lr, wd, beta1, beta2,
-                                       epsilon, coef1, coef2),
+            lambda w, g, m, v: c.adamw(
+                w, _prep(c, g, None, rescale_grad, clip_gradient, 0.0),
+                m, v, lr, wd, beta1, beta2, epsilon, coef1, coef2),
             params, grads, state["m"], state["v"])
         new_p = jax.tree.map(lambda w, tr: tr[0], params, triples)
         new_m = jax.tree.map(lambda w, tr: tr[1], params, triples)
@@ -149,7 +168,7 @@ def adamw(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
 
 
 def rmsprop(learning_rate=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
-            lr_schedule=None):
+            lr_schedule=None, rescale_grad=1.0, clip_gradient=None):
     """Non-centered RMSProp (reference: rmsprop_update)."""
     import jax
     c = _cores()
@@ -161,7 +180,7 @@ def rmsprop(learning_rate=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         pairs = jax.tree.map(
             lambda w, g, n: c.rmsprop(
-                w, c.prep_grad(g, wd=wd if wd else None, w=w),
+                w, _prep(c, g, w, rescale_grad, clip_gradient, wd),
                 n, lr, gamma1, epsilon),
             params, grads, state["n"])
         new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
@@ -170,7 +189,8 @@ def rmsprop(learning_rate=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
     return FunctionalOptimizer(init, update)
 
 
-def adagrad(learning_rate=0.01, epsilon=1e-7, wd=0.0, lr_schedule=None):
+def adagrad(learning_rate=0.01, epsilon=1e-7, wd=0.0, lr_schedule=None,
+            rescale_grad=1.0, clip_gradient=None):
     """AdaGrad (reference: adagrad_update — decoupled wd, epsilon inside
     the sqrt)."""
     import jax
@@ -182,7 +202,9 @@ def adagrad(learning_rate=0.01, epsilon=1e-7, wd=0.0, lr_schedule=None):
     def update(params, grads, state, step):
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         pairs = jax.tree.map(
-            lambda w, g, h: c.adagrad(w, g, h, lr, epsilon, wd),
+            lambda w, g, h: c.adagrad(
+                w, _prep(c, g, None, rescale_grad, clip_gradient, 0.0),
+                h, lr, epsilon, wd),
             params, grads, state["h"])
         new_p = jax.tree.map(lambda w, pr: pr[0], params, pairs)
         new_h = jax.tree.map(lambda w, pr: pr[1], params, pairs)
@@ -191,9 +213,10 @@ def adagrad(learning_rate=0.01, epsilon=1e-7, wd=0.0, lr_schedule=None):
 
 
 def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
-         lr_schedule=None):
+         lr_schedule=None, rescale_grad=1.0, clip_gradient=None):
     """LAMB with per-tensor trust ratio (reference: LAMB optimizer +
-    lamb_update_phase1/2)."""
+    lamb_update_phase1/2).  The trust ratio is a per-tensor reduction, so
+    this rule is NOT elementwise — ZeRO-1 flat sharding excludes it."""
     import jax
     jnp = _jnp()
     c = _cores()
@@ -205,7 +228,9 @@ def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
         lr = lr_schedule(step) if lr_schedule is not None else learning_rate
         t = step.astype(jnp.float32)
         pairs = jax.tree.map(
-            lambda m, g, v: c.moments(m, v, g, beta1, beta2),
+            lambda m, g, v: c.moments(
+                m, v, _prep(c, g, None, rescale_grad, clip_gradient, 0.0),
+                beta1, beta2),
             state["m"], grads, state["v"])
         new_m = jax.tree.map(lambda m, pr: pr[0], state["m"], pairs)
         new_v = jax.tree.map(lambda m, pr: pr[1], state["m"], pairs)
@@ -220,7 +245,7 @@ def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
             return w - lr * ratio * u
         new_p = jax.tree.map(upd, params, new_m, new_v)
         return new_p, {"m": new_m, "v": new_v}
-    return FunctionalOptimizer(init, update)
+    return FunctionalOptimizer(init, update, elementwise=False)
 
 
 _REGISTRY = {"sgd": sgd, "nag": nag, "adam": adam, "adamw": adamw,

@@ -258,7 +258,8 @@ class PipelineTrainer(_SPMDTrainer):
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
-                 shard_optimizer_state=False, pipeline_axis="pipe",
+                 shard_optimizer_state=False, zero1=None,
+                 pipeline_axis="pipe",
                  pipeline_microbatches=None, pipeline_schedule=None,
                  accum_steps=None):
         import jax
@@ -272,13 +273,13 @@ class PipelineTrainer(_SPMDTrainer):
                 "accum_steps does not apply to the pipeline trainer — "
                 "pipeline_microbatches already streams the batch in "
                 "microbatches (raise it for the same memory effect)")
-        if extra_input_shardings or shard_optimizer_state:
+        if extra_input_shardings or shard_optimizer_state or zero1:
             raise MXNetError(
                 "pipeline_axis does not compose with "
-                "extra_input_shardings / shard_optimizer_state yet — "
-                "cell params are already sharded over the pipe axis "
-                "(their optimizer state with them).  sharding_rules DO "
-                "compose: tensor-parallel specs apply on top of the "
+                "extra_input_shardings / shard_optimizer_state / zero1 "
+                "yet — cell params are already sharded over the pipe "
+                "axis (their optimizer state with them).  sharding_rules "
+                "DO compose: tensor-parallel specs apply on top of the "
                 "stage stacking (3D dp x pipe x model parallelism)")
         self._rules = list(sharding_rules or [])
         self._net = net

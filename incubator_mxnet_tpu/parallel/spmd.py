@@ -191,10 +191,12 @@ class SPMDTrainer:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="data", sharding_rules=None,
                  extra_input_shardings=None, donate=True,
-                 shard_optimizer_state=False, pipeline_axis=None,
+                 shard_optimizer_state=False, zero1=None,
+                 pipeline_axis=None,
                  pipeline_microbatches=None, pipeline_schedule=None,
                  accum_steps=None):
         import jax
+        from ..base import getenv_bool
         if pipeline_axis is not None:
             # only reachable from a subclass that didn't override
             # __init__ — SPMDTrainer itself dispatches in __new__
@@ -216,6 +218,28 @@ class SPMDTrainer:
         self._data_axis = data_axis
         self._donate = donate
         self._opt = fopt.create(optimizer, **(optimizer_params or {}))
+        self._zero1 = getenv_bool("MXNET_ZERO1", False) if zero1 is None \
+            else bool(zero1)
+        if self._zero1 and shard_optimizer_state:
+            raise MXNetError(
+                "zero1 and shard_optimizer_state are two spellings of "
+                "the same memory optimization (flat contiguous shards "
+                "vs per-leaf axis sharding) — pick one")
+        if self._zero1 and not getattr(self._opt, "elementwise", True):
+            if zero1 is not None:
+                raise MXNetError(
+                    "zero1: this optimizer's update is not elementwise "
+                    "(per-tensor reductions, e.g. LAMB's trust ratio, "
+                    "straddle shard boundaries) — drop zero1= or pick "
+                    "an elementwise rule")
+            # env-driven request (MXNET_ZERO1=1): degrade gracefully,
+            # mirroring the eager Trainer's fused-path fallback
+            import warnings
+            warnings.warn(
+                "MXNET_ZERO1=1 ignored: optimizer update is not "
+                "elementwise (per-tensor reductions straddle shard "
+                "boundaries); training proceeds unsharded", stacklevel=2)
+            self._zero1 = False
 
         params_all = list(net.collect_params().values())
         for p in params_all:
@@ -242,18 +266,42 @@ class SPMDTrainer:
         self._aux_vals = tuple(
             _placed_copy(p.data()._data, s)
             for p, s in zip(self._aux, self._aux_shardings))
+        # ZeRO-1 weight-update sharding (paper: "Automatic Cross-Replica
+        # Sharding of Weight Update in Data-Parallel Training",
+        # arXiv:2004.13336) — two tiers of the same idea:
+        #   zero1=True: parallel/zero1.Zero1Optimizer flattens the param
+        #     tree into contiguous padded segments, shards the flat state
+        #     + update over the data axis and all-gathers the new weights
+        #     in-program (exactly the paper's scheme);
+        #   shard_optimizer_state=True: per-leaf axis sharding of the
+        #     state tree (coarser — leaves with no divisible dim stay
+        #     replicated — but composes with FSDP rules).
+        if self._zero1:
+            from . import zero1 as _z1mod
+            self._opt = _z1mod.Zero1Optimizer(self._opt, self._mesh,
+                                              data_axis)
         # zeros_like inside opt.init makes each state leaf inherit its
         # param's sharding (XLA propagates NamedSharding through zeros_like)
         self._opt_state = self._opt.init(self._tr_vals)
-        # ZeRO-1-style weight-update sharding (paper: "Automatic
-        # Cross-Replica Sharding of Weight Update in Data-Parallel
-        # Training", arXiv:2004.13336): optimizer state — normally
-        # replicated over the data axis — is sharded over it instead;
-        # GSPMD turns the gradient psum + sharded update into
-        # reduce-scatter + local update + all-gather automatically.
         self._shard_opt_state = bool(shard_optimizer_state)
         self._opt_state_shardings = None
-        if self._shard_opt_state:
+        if self._zero1:
+            # pin the flat state to P(data) in out_shardings so XLA
+            # materializes 1/N state bytes per replica
+            self._opt_state_shardings = self._make_state_shardings()
+            from . import zero1 as _z1mod
+            _telemetry.gauge(
+                "mxtpu_optimizer_state_bytes",
+                "optimizer-state bytes ONE replica materializes "
+                "(replicated state: the full tree; zero1: its 1/N "
+                "shard)").set(
+                    _z1mod.per_replica_state_bytes(self._opt_state))
+            _telemetry.gauge(
+                "mxtpu_zero1_allgather_bytes",
+                "per-step per-replica inbound all-gather volume the "
+                "zero1 weight-update sharding adds").set(
+                    _z1mod.zero1_allgather_bytes(self._opt.spec))
+        elif self._shard_opt_state:
             self._opt_state_shardings = self._make_state_shardings()
             self._opt_state = jax.tree.map(
                 lambda v, s: jax.device_put(v, s),
@@ -269,8 +317,11 @@ class SPMDTrainer:
         its own inherited sharding (zeros_like in opt.init propagates
         the param's) with the data axis added on the first unsharded,
         divisible dim; leaves already sharded over the data axis (FSDP-
-        style rules) are left as they are."""
+        style rules) are left as they are.  Under zero1 every state leaf
+        is a flat padded segment — always P(data)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        if self._zero1:
+            return self._opt.state_shardings(self._opt_state)
         n = self._mesh.shape[self._data_axis]
 
         def _axes_in(entry):
@@ -293,6 +344,24 @@ class SPMDTrainer:
             return NamedSharding(self._mesh, P(*spec))
         import jax
         return jax.tree.map(leaf_sharding, self._opt_state)
+
+    def _state_out_shardings(self):
+        """out_shardings for the optimizer-state output of the step
+        program.  When no sharding policy pinned them (plain replicated
+        runs: ``_opt_state_shardings is None``) the state must still
+        leave the program with the SAME shardings it entered with: the
+        state is donated, and with the output left unconstrained GSPMD
+        is free to shard any data-axis-divisible leaf — the donated
+        (replicated) input buffer then cannot alias the (sharded)
+        output and XLA rejects the executable (seen with BN-channel-
+        sized momentum leaves, 64 % 8 == 0)."""
+        if self._opt_state_shardings is not None:
+            return self._opt_state_shardings
+        import jax
+        try:
+            return jax.tree.map(lambda v: v.sharding, self._opt_state)
+        except AttributeError:
+            return None
 
     # ------------------------------------------------------------------
     @property
@@ -397,7 +466,7 @@ class SPMDTrainer:
         return _telemetry.instrument_jit("spmd", jax.jit(
             pure_step,
             out_shardings=(None, self._tr_shardings, self._aux_shardings,
-                           self._opt_state_shardings),
+                           self._state_out_shardings()),
             donate_argnums=donate))
 
     def _shard_batch(self, arr):

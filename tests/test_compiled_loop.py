@@ -220,11 +220,33 @@ def test_functional_twin_matches_and_guards():
     tw = functional_twin(opt_mod.SGD(learning_rate=0.1, momentum=0.9))
     assert callable(tw.update)
     with pytest.raises(MXNetError):
-        functional_twin(opt_mod.SGD(rescale_grad=0.5))
-    with pytest.raises(MXNetError):
-        functional_twin(opt_mod.SGD(clip_gradient=1.0))
-    with pytest.raises(MXNetError):
         functional_twin(opt_mod.RMSProp(centered=True))
+
+
+def test_functional_twin_rescale_and_clip_parity():
+    """rescale_grad / clip_gradient thread through the functional twin
+    and match the eager update exactly (they used to raise)."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.optimizer.fused import functional_twin
+    eager = opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                        rescale_grad=0.5, clip_gradient=0.04)
+    tw = functional_twin(eager)
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal((5, 3)).astype(np.float32)
+    g0 = rng.standard_normal((5, 3)).astype(np.float32)
+
+    w_nd = NDArray(jnp.asarray(w0))
+    g_nd = NDArray(jnp.asarray(g0))
+    st = eager.create_state(0, w_nd)
+    eager.update(0, w_nd, g_nd, st)
+
+    params = (jnp.asarray(w0),)
+    fstate = tw.init(params)
+    new_p, fstate = tw.update(params, (jnp.asarray(g0),), fstate,
+                              jnp.asarray(1, jnp.int32))
+    assert np.array_equal(np.asarray(w_nd._data), np.asarray(new_p[0]))
 
 
 # -------------------------------------------------- prefetcher behavior

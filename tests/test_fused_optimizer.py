@@ -80,6 +80,9 @@ FUSED_CONFIGS = [
     ("adam", {"learning_rate": 0.01, "clip_gradient": 0.1}),
     ("sgd", {"learning_rate": 0.05, "momentum": 0.9,
              "clip_gradient": 0.05}),
+    ("lamb", {"learning_rate": 0.01, "wd": 0.01}),
+    ("lamb", {"learning_rate": 0.01, "wd": 0.01,
+              "bias_correction": False}),
 ]
 
 
@@ -433,6 +436,7 @@ def test_fused_updater_shares_cores_with_spmd():
     """One set of update cores: the registry the SPMD path uses covers
     every optimizer the fused envelope supports."""
     from incubator_mxnet_tpu.parallel import optim as fopt
-    for name in ("sgd", "nag", "adam", "adamw", "rmsprop", "adagrad"):
+    for name in ("sgd", "nag", "adam", "adamw", "rmsprop", "adagrad",
+                 "lamb"):
         f = fopt.create(name)
         assert isinstance(f, fopt.FunctionalOptimizer)
