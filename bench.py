@@ -646,7 +646,15 @@ def _bench_generate(on_accel, kind, dev):
     attention work.  Both paths are greedy over the same engine, so the
     per-request token sequences are asserted IDENTICAL; the >= 3x
     tokens/sec floor on the CPU config is the acceptance bar of
-    docs/serving.md."""
+    docs/serving.md.
+
+    Two paged-KV axes ride along (docs/serving.md "Paged KV cache"):
+    ``concurrent_streams_per_gb`` pits the paged pool against the dense
+    per-slot cache under an EQUAL cache-byte budget — 16 shared-prefix
+    streaming clients, peak concurrent slots normalized per GB of
+    cache, floor >= 2x — and ``prefix_prefill_savings`` measures the
+    prefill FLOPs drop (XLA_COST plane) when a repeated prompt hits the
+    prefix cache and only its suffix is prefilled, floor >= 1.3x."""
     import threading
 
     import incubator_mxnet_tpu as mx
@@ -669,8 +677,11 @@ def _bench_generate(on_accel, kind, dev):
                    num_heads=heads, max_length=max_len, dropout=0.0)
     net.initialize(init=mx.init.Normal(0.1))
     net(mx.nd.array(np.zeros((1, 2), np.int32)))
+    # prefix_cache off HERE so the naive baseline stays honest: with
+    # sharing on, its repeated full-context prefills would hit the
+    # prefix cache and stop being the cacheless O(n^2) reference
     engine = GenerationEngine(net, name="bench-gen", max_slots=clients,
-                              max_len=max_len)
+                              max_len=max_len, prefix_cache=False)
     engine.warmup()
 
     rng = np.random.default_rng(0)
@@ -756,6 +767,94 @@ def _bench_generate(on_accel, kind, dev):
 
     speedup = round(continuous["tokens_per_sec"]
                     / max(naive["tokens_per_sec"], 1e-9), 3)
+
+    # -- paged vs dense concurrency under an EQUAL cache-byte budget --
+    # dense buys 4 slots x max_len positions; the paged pool holds the
+    # same token-positions as 16-token blocks (plus the null block) and
+    # lets 16 shared-prefix clients fit in them
+    system = [int(t) for t in rng.integers(1, V, size=32)]
+    shared_prompts = [system + [int(t) for t in rng.integers(1, V, size=4)]
+                      for _ in range(clients)]
+    shared_new = 12
+    dense_eng = GenerationEngine(net, name="bench-dense", max_slots=4,
+                                 max_len=max_len, paged=False)
+    paged_eng = GenerationEngine(net, name="bench-paged",
+                                 max_slots=clients, max_len=max_len,
+                                 paged=True, block_size=16,
+                                 num_blocks=1 + (4 * max_len) // 16)
+
+    def peak_streams(eng, tag):
+        bat = ContinuousBatcher(eng, name=f"bench-{tag}")
+        try:
+            reqs = [bat.submit_async(p, max_new_tokens=shared_new)
+                    for p in shared_prompts]
+            outs = [r.result(timeout=300) for r in reqs]
+            return outs, bat.stats()["peak_slots_in_use"]
+        finally:
+            bat.close()
+
+    dense_outs, dense_peak = peak_streams(dense_eng, "dense")
+    paged_outs, paged_peak = peak_streams(paged_eng, "paged")
+    if paged_outs != dense_outs:
+        raise RuntimeError(
+            "paged stream outputs != dense under the shared-prefix "
+            "load (greedy decode must be exact)")
+    gb = float(2 ** 30)
+    dense_spg = dense_peak / (dense_eng.cache_bytes / gb)
+    paged_spg = paged_peak / (paged_eng.cache_bytes / gb)
+    streams_ratio = round(paged_spg / max(dense_spg, 1e-9), 3)
+    streams_axis = {
+        "clients": clients,
+        "dense": {"peak_streams": int(dense_peak),
+                  "cache_mb": round(dense_eng.cache_bytes / 2**20, 3),
+                  "streams_per_gb": round(dense_spg, 1)},
+        "paged": {"peak_streams": int(paged_peak),
+                  "cache_mb": round(paged_eng.cache_bytes / 2**20, 3),
+                  "streams_per_gb": round(paged_spg, 1),
+                  "prefix_cache_hits": paged_eng.pool.hits},
+        "paged_vs_dense": streams_ratio,
+        "floor": "paged_vs_dense >= 2.0",
+        "floor_ok": bool(streams_ratio >= 2.0),
+    }
+
+    # -- prefix-cache prefill savings: the same prompt twice; the hit
+    # run prefills only the suffix bucket, measured on the XLA_COST
+    # plane (analytical FLOPs of each dispatched prefill program) -----
+    pp_eng = GenerationEngine(net, name="bench-prefix", max_slots=4,
+                              max_len=max_len)
+    pp_prompt = system + [3, 1, 4]
+    cost_events = []
+
+    def on_cost(**kw):
+        cost_events.append(kw)
+
+    def prefill_flops():
+        return sum(e["flops"] for e in cost_events
+                   if "prefill" in e["where"])
+
+    telemetry.XLA_COST.subscribe(on_cost)
+    try:
+        cold_out = pp_eng.generate(pp_prompt, max_new_tokens=4)
+        cold_flops = prefill_flops()
+        cost_events.clear()
+        hit_out = pp_eng.generate(pp_prompt, max_new_tokens=4)
+        hit_flops = prefill_flops()
+    finally:
+        telemetry.XLA_COST.unsubscribe(on_cost)
+    if hit_out != cold_out:
+        raise RuntimeError("prefix-hit generation != cold generation")
+    savings = round(cold_flops / max(hit_flops, 1e-9), 3)
+    prefix_axis = {
+        "prompt_tokens": len(pp_prompt),
+        "shared_prefix_tokens": (len(pp_prompt) // 16) * 16,
+        "cold_prefill_gflops": round(cold_flops / 1e9, 5),
+        "hit_prefill_gflops": round(hit_flops / 1e9, 5),
+        "prefix_cache_hits": pp_eng.pool.hits,
+        "savings": savings,
+        "floor": "savings >= 1.3",
+        "floor_ok": bool(savings >= 1.3),
+    }
+
     return {
         "model": f"gpt_{L}L_{U}u_{heads}h",
         "clients": clients,
@@ -771,7 +870,10 @@ def _bench_generate(on_accel, kind, dev):
         "outputs_identical": True,
         "speedup": speedup,
         "speedup_floor": 3.0,
-        "floor_ok": bool(speedup >= 3.0),
+        "concurrent_streams_per_gb": streams_axis,
+        "prefix_prefill_savings": prefix_axis,
+        "floor_ok": bool(speedup >= 3.0 and streams_axis["floor_ok"]
+                         and prefix_axis["floor_ok"]),
     }
 
 

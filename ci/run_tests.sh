@@ -63,6 +63,15 @@ usage: ci/run_tests.sh <function>
                         during decode fails the rider (id on the error
                         event) and recovers via the watchdog, and
                         mxtpu_generate_* series are on /metrics
+  paged_smoke           paged KV-cache drill: under an EQUAL cache-byte
+                        budget (dense 4x128 positions == paged 32x16
+                        blocks), 16 streaming clients with a shared
+                        32-token system prompt; asserts every paged
+                        stream is token-identical to dense solo decode,
+                        paged sustains >= 2x the dense concurrent
+                        slots, prefix-cache hits > 0 with the kv/prefix
+                        series on /metrics, and a child server drains
+                        in-flight streams cleanly on SIGTERM (exit 0)
   lifecycle_smoke       lifecycle drill (three parts): SIGTERM a serving
                         child under 16 concurrent clients — zero reset
                         connections, /readyz flips 503 before the port
@@ -779,6 +788,195 @@ print(f"generate_smoke ok: late first-token led long last-token by "
       f"{len(toks_h)} tokens and recovered, "
       f"{stats['tokens_emitted']} tokens in {stats['decode_steps']} "
       f"decode steps")
+EOF
+}
+
+paged_smoke() {
+    # child server script for the SIGTERM-drain leg
+    cat > /tmp/mxtpu_paged_child.py <<'CHILD'
+import sys
+import numpy as np
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import (GenerationEngine, ModelServer,
+                                         lifecycle)
+
+mx.random.seed(7)
+net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+               num_heads=2, max_length=128, dropout=0.0)
+net.initialize(init=mx.init.Normal(0.6))
+net(mx.nd.array(np.zeros((1, 2), np.int32)))
+eng = GenerationEngine(net, name="gen", max_slots=8, max_len=128)
+srv = ModelServer(port=0)
+srv.add_model("gen", eng, warmup=True)
+srv.start()
+print(f"PORT {srv.port}", flush=True)
+sys.exit(lifecycle.run_until_shutdown(srv))
+CHILD
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import (ContinuousBatcher,
+                                         GenerationEngine, ModelServer)
+
+telemetry.start()
+mx.random.seed(7)
+net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+               num_heads=2, max_length=128, dropout=0.0)
+net.initialize(init=mx.init.Normal(0.6))
+net(mx.nd.array(np.zeros((1, 2), np.int32)))
+
+# Equal cache-byte budget: dense 4 slots x 128 positions == 512
+# cached token-positions == paged 32 usable blocks x 16 tokens.
+SYSTEM = [7] * 32                       # shared system prompt: 2 blocks
+N_CLIENTS, NEW = 16, 12
+
+
+def prompt_for(i):
+    return SYSTEM + [1 + (i % 40), 2 + (i % 37), 3, 4]
+
+
+dense = GenerationEngine(net, name="gen", max_slots=4, max_len=128,
+                         paged=False)
+solo = []
+for i in range(N_CLIENTS):
+    solo.append(dense.generate(prompt_for(i), max_new_tokens=NEW))
+    dense.reset()
+
+# -- 1. dense concurrency under the byte budget: 16 clients share the
+#       4 slots the budget buys ---------------------------------------
+bat = ContinuousBatcher(dense, name="gen")
+reqs = [bat.submit_async(prompt_for(i), max_new_tokens=NEW)
+        for i in range(N_CLIENTS)]
+for i, r in enumerate(reqs):
+    assert r.result(timeout=120) == solo[i], \
+        f"paged_smoke: dense batched output {i} != solo"
+dense_peak = bat.stats()["peak_slots_in_use"]
+bat.close()
+assert dense_peak <= 4, f"paged_smoke: dense peak {dense_peak} > slots"
+
+# -- 2. paged server, SAME byte budget: 16 streaming clients, strictly
+#       more concurrent slots, prefix hits on the shared prompt -------
+paged = GenerationEngine(net, name="gen", max_slots=16, max_len=128,
+                         paged=True, block_size=16, num_blocks=33)
+srv = ModelServer(port=0)
+srv.add_model("gen", paged, warmup=True)
+srv.start()
+url = f"http://127.0.0.1:{srv.port}"
+
+outs, errors = [None] * N_CLIENTS, []
+
+
+def client(i):
+    try:
+        req = urllib.request.Request(
+            url + "/v1/models/gen:generate",
+            data=json.dumps({"tokens": prompt_for(i),
+                             "max_new_tokens": NEW,
+                             "stream": True}).encode())
+        toks = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.strip()
+                if line.startswith(b"data:"):
+                    d = json.loads(line.split(b":", 1)[1])
+                    if "token" in d:
+                        toks.append(d["token"])
+        outs[i] = toks
+    except Exception as e:               # noqa: BLE001
+        errors.append(f"client{i}: {e!r}")
+
+
+threads = [threading.Thread(target=client, args=(i,))
+           for i in range(N_CLIENTS)]
+[t.start() for t in threads]
+[t.join(timeout=180) for t in threads]
+assert not errors, f"paged_smoke: stream failures: {errors[:5]}"
+for i in range(N_CLIENTS):
+    assert outs[i] == solo[i], \
+        f"paged_smoke: paged stream {i} != dense solo"
+
+stats = json.load(urllib.request.urlopen(
+    url + "/v1/models", timeout=10))["models"]["gen"]
+paged_peak = stats["peak_slots_in_use"]
+assert paged_peak > dense_peak and paged_peak >= 2 * dense_peak, \
+    f"paged_smoke: paged peak {paged_peak} vs dense {dense_peak} — " \
+    f"expected >= 2x under the same cache-byte budget"
+assert stats["kv_paged"] and stats["prefix_cache_hits"] > 0, \
+    f"paged_smoke: no prefix hits on the shared system prompt: {stats}"
+
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+for series in ("mxtpu_kv_blocks_in_use", "mxtpu_kv_blocks_total",
+               "mxtpu_prefix_cache_hits"):
+    assert series in prom, f"paged_smoke: {series} missing from /metrics"
+srv.stop()
+
+# -- 3. SIGTERM drain: a child paged server finishes in-flight streams
+#       and exits 0 ----------------------------------------------------
+env = dict(os.environ, MXNET_DRAIN_SECONDS="10", JAX_PLATFORMS="cpu",
+           PYTHONPATH=os.getcwd())
+child = subprocess.Popen([sys.executable, "/tmp/mxtpu_paged_child.py"],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, env=env, text=True)
+line = child.stdout.readline().strip()
+assert line.startswith("PORT "), f"paged_smoke: bad handshake {line!r}"
+port = int(line.split()[1])
+curl = f"http://127.0.0.1:{port}"
+
+drained, derrors = [None] * 4, []
+
+
+def drain_client(i):
+    try:
+        req = urllib.request.Request(
+            curl + "/v1/models/gen:generate",
+            data=json.dumps({"tokens": prompt_for(i),
+                             "max_new_tokens": NEW,
+                             "stream": True}).encode())
+        toks = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            for line in r:
+                line = line.strip()
+                if line.startswith(b"data:"):
+                    d = json.loads(line.split(b":", 1)[1])
+                    if "token" in d:
+                        toks.append(d["token"])
+        drained[i] = toks
+    except Exception as e:               # noqa: BLE001
+        derrors.append(f"drain client{i}: {e!r}")
+
+
+dthreads = [threading.Thread(target=drain_client, args=(i,))
+            for i in range(4)]
+[t.start() for t in dthreads]
+time.sleep(0.5)                          # streams in flight
+child.send_signal(signal.SIGTERM)
+rc = child.wait(timeout=30)
+[t.join(timeout=30) for t in dthreads]
+assert rc == 0, f"paged_smoke: child exited {rc} on SIGTERM, expected 0"
+assert not derrors, f"paged_smoke: drain dropped streams: {derrors}"
+for i in range(4):
+    assert drained[i] == solo[i], \
+        f"paged_smoke: drained stream {i} truncated or wrong"
+
+telemetry.stop()
+print(f"paged_smoke ok: equal 512-token budget sustained "
+      f"{paged_peak} paged vs {dense_peak} dense concurrent slots, "
+      f"{stats['prefix_cache_hits']} prefix-cache hits on the shared "
+      f"system prompt, SIGTERM drained 4 in-flight streams cleanly")
 EOF
 }
 

@@ -124,7 +124,8 @@ def stats_main():
     sys.exit(status)
 
 
-def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None):
+def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
+                            paged=None, block_size=None):
     """Build a :class:`serving.GenerationEngine` from a ``--gen-model``
     JSON config: architecture kwargs for ``models.gpt.GPTModel`` plus a
     ``"params"`` weights file (``Block.save_parameters`` format,
@@ -149,8 +150,12 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None):
     params = cfg.pop("params", None)
     cfg_slots = cfg.pop("max_slots", None)
     cfg_len = cfg.pop("max_len", None)
+    cfg_paged = cfg.pop("paged", None)
+    cfg_bs = cfg.pop("block_size", None)
     max_slots = cfg_slots if max_slots is None else max_slots
     max_len = cfg_len if max_len is None else max_len
+    paged = cfg_paged if paged is None else paged
+    block_size = cfg_bs if block_size is None else block_size
     cfg.setdefault("dropout", 0.0)      # serving never trains
     net = GPTModel(**cfg)
     net.initialize(init.Normal(0.02))
@@ -161,7 +166,8 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None):
                 os.path.abspath(cfg_path)), params)
         net.load_parameters(params)
     return GenerationEngine(net, name=name, max_slots=max_slots,
-                            max_len=max_len)
+                            max_len=max_len, paged=paged,
+                            block_size=block_size)
 
 
 def serve_main():
@@ -175,6 +181,7 @@ def serve_main():
                     [--queue N] [--input-names data]
                     [--input-specs 784] [--warmup]
                     [--gen-slots N] [--gen-max-len N]
+                    [--gen-paged 0|1] [--gen-block-size N]
 
     Each ``--model`` is ``NAME=PREFIX[:EPOCH]`` naming a
     ``HybridBlock.export`` / ``model.save_checkpoint`` pair
@@ -195,7 +202,10 @@ def serve_main():
     ``/v1/models/<NAME>:generate`` behind continuous batching
     (docs/serving.md); ``--gen-slots`` / ``--gen-max-len`` override the
     config and the ``MXNET_GEN_MAX_SLOTS`` / ``MXNET_GEN_MAX_LEN``
-    env defaults."""
+    env defaults.  The KV cache is paged by default (block pool +
+    prefix sharing); ``--gen-paged 0`` restores the dense layout and
+    ``--gen-block-size`` sets tokens per block (``MXNET_KV_PAGED`` /
+    ``MXNET_KV_BLOCK_SIZE``)."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -242,6 +252,13 @@ def serve_main():
     ap.add_argument("--gen-max-len", type=int, default=None,
                     help="KV-cache sequence capacity (default config or "
                          "MXNET_GEN_MAX_LEN or the model's max_length)")
+    ap.add_argument("--gen-paged", type=int, choices=(0, 1), default=None,
+                    help="paged KV cache: 1 on (default; block pool + "
+                         "prefix sharing), 0 dense fallback (also "
+                         "MXNET_KV_PAGED)")
+    ap.add_argument("--gen-block-size", type=int, default=None,
+                    help="tokens per paged KV block (default "
+                         "MXNET_KV_BLOCK_SIZE or 16)")
     ns = ap.parse_args()
     if not ns.model and not ns.gen_model:
         ap.error("at least one --model NAME=PREFIX[:EPOCH] or "
@@ -289,12 +306,16 @@ def serve_main():
             ap.error(f"--gen-model wants NAME=CONFIG.json, got {spec!r}")
         engine = _load_generation_engine(
             name, cfg_path, max_slots=ns.gen_slots,
-            max_len=ns.gen_max_len)
+            max_len=ns.gen_max_len,
+            paged=None if ns.gen_paged is None else bool(ns.gen_paged),
+            block_size=ns.gen_block_size)
         srv.add_model(name, engine, warmup=ns.warmup)
+        kv = (f"paged blocks={engine.num_blocks - 1}x"
+              f"{engine.block_size}" if engine.paged else "dense")
         sys.stderr.write(
             f"mxtpu-serve: loaded generation model {name} from "
             f"{cfg_path} (slots {engine.max_slots}, max_len "
-            f"{engine.max_len}, prefill buckets "
+            f"{engine.max_len}, kv {kv}, prefill buckets "
             f"{list(engine.prefill_buckets)})\n")
     srv.start()
     sys.stderr.write(f"mxtpu-serve: listening on "

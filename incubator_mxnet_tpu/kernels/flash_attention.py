@@ -40,7 +40,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "flash_attention_lse", "decode_attention"]
+__all__ = ["flash_attention", "flash_attention_lse", "decode_attention",
+           "paged_decode_attention"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -654,3 +655,140 @@ def decode_attention(q, k, v, positions, scale=None):
     if platform == "cpu" or not aligned:
         return _xla_decode_attention(q, k, v, positions, scale)
     return _decode_pallas(q, k, v, positions, scale, interpret=False)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention: the same single-query attention, but the KV
+# cache lives in fixed-size blocks (serving/kvcache.py BlockPool) and
+# each slot reads through an int32 block table instead of a dense strip.
+# ---------------------------------------------------------------------------
+
+def _xla_paged_decode_attention(q, k_pages, v_pages, tables, positions,
+                                scale):
+    """Gather each slot's blocks into a dense (S, H, T, D) view and reuse
+    :func:`_xla_decode_attention` verbatim.  Masked (stale / null-block)
+    positions contribute exact-zero softmax weight, so the result is
+    bit-identical to dense decode over the same valid entries."""
+    S, nb = tables.shape
+    _, H, bs, D = k_pages.shape
+    k = jnp.moveaxis(k_pages[tables], 2, 1).reshape(S, H, nb * bs, D)
+    v = jnp.moveaxis(v_pages[tables], 2, 1).reshape(S, H, nb * bs, D)
+    return _xla_decode_attention(q, k, v, positions, scale)
+
+
+def _paged_decode_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, scale, block_k, n_kb):
+    """Grid (S, H, n_kb): like :func:`_decode_kernel`, but the K/V block
+    for grid step ``kb`` was fetched through the scalar-prefetched block
+    table (see the index maps in :func:`_paged_decode_pallas`), so the
+    kernel body only differs in where ``pos`` comes from."""
+    from jax.experimental import pallas as pl
+    s = pl.program_id(0)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[s]
+    D = q_ref.shape[-1]
+    q = q_ref[...].reshape(1, D).astype(jnp.float32)
+    k = k_ref[...].reshape(block_k, D).astype(jnp.float32)
+    sc = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # (1, block_k)
+    idx = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    sc = jnp.where(idx <= pos, sc, -1e30)
+    m_prev, l_prev = m_ref[:], l_ref[:]               # (1, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(sc - m_new)                           # (1, block_k)
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    v_blk = v_ref[...].reshape(block_k, D).astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (1, D)
+
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        o_ref[...] = (acc_ref[:] / l_ref[:]).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, tables, positions, scale,
+                         interpret):
+    """Block tables + positions ride as scalar-prefetch operands, so the
+    BlockSpec index maps can route grid step (s, h, kb) straight to
+    physical block ``tables[s, kb]`` — the gather never materializes a
+    dense (S, H, T, D) view."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, n_kb = tables.shape
+    _, H, bs, D = k_pages.shape
+    kernel = functools.partial(_paged_decode_kernel, scale=scale,
+                               block_k=bs, n_kb=n_kb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda s, h, kb, tbl, pos: (s, h, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda s, h, kb, tbl, pos: (tbl[s, kb], h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda s, h, kb, tbl, pos: (tbl[s, kb], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda s, h, kb, tbl, pos: (s, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), positions.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_decode_attention(q, k_pages, v_pages, tables, positions,
+                           scale=None):
+    """Per-slot single-position attention over a PAGED KV cache.
+
+    ``q`` (S, H, D): this step's query; ``k_pages``/``v_pages``
+    (num_blocks, H, block_size, D): the block pool, already holding this
+    position's K/V; ``tables`` (S, max_blocks) int32: each slot's block
+    table, padded with the null block 0; ``positions`` (S,) int32: each
+    slot's current write head in logical token coordinates.  Attends over
+    logical positions ``<= positions[s]`` and returns (S, H, D).
+
+    The lax gather reference is the default (and the CPU path); the
+    Pallas kernel — the table-driven gather XLA has no good lowering
+    for — sits behind ``MXNET_USE_FUSION`` on accelerators and
+    ``MXNET_FA_DECODE_FORCE_PALLAS=1`` (interpret mode) for parity
+    tests."""
+    from ..base import getenv_bool
+    _, H, bs, D = k_pages.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    force = getenv_bool("MXNET_FA_DECODE_FORCE_PALLAS")
+    aligned = bs % 8 == 0 and D % 8 == 0
+    if force and aligned:
+        return _paged_decode_pallas(q, k_pages, v_pages, tables, positions,
+                                    scale, interpret=platform == "cpu")
+    if platform == "cpu" or not aligned \
+            or not getenv_bool("MXNET_USE_FUSION"):
+        return _xla_paged_decode_attention(q, k_pages, v_pages, tables,
+                                           positions, scale)
+    return _paged_decode_pallas(q, k_pages, v_pages, tables, positions,
+                                scale, interpret=False)

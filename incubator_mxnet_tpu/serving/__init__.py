@@ -22,9 +22,11 @@ Four layers, importable à la carte:
   exporter.  CLI: ``mxtpu-serve``.
 
 Generation serving rides the same layers: :class:`GenerationEngine`
-(preallocated KV cache, prefill/decode split) behind a
+(paged KV cache over a :class:`~.kvcache.BlockPool` — fixed-size
+blocks, per-slot block tables, refcounted prefix sharing; dense mode
+via ``MXNET_KV_PAGED=0`` — with a prefill/decode split) behind a
 :class:`ContinuousBatcher` (per-slot join/leave, one decode dispatch
-per step over all live requests) behind
+per step over all live requests, pool-capacity admission) behind
 ``POST /v1/models/<name>:generate`` with SSE streaming.
 
 Importing this package registers the ``mxtpu_serve_*`` metrics on the
@@ -40,11 +42,13 @@ from .lifecycle import (
 )
 from .engine import InferenceEngine, GenerationEngine, derive_buckets, \
     derive_prefill_buckets
+from .kvcache import BlockPool, blocks_for
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .server import ModelServer
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
-           "derive_prefill_buckets", "DynamicBatcher",
+           "derive_prefill_buckets", "BlockPool", "blocks_for",
+           "DynamicBatcher",
            "ContinuousBatcher", "QueueFullError", "ModelServer",
            "metrics", "lifecycle",
            "CircuitBreaker", "Watchdog", "DeadlineExceeded",

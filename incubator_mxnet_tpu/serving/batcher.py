@@ -82,7 +82,13 @@ __all__ = ["DynamicBatcher", "ContinuousBatcher", "QueueFullError"]
 
 
 class QueueFullError(MXNetError):
-    """The batcher's bounded queue is full — backpressure, not failure."""
+    """The batcher's bounded queue is full — backpressure, not failure.
+    ``retry_after`` (seconds) rides to the HTTP surface as a
+    ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
 
 
 class _Request:
@@ -772,6 +778,7 @@ class ContinuousBatcher(DynamicBatcher):
             [None] * int(engine.max_slots)
         self._step = 0
         self._tokens_emitted = 0
+        self._peak_slots = 0
         super().__init__(engine, **kw)
 
     # admission control: the parent's rows//max_batch estimate is
@@ -819,11 +826,24 @@ class ContinuousBatcher(DynamicBatcher):
         with self._cv:
             if self._closed:
                 raise MXNetError(f"batcher {self.name!r} is closed")
-            if len(self._queue) >= self.queue_size:
+            # capacity-aware backpressure: a queue the KV cache can never
+            # drain fast enough is just a slow 504 — bound admissions by
+            # how many streams of THIS request's footprint the cache
+            # sustains, and tell the client when to come back
+            allowed = self.queue_size
+            cap_fn = getattr(self.engine, "kv_capacity_tokens", None)
+            if cap_fn is not None:
+                streams = max(1, min(int(self.engine.max_slots),
+                                     int(cap_fn()) // (n + budget)))
+                allowed = min(allowed, 4 * streams)
+            if len(self._queue) >= allowed:
                 _m.REJECTED.inc(model=self.name)
+                retry = max(1.0, min(30.0,
+                                     self._avg_batch_seconds * budget))
                 raise QueueFullError(
-                    f"{self.name}: queue full ({self.queue_size} "
-                    "pending) — backpressure")
+                    f"{self.name}: queue full ({len(self._queue)} "
+                    f"pending, {allowed} admitted for this request "
+                    "size) — backpressure", retry_after=retry)
             self._queue.append(req)
             _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
             self._cv.notify_all()
@@ -908,8 +928,17 @@ class ContinuousBatcher(DynamicBatcher):
                 joins = []
                 free = [s for s, r in enumerate(self._slots)
                         if r is None]
+                can = getattr(self.engine, "can_admit", None)
+                est = getattr(self.engine, "reserve_estimate", None)
+                reserved = 0    # blocks promised to earlier admits
                 while self._queue and free:
-                    req = self._queue.popleft()
+                    req = self._queue[0]
+                    if can is not None and not can(
+                            req.tokens, req.n + req.budget, reserved):
+                        break   # head-of-line waits for blocks to free
+                    self._queue.popleft()
+                    if est is not None:
+                        reserved += est(req.n + req.budget)
                     slot = free.pop(0)
                     req.slot = slot
                     self._slots[slot] = req
@@ -918,6 +947,7 @@ class ContinuousBatcher(DynamicBatcher):
                         if r is not None]
                 _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
                 _m.SLOTS_IN_USE.set(len(live), model=self.name)
+                self._peak_slots = max(self._peak_slots, len(live))
                 if leavers or joins or live:
                     self._busy_since = now
                     self._inflight = [r for _, r in live]
@@ -962,7 +992,8 @@ class ContinuousBatcher(DynamicBatcher):
                                    request_id=req.request_id,
                                    prompt_tokens=req.n):
             try:
-                first = self.engine.prefill(req.tokens, slot)
+                first = self.engine.prefill(
+                    req.tokens, slot, reserve_tokens=req.n + req.budget)
             except Exception as e:
                 with self._cv:
                     if self._slots[slot] is req:
@@ -1040,7 +1071,12 @@ class ContinuousBatcher(DynamicBatcher):
     def _leave(self, slot: int, req: _GenRequest, reason: str):
         """Emit the ``slot.leave`` event and settle the request: ok for
         ``finished``, ``Cancelled`` for a client that went away,
-        ``DeadlineExceeded`` (stage=decode) for a budget bust."""
+        ``DeadlineExceeded`` (stage=decode) for a budget bust.  Paged
+        engines get the slot's KV blocks back here (decref — shared
+        prefix blocks survive for other readers)."""
+        rel = getattr(self.engine, "release_slot", None)
+        if rel is not None:
+            rel(slot)
         with _telemetry.trace_span("slot.leave", cat="serving",
                                    model=self.name, slot=slot,
                                    request_id=req.request_id,
@@ -1145,8 +1181,12 @@ class ContinuousBatcher(DynamicBatcher):
                                     if r is not None),
                 "decode_steps": self._step,
                 "tokens_emitted": self._tokens_emitted,
+                "peak_slots_in_use": self._peak_slots,
                 "prefill_buckets": list(self.engine.prefill_buckets),
                 "kv_cache_bytes": int(self.engine.cache_bytes),
             })
+            ks = getattr(self.engine, "kv_stats", None)
+            if ks is not None:
+                out.update(ks())
         out.pop("max_delay_ms", None)
         return out

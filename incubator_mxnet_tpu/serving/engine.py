@@ -429,15 +429,34 @@ class GenerationEngine:
 
     Decoding is greedy (argmax) — the serving contract is determinism:
     cached decode must match the full re-forward token-for-token.
+
+    **Paged mode** (default; ``MXNET_KV_PAGED=0`` falls back to the dense
+    layout above): the cache becomes per-layer block pools ``[num_blocks,
+    heads, block_size, head_dim]`` managed by a
+    :class:`~.kvcache.BlockPool`, and each slot addresses its K/V through
+    an int32 *block table* operand — an (S, max_blocks) array that enters
+    the SAME compiled programs as data, never as a shape.  A request
+    reserves only ``ceil((prompt + budget) / block_size)`` blocks, so the
+    same byte budget admits many more concurrent streams, and full prompt
+    blocks are shared across requests via the pool's prefix cache (a
+    prefix hit prefills only the unshared suffix).  The program set stays
+    closed: one miss-prefill per bucket, one suffix-prefill per bucket
+    (prefix hits), and ONE paged decode.  Decode attention routes through
+    :func:`kernels.flash_attention.paged_decode_attention`, whose lax
+    gather reference keeps paged decode bit-identical to dense.
     """
 
     def __init__(self, block, *, name: Optional[str] = None,
                  max_slots: Optional[int] = None,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
+                 paged: Optional[bool] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  ctx=None):
         import jax
-        from ..base import getenv_int
+        from ..base import getenv_int, getenv_bool
         for attr in ("embed", "pos_embed", "cells", "ln_f", "_units",
                      "_max_length"):
             if not hasattr(block, attr):
@@ -473,12 +492,55 @@ class GenerationEngine:
                     f"{self.prefill_buckets}")
         else:
             self.prefill_buckets = derive_prefill_buckets(self.max_len)
+        # paged KV cache (serving/kvcache.py): on by default, dense stays
+        # available as the fallback and parity oracle
+        self.paged = bool(getenv_bool("MXNET_KV_PAGED", True)
+                          if paged is None else paged)
+        self.block_size = int(block_size
+                              or getenv_int("MXNET_KV_BLOCK_SIZE", 16))
+        if self.block_size < 1:
+            raise MXNetError(f"block_size must be >= 1: {self.block_size}")
+        self.prefix_cache_enabled = self.paged and bool(
+            getenv_bool("MXNET_KV_PREFIX_CACHE", True)
+            if prefix_cache is None else prefix_cache)
+        if self.paged:
+            from .kvcache import BlockPool
+            self.max_blocks_per_slot = -(-self.max_len // self.block_size)
+            nb = int(num_blocks or getenv_int("MXNET_KV_NUM_BLOCKS", 0)) \
+                or 1 + self.max_slots * self.max_blocks_per_slot
+            if nb < 1 + self.max_blocks_per_slot:
+                raise MXNetError(
+                    f"num_blocks {nb} cannot hold even one max_len slot "
+                    f"({self.max_blocks_per_slot} blocks + null block)")
+            self.num_blocks = nb
+            self.pool = BlockPool(nb, self.block_size,
+                                  prefix_cache=self.prefix_cache_enabled,
+                                  model=self.name)
+        else:
+            self.max_blocks_per_slot = 0
+            self.num_blocks = 0
+            self.pool = None
+        self._warming = False
         self._settle_params()
-        self._prefill_jit = jax.jit(self._prefill_pure,
-                                    donate_argnums=(0,))
+        if self.paged:
+            self._prefill_jit = jax.jit(self._prefill_paged_pure,
+                                        donate_argnums=(0,))
+            self._prefill_ext_jit = jax.jit(self._prefill_ext_pure,
+                                            donate_argnums=(0,))
+            self._prefill_ext = _telemetry.instrument_jit(
+                "serving:" + self.name + ":prefill_ext",
+                self._prefill_ext_jit)
+            self._decode_jit = jax.jit(self._decode_paged_pure,
+                                       donate_argnums=(0,))
+        else:
+            self._prefill_jit = jax.jit(self._prefill_pure,
+                                        donate_argnums=(0,))
+            self._prefill_ext_jit = None
+            self._prefill_ext = None
+            self._decode_jit = jax.jit(self._decode_pure,
+                                       donate_argnums=(0,))
         self._prefill = _telemetry.instrument_jit(
             "serving:" + self.name + ":prefill", self._prefill_jit)
-        self._decode_jit = jax.jit(self._decode_pure, donate_argnums=(0,))
         self._decode = _telemetry.instrument_jit(
             "serving:" + self.name + ":decode", self._decode_jit)
         self._warmup_done = False
@@ -598,13 +660,193 @@ class GenerationEngine:
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
         return tuple(caches), nxt
 
+    # -- pure programs, paged layout ------------------------------------
+    def _scatter_block(self, pool, hslice, table, idx, traced_idx):
+        """Write an (H, w, D) strip into block ``table[idx]`` of a
+        (num_blocks, H, block_size, D) pool.  ``idx`` may be traced
+        (``traced_idx``) — out-of-range indices redirect to the null
+        block 0, where padded-garbage writes are harmless."""
+        import jax.numpy as jnp
+        from jax import lax
+        NB = self.max_blocks_per_slot
+        if traced_idx:
+            blk = jnp.where(idx < NB,
+                            jnp.take(table, jnp.minimum(idx, NB - 1)), 0)
+        else:
+            blk = table[idx]
+        return lax.dynamic_update_slice(
+            pool, hslice[None].astype(pool.dtype), (blk, 0, 0, 0))
+
+    def _prefill_paged_pure(self, cache, tokens, n_valid, table,
+                            param_vals, aux_vals, key):
+        """Prefix-cache MISS prefill: the exact dense prefill body (so
+        paged == dense bit-for-bit), with the slot's K/V scattered into
+        the blocks named by ``table`` (max_blocks,) int32 instead of a
+        dense row.  Positions past the table's reservation redirect to
+        the null block."""
+        import jax.numpy as jnp
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        Tb = tokens.shape[1]
+        bs = self.block_size
+
+        def body():
+            x = self.block._embed_at(NDArray(tokens))
+            ks, vs = [], []
+            for cell in self._cells:
+                x, k, v = cell.prime(x)
+                ks.append(k._data)
+                vs.append(v._data)
+            logits = self.block._project(self.block.ln_f(x))
+            return logits._data, ks, vs
+
+        logits, ks, vs = self._with_params(param_vals, aux_vals, key, body)
+        out = list(cache)
+        for l in range(L):
+            kh = ks[l].reshape(Tb, H, D).transpose(1, 0, 2)
+            vh = vs[l].reshape(Tb, H, D).transpose(1, 0, 2)
+            for j in range(-(-Tb // bs)):
+                out[l] = self._scatter_block(
+                    out[l], kh[:, j * bs:(j + 1) * bs], table, j, False)
+                out[L + l] = self._scatter_block(
+                    out[L + l], vh[:, j * bs:(j + 1) * bs], table, j, False)
+        last = jnp.take(logits[0], n_valid - 1, axis=0)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return tuple(out), first
+
+    def _prefill_ext_pure(self, cache, tokens, n_valid, ctx, table,
+                          param_vals, aux_vals, key):
+        """Prefix-cache HIT prefill: ``ctx`` leading positions (always a
+        multiple of block_size) already hold valid K/V in shared blocks;
+        run the transformer over only the SUFFIX ``tokens`` (1, Tb),
+        appending K/V at positions [ctx, ctx+Tb) and attending through
+        the block table — same manual body as decode, widened to Tb query
+        rows.  ``ctx`` is an int32 operand, so one program per suffix
+        bucket serves every hit length."""
+        import jax.numpy as jnp
+        import math as _math
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        Tb = tokens.shape[1]
+        bs = self.block_size
+        T = self.max_blocks_per_slot * bs
+        C = H * D
+        scale = 1.0 / _math.sqrt(D)
+        caches = list(cache)
+
+        def body():
+            pos = jnp.minimum(ctx + jnp.arange(Tb, dtype=jnp.int32),
+                              self.max_len - 1)[None]          # (1, Tb)
+            x = self.block.embed(NDArray(tokens)) \
+                + self.block.pos_embed(NDArray(pos))
+            h = self.block.drop(x)
+            q_idx = jnp.arange(Tb, dtype=jnp.int32)
+            key_idx = jnp.arange(T, dtype=jnp.int32)
+            live = key_idx[None, :] <= (ctx + q_idx)[:, None]  # (Tb, T)
+            for l, cell in enumerate(self._cells):
+                at = cell.attention
+                hn = cell.ln1(h)
+                q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                qh = q._data.reshape(Tb, H, D).transpose(1, 0, 2)[None]
+                knh = kn._data.reshape(Tb, H, D).transpose(1, 0, 2)
+                vnh = vn._data.reshape(Tb, H, D).transpose(1, 0, 2)
+                j0 = ctx // bs
+                for j in range(-(-Tb // bs)):
+                    caches[l] = self._scatter_block(
+                        caches[l], knh[:, j * bs:(j + 1) * bs],
+                        table, j0 + j, True)
+                    caches[L + l] = self._scatter_block(
+                        caches[L + l], vnh[:, j * bs:(j + 1) * bs],
+                        table, j0 + j, True)
+                # gather this slot's whole logical strip and attend
+                # (mirrors _sdpa's stable-softmax arithmetic)
+                ck = jnp.moveaxis(caches[l][table], 1, 0).reshape(
+                    1, H, T, D)
+                cv = jnp.moveaxis(caches[L + l][table], 1, 0).reshape(
+                    1, H, T, D)
+                s = jnp.einsum("bhqd,bhkd->bhqk", qh, ck) * scale
+                s = jnp.where(live[None, None], s, -1e30)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.exp(s - m)
+                lsum = jnp.sum(p, axis=-1, keepdims=True)
+                attn = jnp.einsum("bhqk,bhkd->bhqd",
+                                  (p / lsum).astype(cv.dtype), cv)
+                out_nd = NDArray(attn.transpose(0, 2, 1, 3).reshape(
+                    1, Tb, C).astype(h._data.dtype))
+                h = h + at.dropout(at.proj(out_nd))
+                h = h + cell._ffn_out(cell.ln2(h))
+            logits = self.block._project(self.block.ln_f(h))
+            return logits._data
+
+        logits = self._with_params(param_vals, aux_vals, key, body)
+        last = jnp.take(logits[0], n_valid - 1, axis=0)
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return tuple(caches), first
+
+    def _decode_paged_pure(self, cache, last_tokens, positions, tables,
+                           param_vals, aux_vals, key):
+        """The decode program, paged: identical to :meth:`_decode_pure`
+        except each slot's K/V write lands in block ``tables[s, pos//bs]``
+        at offset ``pos % bs`` and attention reads through
+        :func:`paged_decode_attention`.  ``tables`` (S, max_blocks) int32
+        is an operand — join/leave never recompiles."""
+        import jax.numpy as jnp
+        from ..kernels.flash_attention import paged_decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S = last_tokens.shape[0]
+        C = H * D
+        bs = self.block_size
+        caches = list(cache)
+        rows = jnp.arange(S)
+        blk = tables[rows, positions // bs]                    # (S,)
+        off = positions % bs                                   # (S,)
+
+        def body():
+            pos_nd = NDArray(positions.reshape(S, 1))
+            x = self.block.embed(NDArray(last_tokens)) \
+                + self.block.pos_embed(pos_nd)
+            h = self.block.drop(x)
+            for l, cell in enumerate(self._cells):
+                at = cell.attention
+                hn = cell.ln1(h)
+                q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                qh = q._data.reshape(S, H, D)
+                knh = kn._data.reshape(S, H, D)
+                vnh = vn._data.reshape(S, H, D)
+                ck = caches[l].at[blk, :, off].set(
+                    knh.astype(caches[l].dtype))
+                cv = caches[L + l].at[blk, :, off].set(
+                    vnh.astype(caches[L + l].dtype))
+                caches[l], caches[L + l] = ck, cv
+                attn = paged_decode_attention(qh, ck, cv, tables, positions)
+                out_nd = NDArray(attn.reshape(S, 1, C).astype(h._data.dtype))
+                h = h + at.dropout(at.proj(out_nd))
+                h = h + cell._ffn_out(cell.ln2(h))
+            logits = self.block._project(self.block.ln_f(h))
+            return logits._data
+
+        logits = self._with_params(param_vals, aux_vals, key, body)
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return tuple(caches), nxt
+
     # -- cache lifecycle ------------------------------------------------
     def reset(self):
         """(Re)allocate the cache: all slots free, all rows zero.  Called
         at construction and by the continuous batcher after a watchdog
         restart (a replaced worker must not trust donated buffers that a
-        dying dispatch may have consumed)."""
+        dying dispatch may have consumed).  Paged mode also rewipes the
+        block pool, every block table, and the prefix cache — cached K/V
+        must never outlive the params that computed it."""
         import jax.numpy as jnp
+        if self.paged:
+            N, H, bs, D = (self.num_blocks, self.num_heads,
+                           self.block_size, self.head_dim)
+            self._cache = tuple(jnp.zeros((N, H, bs, D), jnp.float32)
+                                for _ in range(2 * self.num_layers))
+            self.pool.reset()
+            self._slot_blocks = [[] for _ in range(self.max_slots)]
+            self._tables = _np.zeros(
+                (self.max_slots, self.max_blocks_per_slot), _np.int32)
+            self._tables_dev = None
+            return
         S, H, T, D = (self.max_slots, self.num_heads, self.max_len,
                       self.head_dim)
         self._cache = tuple(jnp.zeros((S, H, T, D), jnp.float32)
@@ -640,11 +882,19 @@ class GenerationEngine:
                 "ignore", message="Some donated buffers were not usable")
             return call(self._cache, *args, param_vals, aux_vals, key)
 
-    def prefill(self, tokens, slot: int) -> int:
+    def prefill(self, tokens, slot: int,
+                reserve_tokens: Optional[int] = None) -> int:
         """Admit a prompt into ``slot``: pad to the prompt-length bucket,
         dispatch the bucket's prefill program, return the FIRST generated
         token.  After this the slot's write head is at ``len(tokens)``
-        (the returned token's K/V lands there on its first decode)."""
+        (the returned token's K/V lands there on its first decode).
+
+        Paged mode allocates the slot's block table first —
+        ``reserve_tokens`` (default ``max_len``) is the worst-case total
+        positions (prompt + budget) the request may ever write, so decode
+        NEVER allocates and can never fail mid-flight.  A prefix-cache
+        hit dispatches the suffix program instead, skipping the shared
+        span's prefill work entirely."""
         import jax.numpy as jnp
         toks = _np.asarray(tokens, _np.int32).reshape(-1)
         n = int(toks.shape[0])
@@ -657,16 +907,63 @@ class GenerationEngine:
             raise MXNetError(
                 f"{self.name}: prompt length {n} leaves no room to "
                 f"generate (max_len {self.max_len})")
-        bucket = self.prefill_bucket_for(n)
-        padded = _np.zeros((1, bucket), _np.int32)
-        padded[0, :n] = toks
-        with _telemetry.trace_span("serve.prefill", cat="serving",
-                                   model=self.name, slot=int(slot),
-                                   tokens=n, bucket=bucket):
-            cache, first = self._guarded(
-                self._prefill, jnp.asarray(padded),
-                jnp.asarray(n, jnp.int32), jnp.asarray(int(slot),
-                                                       jnp.int32))
+        if not self.paged:
+            bucket = self.prefill_bucket_for(n)
+            padded = _np.zeros((1, bucket), _np.int32)
+            padded[0, :n] = toks
+            with _telemetry.trace_span("serve.prefill", cat="serving",
+                                       model=self.name, slot=int(slot),
+                                       tokens=n, bucket=bucket):
+                cache, first = self._guarded(
+                    self._prefill, jnp.asarray(padded),
+                    jnp.asarray(n, jnp.int32), jnp.asarray(int(slot),
+                                                           jnp.int32))
+            self._cache = cache
+            return int(first)
+        slot = int(slot)
+        if self._slot_blocks[slot]:
+            self.release_slot(slot)
+        reserve = int(reserve_tokens or self.max_len)
+        reserve = max(n + 1, min(reserve, self.max_len))
+        table, m = self.pool.allocate(toks, n, reserve,
+                                      share=not self._warming)
+        self._slot_blocks[slot] = table
+        row = _np.zeros(self.max_blocks_per_slot, _np.int32)
+        row[:len(table)] = table
+        self._tables[slot] = row
+        self._tables_dev = None
+        try:
+            return self._prefill_paged_dispatch(toks, n, m, row, slot)
+        except Exception:
+            self.release_slot(slot)
+            raise
+
+    def _prefill_paged_dispatch(self, toks, n: int, m: int, row,
+                                slot: int) -> int:
+        import jax.numpy as jnp
+        if m == 0:
+            bucket = self.prefill_bucket_for(n)
+            padded = _np.zeros((1, bucket), _np.int32)
+            padded[0, :n] = toks
+            with _telemetry.trace_span("serve.prefill", cat="serving",
+                                       model=self.name, slot=slot,
+                                       tokens=n, bucket=bucket):
+                cache, first = self._guarded(
+                    self._prefill, jnp.asarray(padded),
+                    jnp.asarray(n, jnp.int32), jnp.asarray(row))
+        else:
+            sn = n - m
+            bucket = self.prefill_bucket_for(sn)
+            padded = _np.zeros((1, bucket), _np.int32)
+            padded[0, :sn] = toks[m:]
+            with _telemetry.trace_span("serve.prefill", cat="serving",
+                                       model=self.name, slot=slot,
+                                       tokens=n, bucket=bucket,
+                                       prefix_hit_tokens=m):
+                cache, first = self._guarded(
+                    self._prefill_ext, jnp.asarray(padded),
+                    jnp.asarray(sn, jnp.int32), jnp.asarray(m, jnp.int32),
+                    jnp.asarray(row))
         self._cache = cache
         return int(first)
 
@@ -679,28 +976,119 @@ class GenerationEngine:
             self.max_slots, 1))
         pos = jnp.asarray(_np.asarray(positions, _np.int32).reshape(
             self.max_slots))
-        cache, nxt = self._guarded(self._decode, lt, pos)
+        if self.paged:
+            if self._tables_dev is None:
+                self._tables_dev = jnp.asarray(self._tables)
+            cache, nxt = self._guarded(self._decode, lt, pos,
+                                       self._tables_dev)
+        else:
+            cache, nxt = self._guarded(self._decode, lt, pos)
         self._cache = cache
         return _np.asarray(nxt)
 
+    # -- paged-pool bookkeeping (no-ops in dense mode) -------------------
+    def release_slot(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the pool (decref — shared prefix
+        blocks stay live for their other readers / the prefix cache)."""
+        if not self.paged:
+            return
+        blocks = self._slot_blocks[int(slot)]
+        if blocks:
+            self.pool.release(blocks)
+        self._slot_blocks[int(slot)] = []
+        self._tables[int(slot)] = 0
+        self._tables_dev = None
+
+    def can_admit(self, tokens, reserve_tokens: int,
+                  reserved_blocks: int = 0) -> bool:
+        """Admission check: can the pool reserve worst-case capacity for
+        this prompt right now?  ``reserved_blocks`` discounts capacity
+        promised to earlier admits in the same scheduling step.  Dense
+        mode always admits (capacity == slots there)."""
+        if not self.paged:
+            return True
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        n = int(toks.shape[0])
+        reserve = max(n + 1, min(int(reserve_tokens), self.max_len))
+        return self.pool.can_admit(toks, n, reserve, reserved_blocks)
+
+    def reserve_estimate(self, reserve_tokens: int) -> int:
+        """Worst-case blocks a request reserving ``reserve_tokens``
+        positions can take (no sharing assumed) — the scheduler's
+        discount unit for multi-admit steps."""
+        if not self.paged:
+            return 0
+        from .kvcache import blocks_for
+        return blocks_for(min(int(reserve_tokens), self.max_len),
+                          self.block_size)
+
+    def kv_capacity_tokens(self) -> int:
+        """Total token positions the KV cache can hold across all
+        requests — the backpressure unit for admission control."""
+        if self.paged:
+            return (self.num_blocks - 1) * self.block_size
+        return self.max_slots * self.max_len
+
+    def kv_stats(self) -> dict:
+        """Cache-utilization facts for ``GET /v1/models`` and
+        ``stats()``."""
+        if not self.paged:
+            return {"kv_paged": False,
+                    "kv_capacity_tokens": self.kv_capacity_tokens()}
+        out = {"kv_paged": True,
+               "kv_capacity_tokens": self.kv_capacity_tokens()}
+        out.update(self.pool.stats())
+        return out
+
     # -- warmup / introspection -----------------------------------------
+    @property
+    def expected_programs(self) -> int:
+        """Size of the CLOSED program set: one prefill per bucket (plus
+        one suffix-prefill per bucket when the prefix cache can hit) and
+        ONE decode."""
+        per_bucket = 2 if self.prefix_cache_enabled else 1
+        return per_bucket * len(self.prefill_buckets) + 1
+
     def warmup(self) -> int:
-        """AOT-compile every prefill bucket plus THE decode program, then
-        reset the cache (warmup traffic must not look like live slots).
-        Returns the number of programs warmed (len(buckets) + 1)."""
-        for b in self.prefill_buckets:
-            self.prefill(_np.zeros(max(1, min(b, self.max_len - 1)),
-                                   _np.int32), 0)
-        self.decode(_np.zeros(self.max_slots, _np.int32),
-                    _np.zeros(self.max_slots, _np.int32))
+        """AOT-compile the whole closed program set — every prefill
+        bucket (miss AND, with the prefix cache on, suffix/hit variants)
+        plus THE decode program — then reset the cache (warmup traffic
+        must not look like live slots or poison the prefix cache).
+        Returns the number of programs warmed."""
+        import jax.numpy as jnp
+        self._warming = True
+        try:
+            for b in self.prefill_buckets:
+                self.prefill(_np.zeros(max(1, min(b, self.max_len - 1)),
+                                       _np.int32), 0)
+                self.release_slot(0)
+            if self.paged and self.prefix_cache_enabled:
+                # suffix programs take ctx/table as OPERANDS: one dummy
+                # dispatch per bucket (writes land in the null block)
+                row = jnp.zeros(self.max_blocks_per_slot, jnp.int32)
+                for b in self.prefill_buckets:
+                    sn = max(1, min(b, self.max_len - 1))
+                    cache, _ = self._guarded(
+                        self._prefill_ext,
+                        jnp.zeros((1, b), jnp.int32),
+                        jnp.asarray(sn, jnp.int32),
+                        jnp.asarray(0, jnp.int32), row)
+                    self._cache = cache
+            self.decode(_np.zeros(self.max_slots, _np.int32),
+                        _np.zeros(self.max_slots, _np.int32))
+        finally:
+            self._warming = False
         self.reset()
         self._warmup_done = True
-        return len(self.prefill_buckets) + 1
+        return self.expected_programs
 
     def compiled_programs(self) -> int:
         try:
-            return int(self._prefill_jit._cache_size()) \
+            n = int(self._prefill_jit._cache_size()) \
                 + int(self._decode_jit._cache_size())
+            if self._prefill_ext_jit is not None:
+                n += int(self._prefill_ext_jit._cache_size())
+            return n
         except Exception:
             return 0
 
@@ -708,7 +1096,7 @@ class GenerationEngine:
     def warm(self) -> bool:
         if self._warmup_done:
             return True
-        return self.compiled_programs() >= len(self.prefill_buckets) + 1
+        return self.compiled_programs() >= self.expected_programs
 
     # -- reference path --------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 32,
@@ -723,17 +1111,20 @@ class GenerationEngine:
             raise MXNetError(
                 f"{self.name}: no token budget (prompt {n}, max_len "
                 f"{self.max_len})")
-        out = [self.prefill(toks, 0)]
-        pos = n
-        lt = _np.zeros(self.max_slots, _np.int32)
-        pv = _np.zeros(self.max_slots, _np.int32)
-        while len(out) < budget and (eos_id is None
-                                     or out[-1] != int(eos_id)):
-            lt[0] = out[-1]
-            pv[0] = pos
-            nxt = self.decode(lt, pv)
-            out.append(int(nxt[0]))
-            pos += 1
+        out = [self.prefill(toks, 0, reserve_tokens=n + budget)]
+        try:
+            pos = n
+            lt = _np.zeros(self.max_slots, _np.int32)
+            pv = _np.zeros(self.max_slots, _np.int32)
+            while len(out) < budget and (eos_id is None
+                                         or out[-1] != int(eos_id)):
+                lt[0] = out[-1]
+                pv[0] = pos
+                nxt = self.decode(lt, pv)
+                out.append(int(nxt[0]))
+                pos += 1
+        finally:
+            self.release_slot(0)
         return out
 
     def __repr__(self):

@@ -46,6 +46,13 @@ SLO_BAD = _telemetry.registry.counter(
     "mxtpu_slo_bad_requests",
     "requests that burned error budget (any failure surfaced to the "
     "caller: backpressure, breaker, deadline, abort, dispatch error)")
+PREFIX_CACHE_HITS = _telemetry.registry.counter(
+    "mxtpu_prefix_cache_hits",
+    "KV blocks reused from the prefix cache instead of being "
+    "re-prefilled (one increment per shared block)")
+PREFIX_CACHE_EVICTIONS = _telemetry.registry.counter(
+    "mxtpu_prefix_cache_evictions",
+    "idle cached KV blocks evicted (LRU) to satisfy new allocations")
 
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(
@@ -73,6 +80,13 @@ QUEUE_DEPTH = _telemetry.registry.gauge(
 SLOTS_IN_USE = _telemetry.registry.gauge(
     "mxtpu_serve_cache_slots_in_use",
     "KV-cache slots occupied by live generation requests, per model")
+KV_BLOCKS_TOTAL = _telemetry.registry.gauge(
+    "mxtpu_kv_blocks_total",
+    "allocatable KV-cache blocks in the paged BlockPool, per model")
+KV_BLOCKS_IN_USE = _telemetry.registry.gauge(
+    "mxtpu_kv_blocks_in_use",
+    "KV-cache blocks held by live slots or pinned in the prefix "
+    "cache with a nonzero refcount, per model")
 MODELS_LOADED = _telemetry.registry.gauge(
     "mxtpu_serve_models_loaded",
     "models registered on the ModelServer")

@@ -179,7 +179,9 @@ class _Handler(BaseJSONHandler):
             err(404, {"error": f"model {name!r} is not "
                       "loaded", "models": sorted(ms.models())})
         except QueueFullError as e:
-            err(429, {"error": str(e)})
+            retry = getattr(e, "retry_after", 1.0)
+            err(429, {"error": str(e), "retry_after": retry},
+                headers=_retry_after_header(retry))
         except _lc.DeadlineExceeded as e:
             err(504, {"error": str(e)})
         except TimeoutError as e:

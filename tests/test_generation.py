@@ -137,10 +137,11 @@ def test_engine_generate_matches_net_generate():
 def test_warmup_compiles_closed_program_set():
     _, eng = _engine()
     warmed = eng.warmup()
-    assert warmed == len(eng.prefill_buckets) + 1
+    assert warmed == eng.expected_programs
     n = eng.compiled_programs()
     eng.generate([4, 4, 4], max_new_tokens=8)
     eng.generate([2] * 17, max_new_tokens=8)     # different bucket
+    eng.generate([2] * 17, max_new_tokens=8)     # prefix-cache hit path
     assert eng.compiled_programs() == n          # nothing new compiled
 
 

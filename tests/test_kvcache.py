@@ -1,0 +1,447 @@
+"""Paged KV-cache subsystem tests: BlockPool invariants (alloc / free /
+refcount / copy-on-write / LRU eviction), the paged GenerationEngine's
+token-for-token parity against the dense oracle (solo, prefix-hit, and
+mid-flight join through the ContinuousBatcher), prefix-cache FLOPs
+savings measured on the ``XLA_COST`` plane, the closed compiled-program
+set, pool-rewipe on ``reset()``, the paged Pallas gather's
+interpret-mode parity, and capacity backpressure on the HTTP surface
+(429 + ``Retry-After``)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import (BlockPool, ContinuousBatcher,
+                                         GenerationEngine, ModelServer,
+                                         blocks_for)
+from incubator_mxnet_tpu.serving import slo as _slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    _slo.tracker.reset()
+    yield
+    fault.clear_plan()
+    telemetry.stop()
+    telemetry.reset()
+    _slo.tracker.reset()
+
+
+def _gpt(max_length=64, seed=3):
+    mx.random.seed(seed)
+    net = GPTModel(vocab_size=50, units=32, hidden_size=64,
+                   num_layers=2, num_heads=2, max_length=max_length,
+                   dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.6))
+    net(mx.nd.array(np.zeros((1, 2), np.int32)))   # settle shapes
+    return net
+
+
+def _pair(max_slots=4, max_len=64, seed=3, **paged_kw):
+    """One model, two engines: dense oracle + paged under test."""
+    net = _gpt(max_length=max_len, seed=seed)
+    dense = GenerationEngine(net, name="dense", max_slots=max_slots,
+                             max_len=max_len, paged=False)
+    paged = GenerationEngine(net, name="paged", max_slots=max_slots,
+                             max_len=max_len, paged=True, **paged_kw)
+    return net, dense, paged
+
+
+# ------------------------------------------------------ pool invariants
+def test_blocks_for():
+    assert blocks_for(0, 16) == 0
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+
+
+def test_pool_alloc_release_refcounts():
+    pool = BlockPool(9, 16, model="t")            # 8 allocatable
+    toks = list(range(40))
+    table, m = pool.allocate(toks, 40, 48)        # 3 blocks, cold
+    assert m == 0 and len(table) == 3
+    assert 0 not in table                         # null block never leaves
+    assert pool.blocks_in_use == 3
+    assert all(pool.refcount(b) == 1 for b in table)
+    pool.release(table)
+    # blocks 0 and 1 covered full prompt blocks -> cached idle, block 2
+    # was the mutable tail -> straight back to the free list
+    assert pool.blocks_in_use == 0
+    assert pool.free_blocks == 8
+    with pytest.raises(MXNetError):
+        pool.release(table)                       # double free
+
+
+def test_pool_prefix_sharing_and_refcounts():
+    pool = BlockPool(17, 16, model="t")
+    toks = list(range(40))                        # 2 full blocks shareable
+    t1, m1 = pool.allocate(toks, 40, 64)
+    assert m1 == 0
+    t2, m2 = pool.allocate(toks, 40, 64)
+    assert m2 == 32                               # both full blocks shared
+    assert t2[:2] == t1[:2]                       # same physical blocks
+    assert t2[2:] != t1[2:]
+    assert pool.refcount(t1[0]) == 2 and pool.refcount(t1[1]) == 2
+    assert pool.hits == 2
+    pool.release(t1)
+    assert pool.refcount(t2[0]) == 1              # survivor keeps them
+    pool.release(t2)
+    assert pool.blocks_in_use == 0
+    assert pool.cached_blocks == 2                # still hittable
+    t3, m3 = pool.allocate(toks, 40, 64)
+    assert m3 == 32                               # idle cached blocks hit
+    pool.release(t3)
+
+
+def test_pool_prefix_cache_disabled():
+    pool = BlockPool(17, 16, prefix_cache=False, model="t")
+    toks = list(range(40))
+    t1, m1 = pool.allocate(toks, 40, 64)
+    t2, m2 = pool.allocate(toks, 40, 64)
+    assert m1 == m2 == 0
+    assert not set(t1) & set(t2)
+    assert pool.hits == 0
+
+
+def test_pool_copy_on_write():
+    pool = BlockPool(9, 16, model="t")
+    toks = list(range(40))
+    t1, _ = pool.allocate(toks, 40, 48)
+    # exclusively-owned mutable tail: no copy
+    tail = t1[2]
+    assert pool.copy_on_write(tail) == tail
+    # exclusively-owned but published: unpublished in place, no copy
+    pub = t1[1]
+    assert pool.copy_on_write(pub) == pub
+    assert pool.refcount(pub) == 1
+    t2, m2 = pool.allocate(toks, 40, 48)
+    assert m2 == 16                               # unpublished block misses
+    shared = t1[0]
+    assert pool.refcount(shared) == 2
+    new = pool.copy_on_write(shared)
+    assert new != shared                          # real copy when shared
+    assert pool.refcount(shared) == 1
+    assert pool.refcount(new) == 1
+    assert pool.cow_copies == 1
+    with pytest.raises(MXNetError):
+        pool.copy_on_write(0)                     # unreferenced
+
+
+def test_pool_exhaustion_and_can_admit():
+    pool = BlockPool(5, 16, model="t")            # 4 allocatable
+    toks = list(range(3))
+    t1, _ = pool.allocate(toks, 3, 64)            # takes all 4
+    assert not pool.can_admit([7] * 3, 3, 17)
+    with pytest.raises(MXNetError):
+        pool.allocate([7] * 3, 3, 17)
+    pool.release(t1)
+    assert pool.can_admit([7] * 3, 3, 17)
+    # the reserved_blocks discount models earlier same-step admits
+    assert not pool.can_admit([7] * 3, 3, 33, reserved_blocks=3)
+
+
+def test_pool_lru_eviction_under_pressure():
+    pool = BlockPool(5, 16, model="t")            # 4 allocatable
+    a = pool.allocate(list(range(16)) + [1], 17, 17)[0]
+    pool.release(a)                               # 1 cached idle
+    b = pool.allocate(list(range(100, 116)) + [1], 17, 17)[0]
+    pool.release(b)                               # 2 cached idle
+    assert pool.cached_blocks == 2
+    # demand 3+ fresh blocks: free list has 2, so the OLDEST idle cached
+    # block (prompt a's) must be reclaimed
+    c, m = pool.allocate([9] * 50, 50, 64)
+    assert m == 0
+    assert pool.evictions >= 1
+    # prompt a's block is gone from the cache; prompt b's may also have
+    # been evicted depending on demand — re-allocating a must miss
+    pool.release(c)
+    t, m = pool.allocate(list(range(16)) + [1], 17, 17)
+    assert m == 0
+
+
+# ------------------------------------------------- paged vs dense parity
+def test_paged_solo_parity_token_for_token():
+    _, dense, paged = _pair()
+    for prompt in ([9, 9, 4, 1], [3, 7, 11], list(range(1, 20)),
+                   [2] * 33, [5] * 40):
+        want = dense.generate(prompt, max_new_tokens=20)
+        got = paged.generate(prompt, max_new_tokens=20)
+        assert got == want, prompt
+        dense.reset()
+        paged.reset()
+
+
+def test_paged_prefix_hit_parity_and_sharing():
+    _, dense, paged = _pair()
+    prompt = [5] * 40
+    want = dense.generate(prompt, max_new_tokens=12)
+    first = paged.generate(prompt, max_new_tokens=12)
+    hits0 = paged.pool.hits
+    second = paged.generate(prompt, max_new_tokens=12)  # through the cache
+    assert first == want
+    assert second == want                     # hit path, same tokens
+    assert paged.pool.hits - hits0 == 2       # both full prompt blocks
+
+
+def test_paged_midflight_join_parity():
+    _, dense, paged = _pair()
+    solo_a = dense.generate([9, 9, 4, 1], max_new_tokens=30)
+    dense.reset()
+    solo_b = dense.generate([3, 7, 11], max_new_tokens=8)
+    dense.reset()
+    bat = ContinuousBatcher(paged, name="paged")
+    try:
+        ra = bat.submit_async([9, 9, 4, 1], max_new_tokens=30)
+        deadline = time.monotonic() + 10
+        while len(ra.tokens_out) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        rb = bat.submit_async([3, 7, 11], max_new_tokens=8)
+        assert ra.result(30) == solo_a
+        assert rb.result(30) == solo_b
+        assert bat.stats()["peak_slots_in_use"] >= 2
+    finally:
+        bat.close()
+
+
+def test_closed_program_set_survives_hits_and_joins():
+    _, _, paged = _pair()
+    warmed = paged.warmup()
+    assert warmed == paged.expected_programs \
+        == 2 * len(paged.prefill_buckets) + 1
+    n = paged.compiled_programs()
+    paged.generate([4, 4, 4], max_new_tokens=8)
+    paged.generate([2] * 17, max_new_tokens=8)
+    paged.generate([2] * 17, max_new_tokens=8)    # prefix-hit program
+    bat = ContinuousBatcher(paged, name="paged")
+    try:
+        ra = bat.submit_async([2] * 17, max_new_tokens=10)
+        rb = bat.submit_async([6] * 40, max_new_tokens=10)
+        ra.result(30)
+        rb.result(30)
+    finally:
+        bat.close()
+    assert paged.compiled_programs() == n         # still closed
+
+
+# -------------------------------------------- prefix cache saves prefill
+def test_prefix_hit_cuts_prefill_flops():
+    _, _, paged = _pair()
+    events = []
+
+    def on_cost(**kw):
+        events.append(kw)
+
+    telemetry.XLA_COST.subscribe(on_cost)
+    try:
+        prompt = [7] * 40                         # 2 shareable blocks
+
+        def prefill_flops():
+            return sum(e["flops"] for e in events
+                       if "prefill" in e["where"])
+
+        paged.generate(prompt, max_new_tokens=4)  # cold: full prefill
+        cold = prefill_flops()
+        events.clear()
+        paged.generate(prompt, max_new_tokens=4)  # warm: suffix only
+        warm = prefill_flops()
+    finally:
+        telemetry.XLA_COST.unsubscribe(on_cost)
+    assert cold > 0 and warm > 0
+    # 32 of 40 prompt tokens came from the cache; the suffix program
+    # runs an 8-bucket forward instead of a 64-bucket one
+    assert warm < 0.6 * cold, (cold, warm)
+
+
+# ------------------------------------------------- engine-level eviction
+def test_engine_eviction_under_pressure_stays_correct():
+    net = _gpt()
+    dense = GenerationEngine(net, name="dense", paged=False,
+                             max_slots=2, max_len=64)
+    # 5 blocks = 80 tokens: one 40-token request + cached leftovers
+    # force LRU eviction on the next distinct prompt
+    paged = GenerationEngine(net, name="paged", paged=True,
+                             max_slots=2, max_len=64, num_blocks=6)
+    prompts = [[5] * 40, [9] * 40, [3] * 40, [5] * 40]
+    for p in prompts:
+        want = dense.generate(p, max_new_tokens=8)
+        dense.reset()
+        assert paged.generate(p, max_new_tokens=8) == want, p
+    assert paged.pool.evictions > 0
+
+
+# ----------------------------------------------------- reset rewipes all
+def test_reset_rewipes_tables_pool_and_prefix_cache():
+    _, _, paged = _pair()
+    paged.generate([5] * 40, max_new_tokens=8)
+    paged.generate([5] * 40, max_new_tokens=8)
+    assert paged.pool.hits > 0
+    assert paged.pool.cached_blocks > 0
+    paged.reset()
+    assert paged.pool.free_blocks == paged.num_blocks - 1
+    assert paged.pool.blocks_in_use == 0
+    assert paged.pool.cached_blocks == 0          # stale K/V unreachable
+    assert not np.any(paged._tables)
+    assert all(not b for b in paged._slot_blocks)
+    # and the engine still serves correctly afterwards
+    out1 = paged.generate([5] * 40, max_new_tokens=8)
+    paged.reset()
+    out2 = paged.generate([5] * 40, max_new_tokens=8)
+    assert out1 == out2
+
+
+def test_watchdog_restart_rewipes_pool():
+    from incubator_mxnet_tpu.serving import CircuitBreaker
+    _, _, paged = _pair(max_slots=2, max_len=128)
+    # short breaker cooldown so the post-restart probe is admitted
+    bat = ContinuousBatcher(paged, name="paged",
+                            breaker=CircuitBreaker("paged",
+                                                   cooldown_seconds=0.1))
+    try:
+        fault.install_plan("serving.infer:hang:30@5")
+        req = bat.submit_async([3, 7, 11], max_new_tokens=100,
+                               request_id="rider-1")
+        deadline = time.monotonic() + 10
+        while not req.tokens_out and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.1)
+        assert bat.check_worker(hang_seconds=0.05) == "hung"
+        with pytest.raises(Exception):
+            req.result(timeout=30)
+        fault.clear_plan()
+        # the replacement worker resets the engine: pool fully free
+        deadline = time.monotonic() + 5
+        while bat.slots_in_use() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert paged.pool.blocks_in_use == 0
+        assert paged.pool.cached_blocks == 0
+        # first request after the cooldown is the breaker's probe
+        deadline = time.monotonic() + 5
+        while True:
+            try:
+                r2 = bat.submit_async([3, 7, 11], max_new_tokens=5)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        assert len(r2.result(30)) == 5
+    finally:
+        fault.clear_plan()
+        bat.close()
+
+
+# ------------------------------------------- paged Pallas gather parity
+def test_paged_pallas_kernel_interpret_parity(monkeypatch):
+    from incubator_mxnet_tpu.kernels.flash_attention import (
+        _paged_decode_pallas, _xla_paged_decode_attention,
+        paged_decode_attention)
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    S, H, bs, D, NBLK, NB = 3, 2, 16, 16, 12, 4
+    kp = jnp.asarray(rng.randn(NBLK, H, bs, D).astype(np.float32))
+    vp = jnp.asarray(rng.randn(NBLK, H, bs, D).astype(np.float32))
+    q = jnp.asarray(rng.randn(S, H, D).astype(np.float32))
+    tables = jnp.asarray(rng.randint(0, NBLK, (S, NB)).astype(np.int32))
+    positions = jnp.asarray(np.array([5, 30, 63], np.int32))
+    ref = _xla_paged_decode_attention(q, kp, vp, tables, positions, 0.25)
+    out = _paged_decode_pallas(q, kp, vp, tables, positions, 0.25,
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    # the dispatch honors the force knob (interpret mode on CPU)
+    monkeypatch.setenv("MXNET_FA_DECODE_FORCE_PALLAS", "1")
+    out2 = paged_decode_attention(q, kp, vp, tables, positions, scale=0.25)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_paged_engine_parity_with_forced_pallas_decode(monkeypatch):
+    monkeypatch.setenv("MXNET_FA_DECODE_FORCE_PALLAS", "1")
+    net = _gpt()
+    paged = GenerationEngine(net, name="paged", paged=True,
+                             max_slots=2, max_len=64)
+    monkeypatch.delenv("MXNET_FA_DECODE_FORCE_PALLAS")
+    dense = GenerationEngine(net, name="dense", paged=False,
+                             max_slots=2, max_len=64)
+    want = dense.generate([3, 7, 11], max_new_tokens=8)
+    got = paged.generate([3, 7, 11], max_new_tokens=8)
+    # interpreted-kernel fp differs from lax at the ulp level; greedy
+    # argmax must still agree token-for-token
+    assert got == want
+
+
+# --------------------------------- HTTP backpressure: 429 + Retry-After
+def test_http_429_retry_after_on_pool_exhaustion():
+    net = _gpt()
+    # one slot, pool sized for exactly one max-length request: the
+    # capacity-aware queue bound admits 4x1 waiters, the 6th submit
+    # must be rejected, not queued unboundedly
+    eng = GenerationEngine(net, name="g", max_slots=1, max_len=64,
+                           paged=True, num_blocks=5)
+    srv = ModelServer(port=0)
+    srv.add_model("g", eng)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/v1/models/g:generate"
+    try:
+        # wedge the worker mid-decode so submissions pile up
+        fault.install_plan("serving.infer:hang:3@2")
+
+        def post(budget=60):
+            req = urllib.request.Request(url, data=json.dumps(
+                {"tokens": [1, 2, 3], "max_new_tokens": budget,
+                 "stream": True}).encode())
+            return urllib.request.urlopen(req, timeout=30)
+
+        streams = [post()]                    # occupies the slot
+        time.sleep(0.3)                       # hang engages
+        for _ in range(4):
+            streams.append(post())            # fill the admitted queue
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post()
+        assert ei.value.code == 429
+        retry = ei.value.headers.get("Retry-After")
+        assert retry is not None and int(retry) >= 1
+        body = json.loads(ei.value.read())
+        assert "backpressure" in body["error"]
+        ei.value.close()
+        prom = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+        assert "mxtpu_serve_rejected" in prom
+        assert "mxtpu_kv_blocks_in_use" in prom
+        assert "mxtpu_kv_blocks_total" in prom
+        fault.clear_plan()
+        for s in streams:
+            s.read()                          # drain to completion
+            s.close()
+        # per-model cache utilization on GET /v1/models
+        stats = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/models", timeout=10))
+        g = stats["models"]["g"]
+        assert g["kv_paged"] is True
+        assert g["kv_blocks_total"] == 4
+        assert "kv_utilization" in g
+    finally:
+        fault.clear_plan()
+        srv.stop()
+
+
+def test_dense_fallback_env(monkeypatch):
+    monkeypatch.setenv("MXNET_KV_PAGED", "0")
+    net = _gpt()
+    eng = GenerationEngine(net, name="g", max_slots=2, max_len=64)
+    assert eng.paged is False
+    assert eng.pool is None
+    out = eng.generate([3, 7, 11], max_new_tokens=5)
+    assert len(out) == 5
