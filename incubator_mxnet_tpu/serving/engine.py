@@ -935,6 +935,12 @@ class GenerationEngine:
         try:
             return self._prefill_paged_dispatch(toks, n, m, row, slot)
         except Exception:
+            # The fresh (non-shared) blocks never got their K/V written;
+            # allocate() already registered the full ones in the prefix
+            # cache, so unregister them before release parks them idle —
+            # a later same-prefix request must prefill cold, not "hit"
+            # garbage.
+            self.pool.invalidate(table[m // self.pool.block_size:])
             self.release_slot(slot)
             raise
 

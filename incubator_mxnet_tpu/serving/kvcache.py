@@ -11,10 +11,13 @@ Three properties fall out:
   longer pay for ``max_len`` worth of cache and many more of them fit in
   the same byte budget.
 * **Prefix sharing** — every *full* block of a prompt is keyed by a
-  chained content hash (hash of the previous block's hash plus this
-  block's tokens), so two requests with a common prefix map their leading
-  blocks to the same physical storage. Shared blocks are refcounted;
-  the joiner skips prefill for the shared span entirely.
+  chained blake2b digest (digest of the previous block's digest plus
+  this block's tokens), so two requests with a common prefix map their
+  leading blocks to the same physical storage. A digest match implies
+  token-exact prefix equality — keys are 128-bit content digests, not
+  Python ``hash()`` values, so distinct prompts cannot alias. Shared
+  blocks are refcounted; the joiner skips prefill for the shared span
+  entirely.
 * **Copy-on-write** — a writer that needs to mutate a block with
   refcount > 1 asks :meth:`copy_on_write` for a private copy first. The
   serving flow never mutates shared blocks by construction (only *full*,
@@ -36,6 +39,7 @@ batcher worker thread and HTTP admission checks.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -85,8 +89,8 @@ class BlockPool:
         with self._lock:
             self._ref = [0] * self.num_blocks
             self._free: deque = deque(range(1, self.num_blocks))
-            self._hash: List[Optional[int]] = [None] * self.num_blocks
-            self._by_hash: Dict[int, int] = {}
+            self._hash: List[Optional[bytes]] = [None] * self.num_blocks
+            self._by_hash: Dict[bytes, int] = {}
             # cached blocks with refcount 0, in LRU order (oldest first)
             self._idle: "OrderedDict[int, None]" = OrderedDict()
             self._update_gauges()
@@ -120,21 +124,26 @@ class BlockPool:
             model=self._model)
 
     # -- prefix hashing ---------------------------------------------------
-    def chain_hashes(self, tokens: Sequence[int], limit: int) -> List[int]:
-        """Chained content hash per full block over ``tokens[:limit]``.
+    def chain_hashes(self, tokens: Sequence[int], limit: int) -> List[bytes]:
+        """Chained blake2b digest per full block over ``tokens[:limit]``.
 
-        ``hashes[i]`` commits to blocks ``0..i`` of the prompt, so a hash
-        match implies the whole prefix matches, not just one block.
+        ``hashes[i]`` commits to blocks ``0..i`` of the prompt, so a
+        digest match implies the whole prefix matches, not just one
+        block. 128-bit content digests make accidental aliasing of
+        distinct prompts cryptographically impossible — unlike Python
+        ``hash()``, where e.g. ``hash(-1) == hash(-2)`` collides.
         """
         bs = self.block_size
-        out: List[int] = []
-        h = hash(("mxtpu-kv", bs))
+        out: List[bytes] = []
+        h = ("mxtpu-kv:%d" % bs).encode()
         for i in range(int(limit) // bs):
-            h = hash((h, tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])))
+            blk = b",".join(b"%d" % int(t)
+                            for t in tokens[i * bs:(i + 1) * bs])
+            h = hashlib.blake2b(h + b"|" + blk, digest_size=16).digest()
             out.append(h)
         return out
 
-    def _match(self, hashes: Sequence[int], usable: int) -> List[int]:
+    def _match(self, hashes: Sequence[bytes], usable: int) -> List[int]:
         """Longest cached run of leading blocks, without increfing."""
         if not self.prefix_cache:
             return []
@@ -165,7 +174,11 @@ class BlockPool:
             shared = self._match(
                 hashes, self._usable_prefix_blocks(n, self.block_size))
             free = len(self._free) + len(self._idle) - int(reserved_blocks)
-            return free >= need - len(shared)
+            # Idle blocks this request would share are pinned by the
+            # share itself — they cannot double as reclaimable capacity
+            # for the fresh tail.
+            shared_idle = sum(1 for b in shared if self._ref[b] == 0)
+            return free - shared_idle >= need - len(shared)
 
     def allocate(self, tokens: Sequence[int], n: int, reserve_tokens: int,
                  share: bool = True) -> Tuple[List[int], int]:
@@ -190,10 +203,17 @@ class BlockPool:
             shared = self._match(
                 hashes, self._usable_prefix_blocks(n, self.block_size))
             fresh_needed = need - len(shared)
-            if len(self._free) + len(self._idle) < fresh_needed:
+            # Full capacity check BEFORE any mutation: idle blocks this
+            # request shares are pinned by the share, so they must not
+            # count toward the fresh tail — otherwise the shortfall
+            # would only surface in _pop_free after refcounts were
+            # already bumped, leaking the partial allocation.
+            shared_idle = sum(1 for b in shared if self._ref[b] == 0)
+            available = len(self._free) + len(self._idle) - shared_idle
+            if available < fresh_needed:
                 raise MXNetError(
                     f"kv pool exhausted: need {fresh_needed} blocks, "
-                    f"{len(self._free) + len(self._idle)} available "
+                    f"{available} available "
                     f"({self.num_blocks - 1} total, block_size "
                     f"{self.block_size})")
             for b in shared:
@@ -236,6 +256,17 @@ class BlockPool:
                     else:
                         self._free.append(b)
             self._update_gauges()
+
+    def invalidate(self, blocks: Sequence[int]) -> None:
+        """Unregister ``blocks`` from the prefix cache without touching
+        refcounts. For blocks whose K/V never became valid — a prefill
+        that failed after :meth:`allocate` had already registered them —
+        so a later request with the same prefix prefills cold instead of
+        "hitting" garbage. Unregistered blocks are a no-op."""
+        with self._lock:
+            for b in blocks:
+                if b != NULL_BLOCK:
+                    self._evict_hash(b)
 
     def copy_on_write(self, block: int) -> int:
         """Private handle for a block the caller wants to mutate. Returns

@@ -170,6 +170,65 @@ def test_pool_lru_eviction_under_pressure():
     assert m == 0
 
 
+def test_pool_shared_idle_blocks_not_double_counted():
+    # Regression: a request that shares an IDLE cached block must not
+    # also count that block as reclaimable capacity for its fresh tail.
+    # The old check passed, then allocate() raised mid-mutation in
+    # _pop_free and leaked the partially-built table.
+    pool = BlockPool(9, 16, model="t")            # 8 allocatable
+    toks = list(range(16)) + [1]
+    a = pool.allocate(toks, 17, 17)[0]            # 1 shareable + tail
+    pool.release(a)                               # 1 idle cached, 7 free
+    live = pool.allocate([9] * 50, 50, 64)[0]     # 4 blocks pinned
+    assert pool.free_blocks == 4                  # 3 free + 1 idle
+    # need 5 blocks, 1 shared (the idle one) -> 4 fresh, but only 3
+    # blocks are truly available once the share pins the idle block
+    assert not pool.can_admit(toks, 17, 65)
+    with pytest.raises(MXNetError):
+        pool.allocate(toks, 17, 65)
+    # the failed allocate mutated nothing: no leaked refcounts/blocks
+    assert pool.blocks_in_use == 4
+    assert pool.free_blocks == 4
+    assert pool.refcount(a[0]) == 0
+    # 1 idle entry from prompt a + 3 full blocks of the live request
+    assert pool.cached_blocks == 4
+    # one block less and the same request fits, sharing the idle block
+    assert pool.can_admit(toks, 17, 64)
+    t, m = pool.allocate(toks, 17, 64)
+    assert m == 16 and t[0] == a[0]
+    pool.release(t)
+    pool.release(live)
+
+
+def test_pool_invalidate_unregisters_prefix_entries():
+    pool = BlockPool(9, 16, model="t")
+    toks = list(range(40))
+    t, _ = pool.allocate(toks, 40, 48)            # 2 full blocks registered
+    assert pool.cached_blocks == 2
+    pool.invalidate(t)
+    assert pool.cached_blocks == 0
+    assert all(pool.refcount(b) == 1 for b in t)  # refcounts untouched
+    pool.release(t)
+    assert pool.free_blocks == 8                  # all straight to free
+    t2, m2 = pool.allocate(toks, 40, 48)
+    assert m2 == 0                                # no hit on invalidated
+    pool.release(t2)
+
+
+def test_prefix_keys_are_collision_resistant():
+    # hash(-1) == hash(-2) in CPython, so Python-hash-keyed prefix
+    # caching would alias these two distinct prompts onto the same
+    # blocks; content digests must keep them apart.
+    pool = BlockPool(17, 16, model="t")
+    t1, m1 = pool.allocate([-1] * 17, 17, 32)
+    t2, m2 = pool.allocate([-2] * 17, 17, 32)
+    assert m1 == 0 and m2 == 0                    # no bogus prefix hit
+    assert not set(t1) & set(t2)
+    assert pool.hits == 0
+    pool.release(t1)
+    pool.release(t2)
+
+
 # ------------------------------------------------- paged vs dense parity
 def test_paged_solo_parity_token_for_token():
     _, dense, paged = _pair()
@@ -232,6 +291,29 @@ def test_closed_program_set_survives_hits_and_joins():
     finally:
         bat.close()
     assert paged.compiled_programs() == n         # still closed
+
+
+def test_failed_prefill_does_not_poison_prefix_cache(monkeypatch):
+    # Regression: allocate() registers full prompt blocks before the
+    # prefill dispatch runs; if that dispatch fails, the never-written
+    # blocks must be unregistered or a later same-prefix request would
+    # "hit" blocks holding garbage K/V.
+    _, dense, paged = _pair()
+    prompt = [5] * 40
+    want = dense.generate(prompt, max_new_tokens=8)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected prefill failure")
+
+    monkeypatch.setattr(paged, "_prefill_paged_dispatch", boom)
+    with pytest.raises(RuntimeError):
+        paged.prefill(prompt, 0)
+    monkeypatch.undo()
+    assert paged.pool.blocks_in_use == 0          # table released
+    assert paged.pool.cached_blocks == 0          # nothing poisoned
+    hits0 = paged.pool.hits
+    assert paged.generate(prompt, max_new_tokens=8) == want
+    assert paged.pool.hits == hits0               # prefilled cold
 
 
 # -------------------------------------------- prefix cache saves prefill
