@@ -80,6 +80,17 @@ usage: ci/run_tests.sh <function>
                         SERVING without a process restart; SIGTERM a
                         training loop — emergency checkpoint at the step
                         boundary, resume bit-identical to golden
+  router_smoke          fleet drill (four parts): a fresh
+                        MXNET_COMPILE_CACHE_DIR makes a second replica's
+                        warmup-to-first-200 >= 1.5x faster; SIGKILL one
+                        of 3 replicas under 16 streaming clients — zero
+                        failed requests (zero-token deaths fail over
+                        transparently, mid-stream deaths end in a loud
+                        terminal SSE error the client re-issues);
+                        rolling drain/restart of all 3 replicas — zero
+                        downtime, zero mid-stream errors; prefix-affine
+                        routing beats random placement on fleet-wide
+                        mxtpu_prefix_cache_hits
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -989,6 +1000,12 @@ lifecycle_smoke() {
     JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py hang --out "$out"
     # preemption drill: cooperative SIGTERM checkpoint, exact resume
     JAX_PLATFORMS=cpu python tools/lifecycle_smoke.py train --out "$out"
+}
+
+router_smoke() {
+    local cc=/tmp/mxtpu_router_smoke_cc
+    rm -rf "$cc"
+    JAX_PLATFORMS=cpu python tools/router_smoke.py all --cache-dir "$cc"
 }
 
 multichip_dryrun() {

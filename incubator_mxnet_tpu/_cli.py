@@ -323,3 +323,73 @@ def serve_main():
                      f"(/v1/models, /healthz, /readyz, /metrics)\n")
     from .serving import lifecycle
     sys.exit(lifecycle.run_until_shutdown(srv))
+
+
+def router_main():
+    """``mxtpu-router`` — fault-tolerant front tier over a fleet of
+    ``mxtpu-serve`` replicas (see docs/serving.md "Serving a fleet")::
+
+        mxtpu-router --replica 127.0.0.1:8080 --replica 127.0.0.1:8081 \\
+                     [--port N] [--retries N] [--health-interval F]
+                     [--no-affinity] [--spill-margin N]
+                     [--upstream-timeout F]
+
+    Spreads ``POST /v1/models/<name>:predict`` / ``:generate`` over the
+    replicas with health-aware least-loaded balancing, breaker-based
+    outlier ejection, retry-with-failover (honoring ``Retry-After``),
+    SSE passthrough, rendezvous-hash prefix-affine routing, and
+    ``POST /admin/drain`` / ``/admin/undrain`` for zero-downtime
+    rolling weight updates.  Knobs default from ``MXNET_ROUTER_*``
+    (docs/env_var.md)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="mxtpu-router",
+        description="route :predict/:generate over mxtpu-serve "
+                    "replicas with failover, drains, and "
+                    "prefix-affine balancing")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="an mxtpu-serve replica (repeatable; also "
+                         "accepts a comma-separated list)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen port (default MXNET_ROUTER_PORT or "
+                         "8081; 0 picks an ephemeral port)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--retries", type=int, default=None,
+                    help="upstream attempts beyond the first per "
+                         "request (default MXNET_ROUTER_RETRIES or 2)")
+    ap.add_argument("--health-interval", type=float, default=None,
+                    help="seconds between /readyz+/slo polls (default "
+                         "MXNET_ROUTER_HEALTH_INTERVAL_SECONDS or 0.5)")
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="disable rendezvous-hash prefix-affine "
+                         "routing for :generate")
+    ap.add_argument("--spill-margin", type=int, default=None,
+                    help="inflight excess over the fleet minimum at "
+                         "which an affinity owner spills (default "
+                         "MXNET_ROUTER_SPILL_MARGIN or 8)")
+    ap.add_argument("--upstream-timeout", type=float, default=None,
+                    help="per-attempt upstream timeout in seconds "
+                         "(default MXNET_ROUTER_UPSTREAM_TIMEOUT_"
+                         "SECONDS or 10)")
+    ns = ap.parse_args()
+    replicas = [r for spec in ns.replica
+                for r in spec.split(",") if r.strip()]
+    if not replicas:
+        ap.error("at least one --replica HOST:PORT is required")
+
+    from .serving import Router, lifecycle
+
+    router = Router(replicas, port=ns.port, host=ns.host,
+                    retries=ns.retries,
+                    health_interval=ns.health_interval,
+                    affinity=False if ns.no_affinity else None,
+                    spill_margin=ns.spill_margin,
+                    upstream_timeout=ns.upstream_timeout)
+    router.start()
+    sys.stderr.write(
+        f"mxtpu-router: listening on http://{ns.host}:{router.port} "
+        f"over {len(router.replicas)} replica(s) "
+        f"({', '.join(r.id for r in router.replicas)})\n")
+    sys.exit(lifecycle.run_until_shutdown(router))

@@ -12,8 +12,10 @@ first-class, *testable* runtime concept instead:
   ``kvstore.pushpull`` (transport), ``dataloader.fetch`` and
   ``prefetch.h2d`` (input pipeline: upstream fetch and the prefetcher's
   host-to-device staging), ``checkpoint.write`` (storage),
-  ``trainer.grad`` (numerics), and the serving pair ``serving.queue`` /
-  ``serving.infer``.  Kinds: ``ioerror`` (raise a transient
+  ``trainer.grad`` (numerics), the serving pair ``serving.queue`` /
+  ``serving.infer``, and ``router.upstream`` (one poll per
+  router→replica attempt, so a plan can kill exactly the Nth upstream
+  try and drill the failover path).  Kinds: ``ioerror`` (raise a transient
   :class:`FaultInjected`), ``latency`` (sleep), ``nonfinite`` (poison a
   gradient — consumed by the trainer's guard via :func:`take`), and
   ``hang`` (a long stall, default 3600 s, modeling a wedged dispatch —
@@ -56,7 +58,8 @@ from . import telemetry as _telemetry
 __all__ = [
     "FaultInjected", "FaultRule", "FaultPlan", "RetryPolicy",
     "install_plan", "clear_plan", "current_plan", "active",
-    "inject", "take", "site_calls", "retry_call", "TRANSIENT",
+    "inject", "take", "site_calls", "retry_call", "retry_after_hint",
+    "TRANSIENT",
 ]
 
 KINDS = ("ioerror", "latency", "nonfinite", "hang")
@@ -279,16 +282,40 @@ class RetryPolicy:
         return d * (1.0 - self.jitter * self._rng.random())
 
 
+def retry_after_hint(err: BaseException) -> Optional[float]:
+    """The default server-provided backoff extractor: a non-negative
+    ``retry_after`` attribute on the error (the convention every
+    transport error in this codebase follows — ``BreakerOpen``,
+    ``QueueFullError``, the router's upstream errors)."""
+    hint = getattr(err, "retry_after", None)
+    if hint is None:
+        return None
+    try:
+        hint = float(hint)
+    except (TypeError, ValueError):
+        return None
+    return hint if hint >= 0.0 else None
+
+
 def retry_call(fn, *args, site: str = "?",
                policy: Optional[RetryPolicy] = None,
-               retry_on=TRANSIENT, **kwargs):
+               retry_on=TRANSIENT, retry_after_hint=None, **kwargs):
     """Call ``fn(*args, **kwargs)``, absorbing up to
     ``policy.max_retries`` transient failures with backoff, under a
     wall-clock deadline.  Each retry publishes a ``FAULT`` ``retry``
     event (→ ``mxtpu_retries``); exhaustion publishes ``giveup``
     (→ ``mxtpu_giveups``) and re-raises the last error.  The success
     path costs one try/except frame — no policy object is built unless
-    something actually fails."""
+    something actually fails.
+
+    ``retry_after_hint`` is an optional ``error -> Optional[float]``
+    extractor for server-provided backoff: when it yields a delay for
+    the caught error, that delay replaces the exponential schedule for
+    the next attempt (capped at the policy's ``max_delay_seconds`` so a
+    hostile upstream cannot park the caller, still counted against the
+    retry budget and the wall-clock deadline).  Pass
+    :func:`fault.retry_after_hint` to honor the ``retry_after``
+    attribute convention used across the serving transport errors."""
     try:
         return fn(*args, **kwargs)
     except retry_on as e:
@@ -300,6 +327,9 @@ def retry_call(fn, *args, site: str = "?",
     while True:
         attempt += 1
         delay = policy.delay(attempt)
+        hinted = retry_after_hint(err) if retry_after_hint else None
+        if hinted is not None:
+            delay = min(hinted, policy.max_delay_seconds)
         if attempt > policy.max_retries \
                 or _time.monotonic() + delay > deadline:
             _telemetry.FAULT.publish(site=site, event="giveup",
@@ -307,7 +337,8 @@ def retry_call(fn, *args, site: str = "?",
             raise err
         _telemetry.FAULT.publish(site=site, event="retry",
                                  kind=type(err).__name__,
-                                 attempt=attempt, seconds=delay)
+                                 attempt=attempt, seconds=delay,
+                                 hinted=hinted is not None)
         _time.sleep(delay)
         try:
             return fn(*args, **kwargs)

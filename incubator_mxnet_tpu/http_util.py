@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import re
+import sys
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -40,6 +41,17 @@ class HTTPServerBase(ThreadingHTTPServer):
     (socketserver's default of 5 resets connections under load)."""
     daemon_threads = True
     request_queue_size = 128
+
+    def handle_error(self, request, client_address):
+        # A client dropping its half of a keep-alive connection (or an
+        # SSE consumer walking away) is business as usual for a server
+        # fronted by a router/balancer — not worth a stderr traceback.
+        # Anything else keeps socketserver's loud default.
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
 
 
 # what survives of a client-supplied x-request-id: word chars, dot,
@@ -92,11 +104,17 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(obj, default=str) + "\n",
                    "application/json", headers)
 
+    def read_body(self) -> bytes:
+        """The raw request body (``b""`` when absent).  The router
+        reads the body once and forwards the same bytes on every
+        failover attempt, so retried requests are byte-identical."""
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length > 0 else b""
+
     def read_json(self):
         """Parse the request body as JSON (``ValueError`` on garbage;
         an absent/empty body parses as ``{}``)."""
-        length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length) if length > 0 else b""
+        raw = self.read_body()
         if not raw:
             return {}
         try:
@@ -125,6 +143,14 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
     def _write_chunk(self, data: bytes) -> None:
         self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
         self.wfile.flush()
+
+    def relay_chunk(self, data: bytes) -> None:
+        """Forward already-framed payload bytes (e.g. upstream SSE
+        lines) onto an open stream without re-encoding — the router's
+        passthrough path.  Same disconnect contract as
+        :meth:`send_event`."""
+        if data:
+            self._write_chunk(data)
 
     def send_event(self, obj, event: Optional[str] = None) -> None:
         """One SSE event carrying a JSON payload.  Raises

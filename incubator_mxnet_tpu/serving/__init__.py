@@ -21,6 +21,13 @@ Four layers, importable à la carte:
   ``/readyz``, ``/metrics``) sharing plumbing with the telemetry
   exporter.  CLI: ``mxtpu-serve``.
 
+Above the single process sits :class:`Router` (``router.py``) — the
+``mxtpu-router`` front tier spreading ``:predict``/``:generate`` over
+N replicas with health-aware least-loaded balancing, breaker-based
+outlier ejection, retry-with-failover, SSE passthrough, zero-downtime
+drain orchestration, and rendezvous-hash prefix-affine routing for
+the paged KV prefix cache (docs/serving.md "Serving a fleet").
+
 Generation serving rides the same layers: :class:`GenerationEngine`
 (paged KV cache over a :class:`~.kvcache.BlockPool` — fixed-size
 blocks, per-slot block tables, refcounted prefix sharing; dense mode
@@ -45,11 +52,13 @@ from .engine import InferenceEngine, GenerationEngine, derive_buckets, \
 from .kvcache import BlockPool, blocks_for
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .server import ModelServer
+from .router import Router, Replica, UpstreamError, NoReplicaAvailable
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
            "derive_prefill_buckets", "BlockPool", "blocks_for",
            "DynamicBatcher",
            "ContinuousBatcher", "QueueFullError", "ModelServer",
+           "Router", "Replica", "UpstreamError", "NoReplicaAvailable",
            "metrics", "lifecycle",
            "CircuitBreaker", "Watchdog", "DeadlineExceeded",
            "BreakerOpen", "Draining", "RequestAborted", "Cancelled",

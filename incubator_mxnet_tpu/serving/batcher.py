@@ -779,7 +779,38 @@ class ContinuousBatcher(DynamicBatcher):
         self._step = 0
         self._tokens_emitted = 0
         self._peak_slots = 0
+        self._kv_starved_sweeps = 0
+        self._kv_starve_threshold = max(1, getenv_int(
+            "MXNET_SERVE_KV_STARVE_SWEEPS", 3))
         super().__init__(engine, **kw)
+
+    # -- KV-capacity starvation (the ``kv:<model>`` readiness blocker) --
+    def check_worker(self, hang_seconds: Optional[float] = None):
+        """The watchdog sweep doubles as the KV-starvation sampler: a
+        paged pool with zero free blocks for
+        ``MXNET_SERVE_KV_STARVE_SWEEPS`` consecutive sweeps flips
+        :attr:`kv_starved`, which surfaces as a ``kv:<model>`` blocker
+        on ``/readyz`` — the router routes generation to replicas with
+        capacity instead of eating this replica's 429s.  One free block
+        resets the count (starvation must be sustained, not a blip)."""
+        pool = getattr(self.engine, "pool", None)
+        if pool is not None:
+            if pool.free_blocks == 0:
+                self._kv_starved_sweeps += 1
+                if self._kv_starved_sweeps == self._kv_starve_threshold:
+                    _telemetry.FAULT.publish(
+                        site="serving.kv", event="starved",
+                        kind="exhausted", model=self.name,
+                        sweeps=self._kv_starved_sweeps)
+            else:
+                self._kv_starved_sweeps = 0
+        return super().check_worker(hang_seconds)
+
+    @property
+    def kv_starved(self) -> bool:
+        """True while the paged BlockPool has been fully exhausted for
+        ``MXNET_SERVE_KV_STARVE_SWEEPS`` consecutive watchdog sweeps."""
+        return self._kv_starved_sweeps >= self._kv_starve_threshold
 
     # admission control: the parent's rows//max_batch estimate is
     # meaningless for multi-dispatch requests — deadlines are enforced
@@ -1184,6 +1215,7 @@ class ContinuousBatcher(DynamicBatcher):
                 "peak_slots_in_use": self._peak_slots,
                 "prefill_buckets": list(self.engine.prefill_buckets),
                 "kv_cache_bytes": int(self.engine.cache_bytes),
+                "kv_starved": self.kv_starved,
             })
             ks = getattr(self.engine, "kv_stats", None)
             if ks is not None:

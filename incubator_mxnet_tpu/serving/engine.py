@@ -37,7 +37,51 @@ from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telemetry
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
-           "derive_prefill_buckets"]
+           "derive_prefill_buckets", "ensure_compile_cache"]
+
+
+_compile_cache_dir: Optional[str] = None
+
+
+def ensure_compile_cache() -> Optional[str]:
+    """Point JAX's persistent compilation cache at
+    ``MXNET_COMPILE_CACHE_DIR`` (idempotent; returns the active dir or
+    None when the env var is unset).
+
+    Every engine constructor calls this BEFORE building its jitted
+    programs, so a fresh replica's ``warmup()`` loads compiled
+    executables from disk instead of re-tracing through XLA — the
+    instant-start half of the serve-fleet story (docs/serving.md):
+    replica N pays the compile once, replicas N+1.. hit the shared
+    directory.  The entry-size/compile-time floors are dropped to zero
+    because serving programs are many small programs (one per bucket)
+    — exactly the population the default floors would skip."""
+    global _compile_cache_dir
+    from ..base import getenv
+    cache_dir = getenv("MXNET_COMPILE_CACHE_DIR")
+    if not cache_dir or _compile_cache_dir is not None:
+        # Configure-once: jax's compilation cache dir cannot be safely
+        # re-pointed mid-process, so later engine inits (even with a
+        # changed env) keep the first wiring.
+        return _compile_cache_dir
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax snapshots the cache at the FIRST compile; if anything
+        # compiled before we got here (eager param init, a warmup
+        # forward) the cache latched "disabled" — reset so the next
+        # compile re-initializes against the dir we just set.
+        from jax._src import compilation_cache as _jax_cc
+        _jax_cc.reset_cache()
+    except Exception as e:      # an old jax without the knobs serves
+        warnings.warn(          # fine, just without instant starts
+            f"MXNET_COMPILE_CACHE_DIR ignored: {e}")
+        return _compile_cache_dir
+    _compile_cache_dir = str(cache_dir)
+    return _compile_cache_dir
 
 
 def derive_buckets(max_batch_size: int) -> Tuple[int, ...]:
@@ -87,6 +131,7 @@ class InferenceEngine:
                  max_batch_size: Optional[int] = None,
                  input_specs=None, ctx=None):
         import jax
+        ensure_compile_cache()
         self.name = str(name)
         self.input_names = [str(n) for n in input_names]
         self._param_fn = param_fn
@@ -457,6 +502,7 @@ class GenerationEngine:
                  ctx=None):
         import jax
         from ..base import getenv_int, getenv_bool
+        ensure_compile_cache()
         for attr in ("embed", "pos_embed", "cells", "ln_f", "_units",
                      "_max_length"):
             if not hasattr(block, attr):

@@ -54,6 +54,43 @@ PREFIX_CACHE_EVICTIONS = _telemetry.registry.counter(
     "mxtpu_prefix_cache_evictions",
     "idle cached KV blocks evicted (LRU) to satisfy new allocations")
 
+# router (serving/router.py; labeled by replica where it matters) ----------
+ROUTER_REQUESTS = _telemetry.registry.counter(
+    "mxtpu_router_requests",
+    "client requests accepted by the mxtpu-router front tier")
+ROUTER_RETRIES = _telemetry.registry.counter(
+    "mxtpu_router_retries",
+    "upstream attempts beyond the first (connect error / 503 / 429 "
+    "re-routed under the per-request retry budget)")
+ROUTER_FAILOVERS = _telemetry.registry.counter(
+    "mxtpu_router_failovers",
+    "requests that ultimately succeeded on a different replica than "
+    "the first one tried")
+ROUTER_EJECTIONS = _telemetry.registry.counter(
+    "mxtpu_router_ejections",
+    "replica ejections (health-loop breaker CLOSED/HALF_OPEN -> OPEN)")
+ROUTER_AFFINITY = _telemetry.registry.counter(
+    "mxtpu_router_affinity_routed",
+    "generation requests routed to their rendezvous-hash prefix owner")
+ROUTER_SPILLS = _telemetry.registry.counter(
+    "mxtpu_router_spills",
+    "generation requests spilled off their prefix owner because it was "
+    "overloaded, draining, or ejected")
+ROUTER_STREAM_ERRORS = _telemetry.registry.counter(
+    "mxtpu_router_stream_errors",
+    "streams terminated with an SSE error event after a mid-stream "
+    "replica death (tokens already on the wire - no silent failover)")
+ROUTER_REPLICA_STATE = _telemetry.registry.gauge(
+    "mxtpu_router_replica_state",
+    "per-replica router view (0 READY, 1 UNREADY, 2 DRAINING, "
+    "3 EJECTED, 4 DOWN)")
+ROUTER_REPLICAS_ELIGIBLE = _telemetry.registry.gauge(
+    "mxtpu_router_replicas_eligible",
+    "replicas currently eligible for new work")
+ROUTER_INFLIGHT = _telemetry.registry.gauge(
+    "mxtpu_router_inflight",
+    "client requests in flight through the router, per replica")
+
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(
     "mxtpu_serve_batch_size",
@@ -72,6 +109,10 @@ DECODE_STEP = _telemetry.registry.histogram(
     "mxtpu_generate_decode_step_seconds",
     "seconds per continuous-batching decode dispatch (all live slots "
     "advance one token)")
+ROUTER_UPSTREAM = _telemetry.registry.histogram(
+    "mxtpu_router_upstream_seconds",
+    "seconds per upstream attempt (router -> replica), successful or "
+    "not")
 
 # gauges -------------------------------------------------------------------
 QUEUE_DEPTH = _telemetry.registry.gauge(

@@ -1,0 +1,912 @@
+"""mxtpu-router — a fault-tolerant HTTP front tier over a fleet of
+``mxtpu-serve`` replicas (docs/serving.md "Serving a fleet").
+
+One replica is a fault domain: a process that can be SIGKILLed by the
+scheduler, drained for a weight update, or wedged behind an exhausted
+KV pool.  The router's job is to make all of that invisible to
+clients.  Stdlib-only (``http.client`` upstream, the shared
+:class:`~..http_util.BaseJSONHandler` downstream), so a fleet needs no
+sidecar infrastructure — same deployment story as ``mxtpu-serve``
+itself.  Five cooperating mechanisms:
+
+* **health-aware balancing** — a background loop polls every replica's
+  ``GET /readyz`` (readiness, blockers: warming models, ``slo:<m>``
+  burn, ``kv:<m>`` starvation) and ``GET /slo`` (worst-model burn
+  rate).  ``:predict`` traffic goes weighted least-loaded:
+  ``score = (inflight + 1) * (1 + burn)``, so a replica burning error
+  budget sheds load before it trips its own SLO blocker.
+* **outlier ejection** — each replica carries a
+  :class:`~.lifecycle.CircuitBreaker` fed by transport-level failures
+  (connect refused/reset, request timeouts, mid-stream death) from
+  both the health loop and the request path.  ``threshold``
+  consecutive failures eject the replica (OPEN → out of rotation);
+  the health loop keeps probing and its first success re-admits it.
+  An HTTP 503 from a *responding* replica is not a transport failure —
+  it flips ``ready`` off without charging the breaker.
+* **retry with failover** — connect errors, 429 and 503 re-route to
+  another replica under a per-request retry budget
+  (``MXNET_ROUTER_RETRIES``), through :func:`fault.retry_call` with
+  the ``retry_after_hint`` extractor: a server-sent ``Retry-After``
+  parks that replica (``backoff_until``) and, when no alternative
+  replica exists, becomes the actual sleep before the next attempt.
+  The request body is read once and the identical bytes are replayed,
+  and the client's ``X-Request-Id`` rides every hop, so one id
+  correlates client ↔ router ↔ whichever replica finally answered.
+* **SSE passthrough** — ``:generate`` streams are relayed chunk-for-
+  chunk (:meth:`~..http_util.BaseJSONHandler.relay_chunk`).  A replica
+  that dies before emitting its first SSE event is a retryable
+  failure: the router fails over and the client never knows.  Once
+  tokens are on the wire the stream cannot be transparently replayed,
+  so a mid-stream death terminates with an SSE ``error`` event
+  carrying the request id — never a silent hang.  A *client*
+  disconnect closes the upstream connection, which the replica sees as
+  its own client vanishing → ``Cancelled`` → KV blocks and the decode
+  slot free at the next step boundary.
+* **drain orchestration** — ``POST /admin/drain {"replica": id}``
+  stops routing to the replica *first*, then forwards the drain (its
+  ``/readyz`` flips for any other balancer), then waits for the
+  router's in-flight count on it to hit zero: the zero-downtime half
+  of a rolling weight update.  ``/admin/undrain`` reverses it and
+  re-polls health so the replica rejoins immediately.
+
+Generation traffic is **prefix-affine**: requests whose token prefix
+shares the same leading ``MXNET_KV_BLOCK_SIZE``-aligned blocks (up to
+``MXNET_ROUTER_AFFINITY_BLOCKS``) rendezvous-hash (highest-random-
+weight over the *eligible* set, so membership churn moves only ~1/N of
+the keyspace) to the same replica, concentrating the paged KV prefix
+cache (``mxtpu_prefix_cache_hits``) instead of smearing identical
+system prompts across the fleet.  When the owner is overloaded
+(inflight exceeds the fleet minimum by ``MXNET_ROUTER_SPILL_MARGIN``)
+the request spills down the rendezvous order — affinity is a
+preference, never a hotspot.
+
+Fault site: ``router.upstream`` fires once per upstream attempt
+(kinds ``ioerror``/``latency``/``hang``), so CI can drill "the second
+attempt's replica is dead" deterministically.  Metrics:
+``mxtpu_router_*`` on the shared registry, exposed by the router's own
+``/metrics``.  CLI: ``mxtpu-router --replica host:port ...``.
+"""
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from ..base import MXNetError, getenv, getenv_bool, getenv_int
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..http_util import BaseJSONHandler, HTTPServerBase
+from . import lifecycle as _lc
+from . import metrics as _m
+
+__all__ = ["Router", "Replica", "UpstreamError", "NoReplicaAvailable",
+           "rendezvous_order", "prefix_key"]
+
+FAULT_SITE = "router.upstream"
+
+#: numeric encoding for the ``mxtpu_router_replica_state`` gauge
+READY_CODE, UNREADY_CODE, DRAINING_CODE, EJECTED_CODE, DOWN_CODE = \
+    0, 1, 2, 3, 4
+
+_HOP_HEADERS = ("content-type", "retry-after")  # upstream headers kept
+_TERMINAL_MARKS = (b"event: done", b"event: error")
+
+
+class UpstreamError(MXNetError):
+    """A retryable upstream failure: connect error, 429/503, or a
+    stream that died before its first SSE event.  Carries the server's
+    ``Retry-After`` (when one was sent and no alternative replica
+    exists — otherwise 0 so failover is immediate);
+    :func:`fault.retry_after_hint` reads it."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None,
+                 replica: Optional[str] = None):
+        super().__init__(msg)
+        if retry_after is not None:
+            self.retry_after = max(0.0, float(retry_after))
+        self.replica = replica
+
+
+class NoReplicaAvailable(UpstreamError):
+    """No replica is eligible for new work right now (all ejected,
+    draining, unready, or backing off)."""
+
+
+def rendezvous_order(key: bytes, replicas: Sequence) -> List:
+    """Highest-random-weight order of ``replicas`` for ``key``.  Each
+    replica's weight is ``blake2b(key || 0 || replica_id)``, so every
+    (key, replica) pair hashes independently: adding or removing one
+    replica reassigns only the keys it wins/owned (~1/N of the
+    keyspace), every other key keeps its owner.  ``replicas`` may be
+    :class:`Replica` objects or plain id strings (tests)."""
+
+    def weight(rep) -> bytes:
+        rid = rep.id if hasattr(rep, "id") else str(rep)
+        h = hashlib.blake2b(digest_size=8)
+        h.update(key)
+        h.update(b"\x00")
+        h.update(rid.encode("utf-8"))
+        return h.digest()
+
+    return sorted(replicas, key=weight, reverse=True)
+
+
+def prefix_key(tokens, block_size: int,
+               max_blocks: int) -> Optional[bytes]:
+    """The affinity key for a generation request: a digest of the
+    leading ``block_size``-aligned token prefix, capped at
+    ``max_blocks`` blocks.  Aligning to the KV block size means two
+    requests share a key exactly when the paged prefix cache could
+    share their leading blocks; capping keeps long unique tails from
+    defeating affinity on a common system prompt.  None when the
+    prompt is shorter than one block (no shareable block → no
+    affinity)."""
+    if not tokens or block_size <= 0:
+        return None
+    n = (len(tokens) // block_size) * block_size
+    if n <= 0:
+        return None
+    if max_blocks > 0:
+        n = min(n, max_blocks * block_size)
+    h = hashlib.blake2b(digest_size=16)
+    for t in tokens[:n]:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+def _parse_hostport(spec: str) -> Tuple[str, int]:
+    spec = spec.strip()
+    if "//" not in spec:
+        spec = "//" + spec
+    split = urlsplit(spec)
+    host = split.hostname
+    if not host or split.port is None:
+        raise MXNetError(
+            f"replica {spec!r}: expected host:port or http://host:port")
+    return host, int(split.port)
+
+
+class Replica:
+    """The router's view of one ``mxtpu-serve`` process."""
+
+    def __init__(self, url: str,
+                 eject_threshold: Optional[int] = None,
+                 eject_cooldown_seconds: Optional[float] = None):
+        self.host, self.port = _parse_hostport(url)
+        self.id = f"{self.host}:{self.port}"
+        self.breaker = _lc.CircuitBreaker(
+            f"replica:{self.id}", threshold=eject_threshold,
+            cooldown_seconds=eject_cooldown_seconds)
+        self._lock = threading.Lock()
+        self.ready = False          # last /readyz verdict
+        self.reachable = False      # last poll/request connected at all
+        self.draining = False       # router-side drain flag
+        self.burn = 0.0             # worst-model SLO burn rate
+        self.blockers: List[str] = []
+        self.backoff_until = 0.0    # honored Retry-After
+        self.last_error = ""
+        self._inflight = 0
+
+    # -- load accounting ------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def _inflight_add(self, delta: int) -> None:
+        with self._lock:
+            self._inflight += delta
+            _m.ROUTER_INFLIGHT.set(self._inflight, replica=self.id)
+
+    # -- eligibility ----------------------------------------------------
+    def eligible(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return (self.ready and not self.draining
+                and self.breaker.state != _lc.OPEN
+                and now >= self.backoff_until)
+
+    def note_backoff(self, seconds: float) -> None:
+        """Honor a server-sent ``Retry-After``: no new work for
+        ``seconds`` (routing only — the health loop keeps polling)."""
+        until = time.monotonic() + max(0.0, float(seconds))
+        with self._lock:
+            self.backoff_until = max(self.backoff_until, until)
+
+    def state_code(self) -> int:
+        if self.draining:
+            return DRAINING_CODE
+        if self.breaker.state == _lc.OPEN:
+            return EJECTED_CODE
+        if not self.reachable:
+            return DOWN_CODE
+        if not self.ready:
+            return UNREADY_CODE
+        return READY_CODE
+
+    def snapshot(self) -> dict:
+        code = self.state_code()
+        name = {READY_CODE: "READY", UNREADY_CODE: "UNREADY",
+                DRAINING_CODE: "DRAINING", EJECTED_CODE: "EJECTED",
+                DOWN_CODE: "DOWN"}[code]
+        return {"id": self.id, "state": name,
+                "ready": self.ready, "reachable": self.reachable,
+                "draining": self.draining,
+                "breaker": self.breaker.state,
+                "burn_rate": self.burn, "blockers": list(self.blockers),
+                "inflight": self.inflight,
+                "backoff_seconds": max(0.0, self.backoff_until
+                                       - time.monotonic()),
+                "last_error": self.last_error}
+
+    def __repr__(self):
+        return f"<Replica {self.id} {self.snapshot()['state']}>"
+
+
+class _RouterHTTPServer(HTTPServerBase):
+    router: "Router"
+
+
+class Router:
+    """Front tier over N replicas.  Programmatic use::
+
+        router = Router(["127.0.0.1:8080", "127.0.0.1:8081"], port=0)
+        router.start()
+        ... client traffic against router.port ...
+        router.stop()
+
+    Constructor args override the ``MXNET_ROUTER_*`` env defaults
+    (docs/env_var.md)."""
+
+    def __init__(self, replicas: Sequence[str],
+                 port: Optional[int] = None, host: str = "0.0.0.0",
+                 retries: Optional[int] = None,
+                 health_interval: Optional[float] = None,
+                 affinity: Optional[bool] = None,
+                 affinity_blocks: Optional[int] = None,
+                 spill_margin: Optional[int] = None,
+                 upstream_timeout: Optional[float] = None,
+                 stream_timeout: Optional[float] = None,
+                 retry_deadline: Optional[float] = None,
+                 eject_threshold: Optional[int] = None,
+                 eject_cooldown_seconds: Optional[float] = None):
+        if not replicas:
+            raise MXNetError("Router needs at least one replica")
+        self._port = getenv_int("MXNET_ROUTER_PORT", 8081) \
+            if port is None else int(port)
+        self._host = host
+        self.retries = getenv_int("MXNET_ROUTER_RETRIES", 2) \
+            if retries is None else int(retries)
+        self.health_interval = float(
+            getenv("MXNET_ROUTER_HEALTH_INTERVAL_SECONDS", 0.5)) \
+            if health_interval is None else float(health_interval)
+        self.affinity = getenv_bool("MXNET_ROUTER_AFFINITY", True) \
+            if affinity is None else bool(affinity)
+        self.affinity_blocks = getenv_int(
+            "MXNET_ROUTER_AFFINITY_BLOCKS", 2) \
+            if affinity_blocks is None else int(affinity_blocks)
+        self.block_size = max(1, getenv_int("MXNET_KV_BLOCK_SIZE", 16))
+        self.spill_margin = getenv_int("MXNET_ROUTER_SPILL_MARGIN", 8) \
+            if spill_margin is None else int(spill_margin)
+        self.upstream_timeout = float(
+            getenv("MXNET_ROUTER_UPSTREAM_TIMEOUT_SECONDS", 10.0)) \
+            if upstream_timeout is None else float(upstream_timeout)
+        self.stream_timeout = float(
+            getenv("MXNET_ROUTER_STREAM_TIMEOUT_SECONDS", 120.0)) \
+            if stream_timeout is None else float(stream_timeout)
+        self.retry_deadline = float(
+            getenv("MXNET_ROUTER_RETRY_DEADLINE_SECONDS", 10.0)) \
+            if retry_deadline is None else float(retry_deadline)
+        if eject_threshold is None:
+            eject_threshold = getenv_int("MXNET_ROUTER_EJECT_THRESHOLD", 3)
+        if eject_cooldown_seconds is None:
+            eject_cooldown_seconds = float(
+                getenv("MXNET_ROUTER_EJECT_COOLDOWN_SECONDS", 2.0))
+        self._replicas: List[Replica] = []
+        for spec in replicas:
+            rep = Replica(spec, eject_threshold=eject_threshold,
+                          eject_cooldown_seconds=eject_cooldown_seconds)
+            if all(r.id != rep.id for r in self._replicas):
+                self._replicas.append(rep)
+        self._lock = threading.Lock()
+        self._http: Optional[_RouterHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._draining = False
+        self._rr = 0                # rotation offset for idle ties
+
+    # -- registry -------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    def replica(self, rid: str) -> Replica:
+        for rep in self._replicas:
+            if rep.id == rid:
+                return rep
+        raise KeyError(rid)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def total_inflight(self) -> int:
+        return sum(r.inflight for r in self._replicas)
+
+    # -- health loop ----------------------------------------------------
+    def check_health_once(self) -> None:
+        """One synchronous sweep over every replica (tests drive this
+        directly; the background loop calls it on an interval)."""
+        for rep in self._replicas:
+            self._poll(rep)
+        self._eligible()            # refresh the eligible-count gauge
+
+    def _poll_timeout(self) -> float:
+        return min(2.0, max(0.25, self.health_interval * 4.0))
+
+    def _poll(self, rep: Replica) -> None:
+        try:
+            status, body = self._get_json(rep, "/readyz",
+                                          self._poll_timeout())
+        except OSError as e:
+            rep.reachable = False
+            rep.ready = False
+            rep.last_error = f"health poll: {e}"
+            self._record_failure(rep, "health poll failed")
+            self._set_state_gauge(rep)
+            return
+        rep.reachable = True
+        rep.ready = status == 200
+        if isinstance(body, dict):
+            rep.blockers = list(body.get("blockers") or [])
+            if body.get("draining"):
+                # the replica drains itself (SIGTERM / direct admin) —
+                # treat like unready; the router-side drain flag is
+                # only flipped by drain_replica()
+                rep.ready = False
+        rep.last_error = ""
+        # a reachable replica is not a transport outlier, whatever its
+        # readiness — close/feed the breaker with the success
+        self._record_success(rep)
+        if rep.ready:
+            try:
+                s, slo = self._get_json(rep, "/slo", self._poll_timeout())
+                if s == 200 and isinstance(slo, dict):
+                    models = slo.get("models", {})
+                    burns = [m.get("burn_rate", 0.0)
+                             for m in models.values()
+                             if isinstance(m, dict)]
+                    rep.burn = max(burns) if burns else 0.0
+            except (OSError, ValueError):
+                pass                # burn is advisory; keep the last view
+        self._set_state_gauge(rep)
+
+    def _set_state_gauge(self, rep: Replica) -> None:
+        _m.ROUTER_REPLICA_STATE.set(rep.state_code(), replica=rep.id)
+
+    def _record_success(self, rep: Replica) -> None:
+        rep.breaker.record_success()
+
+    def _record_failure(self, rep: Replica, reason: str) -> None:
+        was_open = rep.breaker.state == _lc.OPEN
+        rep.breaker.record_failure(reason)
+        if not was_open and rep.breaker.state == _lc.OPEN:
+            _m.ROUTER_EJECTIONS.inc(replica=rep.id)
+            _telemetry.FAULT.publish(site="router.health",
+                                     event="ejected", kind="breaker",
+                                     replica=rep.id, reason=reason)
+        self._set_state_gauge(rep)
+
+    def _health_run(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            try:
+                self.check_health_once()
+            except Exception:       # the health loop must survive
+                pass                # anything one replica throws at it
+
+    # -- routing --------------------------------------------------------
+    def _eligible(self) -> List[Replica]:
+        now = time.monotonic()
+        out = [r for r in self._replicas if r.eligible(now)]
+        _m.ROUTER_REPLICAS_ELIGIBLE.set(len(out))
+        return out
+
+    @staticmethod
+    def _load_score(rep: Replica) -> float:
+        return (rep.inflight + 1.0) * (1.0 + max(0.0, rep.burn))
+
+    def route(self, affinity_key: Optional[bytes] = None,
+              exclude=()) -> Replica:
+        """Pick the replica for one upstream attempt.  ``exclude``
+        holds replica ids already tried this request — preferred
+        avoided, reused only when nothing else is eligible."""
+        pool = self._eligible()
+        if not pool:
+            raise NoReplicaAvailable(
+                "no eligible replica (states: "
+                + ", ".join(f"{r.id}={r.snapshot()['state']}"
+                            for r in self._replicas) + ")",
+                retry_after=min(1.0, max(0.05, self.health_interval)))
+        fresh = [r for r in pool if r.id not in exclude] or pool
+        if affinity_key is not None and self.affinity:
+            ranked = rendezvous_order(affinity_key, fresh)
+            floor = min(r.inflight for r in fresh)
+            for i, rep in enumerate(ranked):
+                if rep.inflight - floor <= self.spill_margin:
+                    if i == 0:
+                        _m.ROUTER_AFFINITY.inc(replica=rep.id)
+                    else:
+                        _m.ROUTER_SPILLS.inc(replica=rep.id)
+                    return rep
+            _m.ROUTER_SPILLS.inc(replica=ranked[-1].id)
+            return min(ranked, key=self._load_score)
+        with self._lock:
+            self._rr += 1
+            start = self._rr % len(fresh)
+        rotated = fresh[start:] + fresh[:start]
+        return min(rotated, key=self._load_score)
+
+    # -- upstream transport ---------------------------------------------
+    def _connect(self, rep: Replica,
+                 timeout: Optional[float] = None
+                 ) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            rep.host, rep.port,
+            timeout=self.upstream_timeout if timeout is None
+            else timeout)
+
+    def _get_json(self, rep: Replica, path: str,
+                  timeout: float) -> Tuple[int, dict]:
+        conn = self._connect(rep, timeout)
+        try:
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                data = resp.read()
+            except http.client.HTTPException as e:
+                raise ConnectionError(str(e)) from e
+            try:
+                body = json.loads(data.decode("utf-8")) if data else {}
+            except (ValueError, UnicodeDecodeError):
+                body = {}
+            return resp.status, body
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _retry_after_of(resp, body: Optional[dict]) -> Optional[float]:
+        raw = resp.getheader("Retry-After")
+        if raw is None and isinstance(body, dict):
+            raw = body.get("retry_after")
+        try:
+            return max(0.0, float(raw)) if raw is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _has_alternative(self, tried) -> bool:
+        return any(r.id not in tried for r in self._eligible())
+
+    # -- the proxy core --------------------------------------------------
+    def proxy(self, handler: BaseJSONHandler, path: str, body: bytes,
+              rid: str, affinity_key: Optional[bytes] = None,
+              stream: bool = False) -> None:
+        """Forward one ``:predict``/``:generate`` POST, retrying with
+        failover, then relay the terminal response (or the SSE stream)
+        to ``handler``."""
+        _m.ROUTER_REQUESTS.inc()
+        if self._draining:
+            handler.send_json(
+                503, {"error": "router is draining", "request_id": rid},
+                headers={"Retry-After": 1})
+            return
+        tried: List[str] = []
+
+        def attempt():
+            rep = self.route(affinity_key=affinity_key, exclude=tried)
+            tried.append(rep.id)
+            if len(tried) > 1:
+                _m.ROUTER_RETRIES.inc(replica=rep.id)
+            t0 = time.monotonic()
+            try:
+                _fault.inject(FAULT_SITE, replica=rep.id,
+                              request_id=rid)
+                return self._dispatch(rep, path, body, rid, stream)
+            finally:
+                _m.ROUTER_UPSTREAM.observe(time.monotonic() - t0)
+
+        try:
+            result = _fault.retry_call(
+                attempt, site=FAULT_SITE,
+                policy=_fault.RetryPolicy(
+                    max_retries=self.retries, base_seconds=0.05,
+                    deadline_seconds=self.retry_deadline),
+                retry_on=(UpstreamError, OSError),
+                retry_after_hint=_fault.retry_after_hint)
+        except (UpstreamError, OSError) as e:
+            retry = getattr(e, "retry_after", None)
+            handler.send_json(
+                503, {"error": f"no replica could serve the request: "
+                               f"{e}", "request_id": rid,
+                      "replicas_tried": tried},
+                headers={"Retry-After": retry if retry else 1})
+            return
+        if len(set(tried)) > 1:
+            _m.ROUTER_FAILOVERS.inc()
+        if result[0] == "json":
+            _, status, data, headers = result
+            handler._send(status, data,
+                          headers.pop("content-type",
+                                      "application/json"),
+                          headers=headers or None)
+        else:
+            _, rep, conn, resp, head = result
+            self._relay_stream(handler, rep, conn, resp, head, rid)
+
+    def _dispatch(self, rep: Replica, path: str, body: bytes, rid: str,
+                  stream: bool):
+        """One upstream attempt.  Returns ``("json", status, text,
+        headers)`` for terminal responses or ``("stream", rep, conn,
+        resp, head)`` once an SSE stream has produced its first event.
+        Raises :class:`UpstreamError` (or ``OSError``) for anything
+        worth failing over."""
+        rep._inflight_add(+1)
+        conn = self._connect(rep)
+        done = False
+        try:
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": rid,
+                             "Accept": "text/event-stream" if stream
+                             else "application/json"})
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                # BadStatusLine/ConnectionReset both mean the same
+                # thing here: the replica's socket is gone
+                rep.reachable = False
+                rep.last_error = str(e)
+                self._record_failure(rep, f"connect: {e}")
+                raise UpstreamError(
+                    f"{rep.id}: {e}", replica=rep.id,
+                    retry_after=0.0 if self._has_alternative([rep.id])
+                    else None) from e
+            if resp.status in (429, 503):
+                data = resp.read()
+                try:
+                    parsed = json.loads(data.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    parsed = {}
+                retry = self._retry_after_of(resp, parsed)
+                if retry is not None:
+                    rep.note_backoff(retry)
+                if resp.status == 503:
+                    # shedding (drain/breaker/abort) — readiness will
+                    # reflect it on the next poll; not a transport fault
+                    rep.ready = False
+                self._set_state_gauge(rep)
+                raise UpstreamError(
+                    f"{rep.id} answered {resp.status}", replica=rep.id,
+                    retry_after=0.0 if self._has_alternative([rep.id])
+                    else retry)
+            if stream and resp.status == 200 and "text/event-stream" in \
+                    (resp.getheader("Content-Type") or ""):
+                head = b""
+                while b"\n\n" not in head:
+                    try:
+                        chunk = resp.read1(65536)
+                    except (OSError,
+                            http.client.HTTPException) as e:
+                        chunk = b""     # IncompleteRead == dead socket
+                        rep.last_error = str(e)
+                    if not chunk:
+                        # died before the FIRST event: nothing reached
+                        # the client, failover is transparent
+                        self._record_failure(
+                            rep, "stream died before first event")
+                        raise UpstreamError(
+                            f"{rep.id} closed the stream before the "
+                            "first event", replica=rep.id,
+                            retry_after=0.0
+                            if self._has_alternative([rep.id])
+                            else None)
+                    head += chunk
+                self._record_success(rep)
+                done = True         # inflight stays held for the relay
+                return ("stream", rep, conn, resp, head)
+            try:
+                data = resp.read().decode("utf-8", "replace")
+            except (OSError, http.client.HTTPException) as e:
+                self._record_failure(rep, f"body read: {e}")
+                raise UpstreamError(
+                    f"{rep.id} died mid-response: {e}", replica=rep.id,
+                    retry_after=0.0 if self._has_alternative([rep.id])
+                    else None) from e
+            headers = {k: resp.getheader(k) for k in _HOP_HEADERS
+                       if resp.getheader(k) is not None}
+            if resp.status < 500:
+                self._record_success(rep)
+            return ("json", resp.status, data, headers)
+        finally:
+            if not done:
+                rep._inflight_add(-1)
+                conn.close()
+
+    def _relay_stream(self, handler: BaseJSONHandler, rep: Replica,
+                      conn, resp, head: bytes, rid: str) -> None:
+        """Relay an open upstream SSE stream.  Downstream disconnect →
+        close upstream (the replica cancels and frees its slot/blocks).
+        Upstream EOF without a terminal ``done``/``error`` event →
+        terminal SSE ``error`` event with the request id."""
+        terminal = any(mark in head for mark in _TERMINAL_MARKS)
+        tail = head[-64:]
+        try:
+            handler.start_stream(200)
+            try:
+                handler.relay_chunk(head)
+            except OSError:
+                return              # client gone → finally closes conn
+            if conn.sock is not None:
+                conn.sock.settimeout(self.stream_timeout)
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except (OSError, http.client.HTTPException) as e:
+                    rep.last_error = str(e)
+                    chunk = b""
+                if not chunk:
+                    break
+                window = tail + chunk
+                if any(mark in window for mark in _TERMINAL_MARKS):
+                    terminal = True
+                tail = window[-64:]
+                try:
+                    handler.relay_chunk(chunk)
+                except OSError:
+                    return          # client disconnect mid-stream
+            if terminal:            # done/error already on the wire —
+                try:                # a late reset changes nothing
+                    handler.end_stream()
+                except OSError:
+                    pass
+                return
+            # mid-stream death with tokens already on the wire: the
+            # stream cannot be transparently replayed — fail loudly
+            _m.ROUTER_STREAM_ERRORS.inc(replica=rep.id)
+            self._record_failure(rep, "mid-stream death")
+            _telemetry.FAULT.publish(site=FAULT_SITE,
+                                     event="stream_error",
+                                     kind="midstream", replica=rep.id,
+                                     request_id=rid)
+            try:
+                handler.send_event(
+                    {"error": f"replica {rep.id} died mid-stream",
+                     "request_id": rid, "replica": rep.id},
+                    event="error")
+                handler.end_stream()
+            except OSError:
+                pass
+        finally:
+            rep._inflight_add(-1)
+            conn.close()
+
+    # -- GET passthrough (registry/SLO views) ----------------------------
+    def forward_get(self, handler: BaseJSONHandler, path: str) -> None:
+        for rep in self._eligible():
+            try:
+                status, body = self._get_json(rep, path,
+                                              self.upstream_timeout)
+            except OSError:
+                continue
+            handler.send_json(status, body)
+            return
+        handler.send_json(503, {"error": "no eligible replica"},
+                          headers={"Retry-After": 1})
+
+    # -- drain orchestration ---------------------------------------------
+    def _admin(self, rep: Replica, path: str) -> None:
+        conn = self._connect(rep)
+        try:
+            conn.request("POST", path, body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+        finally:
+            conn.close()
+
+    def drain_replica(self, rid: str,
+                      wait_seconds: Optional[float] = None) -> dict:
+        """Zero-downtime drain of one replica: stop routing to it
+        FIRST, then forward the drain (its own ``/readyz`` flips for
+        any other balancer), then wait for the router's in-flight
+        count on it to reach zero."""
+        rep = self.replica(rid)     # KeyError → HTTP 404
+        rep.draining = True
+        self._set_state_gauge(rep)
+        _telemetry.FAULT.publish(site="router.admin", event="drain",
+                                 kind="begin", replica=rep.id)
+        try:
+            self._admin(rep, "/admin/drain")
+        except OSError as e:        # already dead — drained by definition
+            rep.last_error = str(e)
+        if wait_seconds is None:
+            wait_seconds = _lc.default_drain_seconds()
+        deadline = time.monotonic() + max(0.0, float(wait_seconds))
+        while rep.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        left = rep.inflight
+        return {"replica": rep.id, "draining": True,
+                "drained": left == 0, "inflight": left}
+
+    def undrain_replica(self, rid: str) -> dict:
+        """Reverse :meth:`drain_replica` and re-poll health so the
+        replica rejoins the eligible set immediately."""
+        rep = self.replica(rid)
+        try:
+            self._admin(rep, "/admin/undrain")
+        except OSError as e:
+            rep.last_error = str(e)
+        rep.draining = False
+        self._poll(rep)
+        _telemetry.FAULT.publish(site="router.admin", event="drain",
+                                 kind="end", replica=rep.id)
+        return {"replica": rep.id, "draining": False,
+                "eligible": rep.eligible()}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Router":
+        if self._http is not None:
+            return self
+        srv = _RouterHTTPServer((self._host, self._port), _RouterHandler)
+        srv.router = self
+        self._port = srv.server_address[1]
+        self._stop.clear()
+        th = threading.Thread(target=srv.serve_forever,
+                              name="mxtpu-router-http", daemon=True)
+        th.start()
+        self._http, self._http_thread = srv, th
+        self.check_health_once()    # serve with a view, not a guess
+        self._health_thread = threading.Thread(
+            target=self._health_run, name="mxtpu-router-health",
+            daemon=True)
+        self._health_thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        th, self._health_thread = self._health_thread, None
+        if th is not None:
+            th.join(timeout=timeout)
+        srv, self._http = self._http, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=timeout)
+            self._http_thread = None
+
+    def shutdown(self, drain_seconds: Optional[float] = None) -> None:
+        """The SIGTERM sequence (``run_until_shutdown``): refuse new
+        work (503 + ``Retry-After``), let in-flight requests finish
+        within the drain budget, then close the port."""
+        self._draining = True
+        if drain_seconds is None:
+            drain_seconds = _lc.default_drain_seconds()
+        deadline = time.monotonic() + max(0.0, float(drain_seconds))
+        while self.total_inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        self.stop()
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class _RouterHandler(BaseJSONHandler):
+    server_version = "mxtpu-router/1.0"
+
+    def do_GET(self):   # noqa: N802 (http.server API)
+        self.guard(self._get)
+
+    def do_POST(self):  # noqa: N802
+        self.guard(self._post)
+
+    def _get(self):
+        router: Router = self.server.router
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            self.send_json(200, {"status": "ok",
+                                 "replicas": len(router.replicas)})
+        elif path == "/readyz":
+            eligible = len(router._eligible())
+            ready = eligible > 0 and not router.draining
+            body = {"status": "ready" if ready else
+                    ("draining" if router.draining else "unready"),
+                    "eligible": eligible,
+                    "replicas": {r.id: r.snapshot()["state"]
+                                 for r in router.replicas}}
+            self.send_json(200 if ready else 503, body,
+                           headers=None if ready else {"Retry-After": 1})
+        elif path == "/replicas":
+            self.send_json(200, {"replicas": [r.snapshot()
+                                              for r in router.replicas]})
+        elif path in ("/v1/models", "/slo"):
+            router.forward_get(self, path)
+        elif path in ("/metrics", "/"):
+            self._send(200, _telemetry.render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        else:
+            self.send_text(404, "not found: try /v1/models /healthz "
+                                "/readyz /replicas /metrics /slo\n")
+
+    def _post(self):
+        router: Router = self.server.router
+        path = self.path.split("?", 1)[0]
+        rid = self.request_id()
+        if path in ("/admin/drain", "/admin/undrain"):
+            try:
+                body = self.read_json()
+            except ValueError as e:
+                self.send_json(400, {"error": str(e)})
+                return
+            target = body.get("replica") if isinstance(body, dict) \
+                else None
+            if not target:
+                self.send_json(400, {
+                    "error": 'expected {"replica": "host:port"}',
+                    "replicas": [r.id for r in router.replicas]})
+                return
+            try:
+                if path == "/admin/drain":
+                    out = router.drain_replica(
+                        target, wait_seconds=body.get("wait_seconds"))
+                else:
+                    out = router.undrain_replica(target)
+            except KeyError:
+                self.send_json(404, {
+                    "error": f"unknown replica {target!r}",
+                    "replicas": [r.id for r in router.replicas]})
+                return
+            self.send_json(200, out)
+            return
+        if not path.startswith("/v1/models/") or ":" not in path:
+            self.send_text(404,
+                           "not found: POST /v1/models/<name>:predict "
+                           "or :generate\n")
+            return
+        verb = path.rpartition(":")[2]
+        body = self.read_body()
+        stream, affinity_key = False, None
+        if verb == "generate":
+            try:
+                payload = json.loads(body.decode("utf-8")) if body \
+                    else {}
+            except (ValueError, UnicodeDecodeError):
+                payload = {}
+            if isinstance(payload, dict):
+                stream = bool(payload.get("stream", False))
+                tokens = payload.get("tokens", payload.get("inputs"))
+                if isinstance(tokens, (list, tuple)) \
+                        and len(tokens) == 1 \
+                        and isinstance(tokens[0], (list, tuple)):
+                    tokens = tokens[0]
+                if isinstance(tokens, (list, tuple)):
+                    try:
+                        affinity_key = prefix_key(
+                            [int(t) for t in tokens],
+                            router.block_size,
+                            router.affinity_blocks)
+                    except (TypeError, ValueError):
+                        affinity_key = None
+        router.proxy(self, path, body, rid,
+                     affinity_key=affinity_key, stream=stream)

@@ -51,6 +51,11 @@ Routes (JSON tensors everywhere):
 * ``GET /trace`` — the span tree, bounded (``?limit=``/``?since=``)
   with per-request lookup (``?request_id=``); same contract as the
   telemetry exporter's route (shared via ``telemetry_http.trace_body``).
+* ``POST /admin/drain`` / ``POST /admin/undrain`` — the rolling-update
+  pair: drain flips ``/readyz`` to 503 (port stays open, in-flight
+  finishes) so a router pulls the replica; undrain takes traffic again.
+  ``mxtpu-router`` orchestrates these for zero-downtime weight updates
+  (docs/serving.md).
 
 Every response carries an ``X-Request-Id`` header (client-supplied
 ``x-request-id`` or generated — ``http_util.BaseJSONHandler``); predict
@@ -136,6 +141,20 @@ class _Handler(BaseJSONHandler):
     def _post(self):
         ms = self.server.model_server
         path = self.path.split("?", 1)[0]
+        if path == "/admin/drain":
+            # flip to DRAINING without closing the port: /readyz answers
+            # 503 so the router/balancer stops sending, in-flight work
+            # finishes — the first half of a zero-downtime rolling update
+            ms.begin_drain()
+            self.send_json(200, {"draining": True,
+                                 "inflight": ms.inflight_http})
+            return
+        if path == "/admin/undrain":
+            # weight update done: take traffic again (readiness still
+            # gates on model state, so an unhealthy model stays blocked)
+            ms.end_drain()
+            self.send_json(200, {"draining": False})
+            return
         if not path.startswith("/v1/models/") or ":" not in path:
             self.send_text(404,
                            "not found: POST /v1/models/<name>:predict\n")
@@ -395,6 +414,13 @@ class ModelServer:
         # while the model itself still answers (serving/slo.py)
         blockers += [f"slo:{n}" for n in _slo.tracker.exhausted()
                      if n in states]
+        # a paged KV pool exhausted for K consecutive watchdog sweeps
+        # pulls the replica too: the router should route generation to
+        # replicas with capacity instead of eating this one's 429s
+        with self._lock:
+            batchers = dict(self._models)
+        blockers += [f"kv:{n}" for n, b in batchers.items()
+                     if n in states and getattr(b, "kv_starved", False)]
         blockers = sorted(blockers)
         ready = not draining and not blockers
         body = {"status": "ready" if ready else
@@ -550,6 +576,12 @@ class ModelServer:
         with self._lock:
             return list(self._models.values())
 
+    @property
+    def inflight_http(self) -> int:
+        """HTTP requests currently inside a predict/generate handler."""
+        with self._lock:
+            return self._inflight_http
+
     def begin_drain(self) -> None:
         """Flip to DRAINING: ``/readyz`` answers 503 and new predict /
         load work is refused with 503 + ``Retry-After`` while in-flight
@@ -560,6 +592,15 @@ class ModelServer:
                 return
             self._draining = True
             self._last_http = time.monotonic()
+
+    def end_drain(self) -> None:
+        """Resume taking traffic after :meth:`begin_drain`
+        (``POST /admin/undrain``): the second half of a rolling weight
+        update — drain, swap weights, undrain — without a process
+        restart.  A server already torn down by :meth:`stop` stays
+        stopped; this only clears the drain gate."""
+        with self._lock:
+            self._draining = False
 
     def shutdown(self, drain_seconds: Optional[float] = None,
                  linger_seconds: float = 0.3) -> None:
