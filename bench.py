@@ -1247,12 +1247,77 @@ def main():
         os.environ.update(_preset)   # in-process callers keep their env
 
 
+# --jsonl journal: every sub-bench result is appended the moment it
+# lands, so a bench run killed mid-round (relay death, wall-clock cap)
+# keeps its finished measurements; --resume replays non-error records
+# from the journal (marked "resumed": true) and re-runs only the rest.
+_JOURNAL_PATH = None
+_RESUME = False
+_JOURNAL_CACHE = None
+
+
+def _journal_lookup(name):
+    global _JOURNAL_CACHE
+    if not (_JOURNAL_PATH and _RESUME):
+        return None
+    if _JOURNAL_CACHE is None:
+        _JOURNAL_CACHE = {}
+        try:
+            with open(_JOURNAL_PATH) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line from a killed run
+                    if isinstance(rec, dict) and "name" in rec:
+                        _JOURNAL_CACHE[rec["name"]] = rec.get("record")
+        except OSError:
+            pass
+    rec = _JOURNAL_CACHE.get(name)
+    if isinstance(rec, dict) and "error" not in rec:
+        return {**rec, "resumed": True}
+    return None  # errors and misses re-run
+
+
+def _journal_append(name, rec):
+    if not _JOURNAL_PATH:
+        return
+    try:
+        with open(_JOURNAL_PATH, "a") as f:
+            f.write(json.dumps({"name": name,
+                                "time_unix": round(time.time(), 3),
+                                "record": rec}, default=str) + "\n")
+            f.flush()
+    except OSError:
+        pass  # the journal must never sink the bench itself
+
+
+def _cpu_bench(name, fn):
+    """CPU-path sub-bench with the same journal semantics as the accel
+    path's _run_sub: resume hit short-circuits, result appends."""
+    cached = _journal_lookup(name)
+    if cached is not None:
+        return cached
+    try:
+        rec = fn()
+    except Exception as e:
+        rec = {"error": str(e)[:200]}
+    _journal_append(name, rec)
+    return rec
+
+
 def _run_sub(name, platform, kind, timeout, extra_env=None):
     """One measurement in a FRESH process: each accel sub-bench gets the
     whole HBM (observed on-chip: the anchor's BERT-large params + Adam
     state stay resident in-process, and every follow-on model then dies
     with RESOURCE_EXHAUSTED).  A shared persistent compilation cache
     keeps the per-process XLA recompiles cheap."""
+    cached = _journal_lookup(name)
+    if cached is not None:
+        return cached
     env = {**os.environ,
            "BENCH_SUB_PLATFORM": platform or "",
            "BENCH_SUB_KIND": kind or "",
@@ -1265,14 +1330,17 @@ def _run_sub(name, platform, kind, timeout, extra_env=None):
             [sys.executable, os.path.abspath(__file__), "--sub", name],
             capture_output=True, text=True, timeout=timeout, env=env)
         if out.returncode == 0 and out.stdout.strip():
-            return json.loads(out.stdout.strip().splitlines()[-1])
-        tail = (out.stderr or out.stdout or "").strip().splitlines()
-        return {"error": (tail[-1][:200] if tail
-                          else f"rc={out.returncode}, no output")}
+            rec = json.loads(out.stdout.strip().splitlines()[-1])
+        else:
+            tail = (out.stderr or out.stdout or "").strip().splitlines()
+            rec = {"error": (tail[-1][:200] if tail
+                             else f"rc={out.returncode}, no output")}
     except subprocess.TimeoutExpired:
-        return {"error": f"sub-bench {name} hung >{timeout}s"}
+        rec = {"error": f"sub-bench {name} hung >{timeout}s"}
     except Exception as e:
-        return {"error": str(e)[:200]}
+        rec = {"error": str(e)[:200]}
+    _journal_append(name, rec)
+    return rec
 
 
 def _sub_main(name):
@@ -1410,34 +1478,19 @@ def _main(preset_fusion):
         samples_per_sec, B_used, T, mfu, remat = _bench_bert(
             False, kind, dev)
         phase2 = fusion = None
-        try:
-            resnet = _bench_resnet50(False, kind, dev)
-        except Exception as e:
-            resnet = {"error": str(e)[:200]}
-        try:
-            int8 = _bench_int8(False, kind, dev)
-        except Exception as e:
-            int8 = {"error": str(e)[:200]}
-        try:
-            int8["conv"] = _bench_int8_conv(False, kind, dev)
-        except Exception as e:
-            int8["conv"] = {"error": str(e)[:200]}
-        try:
-            optim = _bench_optim(False, kind, dev)
-        except Exception as e:
-            optim = {"error": str(e)[:200]}
-        try:
-            serve = _bench_serve(False, kind, dev)
-        except Exception as e:
-            serve = {"error": str(e)[:200]}
-        try:
-            serve["generate"] = _bench_generate(False, kind, dev)
-        except Exception as e:
-            serve["generate"] = {"error": str(e)[:200]}
-        try:
-            train_loop = _bench_train_loop(False, kind, dev)
-        except Exception as e:
-            train_loop = {"error": str(e)[:200]}
+        resnet = _cpu_bench("resnet50",
+                            lambda: _bench_resnet50(False, kind, dev))
+        int8 = _cpu_bench("int8", lambda: _bench_int8(False, kind, dev))
+        int8["conv"] = _cpu_bench(
+            "int8_conv", lambda: _bench_int8_conv(False, kind, dev))
+        optim = _cpu_bench("optim",
+                           lambda: _bench_optim(False, kind, dev))
+        serve = _cpu_bench("serve",
+                           lambda: _bench_serve(False, kind, dev))
+        serve["generate"] = _cpu_bench(
+            "generate", lambda: _bench_generate(False, kind, dev))
+        train_loop = _cpu_bench(
+            "train_loop", lambda: _bench_train_loop(False, kind, dev))
         scaling = _scaling_dryrun()
 
     out = {
@@ -1518,6 +1571,20 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--sub":
         _sub_main(sys.argv[2])   # let failures propagate: the parent
         sys.exit(0)              # records stderr as the sub's error
+    if "--jsonl" in sys.argv:
+        i = sys.argv.index("--jsonl")
+        try:
+            _JOURNAL_PATH = os.path.abspath(sys.argv[i + 1])
+        except IndexError:
+            sys.exit("bench.py: --jsonl needs a PATH")
+        del sys.argv[i:i + 2]
+    if "--resume" in sys.argv:
+        sys.argv.remove("--resume")
+        _RESUME = True
+        if not _JOURNAL_PATH:
+            sys.exit("bench.py: --resume needs --jsonl PATH")
+    if _JOURNAL_PATH and not _RESUME and os.path.exists(_JOURNAL_PATH):
+        os.unlink(_JOURNAL_PATH)  # fresh run: a stale journal would lie
     try:
         main()
     except Exception as e:  # degrade, never lose the artifact

@@ -91,6 +91,15 @@ usage: ci/run_tests.sh <function>
                         downtime, zero mid-stream errors; prefix-affine
                         routing beats random placement on fleet-wide
                         mxtpu_prefix_cache_hits
+  fleet_obs_smoke       observability drill: 3 telemetry-enabled
+                        replicas + router, 16 streaming clients, a
+                        serving.infer:hang wedge on one replica —
+                        stitched GET /trace shows both failover legs
+                        with the surviving replica's spans grafted
+                        under their hop; federated /metrics fleet sums
+                        equal the arithmetic sum of replica counters;
+                        exactly ONE incident bundle written, naming the
+                        request ids that failed on the hung replica
   multichip_dryrun      8-virtual-device full-train-step compile+run
 EOF
     exit 1
@@ -1006,6 +1015,14 @@ router_smoke() {
     local cc=/tmp/mxtpu_router_smoke_cc
     rm -rf "$cc"
     JAX_PLATFORMS=cpu python tools/router_smoke.py all --cache-dir "$cc"
+}
+
+fleet_obs_smoke() {
+    local cc=/tmp/mxtpu_fleet_obs_cc
+    rm -rf "$cc"
+    JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py all \
+        --cache-dir "$cc" \
+        --incident-dir /tmp/mxtpu_fleet_obs_incidents
 }
 
 multichip_dryrun() {

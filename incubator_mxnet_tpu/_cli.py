@@ -43,8 +43,14 @@ def stats_main():
         mxtpu-stats [--format prometheus|json] [--out PATH]
                     [--serve [--port N]] [--slo] [--flight-dump PATH]
                     script.py [args...]
+        mxtpu-stats --fleet http://router:9000 [--slo] [--out PATH]
 
-    The script runs in-process (as ``__main__``) with the telemetry
+    With ``--fleet`` no script runs: the federated fleet view is pulled
+    from a running ``mxtpu-router`` instead — its aggregated ``/metrics``
+    exposition (or merged ``/slo`` with ``--slo``) printed to stdout or
+    ``--out``.
+
+    Otherwise the script runs in-process (as ``__main__``) with the telemetry
     collector started, so every layer (op dispatch, compile cache,
     kvstore, trainer, dataloader) is observed without touching the
     script.  Metrics go to --out (or stdout) when the script finishes —
@@ -75,10 +81,21 @@ def stats_main():
                     help="write a flight-recorder postmortem JSON to "
                          "PATH after the script (always written, even "
                          "on success — useful for inspecting the ring)")
-    ap.add_argument("script", help="python script to run")
+    ap.add_argument("--fleet", metavar="URL", default=None,
+                    help="pull the federated fleet view from a running "
+                         "mxtpu-router at URL instead of running a "
+                         "script (aggregated /metrics, or merged /slo "
+                         "with --slo)")
+    ap.add_argument("script", nargs="?", default=None,
+                    help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script")
     ns = ap.parse_args()
+
+    if ns.fleet:
+        sys.exit(_fleet_stats(ns))
+    if ns.script is None:
+        ap.error("a script is required unless --fleet URL is given")
 
     from . import telemetry
     telemetry.start()
@@ -122,6 +139,31 @@ def stats_main():
         path = telemetry_ring.recorder.dump("cli", path=ns.flight_dump)
         sys.stderr.write(f"mxtpu-stats: flight dump -> {path}\n")
     sys.exit(status)
+
+
+def _fleet_stats(ns) -> int:
+    """``mxtpu-stats --fleet URL``: fetch the router's federated view."""
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    base = ns.fleet.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    path = "/slo" if ns.slo else "/metrics"
+    try:
+        with urlopen(base + path, timeout=10.0) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except (URLError, OSError) as e:
+        sys.stderr.write(f"mxtpu-stats: --fleet {base}{path}: {e}\n")
+        return 1
+    if not text.endswith("\n"):
+        text += "\n"
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
 
 
 def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,

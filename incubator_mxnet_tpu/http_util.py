@@ -32,7 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Type
 
 __all__ = ["BaseJSONHandler", "HTTPServerBase", "start_http_server",
-           "stop_http_server"]
+           "stop_http_server", "parse_trace_id"]
 
 
 class HTTPServerBase(ThreadingHTTPServer):
@@ -59,6 +59,27 @@ class HTTPServerBase(ThreadingHTTPServer):
 # embed in filenames
 _REQUEST_ID_JUNK = re.compile(r"[^A-Za-z0-9._\-]")
 
+# the router's traceparent-style header: <trace root>-<hop span id>.
+# The trace root is the request id (which may itself contain dashes),
+# the hop id is the 8-hex sid of the router span that made this
+# upstream call — so the split is on the LAST dash.
+_TRACE_ID_RE = re.compile(r"^([A-Za-z0-9._\-]{1,64})-([0-9a-f]{1,16})$")
+_TRACE_ID_MAX = 96
+
+
+def parse_trace_id(raw) -> Optional[tuple]:
+    """Parse an ``X-Trace-Id`` header value into ``(trace_id,
+    parent_span_id)``.  Anything malformed, oversized, or
+    junk-charactered returns ``None`` — propagation is best-effort and a
+    hostile/buggy header must never fail the request it rides on."""
+    if not raw or not isinstance(raw, str):
+        return None
+    raw = raw.strip()
+    if len(raw) > _TRACE_ID_MAX:
+        return None
+    m = _TRACE_ID_RE.match(raw)
+    return (m.group(1), m.group(2)) if m else None
+
 
 class BaseJSONHandler(BaseHTTPRequestHandler):
     """Response/request helpers shared by every embedded HTTP server."""
@@ -80,6 +101,14 @@ class BaseJSONHandler(BaseHTTPRequestHandler):
             rid = _REQUEST_ID_JUNK.sub("", raw)[:64] or uuid.uuid4().hex[:16]
             self._mxtpu_request_id = rid
         return rid
+
+    def trace_parent(self) -> Optional[tuple]:
+        """The upstream trace context from this request's ``X-Trace-Id``
+        header: ``(trace_id, parent_span_id)``, or ``None`` when absent
+        or malformed (see :func:`parse_trace_id`)."""
+        if getattr(self, "headers", None) is None:
+            return None
+        return parse_trace_id(self.headers.get("x-trace-id"))
 
     def _send(self, code: int, body: str, ctype: str,
               headers: Optional[dict] = None) -> None:

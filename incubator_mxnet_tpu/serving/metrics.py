@@ -90,6 +90,17 @@ ROUTER_REPLICAS_ELIGIBLE = _telemetry.registry.gauge(
 ROUTER_INFLIGHT = _telemetry.registry.gauge(
     "mxtpu_router_inflight",
     "client requests in flight through the router, per replica")
+ROUTER_INCIDENTS = _telemetry.registry.counter(
+    "mxtpu_router_incidents",
+    "correlated incident bundles written (ejection / "
+    "failover-exhaustion / drain-timeout), by reason")
+ROUTER_FEDERATION_STALE = _telemetry.registry.gauge(
+    "mxtpu_router_federation_stale",
+    "replicas whose cached metrics snapshot has aged past the "
+    "staleness horizon and is excluded from fleet totals")
+ROUTER_TRACE_FANOUT = _telemetry.registry.counter(
+    "mxtpu_router_trace_fanout",
+    "replica /trace fetches made while stitching fleet traces")
 
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(

@@ -71,22 +71,34 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
+import os
+import tempfile
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
-from urllib.parse import urlsplit
+from urllib.parse import quote, urlsplit
 
 from ..base import MXNetError, getenv, getenv_bool, getenv_int
 from .. import fault as _fault
 from .. import telemetry as _telemetry
+from .. import telemetry_ring as _ring
 from ..http_util import BaseJSONHandler, HTTPServerBase
 from . import lifecycle as _lc
 from . import metrics as _m
+from . import slo as _slo
 
 __all__ = ["Router", "Replica", "UpstreamError", "NoReplicaAvailable",
-           "rendezvous_order", "prefix_key"]
+           "rendezvous_order", "prefix_key", "default_incident_dir"]
 
 FAULT_SITE = "router.upstream"
+
+
+def default_incident_dir() -> str:
+    """Where correlated incident bundles land:
+    ``MXNET_ROUTER_INCIDENT_DIR`` or ``<tmpdir>/mxtpu_incidents``."""
+    return getenv("MXNET_ROUTER_INCIDENT_DIR") or \
+        os.path.join(tempfile.gettempdir(), "mxtpu_incidents")
 
 #: numeric encoding for the ``mxtpu_router_replica_state`` gauge
 READY_CODE, UNREADY_CODE, DRAINING_CODE, EJECTED_CODE, DOWN_CODE = \
@@ -168,6 +180,83 @@ def _parse_hostport(spec: str) -> Tuple[str, int]:
         raise MXNetError(
             f"replica {spec!r}: expected host:port or http://host:port")
     return host, int(split.port)
+
+
+class _HopLog:
+    """Bounded per-request record of upstream attempts (hops).
+
+    Every hop gets a span id from the tracer's process-wide sequence —
+    the id stamped on the upstream ``X-Trace-Id`` header — so the
+    replica's remote ``serve.request`` spans can name exactly which
+    router attempt they served.  Works with the tracer off: the hop log
+    IS the router's half of the stitched timeline, and routers don't
+    require ``telemetry.start()`` to answer ``GET /trace``.  Evicts
+    oldest requests beyond ``max_requests`` (LRU on request id)."""
+
+    def __init__(self, max_requests: int = 512):
+        self._lock = threading.Lock()
+        self._by_rid: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._max = max(1, int(max_requests))
+
+    def begin(self, rid: str, replica_id: str) -> dict:
+        hop = {"sid": f"{next(_telemetry._span_seq):08x}",
+               "replica": replica_id,
+               "start_unix": round(time.time(), 6),
+               "t0": time.monotonic(),
+               "outcome": None}
+        with self._lock:
+            hops = self._by_rid.get(rid)
+            if hops is None:
+                hops = self._by_rid[rid] = []
+                while len(self._by_rid) > self._max:
+                    self._by_rid.popitem(last=False)
+            else:
+                self._by_rid.move_to_end(rid)
+            hops.append(hop)
+        return hop
+
+    @staticmethod
+    def end(hop: dict, outcome: str, error=None, status=None) -> None:
+        hop["duration_s"] = round(time.monotonic() - hop["t0"], 6)
+        hop["outcome"] = outcome
+        if error is not None:
+            hop["error"] = str(error)[:200]
+        if status is not None:
+            hop["status"] = int(status)
+
+    @staticmethod
+    def _view(hop: dict) -> dict:
+        return {k: v for k, v in hop.items() if k != "t0"}
+
+    def get(self, rid: str) -> List[dict]:
+        with self._lock:
+            return [self._view(h) for h in self._by_rid.get(rid, ())]
+
+    def recent(self, limit: int = 32) -> List[dict]:
+        with self._lock:
+            items = list(self._by_rid.items())[-max(0, int(limit)):]
+        return [{"request_id": rid,
+                 "hops": [self._view(h) for h in hops]}
+                for rid, hops in items]
+
+    def request_ids_on(self, replica_id: str, failed: bool,
+                       limit: int = 8) -> List[str]:
+        """Newest-first request ids with a hop on ``replica_id`` —
+        failed/open hops when ``failed`` (incident correlation), any
+        otherwise."""
+        out: List[str] = []
+        with self._lock:
+            for rid, hops in reversed(self._by_rid.items()):
+                for h in hops:
+                    if h["replica"] != replica_id:
+                        continue
+                    if failed and h["outcome"] == "ok":
+                        continue
+                    out.append(rid)
+                    break
+                if len(out) >= limit:
+                    break
+        return out
 
 
 class Replica:
@@ -272,7 +361,9 @@ class Router:
                  stream_timeout: Optional[float] = None,
                  retry_deadline: Optional[float] = None,
                  eject_threshold: Optional[int] = None,
-                 eject_cooldown_seconds: Optional[float] = None):
+                 eject_cooldown_seconds: Optional[float] = None,
+                 federate_seconds: Optional[float] = None,
+                 incident_dir: Optional[str] = None):
         if not replicas:
             raise MXNetError("Router needs at least one replica")
         self._port = getenv_int("MXNET_ROUTER_PORT", 8081) \
@@ -318,6 +409,25 @@ class Router:
         self._stop = threading.Event()
         self._draining = False
         self._rr = 0                # rotation offset for idle ties
+        # -- fleet observability (docs/observability.md) ---------------
+        self.federate_seconds = float(
+            getenv("MXNET_ROUTER_FEDERATE_SECONDS", 2.0)) \
+            if federate_seconds is None else float(federate_seconds)
+        self.incident_dir = default_incident_dir() \
+            if incident_dir is None else str(incident_dir)
+        self.incident_debounce = 10.0   # seconds per (reason, replica)
+        self.max_incidents = getenv_int("MXNET_ROUTER_MAX_INCIDENTS", 8)
+        self._hops = _HopLog()
+        self._federation: Dict[str, dict] = {}   # rep.id -> cached view
+        self._federate_last = -1e9
+        self._incident_lock = threading.Lock()
+        self._incident_last: Dict[tuple, float] = {}
+        self._incident_count = 0
+        self._incident_seq = 0
+        self._metrics_baseline: Dict[str, float] = {}
+        self._baseline_time = time.time()
+        self.last_incident_path: Optional[str] = None
+        self._recorder: Optional[_ring.FlightRecorder] = None
 
     # -- registry -------------------------------------------------------
     @property
@@ -404,6 +514,8 @@ class Router:
             _telemetry.FAULT.publish(site="router.health",
                                      event="ejected", kind="breaker",
                                      replica=rep.id, reason=reason)
+            self._incident("ejected", rep.id,
+                           self._hops.request_ids_on(rep.id, failed=True))
         self._set_state_gauge(rep)
 
     def _health_run(self) -> None:
@@ -412,6 +524,320 @@ class Router:
                 self.check_health_once()
             except Exception:       # the health loop must survive
                 pass                # anything one replica throws at it
+            try:
+                self._federate_maybe()
+            except Exception:
+                pass
+
+    # -- metrics federation ----------------------------------------------
+    def _federate_maybe(self, force: bool = False) -> None:
+        """Refresh the per-replica snapshot cache (``/metrics.json`` +
+        ``/slo``) at the ``MXNET_ROUTER_FEDERATE_SECONDS`` cadence.
+        Piggybacks on the health loop; also called on-demand by the
+        federated ``GET /metrics``/``/slo`` so a router driven without
+        the background loop (tests) still federates."""
+        now = time.monotonic()
+        if not force and now - self._federate_last < self.federate_seconds:
+            return
+        self._federate_last = now
+        for rep in self._replicas:
+            if not rep.reachable:
+                continue            # last snapshot stays and ages out
+            try:
+                s, state = self._get_json(rep, "/metrics.json",
+                                          self._poll_timeout())
+                if s != 200 or not isinstance(state, dict):
+                    continue
+                s2, slo = self._get_json(rep, "/slo",
+                                         self._poll_timeout())
+            except OSError:
+                continue
+            entry = {"state": state,
+                     "slo": slo if s2 == 200 and isinstance(slo, dict)
+                     else None,
+                     "time": time.monotonic(),
+                     "time_unix": time.time()}
+            with self._lock:
+                self._federation[rep.id] = entry
+
+    def _stale_horizon(self) -> float:
+        return max(3.0 * self.federate_seconds, 1.0)
+
+    @staticmethod
+    def _strip_router_series(state: dict) -> dict:
+        """Drop ``mxtpu_router_*`` families from a replica snapshot.
+        The router's own series are rendered exactly once from its
+        local registry; a replica that happens to share a registry with
+        a router (in-process tests) or fronts a nested router must not
+        double-count them in fleet sums."""
+        return {kind: {name: v for name, v in
+                       (state or {}).get(kind, {}).items()
+                       if not name.startswith("mxtpu_router_")}
+                for kind in ("counters", "gauges", "histograms")}
+
+    def _federation_view(self):
+        """``[(replica_id, entry, stale)]`` for every cached snapshot,
+        refreshing the ``mxtpu_router_federation_stale`` gauge."""
+        with self._lock:
+            fed = dict(self._federation)
+        now = time.monotonic()
+        horizon = self._stale_horizon()
+        out = [(rid, entry, now - entry["time"] > horizon)
+               for rid, entry in sorted(fed.items())]
+        _m.ROUTER_FEDERATION_STALE.set(sum(1 for _, _, s in out if s))
+        return out
+
+    def fleet_metrics_state(self) -> dict:
+        """One mergeable state for the whole fleet: counters/gauges hold
+        the fleet-sum label sets PLUS per-replica ``replica=``-labeled
+        series (stale snapshots keep their series, tagged
+        ``stale="true"``, but are excluded from the sums); histograms
+        are the cross-replica reservoir union, so fleet quantiles come
+        from merged distributions, not averaged percentiles."""
+        view = self._federation_view()
+        fresh = [self._strip_router_series(e["state"])
+                 for _, e, stale in view if not stale]
+        fleet = _telemetry.merge_states(fresh)
+        for rid, entry, stale in view:
+            state = self._strip_router_series(entry["state"])
+            extra = f"replica={rid}" + (",stale=true" if stale else "")
+            for kind in ("counters", "gauges"):
+                for name, m in state.get(kind, {}).items():
+                    dst = fleet[kind].setdefault(
+                        name, {"help": m.get("help", ""), "values": {}})
+                    total = sum(float(v) for v in
+                                (m.get("values") or {}).values())
+                    dst["values"][extra] = total
+        return fleet
+
+    def render_fleet_metrics(self) -> str:
+        """The federated ``GET /metrics`` body: the router's own
+        ``mxtpu_router_*`` series (local registry, rendered once) +
+        fleet sums and per-replica series for everything the replicas
+        report."""
+        self._federate_maybe()
+        local = _telemetry.registry.export_state()
+        local = {kind: {name: v for name, v in local[kind].items()
+                        if name.startswith("mxtpu_router_")}
+                 for kind in ("counters", "gauges", "histograms")}
+        return _telemetry.render_prometheus_state(local) + \
+            _telemetry.render_prometheus_state(self.fleet_metrics_state())
+
+    def fleet_slo(self) -> dict:
+        """The fleet ``GET /slo`` body: per-replica windows merged by
+        summed counts (:func:`serving.slo.merge_snapshots`) — the burn a
+        user sees through the router, not any one replica's view."""
+        self._federate_maybe()
+        view = self._federation_view()
+        body = _slo.merge_snapshots(
+            {rid: e.get("slo") for rid, e, stale in view if not stale})
+        stale = [rid for rid, _, s in view if s]
+        if stale:
+            body["stale_replicas"] = stale
+        return body
+
+    # -- cross-process trace stitching ------------------------------------
+    @staticmethod
+    def _remote_parent_of(span: dict) -> Optional[str]:
+        attrs = span.get("attrs") if isinstance(span, dict) else None
+        return attrs.get("remote_parent") if isinstance(attrs, dict) \
+            else None
+
+    def stitch_trace(self, rid: str) -> Optional[dict]:
+        """One end-to-end timeline for request ``rid``: the router's hop
+        records (every upstream attempt, retries and failovers included)
+        with each replica's remote span subtree grafted under the hop
+        whose span id it names in its ``remote_parent`` attr.  A replica
+        that can't answer ``/trace`` anymore (died mid-request) shows up
+        as a synthetic ``unreachable`` span under its hop.  ``None``
+        when the request id is unknown (aged out or never seen)."""
+        hops = self._hops.get(rid)
+        if not hops:
+            return None
+        remote: Dict[str, object] = {}
+        for rep_id in sorted({h["replica"] for h in hops}):
+            _m.ROUTER_TRACE_FANOUT.inc(replica=rep_id)
+            try:
+                rep = self.replica(rep_id)
+                status, body = self._get_json(
+                    rep, "/trace?request_id=" + quote(rid, safe=""),
+                    self.upstream_timeout)
+                if status == 200 and isinstance(body, dict):
+                    remote[rep_id] = body.get("spans") or []
+                else:
+                    remote[rep_id] = OSError(f"/trace answered {status}")
+            except (KeyError, OSError) as e:
+                remote[rep_id] = e
+        claimed = set()
+        out_hops = []
+        for h in hops:
+            d = {"name": "router.hop", "cat": "router", "id": h["sid"],
+                 "request_id": rid}
+            d.update({k: v for k, v in h.items() if k != "sid"})
+            spans = remote.get(h["replica"])
+            if isinstance(spans, Exception):
+                d["children"] = [{
+                    "name": "unreachable", "cat": "router",
+                    "synthetic": True, "replica": h["replica"],
+                    "error": str(spans)[:200]}]
+            else:
+                kids = [s for s in (spans or [])
+                        if self._remote_parent_of(s) == h["sid"]]
+                claimed.update(id(s) for s in kids)
+                if kids:
+                    d["children"] = kids
+            out_hops.append(d)
+        out = {"request_id": rid, "trace_id": rid, "stitched": True,
+               "hops": out_hops}
+        unlinked = {rep_id: [s for s in spans if id(s) not in claimed]
+                    for rep_id, spans in remote.items()
+                    if isinstance(spans, list)}
+        unlinked = {k: v for k, v in unlinked.items() if v}
+        if unlinked:
+            # spans that match the request id but name no known hop —
+            # direct-to-replica traffic or a pre-propagation replica;
+            # surfaced rather than dropped
+            out["unlinked_spans"] = unlinked
+        if _telemetry.tracer.active:
+            router_spans = _telemetry.tracer.find_spans("request_id", rid)
+            if router_spans:
+                out["router_spans"] = router_spans
+        return out
+
+    # -- correlated incident bundles --------------------------------------
+    def _incident(self, reason: str, replica_id: Optional[str],
+                  request_ids: Sequence[str]) -> None:
+        """Budgeted, debounced, async incident-bundle trigger — the
+        router-side analogue of ``FlightRecorder._auto_dump``.  Debounce
+        is per (reason, replica): one flapping replica costs one bundle
+        per ``incident_debounce`` window, and the process writes at most
+        ``MXNET_ROUTER_MAX_INCIDENTS`` bundles."""
+        now = time.monotonic()
+        key = (reason, replica_id or "")
+        with self._incident_lock:
+            if self._incident_count >= self.max_incidents:
+                return
+            if now - self._incident_last.get(key, -1e9) < \
+                    self.incident_debounce:
+                return
+            self._incident_last[key] = now
+            self._incident_count += 1
+            self._incident_seq += 1
+            seq = self._incident_seq
+        threading.Thread(
+            target=self._write_incident_guarded,
+            args=(reason, replica_id, list(request_ids or ()), seq),
+            name="mxtpu-router-incident", daemon=True).start()
+
+    def _write_incident_guarded(self, reason, replica_id, request_ids,
+                                seq) -> None:
+        try:
+            self.write_incident(reason, replica_id, request_ids, seq)
+        except Exception:           # the observer must never take
+            pass                    # the router down
+
+    def _fleet_counters_flat(self) -> Dict[str, float]:
+        """name → fleet-total for every counter (fresh replicas + the
+        router's own ``mxtpu_router_*``) — the incident bundle's metrics
+        delta is computed against this."""
+        out: Dict[str, float] = {}
+        for _, entry, stale in self._federation_view():
+            if stale:
+                continue
+            state = self._strip_router_series(entry["state"])
+            for name, m in state.get("counters", {}).items():
+                out[name] = out.get(name, 0.0) + sum(
+                    float(v) for v in (m.get("values") or {}).values())
+        for name, m in _telemetry.registry.export_state()[
+                "counters"].items():
+            if name.startswith("mxtpu_router_"):
+                out[name] = sum(float(v) for v in
+                                (m.get("values") or {}).values())
+        return out
+
+    def write_incident(self, reason: str, replica_id: Optional[str],
+                       request_ids: Sequence[str],
+                       seq: Optional[int] = None) -> str:
+        """Write one atomic incident bundle directory and return its
+        path: the router's flight-recorder payload, the implicated
+        replica's ring (``GET /flight``) and recent spans, the stitched
+        traces for the request ids that observed the failure, and the
+        fleet metrics delta since the router's baseline — all
+        cross-keyed by request id in ``incident.json``.  Atomicity:
+        assembled under a dot-tmp name, ``os.rename``d into place, so a
+        reader never sees a half-written bundle."""
+        request_ids = [str(r) for r in (request_ids or ())][:8]
+        if seq is None:
+            with self._incident_lock:
+                self._incident_seq += 1
+                seq = self._incident_seq
+        base = self.incident_dir
+        os.makedirs(base, exist_ok=True)
+        name = f"incident_{os.getpid()}_{seq:03d}_{reason}"
+        tmp = os.path.join(base, f".{name}.tmp-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+
+        def _write(fname, payload):
+            with open(os.path.join(tmp, fname), "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+                f.write("\n")
+            return fname
+
+        files = [_write("router_flight.json",
+                        _ring.recorder.payload(f"incident:{reason}"))]
+        if replica_id:
+            safe = replica_id.replace(":", "_")
+            try:
+                rep = self.replica(replica_id)
+                _, flight = self._get_json(rep, "/flight",
+                                           self.upstream_timeout)
+            except (KeyError, OSError) as e:
+                flight = {"unreachable": True, "error": str(e)[:200]}
+            files.append(_write(f"replica_{safe}_flight.json", flight))
+            traces = {}
+            for rid in request_ids:
+                try:
+                    rep = self.replica(replica_id)
+                    _, traces[rid] = self._get_json(
+                        rep,
+                        "/trace?request_id=" + quote(rid, safe=""),
+                        self.upstream_timeout)
+                except (KeyError, OSError) as e:
+                    traces[rid] = {"unreachable": True,
+                                   "error": str(e)[:200]}
+            files.append(_write(f"replica_{safe}_trace.json",
+                                {"replica": replica_id,
+                                 "request_ids": traces}))
+        files.append(_write(
+            "stitched_traces.json",
+            {rid: self.stitch_trace(rid) for rid in request_ids}))
+        current = self._fleet_counters_flat()
+        delta = {k: v - self._metrics_baseline.get(k, 0.0)
+                 for k, v in sorted(current.items())
+                 if v - self._metrics_baseline.get(k, 0.0) != 0.0}
+        files.append(_write("metrics_delta.json", {
+            "since_unix": round(self._baseline_time, 3),
+            "window_seconds": round(
+                time.time() - self._baseline_time, 3),
+            "counters_delta": delta}))
+        _write("incident.json", {
+            "reason": reason,
+            "time_unix": round(time.time(), 3),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "pid": os.getpid(),
+            "replica": replica_id,
+            "request_ids": request_ids,
+            "replicas": [r.snapshot() for r in self._replicas],
+            "files": files,
+        })
+        final = os.path.join(base, name)
+        os.rename(tmp, final)       # readers never see a torn bundle
+        self.last_incident_path = final
+        _m.ROUTER_INCIDENTS.inc(reason=reason)
+        _telemetry.FAULT.publish(site="router.incident", event="bundle",
+                                 kind=reason, replica=replica_id or "",
+                                 path=final)
+        return final
 
     # -- routing --------------------------------------------------------
     def _eligible(self) -> List[Replica]:
@@ -533,6 +959,9 @@ class Router:
                 retry_after_hint=_fault.retry_after_hint)
         except (UpstreamError, OSError) as e:
             retry = getattr(e, "retry_after", None)
+            self._incident("failover_exhausted",
+                           getattr(e, "replica", None)
+                           or (tried[-1] if tried else None), [rid])
             handler.send_json(
                 503, {"error": f"no replica could serve the request: "
                                f"{e}", "request_id": rid,
@@ -548,8 +977,8 @@ class Router:
                                       "application/json"),
                           headers=headers or None)
         else:
-            _, rep, conn, resp, head = result
-            self._relay_stream(handler, rep, conn, resp, head, rid)
+            _, rep, conn, resp, head, hop = result
+            self._relay_stream(handler, rep, conn, resp, head, rid, hop)
 
     def _dispatch(self, rep: Replica, path: str, body: bytes, rid: str,
                   stream: bool):
@@ -559,6 +988,7 @@ class Router:
         Raises :class:`UpstreamError` (or ``OSError``) for anything
         worth failing over."""
         rep._inflight_add(+1)
+        hop = self._hops.begin(rid, rep.id)
         conn = self._connect(rep)
         done = False
         try:
@@ -567,6 +997,11 @@ class Router:
                     "POST", path, body=body,
                     headers={"Content-Type": "application/json",
                              "X-Request-Id": rid,
+                             # traceparent: <trace root>-<hop span id> —
+                             # the replica's serve.request span records
+                             # both, so the stitcher can graft it under
+                             # exactly this attempt
+                             "X-Trace-Id": f"{rid}-{hop['sid']}",
                              "Accept": "text/event-stream" if stream
                              else "application/json"})
                 resp = conn.getresponse()
@@ -575,6 +1010,7 @@ class Router:
                 # thing here: the replica's socket is gone
                 rep.reachable = False
                 rep.last_error = str(e)
+                self._hops.end(hop, "connect_error", error=e)
                 self._record_failure(rep, f"connect: {e}")
                 raise UpstreamError(
                     f"{rep.id}: {e}", replica=rep.id,
@@ -594,6 +1030,7 @@ class Router:
                     # reflect it on the next poll; not a transport fault
                     rep.ready = False
                 self._set_state_gauge(rep)
+                self._hops.end(hop, "shed", status=resp.status)
                 raise UpstreamError(
                     f"{rep.id} answered {resp.status}", replica=rep.id,
                     retry_after=0.0 if self._has_alternative([rep.id])
@@ -611,6 +1048,8 @@ class Router:
                     if not chunk:
                         # died before the FIRST event: nothing reached
                         # the client, failover is transparent
+                        self._hops.end(hop, "stream_died_before_first",
+                                       error=rep.last_error or None)
                         self._record_failure(
                             rep, "stream died before first event")
                         raise UpstreamError(
@@ -622,10 +1061,11 @@ class Router:
                     head += chunk
                 self._record_success(rep)
                 done = True         # inflight stays held for the relay
-                return ("stream", rep, conn, resp, head)
+                return ("stream", rep, conn, resp, head, hop)
             try:
                 data = resp.read().decode("utf-8", "replace")
             except (OSError, http.client.HTTPException) as e:
+                self._hops.end(hop, "body_read_error", error=e)
                 self._record_failure(rep, f"body read: {e}")
                 raise UpstreamError(
                     f"{rep.id} died mid-response: {e}", replica=rep.id,
@@ -635,6 +1075,8 @@ class Router:
                        if resp.getheader(k) is not None}
             if resp.status < 500:
                 self._record_success(rep)
+            self._hops.end(hop, "ok" if resp.status < 500
+                           else "upstream_error", status=resp.status)
             return ("json", resp.status, data, headers)
         finally:
             if not done:
@@ -642,13 +1084,15 @@ class Router:
                 conn.close()
 
     def _relay_stream(self, handler: BaseJSONHandler, rep: Replica,
-                      conn, resp, head: bytes, rid: str) -> None:
+                      conn, resp, head: bytes, rid: str,
+                      hop: Optional[dict] = None) -> None:
         """Relay an open upstream SSE stream.  Downstream disconnect →
         close upstream (the replica cancels and frees its slot/blocks).
         Upstream EOF without a terminal ``done``/``error`` event →
         terminal SSE ``error`` event with the request id."""
         terminal = any(mark in head for mark in _TERMINAL_MARKS)
         tail = head[-64:]
+        outcome = "client_disconnect"
         try:
             handler.start_stream(200)
             try:
@@ -674,6 +1118,7 @@ class Router:
                 except OSError:
                     return          # client disconnect mid-stream
             if terminal:            # done/error already on the wire —
+                outcome = "ok"
                 try:                # a late reset changes nothing
                     handler.end_stream()
                 except OSError:
@@ -681,6 +1126,7 @@ class Router:
                 return
             # mid-stream death with tokens already on the wire: the
             # stream cannot be transparently replayed — fail loudly
+            outcome = "midstream_error"
             _m.ROUTER_STREAM_ERRORS.inc(replica=rep.id)
             self._record_failure(rep, "mid-stream death")
             _telemetry.FAULT.publish(site=FAULT_SITE,
@@ -696,6 +1142,8 @@ class Router:
             except OSError:
                 pass
         finally:
+            if hop is not None:
+                self._hops.end(hop, outcome)
             rep._inflight_add(-1)
             conn.close()
 
@@ -743,6 +1191,12 @@ class Router:
         while rep.inflight > 0 and time.monotonic() < deadline:
             time.sleep(0.02)
         left = rep.inflight
+        if left > 0:
+            # requests wedged past the drain budget: capture both sides
+            # while the replica can still answer /flight and /trace
+            self._incident("drain_timeout", rep.id,
+                           self._hops.request_ids_on(rep.id,
+                                                     failed=True))
         return {"replica": rep.id, "draining": True,
                 "drained": left == 0, "inflight": left}
 
@@ -773,12 +1227,26 @@ class Router:
                               name="mxtpu-router-http", daemon=True)
         th.start()
         self._http, self._http_thread = srv, th
+        # the router is an incident witness: its flight ring records
+        # FAULT events (ejections, stream errors) and the provider adds
+        # the fleet view + recent hops to every dump/bundle
+        self._recorder = _ring.recorder
+        self._recorder.start()
+        self._recorder.register_provider("router", self._flight_state)
         self.check_health_once()    # serve with a view, not a guess
+        self._federate_maybe(force=True)
+        self._metrics_baseline = self._fleet_counters_flat()
+        self._baseline_time = time.time()
         self._health_thread = threading.Thread(
             target=self._health_run, name="mxtpu-router-health",
             daemon=True)
         self._health_thread.start()
         return self
+
+    def _flight_state(self) -> dict:
+        return {"draining": self._draining,
+                "replicas": [r.snapshot() for r in self._replicas],
+                "recent_hops": self._hops.recent(32)}
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -792,6 +1260,10 @@ class Router:
         if self._http_thread is not None:
             self._http_thread.join(timeout=timeout)
             self._http_thread = None
+        rec, self._recorder = self._recorder, None
+        if rec is not None:
+            rec.unregister_provider("router")
+            rec.stop()
 
     def shutdown(self, drain_seconds: Optional[float] = None) -> None:
         """The SIGTERM sequence (``run_until_shutdown``): refuse new
@@ -822,8 +1294,11 @@ class _RouterHandler(BaseJSONHandler):
         self.guard(self._post)
 
     def _get(self):
+        from urllib.parse import parse_qs, urlsplit
         router: Router = self.server.router
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        params = parse_qs(split.query)
+        path = split.path.rstrip("/") or "/"
         if path == "/healthz":
             self.send_json(200, {"status": "ok",
                                  "replicas": len(router.replicas)})
@@ -840,14 +1315,31 @@ class _RouterHandler(BaseJSONHandler):
         elif path == "/replicas":
             self.send_json(200, {"replicas": [r.snapshot()
                                               for r in router.replicas]})
-        elif path in ("/v1/models", "/slo"):
+        elif path == "/v1/models":
             router.forward_get(self, path)
+        elif path == "/slo":
+            self.send_json(200, router.fleet_slo())
+        elif path == "/trace":
+            vals = params.get("request_id")
+            rid = vals[-1] if vals else None
+            if not rid:
+                self.send_json(400, {
+                    "error": "expected /trace?request_id=<rid>"})
+                return
+            body = router.stitch_trace(rid)
+            if body is None:
+                self.send_json(404, {
+                    "error": f"no hops recorded for request {rid!r}",
+                    "request_id": rid})
+                return
+            self.send_json(200, body)
         elif path in ("/metrics", "/"):
-            self._send(200, _telemetry.render_prometheus(),
+            self._send(200, router.render_fleet_metrics(),
                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
-                                "/readyz /replicas /metrics /slo\n")
+                                "/readyz /replicas /metrics /slo "
+                                "/trace?request_id=<rid>\n")
 
     def _post(self):
         router: Router = self.server.router

@@ -81,6 +81,7 @@ import numpy as _np
 from ..base import MXNetError, getenv_int
 from ..http_util import BaseJSONHandler, HTTPServerBase, \
     start_http_server, stop_http_server
+from .. import telemetry as _telemetry
 from .. import telemetry_ring as _ring
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .engine import GenerationEngine, InferenceEngine
@@ -130,13 +131,32 @@ class _Handler(BaseJSONHandler):
         elif path == "/trace":
             from .. import telemetry_http
             self.send_json(200, telemetry_http.trace_body(params))
+        elif path == "/flight":
+            from .. import telemetry_http
+            self.send_json(200, telemetry_http.flight_body())
+        elif path == "/metrics.json":
+            from .. import telemetry_http
+            self.send_json(200, telemetry_http.metrics_state_body())
         elif path in ("/metrics", "/"):
             from .. import telemetry
             self._send(200, telemetry.render_prometheus(),
                        "text/plain; version=0.0.4; charset=utf-8")
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
-                                "/readyz /metrics /slo /trace\n")
+                                "/readyz /metrics /metrics.json /slo "
+                                "/trace /flight\n")
+
+    def _remote_trace(self):
+        """Adopt the router's ``X-Trace-Id`` hop as the remote parent of
+        spans this request opens (``serve.request`` and below), so the
+        router's ``GET /trace`` stitcher can graft this replica's
+        subtree under its hop span.  A no-op context when the header is
+        absent or malformed — propagation never fails a request."""
+        tp = self.trace_parent()
+        if tp is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return _telemetry.tracer.remote(*tp)
 
     def _post(self):
         ms = self.server.model_server
@@ -175,14 +195,17 @@ class _Handler(BaseJSONHandler):
             if verb == "predict":
                 ms._http_enter()
                 try:
-                    out = ms.predict_json(name, payload, request_id=rid)
+                    with self._remote_trace():
+                        out = ms.predict_json(name, payload,
+                                              request_id=rid)
                 finally:
                     ms._http_exit()
                 self.send_json(200, out)
             elif verb == "generate":
                 ms._http_enter()
                 try:
-                    self._generate(ms, name, payload, rid)
+                    with self._remote_trace():
+                        self._generate(ms, name, payload, rid)
                 finally:
                     ms._http_exit()
             elif verb == "load":
@@ -224,39 +247,50 @@ class _Handler(BaseJSONHandler):
         yet.  Once the stream is open the status is on the wire, so
         worker-side failures become terminal SSE ``error`` events
         instead, and a broken pipe (client disconnect) cancels the
-        request so its slot frees at the next decode-step boundary."""
-        req = ms.generate_request(name, payload, request_id=rid)
+        request so its slot frees at the next decode-step boundary.
+
+        The whole admission-to-last-event lifetime runs under a
+        ``serve.request`` span opened HERE: the blocking predict path
+        gets its span inside ``DynamicBatcher.submit``, but generation
+        hands the request handle back to this thread, so without this
+        span the HTTP ``:generate`` path would leave no request-scoped
+        trace — and nothing for :meth:`_remote_trace` to stamp the
+        router's hop onto."""
         stream = bool(payload.get("stream", False)) \
             if isinstance(payload, dict) else False
-        if not stream:
-            toks = req.result()
-            self.send_json(200, {"tokens": toks, "count": len(toks),
-                                 "request_id": req.request_id})
-            return
-        self.start_stream(200)
-        try:
-            for i, tok in enumerate(req.stream()):
-                self.send_event({"token": int(tok), "index": i},
-                                event="token")
-            self.send_event({"tokens": list(req.tokens_out),
-                             "count": len(req.tokens_out),
-                             "request_id": req.request_id},
-                            event="done")
-        except (BrokenPipeError, ConnectionError, OSError):
-            req.cancel()                # client went away mid-stream
-            return
-        except Exception as e:
-            try:
-                self.send_event({"error": str(e),
-                                 "request_id": req.request_id},
-                                event="error")
-            except OSError:
-                req.cancel()
+        with _telemetry.trace_span("serve.request", cat="serving",
+                                   model=name, request_id=rid,
+                                   stream=stream):
+            req = ms.generate_request(name, payload, request_id=rid)
+            if not stream:
+                toks = req.result()
+                self.send_json(200, {"tokens": toks, "count": len(toks),
+                                     "request_id": req.request_id})
                 return
-        try:
-            self.end_stream()
-        except OSError:
-            pass
+            self.start_stream(200)
+            try:
+                for i, tok in enumerate(req.stream()):
+                    self.send_event({"token": int(tok), "index": i},
+                                    event="token")
+                self.send_event({"tokens": list(req.tokens_out),
+                                 "count": len(req.tokens_out),
+                                 "request_id": req.request_id},
+                                event="done")
+            except (BrokenPipeError, ConnectionError, OSError):
+                req.cancel()            # client went away mid-stream
+                return
+            except Exception as e:
+                try:
+                    self.send_event({"error": str(e),
+                                     "request_id": req.request_id},
+                                    event="error")
+                except OSError:
+                    req.cancel()
+                    return
+            try:
+                self.end_stream()
+            except OSError:
+                pass
 
 
 class ModelServer:

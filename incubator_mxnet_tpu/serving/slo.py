@@ -58,7 +58,7 @@ from typing import Dict, Optional
 from ..base import getenv, getenv_int
 from . import metrics as _m
 
-__all__ = ["ModelSLO", "SLOTracker", "tracker",
+__all__ = ["ModelSLO", "SLOTracker", "tracker", "merge_snapshots",
            "objective_availability", "objective_p99_ms",
            "objective_token_p99_ms", "default_window", "min_requests"]
 
@@ -146,9 +146,15 @@ class ModelSLO:
             "availability_objective": avail_obj,
             "p99_seconds": None,
             "p99_objective_seconds": p99_obj_s or None,
+            # absolute over-objective counts ride along so a federator
+            # can recompute fleet burn from summed windows instead of
+            # averaging per-replica rates (which over-weights idle
+            # replicas)
+            "slow": None,
             "token_window": len(token_window),
             "token_p99_seconds": None,
             "token_p99_objective_seconds": tok_obj_s or None,
+            "token_slow": None,
             "burn_rate": 0.0,
             "error_budget_remaining": 1.0,
             "exhausted": False,
@@ -165,6 +171,7 @@ class ModelSLO:
             out["token_p99_seconds"] = _p99(gaps)
             if tok_obj_s > 0.0:
                 slow = sum(1 for g in token_window if g > tok_obj_s)
+                out["token_slow"] = slow
                 burns.append((slow / len(token_window)) / 0.01)
         if total == 0:
             # token-gap burn alone can spend budget, but readiness only
@@ -178,6 +185,7 @@ class ModelSLO:
             burns.append((bad / total) / (1.0 - avail_obj))
         if p99_obj_s > 0.0:
             slow = sum(1 for _, lat in window if lat > p99_obj_s)
+            out["slow"] = slow
             burns.append((slow / total) / 0.01)
         burn = max(burns) if burns else 0.0
         out["burn_rate"] = burn
@@ -243,3 +251,75 @@ class SLOTracker:
 
 
 tracker = SLOTracker()
+
+
+def merge_snapshots(snapshots: Dict[str, Optional[dict]]) -> dict:
+    """Fold per-replica ``tracker.snapshot()`` bodies (keyed by replica
+    id) into the FLEET ``/slo`` view — the burn a user sees through the
+    router.  Windows merge by summing absolute counts (window/bad/slow),
+    so fleet burn is ``(Σbad/Σtotal)/(1−objective)`` rather than an
+    average of per-replica burns: one replica failing 100% of its 10
+    requests in a 1000-request fleet burns the fleet at 1%, not 50%.
+    Fleet p99 is reported as the worst replica's p99 (windows don't
+    carry raw latencies; the merged-histogram quantile lives on the
+    federated ``/metrics``)."""
+    per_model: Dict[str, Dict[str, dict]] = {}
+    objectives: dict = {}
+    for rid, snap in snapshots.items():
+        if not snap:
+            continue
+        objectives = snap.get("objectives") or objectives
+        for name, ms in (snap.get("models") or {}).items():
+            per_model.setdefault(name, {})[rid] = ms
+
+    def _sum(parts, key):
+        vals = [p.get(key) for p in parts if p.get(key) is not None]
+        return sum(vals) if vals else None
+
+    models = {}
+    for name, by_rep in per_model.items():
+        parts = list(by_rep.values())
+        total = int(_sum(parts, "window") or 0)
+        bad = int(_sum(parts, "bad") or 0)
+        slow = _sum(parts, "slow")
+        tok_total = int(_sum(parts, "token_window") or 0)
+        tok_slow = _sum(parts, "token_slow")
+        avail_obj = next((p["availability_objective"] for p in parts
+                          if p.get("availability_objective") is not None),
+                         min(1.0, max(0.0, objective_availability())))
+        burns = []
+        if total and avail_obj < 1.0:
+            burns.append((bad / total) / (1.0 - avail_obj))
+        if total and slow is not None:
+            burns.append((slow / total) / 0.01)
+        if tok_total and tok_slow is not None:
+            burns.append((tok_slow / tok_total) / 0.01)
+        burn = max(burns) if burns else 0.0
+        p99s = [p.get("p99_seconds") for p in parts
+                if p.get("p99_seconds") is not None]
+        tok_p99s = [p.get("token_p99_seconds") for p in parts
+                    if p.get("token_p99_seconds") is not None]
+        models[name] = {
+            "model": name,
+            "window": total,
+            "bad": bad,
+            "slow": slow,
+            "availability": 1.0 if total == 0 else (total - bad) / total,
+            "availability_objective": avail_obj,
+            "p99_seconds_worst_replica": max(p99s) if p99s else None,
+            "token_window": tok_total,
+            "token_slow": tok_slow,
+            "token_p99_seconds_worst_replica":
+                max(tok_p99s) if tok_p99s else None,
+            "burn_rate": burn,
+            "error_budget_remaining": min(1.0, max(0.0, 1.0 - burn)),
+            "exhausted": burn >= 1.0 and total >= min_requests(),
+            "per_replica": {rid: {"window": p.get("window"),
+                                  "bad": p.get("bad"),
+                                  "burn_rate": p.get("burn_rate")}
+                            for rid, p in sorted(by_rep.items())},
+        }
+    return {"fleet": True,
+            "replicas": sorted(r for r, s in snapshots.items() if s),
+            "objectives": objectives,
+            "models": models}

@@ -86,6 +86,7 @@ __all__ = [
     "TRAINER", "DATALOADER", "SPAN", "XLA_COST", "FAULT",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram",
+    "merge_states", "render_prometheus_state",
     "Span", "Tracer", "tracer", "trace_span", "traced", "current_span",
     "new_request_id",
     "start", "stop", "enabled", "reset",
@@ -385,6 +386,69 @@ class Histogram:
     def sample(self):
         return self.stats()
 
+    def state(self) -> dict:
+        """Mergeable export: exact ``count``/``sum``/``max`` plus the raw
+        reservoir, so another process can union distributions instead of
+        averaging pre-computed quantiles (which under-merges the tail —
+        a per-replica p99 of 10ms and 1s does NOT average to a fleet
+        p99)."""
+        with self._lock:
+            return {"count": self._count, "sum": self._sum,
+                    "max": self._max, "samples": list(self._samples)}
+
+    @staticmethod
+    def merge(states, max_samples: int = 4096) -> dict:
+        """Union N :meth:`state` exports into one state.  count/sum/max
+        merge exactly; reservoirs concatenate, and when the union
+        overflows ``max_samples`` each source is downsampled to its
+        proportional share by evenly-spaced picks over its SORTED
+        samples — a deterministic quantile sketch (no RNG), so merged
+        percentiles are reproducible across runs and processes."""
+        srcs = [s for s in states if s and s.get("count")]
+        count = sum(int(s["count"]) for s in srcs)
+        total = sum(float(s["sum"]) for s in srcs)
+        maxes = [s["max"] for s in srcs if s.get("max") is not None]
+        pools = [sorted(float(v) for v in (s.get("samples") or ()))
+                 for s in srcs]
+        pools = [p for p in pools if p]
+        kept = sum(len(p) for p in pools)
+        if kept <= max_samples:
+            merged = sorted(v for p in pools for v in p)
+        else:
+            merged = []
+            for p in pools:
+                k = max(1, int(round(max_samples * len(p) / kept)))
+                k = min(k, len(p))
+                if k == len(p):
+                    merged.extend(p)
+                elif k == 1:
+                    merged.append(p[len(p) // 2])
+                else:
+                    step = (len(p) - 1) / (k - 1)
+                    merged.extend(p[int(round(j * step))]
+                                  for j in range(k))
+            merged.sort()
+            del merged[max_samples:]
+        return {"count": count, "sum": total,
+                "max": max(maxes) if maxes else None, "samples": merged}
+
+    @staticmethod
+    def stats_of(state: dict) -> dict:
+        """The :meth:`stats` summary of a :meth:`state`/:meth:`merge`
+        export (nearest-rank percentiles over its reservoir)."""
+        data = sorted(float(v) for v in (state.get("samples") or ()))
+        if not data:
+            return {"count": 0, "sum": 0.0, "p50": None, "p95": None,
+                    "p99": None, "max": None}
+
+        def pct(q):
+            return data[min(len(data) - 1,
+                            max(0, int(round(q * (len(data) - 1)))))]
+        return {"count": int(state.get("count") or 0),
+                "sum": float(state.get("sum") or 0.0),
+                "p50": pct(0.5), "p95": pct(0.95), "p99": pct(0.99),
+                "max": state.get("max")}
+
     def _reset(self):
         with self._lock:
             self._samples.clear()
@@ -448,6 +512,28 @@ class MetricsRegistry:
         return {m.name: m.value for m in self.metrics()
                 if m.kind in ("counter", "gauge")}
 
+    def export_state(self) -> dict:
+        """Lossless JSON-ready export for cross-process federation:
+        counters/gauges keep their per-label-set values (label sets as
+        ``"k=v,k2=v2"`` strings, ``""`` for unlabeled), histograms export
+        their full :meth:`Histogram.state` reservoir.  The router fetches
+        this from every replica and folds them with
+        :func:`merge_states`."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            if m.kind in ("counter", "gauge"):
+                with m._lock:
+                    vals = dict(m._values)
+                out[m.kind + "s"][m.name] = {
+                    "help": m.help,
+                    "values": {",".join(f"{k}={v}" for k, v in key): val
+                               for key, val in sorted(vals.items())}}
+            else:
+                st = m.state()
+                st["help"] = m.help
+                out["histograms"][m.name] = st
+        return out
+
     def render_prometheus(self) -> str:
         lines = []
         for m in self.metrics():
@@ -479,6 +565,82 @@ class MetricsRegistry:
 
 
 registry = MetricsRegistry()
+
+
+def merge_states(states, max_samples: int = 4096) -> dict:
+    """Fold N :meth:`MetricsRegistry.export_state` exports into one
+    state of the same shape: counters and gauges sum per label set,
+    histograms union via :meth:`Histogram.merge`.  Summing gauges gives
+    fleet totals for capacity-style gauges (inflight, queue depth); the
+    ratio-style SLO gauges are federated properly by the router's fleet
+    ``/slo`` from merged windows, not from here."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "gauges"):
+        for st in states:
+            for name, m in (st or {}).get(kind, {}).items():
+                dst = out[kind].setdefault(
+                    name, {"help": m.get("help", ""), "values": {}})
+                for label, val in (m.get("values") or {}).items():
+                    dst["values"][label] = \
+                        dst["values"].get(label, 0.0) + float(val)
+    hist_names = {}
+    for st in states:
+        for name, hs in (st or {}).get("histograms", {}).items():
+            hist_names.setdefault(name, []).append(hs)
+    for name, parts in hist_names.items():
+        merged = Histogram.merge(parts, max_samples=max_samples)
+        merged["help"] = next(
+            (p.get("help") for p in parts if p.get("help")), "")
+        out["histograms"][name] = merged
+    return out
+
+
+def render_prometheus_state(state: dict, extra_labels: dict = None,
+                            type_lines: bool = True) -> str:
+    """Prometheus text exposition of an :func:`merge_states` /
+    :meth:`MetricsRegistry.export_state` state.  ``extra_labels`` are
+    appended to every series (the router renders per-replica series with
+    ``replica="host:port"`` and stale snapshots with ``stale="true"``)."""
+    extra = ",".join(f'{k}="{v}"' for k, v in (extra_labels or {}).items())
+    lines = []
+
+    def fmt_labels(label_str):
+        parts = [f'{k}="{v}"' for k, v in
+                 (kv.split("=", 1) for kv in label_str.split(",") if kv)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    for kind, ptype in (("counters", "counter"), ("gauges", "gauge")):
+        for name in sorted((state or {}).get(kind, {})):
+            m = state[kind][name]
+            if type_lines:
+                if m.get("help"):
+                    lines.append(f"# HELP {name} {m['help']}")
+                lines.append(f"# TYPE {name} {ptype}")
+            vals = m.get("values") or {}
+            if not vals:
+                lines.append(f"{name}{fmt_labels('')} 0")
+            for label, val in sorted(vals.items()):
+                lines.append(f"{name}{fmt_labels(label)} {_fmt_num(val)}")
+    for name in sorted((state or {}).get("histograms", {})):
+        hs = state["histograms"][name]
+        if type_lines:
+            if hs.get("help"):
+                lines.append(f"# HELP {name} {hs['help']}")
+            lines.append(f"# TYPE {name} summary")
+        s = Histogram.stats_of(hs)
+        for q, k in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            if s[k] is not None:
+                lines.append(f'{name}{{quantile="{q}"'
+                             + (f",{extra}" if extra else "")
+                             + f'}} {repr(s[k])}')
+        tail = fmt_labels("")
+        lines.append(f"{name}_sum{tail} {repr(float(s['sum']))}")
+        lines.append(f"{name}_count{tail} {int(s['count'])}")
+        if s["max"] is not None:
+            lines.append(f"{name}_max{tail} {repr(s['max'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def counter(name: str, help: str = "") -> Counter:
@@ -632,6 +794,15 @@ class Tracer:
         stack = self._stack()
         par = parent if parent is not None else \
             (stack[-1] if stack else None)
+        if par is None:
+            # a remote parent (another process's span, delivered via
+            # X-Trace-Id) can't be a tree edge — record it as linkage
+            # attrs so the router's stitcher re-parents this subtree
+            rc = getattr(self._tl, "remote", None)
+            if rc is not None:
+                attrs = dict(attrs) if attrs else {}
+                attrs.setdefault("trace_id", rc[0])
+                attrs.setdefault("remote_parent", rc[1])
         sp = Span(name, cat, attrs)
         sp.t0 = time.perf_counter()
         sp.tid = threading.get_ident()
@@ -683,6 +854,34 @@ class Tracer:
             elif self._span in stack:
                 stack.remove(self._span)
             return False
+
+    class _RemoteAttach:
+        __slots__ = ("_ctx", "_prev")
+
+        def __init__(self, ctx):
+            self._ctx = ctx
+            self._prev = None
+
+        def __enter__(self):
+            self._prev = getattr(tracer._tl, "remote", None)
+            tracer._tl.remote = self._ctx
+            return self._ctx
+
+        def __exit__(self, *exc):
+            tracer._tl.remote = self._prev
+            return False
+
+    def remote(self, trace_id: str,
+               parent_sid: str) -> "Tracer._RemoteAttach":
+        """Adopt a REMOTE parent for root spans opened on this thread
+        while the context is held: each such span gets ``trace_id`` and
+        ``remote_parent`` attrs naming the upstream hop span it belongs
+        under.  This is the replica half of cross-process trace
+        propagation — ``serving/server.py`` wraps request handling in
+        ``tracer.remote(*parsed_x_trace_id)`` and the router's
+        ``GET /trace`` stitcher grafts the resulting subtree under the
+        hop span whose sid matches ``remote_parent``."""
+        return Tracer._RemoteAttach((str(trace_id), str(parent_sid)))
 
     def attach(self, span: Span) -> "Tracer._Attach":
         """Adopt ``span`` as this thread's current span (does not close

@@ -43,7 +43,7 @@ from typing import Optional
 from .http_util import BaseJSONHandler, start_http_server, stop_http_server
 
 __all__ = ["start_server", "stop_server", "server", "trace_body",
-           "slo_body"]
+           "slo_body", "flight_body", "metrics_state_body"]
 
 #: ``/trace`` bounds: default and hard cap for ``?limit=``
 TRACE_DEFAULT_LIMIT = 32
@@ -83,6 +83,23 @@ def trace_body(params: dict) -> dict:
     return telemetry.tracer.tree(max_finished=limit, since=since)
 
 
+def flight_body(reason: str = "http") -> dict:
+    """The ``/flight`` response body: the flight recorder's full
+    postmortem payload (ring + metrics + providers) WITHOUT writing a
+    dump file — the router pulls this view of an implicated replica into
+    a fleet incident bundle."""
+    from . import telemetry_ring
+    return telemetry_ring.recorder.payload(reason)
+
+
+def metrics_state_body() -> dict:
+    """The ``/metrics.json`` response body: the registry's mergeable
+    export (per-label counter/gauge values + raw histogram reservoirs),
+    the feed behind the router's federated ``/metrics``."""
+    from . import telemetry
+    return telemetry.registry.export_state()
+
+
 def slo_body() -> dict:
     """The ``/slo`` response body.  Reads the tracker only when the
     serving plane is already in ``sys.modules`` — a metrics exporter
@@ -112,6 +129,14 @@ class _Handler(BaseJSONHandler):
         if path in ("/metrics", "/"):
             self._send(200, telemetry.render_prometheus(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/metrics.json":
+            self._send(200,
+                       json.dumps(metrics_state_body(), default=str)
+                       + "\n", "application/json")
+        elif path == "/flight":
+            self._send(200,
+                       json.dumps(flight_body(), default=str) + "\n",
+                       "application/json")
         elif path == "/healthz":
             self._send(200, json.dumps({
                 "status": "ok",
@@ -130,8 +155,8 @@ class _Handler(BaseJSONHandler):
                        json.dumps(slo_body(), default=str) + "\n",
                        "application/json")
         else:
-            self._send(404, "not found: try /metrics /healthz /trace "
-                            "/slo\n",
+            self._send(404, "not found: try /metrics /metrics.json "
+                            "/healthz /trace /slo /flight\n",
                        "text/plain; charset=utf-8")
 
 

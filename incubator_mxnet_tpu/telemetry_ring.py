@@ -237,9 +237,12 @@ class FlightRecorder:
         except Exception:               # the recorder must never take
             pass                        # the recorded program down
 
-    def dump(self, reason: str, path: Optional[str] = None) -> str:
-        """Write the postmortem JSON and return its path.  ``path=None``
-        picks ``<dump_dir>/flight_<pid>_<seq>_<reason>.json``."""
+    def payload(self, reason: str) -> dict:
+        """The postmortem document :meth:`dump` writes, as a dict: ring
+        entries, a metrics snapshot, and every registered provider's
+        state.  Served on replica ``GET /flight`` so a router can pull
+        the implicated replica's view into a fleet incident bundle
+        without touching the replica's disk."""
         self.note_metrics(force=True)
         payload = {
             "reason": reason,
@@ -254,13 +257,20 @@ class FlightRecorder:
             payload["metrics"] = {"error": repr(e)}
         with self._lock:
             providers = dict(self._providers)
-            self._dump_seq += 1
-            seq = self._dump_seq
         for name, fn in providers.items():
             try:
                 payload[name] = fn()
             except Exception as e:      # a sick provider is itself data
                 payload[name] = {"error": repr(e)}
+        return payload
+
+    def dump(self, reason: str, path: Optional[str] = None) -> str:
+        """Write the postmortem JSON and return its path.  ``path=None``
+        picks ``<dump_dir>/flight_<pid>_<seq>_<reason>.json``."""
+        payload = self.payload(reason)
+        with self._lock:
+            self._dump_seq += 1
+            seq = self._dump_seq
         if path is None:
             d = default_dump_dir()
             os.makedirs(d, exist_ok=True)

@@ -12,6 +12,12 @@ Exits 0 with an artifact either way; "status" says what happened:
   tpu_down      — probe found no reachable accelerator (probe_error says
                   why); tier not run
   ran_with_failures — tier ran, some tests failed (counts + tail)
+
+Every test verdict is ALSO journaled incrementally to --jsonl (default
+<out>.jsonl) as it lands, so a run killed mid-tier (tunnel death,
+timeout) keeps what it proved.  --resume reads that journal and
+deselects tests whose last verdict was 'passed' — only the remainder
+re-runs, and the artifact merges both (counts labeled "resumed").
 """
 import argparse
 import json
@@ -47,11 +53,30 @@ def main():
     ap.add_argument("--out", default="TPU_TIER.json")
     ap.add_argument("--timeout", type=int, default=3600,
                     help="whole-tier pytest timeout (seconds)")
+    ap.add_argument("--jsonl", default=None,
+                    help="incremental per-test journal (default "
+                         "<out>.jsonl); appended as each test finishes")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip tests already passed per the --jsonl "
+                         "journal; merge old + new verdicts in the "
+                         "artifact")
     args = ap.parse_args()
+    jsonl_path = os.path.abspath(args.jsonl or (args.out + ".jsonl"))
+
+    import pytest_jsonl  # sits next to this script
+
+    resumed_passed = set()
+    if args.resume:
+        resumed_passed, _ = pytest_jsonl.load_journal(jsonl_path)
+    elif os.path.exists(jsonl_path):
+        os.unlink(jsonl_path)  # fresh run: a stale journal would lie
 
     rec = {"git_sha": git_sha(),
            "started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                         time.gmtime())}
+    if resumed_passed:
+        rec["resumed"] = {"journal": jsonl_path,
+                          "already_passed": len(resumed_passed)}
     platform, kind, err = probe()
     if platform in (None, "cpu"):
         rec.update(status="tpu_down", device=f"{platform or 'none'}",
@@ -64,15 +89,22 @@ def main():
     rec["device"] = f"{platform}:{kind}"
     xml_path = os.path.join(_REPO, ".tpu_tier_junit.xml")
     t0 = time.time()
+    cmd = [sys.executable, "-m", "pytest", "tests_tpu/", "-q",
+           "--tb=line", f"--junitxml={xml_path}", "-p", "pytest_jsonl"]
+    for nid in sorted(resumed_passed):
+        cmd += ["--deselect", nid]
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ,
+           # hand the probe verdict down so conftest skips its own
+           # probe (one PJRT handshake per tier run, not two)
+           "MXNET_TPU_TIER_REACHABLE": "1",
+           "MXNET_TEST_JSONL": jsonl_path,
+           "PYTHONPATH": tools_dir + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
     try:
         out = subprocess.run(
-            [sys.executable, "-m", "pytest", "tests_tpu/", "-q",
-             "--tb=line", f"--junitxml={xml_path}"],
-            capture_output=True, text=True, timeout=args.timeout,
-            cwd=_REPO,
-            # hand the probe verdict down so conftest skips its own
-            # probe (one PJRT handshake per tier run, not two)
-            env={**os.environ, "MXNET_TPU_TIER_REACHABLE": "1"})
+            cmd, capture_output=True, text=True, timeout=args.timeout,
+            cwd=_REPO, env=env)
         rec["wall_seconds"] = round(time.time() - t0, 1)
         counts = {}
         bad_names = []
@@ -95,6 +127,12 @@ def main():
                             + (node.get("message") or "")[:90])
         except (OSError, ET.ParseError, IndexError) as pe:
             counts = {"junit_parse_error": str(pe)[:200]}
+        if resumed_passed and "junit_parse_error" not in counts:
+            # fold the journal's prior passes back into the totals so a
+            # resumed artifact describes the WHOLE tier, not the rump
+            counts["tests"] += len(resumed_passed)
+            counts["passed"] += len(resumed_passed)
+            counts["passed_resumed"] = len(resumed_passed)
         rec.update(counts)
         if bad_names:
             rec["failing_tests"] = bad_names[:40]
