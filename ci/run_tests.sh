@@ -63,6 +63,14 @@ usage: ci/run_tests.sh <function>
                         during decode fails the rider (id on the error
                         event) and recovers via the watchdog, and
                         mxtpu_generate_* series are on /metrics
+  spec_smoke            speculative-decoding drill: 16 streaming clients
+                        against a preloaded paged target+draft server with
+                        MXNET_SPEC_K=4; asserts every stream is
+                        bit-identical to a no-draft golden run,
+                        mxtpu_spec_accepted_tokens_per_dispatch > 1.0 on
+                        /metrics, and a serving.infer:hang wedged
+                        mid-verify fails its riders with ids on the
+                        terminal SSE error and recovers via the watchdog
   paged_smoke           paged KV-cache drill: under an EQUAL cache-byte
                         budget (dense 4x128 positions == paged 32x16
                         blocks), 16 streaming clients with a shared
@@ -808,6 +816,154 @@ print(f"generate_smoke ok: late first-token led long last-token by "
       f"{len(toks_h)} tokens and recovered, "
       f"{stats['tokens_emitted']} tokens in {stats['decode_steps']} "
       f"decode steps")
+EOF
+}
+
+spec_smoke() {
+    MXNET_SPEC_K=4 \
+    MXNET_SERVE_HANG_SECONDS=0.5 \
+    MXNET_SERVE_BREAKER_COOLDOWN_SECONDS=0.3 \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import GenerationEngine, ModelServer
+
+telemetry.start()
+CLIENTS, NEW = 16, 24
+SYSTEM = list(range(1, 33))            # shared 32-token system prompt
+PROMPTS = [SYSTEM + [40 + i % 8, i % 5] for i in range(CLIENTS)]
+
+def build(name, seed, max_slots):
+    mx.random.seed(seed)
+    net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+                   num_heads=2, max_length=128, dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.6))
+    net(mx.nd.array(np.zeros((1, 2), np.int32)))
+    return GenerationEngine(net, name=name, max_slots=max_slots,
+                            max_len=128, paged=True, block_size=16)
+
+# -- golden: the SAME weights, no draft attached ----------------------
+golden_eng = build("golden", 3, 1)
+golden = [golden_eng.generate(p, max_new_tokens=NEW) for p in PROMPTS]
+del golden_eng
+
+# -- target + draft (identical weights => high accept rate) -----------
+engine = build("gen", 3, 4)
+draft = build("gen-draft", 3, 4)
+engine.attach_draft(draft)             # k from MXNET_SPEC_K=4
+assert engine.spec_k == 4, engine.spec_k
+
+srv = ModelServer(port=0)
+srv.add_model("gen", engine)
+srv.preload()                          # all programs warm pre-bind
+assert engine.warm and draft.warm, "spec_smoke: preload left a cold model"
+srv.start()
+url = f"http://127.0.0.1:{srv.port}"
+
+def stream(prompt, n, rid):
+    req = urllib.request.Request(
+        url + "/v1/models/gen:generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": n,
+                         "stream": True}).encode(),
+        headers={"x-request-id": rid})
+    r = urllib.request.urlopen(req, timeout=120)
+    toks, finals = [], []
+    for line in r:
+        line = line.strip()
+        if line.startswith(b"data:"):
+            d = json.loads(line.split(b":", 1)[1])
+            if "token" in d:
+                toks.append(d["token"])
+            else:
+                finals.append(d)
+    return toks, finals, r.headers.get("X-Request-Id")
+
+# -- 1. 16 concurrent streaming clients, bit-identical to golden ------
+results, errors = {}, []
+def run(i):
+    try:
+        results[i] = stream(PROMPTS[i], NEW, f"spec-{i}")
+    except Exception as e:
+        errors.append(f"spec-{i}: {e!r}")
+
+threads = [threading.Thread(target=run, args=(i,)) for i in range(CLIENTS)]
+for t in threads:
+    t.start()
+    time.sleep(0.01)                   # staggered mid-flight joins
+for t in threads:
+    t.join()
+assert not errors, "spec_smoke: " + "; ".join(errors[:3])
+total_acc = total_drafted = 0
+for i in range(CLIENTS):
+    toks, finals, rid = results[i]
+    assert rid == f"spec-{i}", f"spec_smoke: X-Request-Id lost: {rid!r}"
+    assert toks == golden[i], \
+        f"spec_smoke: client {i} diverged from no-draft golden: " \
+        f"{toks[:8]}... != {golden[i][:8]}..."
+    done = finals[-1]
+    assert done["request_id"] == f"spec-{i}", done
+    total_acc += done["accepted_tokens"]
+    total_drafted += done["draft_tokens"]
+assert total_drafted > 0 and total_acc > 0, (total_acc, total_drafted)
+
+# -- 2. the amortization gauge must show the draft actually helping ---
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+m = re.search(
+    r'mxtpu_spec_accepted_tokens_per_dispatch\{[^}]*\}\s+([0-9.eE+-]+)',
+    prom)
+assert m, "spec_smoke: spec gauge missing from /metrics"
+tpd = float(m.group(1))
+assert tpd > 1.0, \
+    f"spec_smoke: accepted_tokens_per_dispatch {tpd} <= 1.0 — the " \
+    f"draft never beat plain decode"
+
+# -- 3. wedge a verify dispatch mid-stream; riders must fail loudly
+#       with their ids, then the watchdog restart must recover --------
+fault.install_plan("serving.infer:hang:30@3")
+toks_h, finals_h, rid_h = stream(PROMPTS[0], 100, "spec-hang")
+assert rid_h == "spec-hang"
+assert 0 < len(toks_h) < 100, \
+    f"spec_smoke: hang drill emitted {len(toks_h)} tokens"
+assert finals_h and "error" in finals_h[-1], \
+    f"spec_smoke: no terminal error event: {finals_h}"
+assert finals_h[-1]["request_id"] == "spec-hang"
+fault.clear_plan()
+
+recovered = None
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline and recovered is None:
+    time.sleep(0.2)
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            url + "/v1/models/gen:generate",
+            data=json.dumps({"tokens": PROMPTS[1],
+                             "max_new_tokens": NEW}).encode()), timeout=30)
+        recovered = json.loads(r.read())["tokens"]
+    except urllib.error.HTTPError as e:
+        e.read()                       # 503 while the breaker cools down
+assert recovered == golden[1], \
+    f"spec_smoke: post-restart output != golden"
+
+stats = json.load(urllib.request.urlopen(url + "/v1/models",
+                                         timeout=10))["models"]["gen"]
+assert stats["spec_k"] == 4 and stats["watchdog_restarts"] == 1, stats
+srv.stop()
+telemetry.stop()
+print(f"spec_smoke ok: {CLIENTS} streams bit-identical to no-draft "
+      f"golden, {tpd:.2f} accepted tokens/dispatch "
+      f"(accept rate {stats['spec_accept_rate']:.2f}), hang drill "
+      f"failed rider 'spec-hang' after {len(toks_h)} tokens and "
+      f"recovered")
 EOF
 }
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import importlib.util
 import os
 import sys
+import time
 
 
 def _load_tool(name):
@@ -194,6 +195,7 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
     cfg_len = cfg.pop("max_len", None)
     cfg_paged = cfg.pop("paged", None)
     cfg_bs = cfg.pop("block_size", None)
+    cfg_spec_k = cfg.pop("spec_k", None)    # draft configs only
     max_slots = cfg_slots if max_slots is None else max_slots
     max_len = cfg_len if max_len is None else max_len
     paged = cfg_paged if paged is None else paged
@@ -207,9 +209,12 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
             params = os.path.join(os.path.dirname(
                 os.path.abspath(cfg_path)), params)
         net.load_parameters(params)
-    return GenerationEngine(net, name=name, max_slots=max_slots,
-                            max_len=max_len, paged=paged,
-                            block_size=block_size)
+    engine = GenerationEngine(net, name=name, max_slots=max_slots,
+                              max_len=max_len, paged=paged,
+                              block_size=block_size)
+    # surfaced by serve_main when this config backs a --gen-draft
+    engine._cfg_spec_k = cfg_spec_k
+    return engine
 
 
 def serve_main():
@@ -219,9 +224,10 @@ def serve_main():
         mxtpu-serve --model mnist=/models/mnist:7 \\
                     --model small=/models/small \\
                     [--gen-model gpt=/models/gpt.json] \\
+                    [--gen-draft gpt=/models/gpt-small.json] \\
                     [--port N] [--max-batch N] [--max-delay-ms F]
                     [--queue N] [--input-names data]
-                    [--input-specs 784] [--warmup]
+                    [--input-specs 784] [--warmup] [--preload]
                     [--gen-slots N] [--gen-max-len N]
                     [--gen-paged 0|1] [--gen-block-size N]
 
@@ -247,7 +253,16 @@ def serve_main():
     env defaults.  The KV cache is paged by default (block pool +
     prefix sharing); ``--gen-paged 0`` restores the dense layout and
     ``--gen-block-size`` sets tokens per block (``MXNET_KV_PAGED`` /
-    ``MXNET_KV_BLOCK_SIZE``)."""
+    ``MXNET_KV_BLOCK_SIZE``).
+
+    ``--gen-draft NAME=CONFIG.json`` attaches a small draft model to
+    the generation model registered as ``NAME``, enabling speculative
+    decoding: the draft proposes ``MXNET_SPEC_K`` tokens per step (or
+    the draft config's ``"spec_k"``) and the target verifies them in
+    one k+1-wide dispatch — greedy outputs stay bit-identical.
+    ``--preload`` AOT-compiles every registered model's full program
+    set BEFORE the port is bound, so ``/readyz`` never serves a cold
+    replica."""
     import argparse
 
     ap = argparse.ArgumentParser(
@@ -301,6 +316,17 @@ def serve_main():
     ap.add_argument("--gen-block-size", type=int, default=None,
                     help="tokens per paged KV block (default "
                          "MXNET_KV_BLOCK_SIZE or 16)")
+    ap.add_argument("--gen-draft", action="append", default=[],
+                    metavar="NAME=CONFIG.json",
+                    help="attach a draft model to generation model NAME "
+                         "for speculative decoding (k from the config's "
+                         "'spec_k' or MXNET_SPEC_K, default 4); "
+                         "repeatable")
+    ap.add_argument("--preload", action="store_true",
+                    help="AOT-compile every model's full program set "
+                         "(all buckets, decode, and the speculative "
+                         "verify program) before binding the port — "
+                         "/readyz never serves a cold replica")
     ns = ap.parse_args()
     if not ns.model and not ns.gen_model:
         ap.error("at least one --model NAME=PREFIX[:EPOCH] or "
@@ -342,6 +368,16 @@ def serve_main():
         sys.stderr.write(f"mxtpu-serve: loaded {name} from {prefix} "
                          f"(epoch {int(epoch)}, buckets "
                          f"{list(engine.buckets)})\n")
+    drafts = {}
+    for spec in ns.gen_draft:
+        name, _, cfg_path = spec.partition("=")
+        if not name or not cfg_path:
+            ap.error(f"--gen-draft wants NAME=CONFIG.json, got {spec!r}")
+        drafts[name] = cfg_path
+    gen_names = {spec.partition("=")[0] for spec in ns.gen_model}
+    for name in drafts:
+        if name not in gen_names:
+            ap.error(f"--gen-draft {name}: no matching --gen-model")
     for spec in ns.gen_model:
         name, _, cfg_path = spec.partition("=")
         if not name or not cfg_path:
@@ -351,6 +387,19 @@ def serve_main():
             max_len=ns.gen_max_len,
             paged=None if ns.gen_paged is None else bool(ns.gen_paged),
             block_size=ns.gen_block_size)
+        if name in drafts:
+            # the draft mirrors the target's slot/sequence geometry so
+            # its cache rolls back in lock-step with the target's
+            draft = _load_generation_engine(
+                name + "-draft", drafts[name],
+                max_slots=engine.max_slots, max_len=engine.max_len,
+                paged=engine.paged,
+                block_size=engine.block_size if engine.paged else None)
+            engine.attach_draft(
+                draft, spec_k=getattr(draft, "_cfg_spec_k", None))
+            sys.stderr.write(
+                f"mxtpu-serve: attached draft to {name} from "
+                f"{drafts[name]} (spec_k {engine.spec_k})\n")
         srv.add_model(name, engine, warmup=ns.warmup)
         kv = (f"paged blocks={engine.num_blocks - 1}x"
               f"{engine.block_size}" if engine.paged else "dense")
@@ -359,6 +408,13 @@ def serve_main():
             f"{cfg_path} (slots {engine.max_slots}, max_len "
             f"{engine.max_len}, kv {kv}, prefill buckets "
             f"{list(engine.prefill_buckets)})\n")
+    if ns.preload:
+        sys.stderr.write("mxtpu-serve: preloading — compiling all "
+                         "programs before binding the port...\n")
+        t0 = time.time()
+        srv.preload()
+        sys.stderr.write(f"mxtpu-serve: preload done in "
+                         f"{time.time() - t0:.1f}s\n")
     srv.start()
     sys.stderr.write(f"mxtpu-serve: listening on "
                      f"http://{ns.host}:{srv.port} "

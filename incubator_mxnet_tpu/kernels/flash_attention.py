@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["flash_attention", "flash_attention_lse", "decode_attention",
-           "paged_decode_attention"]
+           "paged_decode_attention", "verify_decode_attention",
+           "paged_verify_decode_attention"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -791,4 +792,266 @@ def paged_decode_attention(q, k_pages, v_pages, tables, positions,
         return _xla_paged_decode_attention(q, k_pages, v_pages, tables,
                                            positions, scale)
     return _paged_decode_pallas(q, k_pages, v_pages, tables, positions,
+                                scale, interpret=False)
+
+
+# ---------------------------------------------------------------------------
+# verify-shaped attention: a k+1-wide query block per slot over the same
+# caches — the speculative-decode verify program scores every drafted
+# position in ONE dispatch.  Query row j of slot s sits at logical
+# position positions[s] + j, so the mask is causal-within-the-block on
+# top of the per-slot length mask the single-query kernels already use.
+# ---------------------------------------------------------------------------
+
+def _xla_verify_decode_attention(q, k, v, positions, scale):
+    """(S, H, Q, D) query-block attention over (S, H, T, D) caches.
+    ``positions`` (S,) is the base position of query row 0; row j attends
+    keys ``<= positions[s] + j`` (causal inside the block, stale entries
+    beyond each row's head masked exactly like single-query decode)."""
+    S, H, Q, D = q.shape
+    T = k.shape[2]
+    s = jnp.einsum("shqd,shtd->shqt", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    key_idx = jnp.arange(T, dtype=jnp.int32)
+    qpos = positions[:, None].astype(jnp.int32) \
+        + jnp.arange(Q, dtype=jnp.int32)[None, :]          # (S, Q)
+    live = key_idx[None, None, None, :] <= qpos[:, None, :, None]
+    s = jnp.where(live, s, -1e30)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("shqt,shtd->shqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _verify_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, n_q, block_k, n_kb):
+    """Grid (S, H, n_kb): a (Q, D) query block against K/V blocks
+    (block_k, D), online softmax across the kb axis with per-row
+    running max / denominator (scratch (Q, 1) instead of (1, 1))."""
+    from jax.experimental import pallas as pl
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[0]
+    D = q_ref.shape[-1]
+    q = q_ref[...].reshape(n_q, D).astype(jnp.float32)
+    k = k_ref[...].reshape(block_k, D).astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # (Q, block_k)
+    idx = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (n_q, block_k), 1)
+    head = pos + jax.lax.broadcasted_iota(jnp.int32, (n_q, block_k), 0)
+    s = jnp.where(idx <= head, s, -1e30)
+    m_prev, l_prev = m_ref[:], l_ref[:]               # (Q, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                            # (Q, block_k)
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    v_blk = v_ref[...].reshape(block_k, D).astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (Q, D)
+
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        o_ref[...] = (acc_ref[:] / l_ref[:]).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _verify_pallas(q, k, v, positions, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, H, T, D = k.shape
+    n_q = q.shape[2]
+    block_k = min(_BLOCK_K, T)
+    n_kb = T // block_k
+    kernel = functools.partial(_verify_kernel, scale=scale, n_q=n_q,
+                               block_k=block_k, n_kb=n_kb)
+    return pl.pallas_call(
+        kernel,
+        grid=(S, H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1,), lambda s, h, kb: (s,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, n_q, D), lambda s, h, kb: (s, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, kb: (s, h, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, kb: (s, h, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, n_q, D), lambda s, h, kb: (s, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((n_q, D), jnp.float32),
+            pltpu.VMEM((n_q, 1), jnp.float32),
+            pltpu.VMEM((n_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(positions.astype(jnp.int32), q, k, v)
+
+
+def verify_decode_attention(q, k, v, positions, scale=None):
+    """Per-slot k+1-wide attention over a preallocated KV cache.
+
+    ``q`` (S, H, Q, D): this step's query block — row j is the query of
+    the token at logical position ``positions[s] + j``; ``k``/``v``
+    (S, H, T, D): the cache, already holding all Q positions' K/V;
+    ``positions`` (S,) int32: the base position of row 0.  Row j attends
+    entries ``<= positions[s] + j`` and the call returns (S, H, Q, D).
+    With Q == 1 this is exactly :func:`decode_attention`.
+
+    Dispatch gates mirror :func:`decode_attention`: Pallas when T is
+    tile-aligned and K+V fit the VMEM budget, lax otherwise; on CPU the
+    lax path is the default and ``MXNET_FA_DECODE_FORCE_PALLAS=1`` forces
+    the interpreted kernel for parity tests."""
+    from ..base import getenv_bool
+    S, H, T, D = k.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    force = getenv_bool("MXNET_FA_DECODE_FORCE_PALLAS")
+    kv_bytes = 2 * T * D * q.dtype.itemsize
+    aligned = T % _BLOCK_K == 0 and kv_bytes <= 8 * 2 ** 20
+    if force and aligned:
+        return _verify_pallas(q, k, v, positions, scale,
+                              interpret=platform == "cpu")
+    if platform == "cpu" or not aligned:
+        return _xla_verify_decode_attention(q, k, v, positions, scale)
+    return _verify_pallas(q, k, v, positions, scale, interpret=False)
+
+
+def _xla_paged_verify_decode_attention(q, k_pages, v_pages, tables,
+                                       positions, scale):
+    """Gather each slot's blocks into a dense (S, H, T, D) view and reuse
+    :func:`_xla_verify_decode_attention` verbatim (same bit-identity
+    argument as the single-query paged gather)."""
+    S, nb = tables.shape
+    _, H, bs, D = k_pages.shape
+    k = jnp.moveaxis(k_pages[tables], 2, 1).reshape(S, H, nb * bs, D)
+    v = jnp.moveaxis(v_pages[tables], 2, 1).reshape(S, H, nb * bs, D)
+    return _xla_verify_decode_attention(q, k, v, positions, scale)
+
+
+def _paged_verify_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, scale, n_q, block_k,
+                         n_kb):
+    """Grid (S, H, n_kb): :func:`_verify_kernel` with the K/V block for
+    grid step ``kb`` fetched through the scalar-prefetched block table
+    (index maps in :func:`_paged_verify_pallas`)."""
+    from jax.experimental import pallas as pl
+    s = pl.program_id(0)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[s]
+    D = q_ref.shape[-1]
+    q = q_ref[...].reshape(n_q, D).astype(jnp.float32)
+    k = k_ref[...].reshape(block_k, D).astype(jnp.float32)
+    sc = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # (Q, block_k)
+    idx = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (n_q, block_k), 1)
+    head = pos + jax.lax.broadcasted_iota(jnp.int32, (n_q, block_k), 0)
+    sc = jnp.where(idx <= head, sc, -1e30)
+    m_prev, l_prev = m_ref[:], l_ref[:]               # (Q, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(sc - m_new)                           # (Q, block_k)
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    v_blk = v_ref[...].reshape(block_k, D).astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (Q, D)
+
+    @pl.when(kb == n_kb - 1)
+    def _fin():
+        o_ref[...] = (acc_ref[:] / l_ref[:]).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _paged_verify_pallas(q, k_pages, v_pages, tables, positions, scale,
+                         interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, n_kb = tables.shape
+    _, H, bs, D = k_pages.shape
+    n_q = q.shape[2]
+    kernel = functools.partial(_paged_verify_kernel, scale=scale, n_q=n_q,
+                               block_k=bs, n_kb=n_kb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_q, D),
+                         lambda s, h, kb, tbl, pos: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda s, h, kb, tbl, pos: (tbl[s, kb], h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda s, h, kb, tbl, pos: (tbl[s, kb], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, n_q, D),
+                               lambda s, h, kb, tbl, pos: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_q, D), jnp.float32),
+            pltpu.VMEM((n_q, 1), jnp.float32),
+            pltpu.VMEM((n_q, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), positions.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_verify_decode_attention(q, k_pages, v_pages, tables, positions,
+                                  scale=None):
+    """Per-slot k+1-wide attention over a PAGED KV cache.
+
+    ``q`` (S, H, Q, D): query block, row j at logical position
+    ``positions[s] + j``; ``k_pages``/``v_pages`` (num_blocks, H,
+    block_size, D); ``tables`` (S, max_blocks) int32 padded with null
+    block 0; ``positions`` (S,) int32 base positions.  Returns
+    (S, H, Q, D).  Gates mirror :func:`paged_decode_attention` (lax is
+    the CPU/default path, Pallas behind ``MXNET_USE_FUSION``,
+    ``MXNET_FA_DECODE_FORCE_PALLAS=1`` interprets for parity)."""
+    from ..base import getenv_bool
+    _, H, bs, D = k_pages.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    try:
+        platform = next(iter(q.devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    force = getenv_bool("MXNET_FA_DECODE_FORCE_PALLAS")
+    aligned = bs % 8 == 0 and D % 8 == 0
+    if force and aligned:
+        return _paged_verify_pallas(q, k_pages, v_pages, tables, positions,
+                                    scale, interpret=platform == "cpu")
+    if platform == "cpu" or not aligned \
+            or not getenv_bool("MXNET_USE_FUSION"):
+        return _xla_paged_verify_decode_attention(q, k_pages, v_pages,
+                                                  tables, positions, scale)
+    return _paged_verify_pallas(q, k_pages, v_pages, tables, positions,
                                 scale, interpret=False)

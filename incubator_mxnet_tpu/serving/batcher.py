@@ -632,7 +632,8 @@ class _GenRequest:
     __slots__ = ("tokens", "n", "budget", "eos_id", "event", "error",
                  "tokens_out", "t_submit", "t_first", "t_emit",
                  "deadline", "model", "request_id", "trace_ctx",
-                 "slot", "_q", "_cancelled")
+                 "slot", "_q", "_cancelled",
+                 "accepted_tokens", "draft_tokens")
 
     def __init__(self, tokens, budget, eos_id=None, deadline=None,
                  model="?", request_id=None, trace_ctx=None):
@@ -654,6 +655,11 @@ class _GenRequest:
         self.slot: Optional[int] = None
         self._q = _pyqueue.Queue()
         self._cancelled = False
+        # speculative-decoding accounting (stay 0 on the plain path):
+        # draft_tokens counts tokens the draft proposed for THIS request,
+        # accepted_tokens counts how many of those the target kept
+        self.accepted_tokens = 0
+        self.draft_tokens = 0
 
     # -- producer side (worker thread) ----------------------------------
     def _emit(self, tok: int) -> float:
@@ -779,6 +785,13 @@ class ContinuousBatcher(DynamicBatcher):
         self._step = 0
         self._tokens_emitted = 0
         self._peak_slots = 0
+        # speculative decoding totals (see serving/metrics.py): verify
+        # dispatches, tokens emitted from them, and draft proposals made
+        self._spec_dispatches = 0
+        self._spec_slot_steps = 0   # (live slot, dispatch) pairs
+        self._spec_emitted = 0
+        self._spec_accepted = 0
+        self._spec_drafted = 0
         self._kv_starved_sweeps = 0
         self._kv_starve_threshold = max(1, getenv_int(
             "MXNET_SERVE_KV_STARVE_SWEEPS", 3))
@@ -1013,7 +1026,10 @@ class ContinuousBatcher(DynamicBatcher):
                 live = [(s, r) for s, r in enumerate(self._slots)
                         if r is not None]
             if live:
-                self._decode_once(gen, live)
+                if getattr(self.engine, "draft", None) is not None:
+                    self._spec_once(gen, live)
+                else:
+                    self._decode_once(gen, live)
 
     def _join(self, slot: int, req: _GenRequest, gen: int):
         """Admit one request mid-flight: its prefill dispatch runs
@@ -1075,6 +1091,83 @@ class ContinuousBatcher(DynamicBatcher):
             self._emit(r, int(nxt[s]))
             if self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
+
+    def _spec_once(self, gen: int, live):
+        """ONE speculative step for every slot: k draft dispatches plus
+        ONE k+1-wide verify advance each live slot by 1..k+1 tokens.
+        Token-for-token identical to :meth:`_decode_once` — only the
+        grouping into dispatches changes.  Join/leave stays at step
+        boundaries, so a stream that joined mid-flight never observes a
+        neighbor's rejected-token rollback (rollback happens inside
+        ``spec_step``, before any rider's next dispatch)."""
+        import numpy as _np
+        S = int(self.engine.max_slots)
+        k = int(self.engine.spec_k)
+        last = _np.zeros(S, _np.int32)
+        pos = _np.zeros(S, _np.int32)
+        for s, r in live:
+            last[s] = r.tokens_out[-1]
+            pos[s] = r.n + len(r.tokens_out) - 1
+        rids = [r.request_id for _, r in live]
+        _m.BATCHES.inc(model=self.name)
+        _m.BATCH_SIZE.observe(len(live))
+
+        def run():
+            _fault.inject("serving.infer", model=self.name,
+                          request_ids=rids)
+            if self._current_gen() != gen:
+                raise _lc.RequestAborted(
+                    f"{self.name}: stale worker generation")
+            return self.engine.spec_step(last, pos)
+
+        t0 = time.monotonic()
+        try:
+            burst, accepted = _fault.retry_call(
+                run, site="serving.infer", policy=self.retry_policy)
+        except Exception as e:
+            self._decode_failed(gen, live, e)
+            return
+        dt = time.monotonic() - t0
+        _m.DECODE_STEP.observe(dt)
+        _m.SPEC_STEP.observe(dt)
+        self._avg_batch_seconds = dt if self._avg_batch_seconds <= 0.0 \
+            else 0.8 * self._avg_batch_seconds + 0.2 * dt
+        self._degraded = False
+        self.breaker.record_success()
+        # accounting lives HERE, not in the engine: free slots ride
+        # along in the dispatch at position 0 and their accepts are
+        # meaningless.  Of a request's emitted burst, everything past
+        # the first token is a draft proposal the target kept — a
+        # budget/eos cut mid-burst caps the accepted count to match.
+        self._spec_dispatches += 1
+        step_emitted = 0
+        step_accepted = 0
+        for s, r in live:
+            n_emit = 0
+            for j in range(int(accepted[s]) + 1):
+                self._emit(r, int(burst[s, j]))
+                n_emit += 1
+                if self._maybe_finished(r):
+                    self._free_slot(s, r, "finished")
+                    break
+            r.draft_tokens += k
+            r.accepted_tokens += n_emit - 1
+            step_emitted += n_emit
+            step_accepted += n_emit - 1
+        self._spec_emitted += step_emitted
+        self._spec_accepted += step_accepted
+        self._spec_drafted += len(live) * k
+        self._spec_slot_steps += len(live)
+        _m.SPEC_DISPATCHES.inc(model=self.name)
+        _m.SPEC_DRAFT_TOKENS.inc(len(live) * k, model=self.name)
+        _m.SPEC_ACCEPTED_TOKENS.inc(step_accepted, model=self.name)
+        # per live slot per verify dispatch: 1.0 means the draft never
+        # helps, k+1 is the ceiling (full accept + bonus token)
+        _m.SPEC_TOKENS_PER_DISPATCH.set(
+            self._spec_emitted / self._spec_slot_steps, model=self.name)
+        _m.SPEC_ACCEPT_RATE.set(
+            self._spec_accepted / max(1, self._spec_drafted),
+            model=self.name)
 
     # -- step-boundary helpers ------------------------------------------
     def _emit(self, req: _GenRequest, tok: int):
@@ -1217,6 +1310,18 @@ class ContinuousBatcher(DynamicBatcher):
                 "kv_cache_bytes": int(self.engine.cache_bytes),
                 "kv_starved": self.kv_starved,
             })
+            if getattr(self.engine, "draft", None) is not None:
+                out.update({
+                    "spec_k": int(self.engine.spec_k),
+                    "spec_draft_model": self.engine.draft.name,
+                    "spec_dispatches": self._spec_dispatches,
+                    "accepted_tokens_per_dispatch":
+                        self._spec_emitted
+                        / max(1, self._spec_slot_steps),
+                    "spec_accept_rate":
+                        self._spec_accepted
+                        / max(1, self._spec_drafted),
+                })
             ks = getattr(self.engine, "kv_stats", None)
             if ks is not None:
                 out.update(ks())

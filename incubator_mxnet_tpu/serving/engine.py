@@ -578,6 +578,8 @@ class GenerationEngine:
                 self._prefill_ext_jit)
             self._decode_jit = jax.jit(self._decode_paged_pure,
                                        donate_argnums=(0,))
+            self._verify_jit = jax.jit(self._verify_paged_pure,
+                                       donate_argnums=(0,))
         else:
             self._prefill_jit = jax.jit(self._prefill_pure,
                                         donate_argnums=(0,))
@@ -585,10 +587,20 @@ class GenerationEngine:
             self._prefill_ext = None
             self._decode_jit = jax.jit(self._decode_pure,
                                        donate_argnums=(0,))
+            self._verify_jit = jax.jit(self._verify_pure,
+                                       donate_argnums=(0,))
         self._prefill = _telemetry.instrument_jit(
             "serving:" + self.name + ":prefill", self._prefill_jit)
         self._decode = _telemetry.instrument_jit(
             "serving:" + self.name + ":decode", self._decode_jit)
+        self._verify = _telemetry.instrument_jit(
+            "serving:" + self.name + ":verify", self._verify_jit)
+        # speculative decoding: a draft engine attached via attach_draft
+        # proposes spec_k tokens per slot; THE verify program scores all
+        # spec_k + 1 positions in one dispatch (exactly one extra
+        # compiled program — Q is baked from spec_k, never per-request)
+        self.draft: Optional["GenerationEngine"] = None
+        self.spec_k = 0
         self._warmup_done = False
         self.reset()
 
@@ -704,6 +716,57 @@ class GenerationEngine:
 
         logits = self._with_params(param_vals, aux_vals, key, body)
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return tuple(caches), nxt
+
+    def _verify_pure(self, cache, tokens, positions,
+                     param_vals, aux_vals, key):
+        """The speculative-decode VERIFY program: a k+1-wide
+        generalization of :meth:`_decode_pure`.  ``tokens`` (S, Q) int32
+        — row 0 is each slot's last accepted token, rows 1..Q-1 the
+        draft's proposals; ``positions`` (S,) int32 the base write head.
+        Scatters Q K/V writes per slot per layer (positions past
+        ``max_len`` drop — overrun rows near the budget edge must not
+        stomp a live entry), attends via
+        :func:`kernels.flash_attention.verify_decode_attention`, and
+        returns (cache', argmax (S, Q)): the target's next token AFTER
+        each of the Q positions.  With Q == 1 this is exactly decode."""
+        import jax.numpy as jnp
+        from ..kernels.flash_attention import verify_decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S, Q = tokens.shape
+        C = H * D
+        caches = list(cache)
+        rows = jnp.arange(S)
+        pos_q = positions[:, None] \
+            + jnp.arange(Q, dtype=jnp.int32)[None, :]          # (S, Q)
+
+        def body():
+            pos_nd = NDArray(jnp.minimum(pos_q, self.max_len - 1))
+            x = self.block.embed(NDArray(tokens)) \
+                + self.block.pos_embed(pos_nd)
+            h = self.block.drop(x)
+            for l, cell in enumerate(self._cells):
+                at = cell.attention
+                hn = cell.ln1(h)
+                q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                qh = q._data.reshape(S, Q, H, D).transpose(0, 2, 1, 3)
+                knh = kn._data.reshape(S, Q, H, D)
+                vnh = vn._data.reshape(S, Q, H, D)
+                ck = caches[l].at[rows[:, None], :, pos_q].set(
+                    knh.astype(caches[l].dtype), mode="drop")
+                cv = caches[L + l].at[rows[:, None], :, pos_q].set(
+                    vnh.astype(caches[L + l].dtype), mode="drop")
+                caches[l], caches[L + l] = ck, cv
+                attn = verify_decode_attention(qh, ck, cv, positions)
+                out_nd = NDArray(attn.transpose(0, 2, 1, 3).reshape(
+                    S, Q, C).astype(h._data.dtype))
+                h = h + at.dropout(at.proj(out_nd))
+                h = h + cell._ffn_out(cell.ln2(h))
+            logits = self.block._project(self.block.ln_f(h))
+            return logits._data
+
+        logits = self._with_params(param_vals, aux_vals, key, body)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return tuple(caches), nxt
 
     # -- pure programs, paged layout ------------------------------------
@@ -873,6 +936,60 @@ class GenerationEngine:
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
         return tuple(caches), nxt
 
+    def _verify_paged_pure(self, cache, tokens, positions, tables,
+                           param_vals, aux_vals, key):
+        """The verify program, paged: :meth:`_verify_pure` with each
+        slot's Q writes routed through its block table.  Positions past a
+        slot's reservation (table padding) or past ``max_len`` redirect
+        to the null block — overrun rows near the budget edge land in
+        the sink, never in a neighbor's block."""
+        import jax.numpy as jnp
+        from ..kernels.flash_attention import paged_verify_decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S, Q = tokens.shape
+        C = H * D
+        bs = self.block_size
+        NB = self.max_blocks_per_slot
+        caches = list(cache)
+        rows = jnp.arange(S)
+        pos_q = positions[:, None] \
+            + jnp.arange(Q, dtype=jnp.int32)[None, :]          # (S, Q)
+        col = pos_q // bs
+        ok = (col < NB) & (pos_q < self.max_len)
+        blk = jnp.where(ok, tables[rows[:, None],
+                                   jnp.minimum(col, NB - 1)], 0)  # (S, Q)
+        off = pos_q % bs                                          # (S, Q)
+
+        def body():
+            pos_nd = NDArray(jnp.minimum(pos_q, self.max_len - 1))
+            x = self.block.embed(NDArray(tokens)) \
+                + self.block.pos_embed(pos_nd)
+            h = self.block.drop(x)
+            for l, cell in enumerate(self._cells):
+                at = cell.attention
+                hn = cell.ln1(h)
+                q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                qh = q._data.reshape(S, Q, H, D).transpose(0, 2, 1, 3)
+                knh = kn._data.reshape(S, Q, H, D)
+                vnh = vn._data.reshape(S, Q, H, D)
+                ck = caches[l].at[blk, :, off].set(
+                    knh.astype(caches[l].dtype))
+                cv = caches[L + l].at[blk, :, off].set(
+                    vnh.astype(caches[L + l].dtype))
+                caches[l], caches[L + l] = ck, cv
+                attn = paged_verify_decode_attention(qh, ck, cv, tables,
+                                                     positions)
+                out_nd = NDArray(attn.transpose(0, 2, 1, 3).reshape(
+                    S, Q, C).astype(h._data.dtype))
+                h = h + at.dropout(at.proj(out_nd))
+                h = h + cell._ffn_out(cell.ln2(h))
+            logits = self.block._project(self.block.ln_f(h))
+            return logits._data
+
+        logits = self._with_params(param_vals, aux_vals, key, body)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tuple(caches), nxt
+
     # -- cache lifecycle ------------------------------------------------
     def reset(self):
         """(Re)allocate the cache: all slots free, all rows zero.  Called
@@ -882,6 +999,8 @@ class GenerationEngine:
         block pool, every block table, and the prefix cache — cached K/V
         must never outlive the params that computed it."""
         import jax.numpy as jnp
+        if getattr(self, "draft", None) is not None:
+            self.draft.reset()
         if self.paged:
             N, H, bs, D = (self.num_blocks, self.num_heads,
                            self.block_size, self.head_dim)
@@ -953,6 +1072,18 @@ class GenerationEngine:
             raise MXNetError(
                 f"{self.name}: prompt length {n} leaves no room to "
                 f"generate (max_len {self.max_len})")
+        if self.draft is not None:
+            # The draft mirrors the target's slot layout: prefill it with
+            # the same prompt so its write head tracks ours.  Its own
+            # first-token output is discarded — only the target's argmax
+            # is ever emitted.  Reserve spec_k extra positions on BOTH
+            # engines: a verify near the budget edge writes up to k
+            # positions past the last consumed token.
+            self.draft._warming = self._warming
+            self.draft.prefill(toks, slot,
+                               reserve_tokens=int(
+                                   reserve_tokens or self.max_len)
+                               + self.spec_k)
         if not self.paged:
             bucket = self.prefill_bucket_for(n)
             padded = _np.zeros((1, bucket), _np.int32)
@@ -969,7 +1100,8 @@ class GenerationEngine:
         slot = int(slot)
         if self._slot_blocks[slot]:
             self.release_slot(slot)
-        reserve = int(reserve_tokens or self.max_len)
+        reserve = int(reserve_tokens or self.max_len) \
+            + (self.spec_k if self.draft is not None else 0)
         reserve = max(n + 1, min(reserve, self.max_len))
         table, m = self.pool.allocate(toks, n, reserve,
                                       share=not self._warming)
@@ -1038,10 +1170,133 @@ class GenerationEngine:
         self._cache = cache
         return _np.asarray(nxt)
 
+    # -- speculative decoding -------------------------------------------
+    def attach_draft(self, draft: "GenerationEngine",
+                     spec_k: Optional[int] = None) -> None:
+        """Attach a (small) draft engine for speculative decoding.
+
+        The draft proposes ``spec_k`` tokens per slot (default
+        ``MXNET_SPEC_K``); the target scores all ``spec_k + 1`` positions
+        in ONE verify dispatch.  The draft must mirror the target's slot
+        layout and position space — same ``max_slots``, ``max_len`` at
+        least the target's, same vocabulary (argmax ids are compared).
+        Attach BEFORE :meth:`warmup` so the verify program joins the
+        warmed set."""
+        from ..base import getenv_int
+        if draft is self:
+            raise MXNetError(f"{self.name}: a model cannot draft itself")
+        if int(draft.max_slots) != self.max_slots:
+            raise MXNetError(
+                f"{self.name}: draft max_slots {draft.max_slots} != "
+                f"target max_slots {self.max_slots}")
+        if int(draft.max_len) < self.max_len:
+            raise MXNetError(
+                f"{self.name}: draft max_len {draft.max_len} < target "
+                f"max_len {self.max_len} (the draft decodes at the same "
+                f"positions)")
+        tv = getattr(self.block, "_vocab_size", None)
+        dv = getattr(draft.block, "_vocab_size", None)
+        if tv is not None and dv is not None and int(tv) != int(dv):
+            raise MXNetError(
+                f"{self.name}: draft vocab {dv} != target vocab {tv}")
+        k = int(spec_k if spec_k is not None
+                else getenv_int("MXNET_SPEC_K", 4))
+        if k < 1:
+            raise MXNetError(f"spec_k must be >= 1, got {k}")
+        self.draft = draft
+        self.spec_k = k
+
+    def verify(self, tokens, positions):
+        """Score ``spec_k + 1`` positions for EVERY slot in one dispatch:
+        ``tokens`` (S, Q) int32 — column 0 each slot's last accepted
+        token, columns 1..Q-1 the draft proposals; ``positions`` (S,)
+        int32 base write heads.  Returns the target's argmax (S, Q) as a
+        host array: ``out[s, j]`` is the next token after consuming
+        ``tokens[s, :j + 1]``."""
+        import jax.numpy as jnp
+        toks = _np.asarray(tokens, _np.int32).reshape(self.max_slots, -1)
+        lt = jnp.asarray(toks)
+        pos = jnp.asarray(_np.asarray(positions, _np.int32).reshape(
+            self.max_slots))
+        if self.paged:
+            if self._tables_dev is None:
+                self._tables_dev = jnp.asarray(self._tables)
+            cache, out = self._guarded(self._verify, lt, pos,
+                                       self._tables_dev)
+        else:
+            cache, out = self._guarded(self._verify, lt, pos)
+        self._cache = cache
+        return _np.asarray(out)
+
+    def spec_step(self, last_tokens, positions):
+        """One speculative step for EVERY slot: ``spec_k`` draft decode
+        dispatches propose tokens autoregressively, then ONE target
+        verify dispatch scores all ``spec_k + 1`` positions.  Greedy
+        acceptance: the longest prefix where draft argmax == target
+        argmax, plus the target's bonus token.
+
+        Returns ``(out, accepted)``: ``out`` (S, spec_k + 1) int32 —
+        ``out[s, :accepted[s] + 1]`` are this step's emitted tokens,
+        every one of them a target argmax (bit-identical to plain
+        decode by construction); ``accepted`` (S,) int64 in
+        ``[0, spec_k]`` counts the draft tokens accepted per slot.
+        Rejected positions' K/V is rolled back: the cursor simply does
+        not advance past them (stale entries are masked and then
+        overwritten by the next dispatch at the same position), and in
+        paged mode the pool's :meth:`~.kvcache.BlockPool.rewind` COW
+        guard keeps the overwrite out of any shared block."""
+        if self.draft is None:
+            raise MXNetError(f"{self.name}: no draft attached "
+                             "(attach_draft first)")
+        k = self.spec_k
+        S = self.max_slots
+        last = _np.asarray(last_tokens, _np.int32).reshape(S)
+        pos = _np.asarray(positions, _np.int32).reshape(S)
+        drafted = _np.zeros((S, k), _np.int32)
+        lt, pv = last, pos
+        for j in range(k):
+            nxt = _np.asarray(self.draft.decode(lt, pv),
+                              _np.int32).reshape(S)
+            drafted[:, j] = nxt
+            lt, pv = nxt, pv + 1
+        toks = _np.concatenate([last[:, None], drafted], axis=1)
+        out = self.verify(toks, pos)
+        match = out[:, :k] == drafted                          # (S, k)
+        accepted = _np.where(match.all(axis=1), k,
+                             _np.argmin(match, axis=1))
+        if self.paged or self.draft.paged:
+            self._rollback_rejected(pos, accepted)
+        return out, accepted
+
+    def _rollback_rejected(self, base_positions, accepted) -> None:
+        """Paged rollback after a verify: for every slot that rejected
+        draft tokens, run the pool's COW guard over the dirty tail so
+        the next dispatch's overwrites cannot touch a shared block.
+        Block tables are per-slot operands, so a neighbor never observes
+        another slot's rollback."""
+        for s in range(self.max_slots):
+            if int(accepted[s]) >= self.spec_k:
+                continue
+            keep = int(base_positions[s]) + int(accepted[s]) + 1
+            for eng in (self, self.draft):
+                if not eng.paged or not eng._slot_blocks[s]:
+                    continue
+                blocks = eng._slot_blocks[s]
+                new = eng.pool.rewind(blocks, keep)
+                if new != blocks:
+                    eng._slot_blocks[s] = new
+                    row = _np.zeros(eng.max_blocks_per_slot, _np.int32)
+                    row[:len(new)] = new
+                    eng._tables[s] = row
+                    eng._tables_dev = None
+
     # -- paged-pool bookkeeping (no-ops in dense mode) -------------------
     def release_slot(self, slot: int) -> None:
         """Return ``slot``'s blocks to the pool (decref — shared prefix
-        blocks stay live for their other readers / the prefix cache)."""
+        blocks stay live for their other readers / the prefix cache).
+        Cascades to the draft engine's mirrored slot."""
+        if self.draft is not None:
+            self.draft.release_slot(slot)
         if not self.paged:
             return
         blocks = self._slot_blocks[int(slot)]
@@ -1056,12 +1311,20 @@ class GenerationEngine:
         """Admission check: can the pool reserve worst-case capacity for
         this prompt right now?  ``reserved_blocks`` discounts capacity
         promised to earlier admits in the same scheduling step.  Dense
-        mode always admits (capacity == slots there)."""
+        mode always admits (capacity == slots there).  With a draft
+        attached both pools must fit the reservation (plus the spec_k
+        verify headroom)."""
+        if self.draft is not None and not self.draft.can_admit(
+                tokens, int(reserve_tokens) + self.spec_k,
+                reserved_blocks):
+            return False
         if not self.paged:
             return True
         toks = _np.asarray(tokens, _np.int32).reshape(-1)
         n = int(toks.shape[0])
-        reserve = max(n + 1, min(int(reserve_tokens), self.max_len))
+        reserve = int(reserve_tokens) \
+            + (self.spec_k if self.draft is not None else 0)
+        reserve = max(n + 1, min(reserve, self.max_len))
         return self.pool.can_admit(toks, n, reserve, reserved_blocks)
 
     def reserve_estimate(self, reserve_tokens: int) -> int:
@@ -1071,8 +1334,9 @@ class GenerationEngine:
         if not self.paged:
             return 0
         from .kvcache import blocks_for
-        return blocks_for(min(int(reserve_tokens), self.max_len),
-                          self.block_size)
+        reserve = int(reserve_tokens) \
+            + (self.spec_k if self.draft is not None else 0)
+        return blocks_for(min(reserve, self.max_len), self.block_size)
 
     def kv_capacity_tokens(self) -> int:
         """Total token positions the KV cache can hold across all
@@ -1096,10 +1360,13 @@ class GenerationEngine:
     @property
     def expected_programs(self) -> int:
         """Size of the CLOSED program set: one prefill per bucket (plus
-        one suffix-prefill per bucket when the prefix cache can hit) and
-        ONE decode."""
+        one suffix-prefill per bucket when the prefix cache can hit),
+        ONE decode, and — with a draft attached — ONE verify (the
+        query width is baked from ``spec_k``, so no per-accept-length
+        programs exist)."""
         per_bucket = 2 if self.prefix_cache_enabled else 1
-        return per_bucket * len(self.prefill_buckets) + 1
+        return per_bucket * len(self.prefill_buckets) + 1 \
+            + (1 if self.draft is not None else 0)
 
     def warmup(self) -> int:
         """AOT-compile the whole closed program set — every prefill
@@ -1128,16 +1395,24 @@ class GenerationEngine:
                     self._cache = cache
             self.decode(_np.zeros(self.max_slots, _np.int32),
                         _np.zeros(self.max_slots, _np.int32))
+            if self.draft is not None:
+                self.verify(
+                    _np.zeros((self.max_slots, self.spec_k + 1),
+                              _np.int32),
+                    _np.zeros(self.max_slots, _np.int32))
         finally:
             self._warming = False
         self.reset()
+        if self.draft is not None:
+            self.draft.warmup()
         self._warmup_done = True
         return self.expected_programs
 
     def compiled_programs(self) -> int:
         try:
             n = int(self._prefill_jit._cache_size()) \
-                + int(self._decode_jit._cache_size())
+                + int(self._decode_jit._cache_size()) \
+                + int(self._verify_jit._cache_size())
             if self._prefill_ext_jit is not None:
                 n += int(self._prefill_ext_jit._cache_size())
             return n
@@ -1152,10 +1427,14 @@ class GenerationEngine:
 
     # -- reference path --------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 32,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 speculative: Optional[bool] = None):
         """Solo generation through the SERVING programs (slot 0) — the
         engine-level convenience used by tests and the bench; the
-        continuous batcher drives the same programs for many slots."""
+        continuous batcher drives the same programs for many slots.
+        With a draft attached the speculative step loop is the default
+        (``speculative=False`` forces plain decode); every emitted token
+        is a target argmax either way, so the outputs are identical."""
         toks = list(_np.asarray(tokens, _np.int32).reshape(-1))
         n = len(toks)
         budget = min(int(max_new_tokens), self.max_len - n)
@@ -1163,18 +1442,27 @@ class GenerationEngine:
             raise MXNetError(
                 f"{self.name}: no token budget (prompt {n}, max_len "
                 f"{self.max_len})")
+        spec = self.draft is not None if speculative is None \
+            else bool(speculative) and self.draft is not None
         out = [self.prefill(toks, 0, reserve_tokens=n + budget)]
         try:
-            pos = n
             lt = _np.zeros(self.max_slots, _np.int32)
             pv = _np.zeros(self.max_slots, _np.int32)
             while len(out) < budget and (eos_id is None
                                          or out[-1] != int(eos_id)):
                 lt[0] = out[-1]
-                pv[0] = pos
-                nxt = self.decode(lt, pv)
-                out.append(int(nxt[0]))
-                pos += 1
+                pv[0] = n + len(out) - 1
+                if spec:
+                    burst, acc = self.spec_step(lt, pv)
+                    for j in range(int(acc[0]) + 1):
+                        out.append(int(burst[0, j]))
+                        if len(out) >= budget or (
+                                eos_id is not None
+                                and out[-1] == int(eos_id)):
+                            break
+                else:
+                    nxt = self.decode(lt, pv)
+                    out.append(int(nxt[0]))
         finally:
             self.release_slot(0)
         return out

@@ -80,6 +80,7 @@ class BlockPool:
         self.hits = 0            # blocks reused from the prefix cache
         self.evictions = 0       # idle cached blocks reclaimed (LRU)
         self.cow_copies = 0      # copy_on_write calls that actually copied
+        self.rewinds = 0         # rewind() calls that had work to do
         self.reset()
 
     # -- state ------------------------------------------------------------
@@ -293,6 +294,49 @@ class BlockPool:
             self._update_gauges()
             return new
 
+    def rewind(self, table: Sequence[int], keep_tokens: int) -> List[int]:
+        """Prepare ``table`` for overwriting every position
+        ``>= keep_tokens`` (speculative-decode rollback: rejected draft
+        positions will be re-written by the next dispatch).
+
+        No block is ever freed — the reservation stays intact, and blocks
+        holding only kept positions (the shared prefix among them) are
+        untouched.  Blocks in the dirty span that are shared (refcount
+        > 1) or published in the prefix cache get :meth:`copy_on_write`
+        treatment so the overwrite cannot corrupt a neighbor's view;
+        the returned table carries any replacement ids.
+
+        The serving flow only ever writes past the prompt, and only full
+        immutable prompt blocks are shared/published, so the COW branch
+        is a contract guard rather than a hot path.  A shared block that
+        also holds kept positions cannot be rolled back on the host alone
+        (the private copy would lose the kept K/V) — that state is
+        unreachable through the engine and raises.
+        """
+        keep_tokens = max(0, int(keep_tokens))
+        bs = self.block_size
+        with self._lock:
+            out = list(table)
+            first = keep_tokens // bs   # first block with a dirty position
+            touched = False
+            for i in range(first, len(out)):
+                b = out[i]
+                if b == NULL_BLOCK:
+                    continue
+                if self._ref[b] <= 1 and self._hash[b] is None:
+                    continue
+                if i * bs < keep_tokens:
+                    raise MXNetError(
+                        f"rewind would copy-on-write block {b} holding "
+                        f"kept positions (keep={keep_tokens}); decode "
+                        f"writes must never land in shared prompt blocks")
+                out[i] = self.copy_on_write(b)
+                touched = True
+            if touched:
+                self.rewinds += 1
+                self._update_gauges()
+            return out
+
     # -- internals --------------------------------------------------------
     def _incref(self, b: int) -> None:
         self._ref[b] += 1
@@ -329,4 +373,5 @@ class BlockPool:
                 "prefix_cache": self.prefix_cache,
                 "prefix_cache_hits": self.hits,
                 "prefix_cache_evictions": self.evictions,
+                "rewinds": self.rewinds,
             }

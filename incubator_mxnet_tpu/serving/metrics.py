@@ -53,6 +53,17 @@ PREFIX_CACHE_HITS = _telemetry.registry.counter(
 PREFIX_CACHE_EVICTIONS = _telemetry.registry.counter(
     "mxtpu_prefix_cache_evictions",
     "idle cached KV blocks evicted (LRU) to satisfy new allocations")
+SPEC_DISPATCHES = _telemetry.registry.counter(
+    "mxtpu_spec_verify_dispatches",
+    "speculative-decoding verify dispatches (one k+1-wide target "
+    "forward scoring all drafted positions at once)")
+SPEC_DRAFT_TOKENS = _telemetry.registry.counter(
+    "mxtpu_spec_draft_tokens",
+    "tokens proposed by the draft model, per target model")
+SPEC_ACCEPTED_TOKENS = _telemetry.registry.counter(
+    "mxtpu_spec_accepted_tokens",
+    "drafted tokens the target model accepted and emitted (excludes "
+    "the guaranteed bonus token per dispatch)")
 
 # router (serving/router.py; labeled by replica where it matters) ----------
 ROUTER_REQUESTS = _telemetry.registry.counter(
@@ -120,6 +131,11 @@ DECODE_STEP = _telemetry.registry.histogram(
     "mxtpu_generate_decode_step_seconds",
     "seconds per continuous-batching decode dispatch (all live slots "
     "advance one token)")
+SPEC_STEP = _telemetry.registry.histogram(
+    "mxtpu_spec_step_seconds",
+    "seconds per speculative step (k draft dispatches plus one verify; "
+    "compare with mxtpu_generate_decode_step_seconds for the draft "
+    "overhead per accepted-token burst)")
 ROUTER_UPSTREAM = _telemetry.registry.histogram(
     "mxtpu_router_upstream_seconds",
     "seconds per upstream attempt (router -> replica), successful or "
@@ -149,6 +165,14 @@ MODEL_STATE = _telemetry.registry.gauge(
     "mxtpu_serve_model_state",
     "per-model serving state (0 SERVING, 1 STARTING, 2 DEGRADED, "
     "3 UNHEALTHY, 4 DRAINING)")
+SPEC_TOKENS_PER_DISPATCH = _telemetry.registry.gauge(
+    "mxtpu_spec_accepted_tokens_per_dispatch",
+    "tokens emitted per verify dispatch, cumulative per model "
+    "(1.0 would mean the draft never helps; k+1 is the ceiling)")
+SPEC_ACCEPT_RATE = _telemetry.registry.gauge(
+    "mxtpu_spec_accept_rate",
+    "fraction of drafted tokens the target accepted, cumulative per "
+    "model (tune MXNET_SPEC_K down when this drops)")
 
 # SLO plane (serving/slo.py; docs/observability.md) -------------------------
 SLO_AVAILABILITY = _telemetry.registry.gauge(

@@ -265,6 +265,10 @@ class _Handler(BaseJSONHandler):
             if not stream:
                 toks = req.result()
                 self.send_json(200, {"tokens": toks, "count": len(toks),
+                                     "accepted_tokens":
+                                         int(req.accepted_tokens),
+                                     "draft_tokens":
+                                         int(req.draft_tokens),
                                      "request_id": req.request_id})
                 return
             self.start_stream(200)
@@ -274,6 +278,9 @@ class _Handler(BaseJSONHandler):
                                     event="token")
                 self.send_event({"tokens": list(req.tokens_out),
                                  "count": len(req.tokens_out),
+                                 "accepted_tokens":
+                                     int(req.accepted_tokens),
+                                 "draft_tokens": int(req.draft_tokens),
                                  "request_id": req.request_id},
                                 event="done")
             except (BrokenPipeError, ConnectionError, OSError):
@@ -566,6 +573,26 @@ class ModelServer:
             self._last_http = time.monotonic()
 
     # -- lifecycle ------------------------------------------------------
+    def preload(self) -> "ModelServer":
+        """Synchronously AOT-compile every registered model's full
+        program set — all prefill buckets, the decode program, and the
+        speculative ``verify`` program when a draft is attached —
+        BEFORE :meth:`start` binds the port (``mxtpu-serve
+        --preload``).  A replica started this way never answers
+        ``/readyz`` 200 with a cold program cache: the router's
+        cold-start drill asserts first-token latency matches steady
+        state.  Idempotent; engines that are already warm are
+        skipped."""
+        with self._lock:
+            batchers = sorted(self._models.items())
+        for name, b in batchers:
+            eng = getattr(b, "engine", None)
+            wu = getattr(eng, "warmup", None)
+            if wu is None or getattr(eng, "warm", False):
+                continue
+            wu()
+        return self
+
     def start(self) -> "ModelServer":
         """Bind and serve in daemon threads; returns self.  ``port=0``
         binds an ephemeral port (see :attr:`port`).  Also starts the

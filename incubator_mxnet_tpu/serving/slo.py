@@ -109,7 +109,14 @@ class ModelSLO:
         """Fold one inter-token emission gap into the token window
         (recorded by ``ContinuousBatcher`` per emitted token).  Gauges
         refresh on the next :meth:`record` — per-token gauge updates
-        would cost a sort per decode step per slot."""
+        would cost a sort per decode step per slot.
+
+        Under speculative decoding tokens arrive in bursts of 1..k+1
+        per verify dispatch: the first token of a burst carries the
+        whole step's latency and the rest land with near-zero gaps.
+        That is exactly what a streaming client observes, so the
+        token-latency SLI keeps the raw gaps — a p99 over them rewards
+        high accept rates instead of hiding them."""
         with self._lock:
             self._token_window.append(float(gap_seconds))
 
