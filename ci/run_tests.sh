@@ -109,8 +109,20 @@ usage: ci/run_tests.sh <function>
                         exactly ONE incident bundle written, naming the
                         request ids that failed on the hung replica
   multichip_dryrun      8-virtual-device full-train-step compile+run
+  static                mxtpu-lint static analysis (host-sync, donation,
+                        closed-program-set, lock-discipline,
+                        registry-drift; see docs/static_analysis.md)
+                        plus the numpy-API audit — fails on any
+                        unsuppressed finding
 EOF
     exit 1
+}
+
+static() {
+    # stdlib-only: runs without jax. Lint first (includes the
+    # code<->docs registry-drift pass), then the numpy surface audit.
+    python tools/mxtpu_lint.py incubator_mxnet_tpu
+    python tools/np_audit.py --check
 }
 
 unittest_cpu() {

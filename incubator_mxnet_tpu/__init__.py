@@ -68,6 +68,8 @@ from .feedforward import FeedForward
 from . import runtime
 from . import contrib
 
+base.log_compat_env_once()
+
 __all__ = ["MXNetError", "MXTPUError", "Context", "Device", "cpu", "gpu",
            "tpu", "cpu_pinned", "cpu_shared", "current_context",
            "current_device", "num_gpus", "num_tpus", "nd", "ndarray",

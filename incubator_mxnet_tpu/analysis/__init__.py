@@ -1,0 +1,13 @@
+"""mxtpu-lint: JAX/TPU-aware static analysis for this repo.
+
+Stdlib-only (``ast``-based) so it runs in CI without importing jax or
+the package under analysis.  See ``docs/static_analysis.md``.
+"""
+from .core import (BASELINE_FILENAME, Baseline, Checker, FileContext,
+                   Finding, collect_files, default_checkers, find_root,
+                   run_checks)
+
+__all__ = [
+    "BASELINE_FILENAME", "Baseline", "Checker", "FileContext", "Finding",
+    "collect_files", "default_checkers", "find_root", "run_checks",
+]

@@ -1,0 +1,116 @@
+"""Small shared AST helpers for the mxtpu-lint checkers."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def attr_tail(node: ast.expr) -> Optional[str]:
+    """Last component of a Name/Attribute chain (``self.a.b`` -> ``b``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def attr_parts(node: ast.expr) -> List[str]:
+    """Components of a Name/Attribute chain, outermost first
+    (``self.engine.decode`` -> ``["self", "engine", "decode"]``)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def dotted(node: ast.expr) -> str:
+    return ".".join(attr_parts(node))
+
+
+def iter_functions(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield ``(qualname, node)`` for every function/method, including
+    nested defs (qualname uses ``.`` between scopes)."""
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` over a function body WITHOUT descending into nested
+    function/class definitions (those are visited as their own
+    scopes)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def is_docstring_const(parent: ast.AST, node: ast.AST) -> bool:
+    body = getattr(parent, "body", None)
+    return (isinstance(parent, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                                ast.AsyncFunctionDef))
+            and bool(body)
+            and isinstance(body[0], ast.Expr)
+            and body[0].value is node)
+
+
+def string_constants(tree: ast.AST, skip_docstrings: bool = True
+                     ) -> Iterator[Tuple[str, int]]:
+    """Yield ``(value, lineno)`` for every string literal, optionally
+    skipping docstrings.  Implicitly-concatenated adjacent literals are
+    one ``ast.Constant`` already."""
+    doc_ids = set()
+    if skip_docstrings:
+        for node in ast.walk(tree):
+            body = getattr(node, "body", None)
+            if (isinstance(node, (ast.Module, ast.ClassDef,
+                                  ast.FunctionDef, ast.AsyncFunctionDef))
+                    and body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                doc_ids.add(id(body[0].value))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) in doc_ids:
+                continue
+            yield node.value, node.lineno
+
+
+def const_int_tuple(node: ast.expr) -> Optional[Tuple[int, ...]]:
+    """Evaluate a literal int / tuple-of-ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) \
+                    and isinstance(elt.value, int) \
+                    and not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def call_keywords(call: ast.Call) -> Dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}

@@ -1,0 +1,116 @@
+"""``mxtpu-lint`` command-line entry point.
+
+Usage::
+
+    mxtpu-lint incubator_mxnet_tpu/            # lint, exit 1 on findings
+    mxtpu-lint --checks lock-discipline pkg/   # subset of checkers
+    mxtpu-lint --write-baseline pkg/           # snapshot current findings
+    mxtpu-lint --format json pkg/              # machine-readable output
+
+The baseline (``.mxtpu-lint-baseline.json`` at the repo root, or
+``--baseline PATH``) suppresses known-intentional findings; every entry
+carries a one-line justification.  Inline ``# mxtpu-lint:
+disable=<check>`` pragmas are applied before the baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import (BASELINE_FILENAME, Baseline, collect_files,
+                   default_checkers, find_root, line_text_lookup,
+                   run_checks)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mxtpu-lint",
+        description="JAX/TPU-aware static analysis for mxnet-tpu "
+                    "(host-sync, donation, closed-program-set, "
+                    "lock-discipline, registry-drift).")
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to lint")
+    p.add_argument("--checks", default=None,
+                   help="comma-separated subset of check names")
+    p.add_argument("--list-checks", action="store_true",
+                   help="list available checks and exit")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: "
+                        f"{BASELINE_FILENAME} at the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="parallel file-walk workers")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print baselined findings (marked)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_checks:
+        for c in default_checkers():
+            print(c.name)
+        return 0
+    if not args.paths:
+        _parser().error("no paths given")
+
+    files = collect_files(args.paths)
+    if not files:
+        print("mxtpu-lint: no python files under "
+              + ", ".join(args.paths), file=sys.stderr)
+        return 2
+    root = find_root(files[0])
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    try:
+        findings = run_checks(args.paths, checks=checks, root=root,
+                              jobs=args.jobs)
+    except ValueError as exc:   # unknown check name
+        print(f"mxtpu-lint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_FILENAME)
+    line_text = line_text_lookup(root)
+
+    if args.write_baseline:
+        Baseline.from_findings(findings, line_text).save(baseline_path)
+        print(f"mxtpu-lint: wrote {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to "
+              f"{baseline_path}")
+        return 0
+
+    baselined: List = []
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        findings, baselined = Baseline.load(baseline_path).filter(
+            findings, line_text)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "baselined": [f.as_dict() for f in baselined],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if args.show_baselined:
+            for f in baselined:
+                print(f"{f.render()}  [baselined]")
+        n, b = len(findings), len(baselined)
+        print(f"mxtpu-lint: {n} finding{'' if n == 1 else 's'} "
+              f"({b} baselined) across {len(files)} files",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""closed-program-set: every compiled program must be registered.
+
+Two rules keep the set of compiled programs closed and observable:
+
+1. **Raw ``jax.jit`` must route through ``instrument_jit``** (the
+   program registry feeding the compile-cache, XLA-cost and span
+   planes).  Accepted shapes:
+
+   * ``instrument_jit("site", jax.jit(...))`` — direct wrap;
+   * ``self._x = jax.jit(...)`` later passed to ``instrument_jit(...,
+     self._x)`` anywhere in the module (the engine's
+     build-then-wrap pattern).
+
+   Anything else is an unregistered program: its compiles, cache
+   misses and FLOPs are invisible to telemetry.
+
+2. **No traced-value Python branching in jitted bodies** — a function
+   handed to ``jax.jit``/``lax.scan`` must not ``if``/``while`` on its
+   traced parameters (that forks the program set per value; use
+   ``lax.cond``/``jnp.where``).  Shape/dtype/ndim/len/isinstance
+   inspection and ``is None`` checks are static and allowed.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import _astutil
+from .core import Checker, FileContext, Finding
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        return _astutil.attr_parts(fn)[0:1] == ["jax"]
+    return isinstance(fn, ast.Name) and fn.id == "jit"
+
+
+class ClosedProgramChecker(Checker):
+    name = "closed-program-set"
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_registration(ctx))
+        findings.extend(self._check_traced_branching(ctx))
+        return findings
+
+    # -- rule 1: instrument_jit registration ----------------------------
+    def _check_registration(self, ctx: FileContext) -> List[Finding]:
+        # names/attrs that appear as instrument_jit arguments anywhere
+        wrapped_names: Set[str] = set()
+        wrapped_call_ids: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or _astutil.attr_tail(node.func) != "instrument_jit":
+                continue
+            for arg in ast.walk(node):
+                if isinstance(arg, ast.Call) and _is_jax_jit(arg):
+                    wrapped_call_ids.add(id(arg))
+                tail = _astutil.attr_tail(arg) \
+                    if isinstance(arg, (ast.Name, ast.Attribute)) else None
+                if tail:
+                    wrapped_names.add(tail)
+
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jax_jit(node):
+                continue
+            if id(node) in wrapped_call_ids:
+                continue
+            if self._assigned_name(ctx, node) in wrapped_names:
+                continue
+            findings.append(Finding(
+                self.name, ctx.relpath, node.lineno,
+                "raw `jax.jit` not routed through "
+                "`telemetry.instrument_jit` — the program is invisible "
+                "to the compile-cache/cost/span planes"))
+        return findings
+
+    @staticmethod
+    def _assigned_name(ctx: FileContext,
+                       call: ast.Call) -> Optional[str]:
+        """Name/attr this jit call is assigned to, if any."""
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for tgt in node.targets:
+                    tail = _astutil.attr_tail(tgt)
+                    if tail:
+                        return tail
+        return None
+
+    # -- rule 2: traced-value branching ---------------------------------
+    def _check_traced_branching(self, ctx: FileContext) -> List[Finding]:
+        funcs = dict(_astutil.iter_functions(ctx.tree))
+        by_bare: Dict[str, List[ast.AST]] = {}
+        for _, node in funcs.items():
+            by_bare.setdefault(node.name, []).append(node)
+
+        jitted: List[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _astutil.attr_tail(node.func)
+            if not (_is_jax_jit(node) or tail in ("scan", "while_loop",
+                                                  "fori_loop")):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in by_bare:
+                    jitted.extend(by_bare[arg.id])
+
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for fn in jitted:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            taint = {a.arg for a in fn.args.args
+                     + fn.args.posonlyargs + fn.args.kwonlyargs
+                     if a.arg != "self"}
+            if fn.args.vararg:
+                taint.add(fn.args.vararg.arg)
+            for n in _astutil.walk_shallow(fn):
+                if not isinstance(n, (ast.If, ast.While)):
+                    continue
+                bad = self._traced_names_in_test(n.test, taint)
+                if bad:
+                    findings.append(Finding(
+                        self.name, ctx.relpath, n.lineno,
+                        f"Python `{type(n).__name__.lower()}` on traced "
+                        f"value(s) {sorted(bad)} inside jitted "
+                        f"`{fn.name}` — each value forks a new compiled "
+                        "program; use lax.cond/jnp.where"))
+        return findings
+
+    @staticmethod
+    def _traced_names_in_test(test: ast.expr,
+                              taint: Set[str]) -> Set[str]:
+        static_ids: Set[int] = set()
+        for n in ast.walk(test):
+            # x.shape / x.dtype / x.ndim / x.size are static under trace
+            if isinstance(n, ast.Attribute) \
+                    and n.attr in ("shape", "dtype", "ndim", "size") \
+                    and isinstance(n.value, ast.Name):
+                static_ids.add(id(n.value))
+            # len(x) / isinstance(x, T) are static
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in ("len", "isinstance"):
+                for a in ast.walk(n):
+                    if isinstance(a, ast.Name):
+                        static_ids.add(id(a))
+            # `x is None` / `x is not None` is an identity check
+            elif isinstance(n, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in n.ops) \
+                    and all(isinstance(c, ast.Constant)
+                            for c in n.comparators):
+                for a in ast.walk(n):
+                    if isinstance(a, ast.Name):
+                        static_ids.add(id(a))
+        bad: Set[str] = set()
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and n.id in taint \
+                    and id(n) not in static_ids:
+                bad.add(n.id)
+        return bad

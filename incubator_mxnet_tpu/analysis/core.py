@@ -1,0 +1,296 @@
+"""mxtpu-lint core: file contexts, pragmas, baseline, runner.
+
+The checker framework is stdlib-only (``ast`` + ``re``) so the lint can
+run in CI without importing jax or the framework itself.  Each checker
+sees a parsed :class:`FileContext` per file (walked in parallel) and may
+also implement a whole-project ``finalize`` pass (lock-order pairing,
+code<->docs registry drift).
+
+Suppression planes, outermost first:
+
+* ``# mxtpu-lint: disable=<check>[,<check>...]`` on the offending line or
+  the line above (``disable=all`` silences every check);
+* a committed baseline file (``.mxtpu-lint-baseline.json``) whose entries
+  carry a one-line justification.  Baseline fingerprints are
+  ``(check, path, normalized source line, occurrence index)`` so they
+  survive unrelated line-number churn.
+
+``# mxtpu-lint: hot-path`` on (or directly above) a ``def`` marks a
+host-sync-checker root; see ``analysis/host_sync.py``.
+"""
+from __future__ import annotations
+
+import ast
+import concurrent.futures
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*mxtpu-lint:\s*(disable|hot-path)\s*(?:=\s*([A-Za-z0-9_,\- ]+))?")
+
+BASELINE_FILENAME = ".mxtpu-lint-baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured finding: ``<check>: <path>:<line>: <message>``."""
+    check: str
+    path: str           # repo-root-relative, forward slashes
+    line: int
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"check": self.check, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+class FileContext:
+    """One parsed source file: tree, lines, pragma maps."""
+
+    def __init__(self, root: str, path: str):
+        self.root = root
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        # line -> set of disabled check names ("all" disables everything)
+        self.disabled: Dict[int, Set[str]] = {}
+        # lines carrying a hot-path marker (the marker line itself)
+        self.hot_lines: Set[int] = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            kind, arg = m.group(1), m.group(2)
+            if kind == "hot-path":
+                self.hot_lines.add(i)
+            else:
+                checks = {c.strip() for c in (arg or "all").split(",")
+                          if c.strip()}
+                # a pragma suppresses its own line and the line below,
+                # so it can ride the statement or sit just above it
+                for ln in (i, i + 1):
+                    self.disabled.setdefault(ln, set()).update(checks)
+
+    def is_disabled(self, check: str, line: int) -> bool:
+        d = self.disabled.get(line)
+        return bool(d) and (check in d or "all" in d)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+class Checker:
+    """Base class: implement ``check_file`` and/or ``finalize``."""
+
+    name = ""
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        return []
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        return []
+
+
+# -- baseline ---------------------------------------------------------------
+
+def _fingerprint(check: str, path: str, text: str, occ: int) -> Tuple:
+    return (check, path, text, occ)
+
+
+class Baseline:
+    """Committed suppression file.  Entries are JSON objects with
+    ``check``/``path``/``text`` (the normalized source line)/``occ``
+    (0-based index among same-text findings in that file) and a
+    mandatory one-line ``reason``."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries: List[dict] = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(list(data.get("entries", [])))
+
+    def save(self, path: str) -> None:
+        payload = {
+            "comment": "mxtpu-lint baseline; every entry carries a "
+                       "one-line justification. Regenerate with "
+                       "mxtpu-lint --write-baseline (then fill in "
+                       "reasons).",
+            "entries": sorted(
+                self.entries,
+                key=lambda e: (e["path"], e["check"], e["text"],
+                               e.get("occ", 0))),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+
+    def _index(self) -> Set[Tuple]:
+        return {_fingerprint(e["check"], e["path"], e["text"],
+                             int(e.get("occ", 0)))
+                for e in self.entries}
+
+    def filter(self, findings: Sequence[Finding],
+               line_text) -> Tuple[List[Finding], List[Finding]]:
+        """Split ``findings`` into (unsuppressed, baselined).
+        ``line_text(finding)`` must return the finding's source line."""
+        index = self._index()
+        occ_seen: Dict[Tuple, int] = {}
+        keep: List[Finding] = []
+        suppressed: List[Finding] = []
+        for f in findings:
+            text = line_text(f)
+            key = (f.check, f.path, text)
+            occ = occ_seen.get(key, 0)
+            occ_seen[key] = occ + 1
+            if _fingerprint(f.check, f.path, text, occ) in index:
+                suppressed.append(f)
+            else:
+                keep.append(f)
+        return keep, suppressed
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding], line_text,
+                      reason: str = "TODO: justify") -> "Baseline":
+        occ_seen: Dict[Tuple, int] = {}
+        entries = []
+        for f in findings:
+            text = line_text(f)
+            key = (f.check, f.path, text)
+            occ = occ_seen.get(key, 0)
+            occ_seen[key] = occ + 1
+            entries.append({"check": f.check, "path": f.path,
+                            "text": text, "occ": occ, "reason": reason})
+        return cls(entries)
+
+
+# -- runner -----------------------------------------------------------------
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(set(out))
+
+
+def find_root(start: str) -> str:
+    """Walk up from ``start`` to the repo root (the directory holding
+    ``docs/`` or ``.git``); fall back to ``start``'s directory."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    cur = d
+    while True:
+        if os.path.isdir(os.path.join(cur, "docs")) \
+                or os.path.isdir(os.path.join(cur, ".git")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return d
+        cur = parent
+
+
+def build_contexts(files: Sequence[str], root: str,
+                   jobs: Optional[int] = None) -> List[FileContext]:
+    """Parse every file, in parallel (per-file walk)."""
+    if not files:
+        return []
+    jobs = jobs or min(8, (os.cpu_count() or 2))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+        return list(ex.map(lambda p: FileContext(root, p), files))
+
+
+def default_checkers() -> List[Checker]:
+    from .host_sync import HostSyncChecker
+    from .donation import DonationChecker
+    from .closed_program import ClosedProgramChecker
+    from .lock_discipline import LockDisciplineChecker
+    from .registry_drift import RegistryDriftChecker
+    return [HostSyncChecker(), DonationChecker(), ClosedProgramChecker(),
+            LockDisciplineChecker(), RegistryDriftChecker()]
+
+
+def run_checks(paths: Sequence[str],
+               checks: Optional[Sequence[str]] = None,
+               root: Optional[str] = None,
+               jobs: Optional[int] = None) -> List[Finding]:
+    """Run the (selected) checkers over ``paths``; returns findings with
+    inline pragmas already applied (baseline filtering is the CLI's
+    job)."""
+    files = collect_files(paths)
+    if root is None:
+        root = find_root(files[0]) if files else os.getcwd()
+    ctxs = build_contexts(files, root, jobs=jobs)
+    checkers = default_checkers()
+    if checks:
+        wanted = set(checks)
+        unknown = wanted - {c.name for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown check(s): {sorted(unknown)}")
+        checkers = [c for c in checkers if c.name in wanted]
+    findings: List[Finding] = []
+    jobs = jobs or min(8, (os.cpu_count() or 2))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+        for per_file in ex.map(
+                lambda ctx: [f for c in checkers
+                             for f in c.check_file(ctx)], ctxs):
+            findings.extend(per_file)
+    for c in checkers:
+        findings.extend(c.finalize(ctxs))
+    by_path = {ctx.relpath: ctx for ctx in ctxs}
+    kept = []
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and ctx.is_disabled(f.check, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.check, f.message))
+    return kept
+
+
+def line_text_lookup(root: str):
+    """Return ``line_text(finding)`` backed by a tiny file cache — used
+    to fingerprint findings against the baseline (doc findings
+    included)."""
+    cache: Dict[str, List[str]] = {}
+
+    def lookup(f: Finding) -> str:
+        lines = cache.get(f.path)
+        if lines is None:
+            try:
+                with open(os.path.join(root, f.path), "r",
+                          encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+            except OSError:
+                lines = []
+            cache[f.path] = lines
+        if 1 <= f.line <= len(lines):
+            return lines[f.line - 1].strip()
+        return ""
+
+    return lookup

@@ -1,0 +1,137 @@
+"""donation-hazard: a local reused after being passed at a donated
+position of a ``donate_argnums`` dispatch.
+
+XLA donation invalidates the argument buffer the moment the call is
+issued; reading it afterwards returns garbage (or deadlocks on TPU).
+The sanctioned pattern rebinds the name from the call's result::
+
+    cache, out = self._decode_jit(cache, ...)   # ok: rebound
+    out = self._decode_jit(cache, ...)
+    use(cache)                                  # HAZARD
+
+Detection is module-local: assignments of ``jax.jit(...,
+donate_argnums=...)`` (optionally already wrapped in
+``instrument_jit``) register the target name/attribute and its donated
+positions; any later call through that name is a dispatch site.  After
+a dispatch, the first event on a donated bare-name argument must be a
+store — a load is flagged.  Attribute arguments (``self._cache``) are
+tracked the same way by their final component.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import _astutil
+from .core import Checker, FileContext, Finding
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """``jax.jit(..., donate_argnums=...)`` -> positions, else None.
+    Unwraps an ``instrument_jit(...)`` wrapper around the jit call."""
+    tail = _astutil.attr_tail(call.func)
+    if tail == "instrument_jit":
+        for arg in call.args:
+            if isinstance(arg, ast.Call):
+                pos = _donated_positions(arg)
+                if pos:
+                    return pos
+        return None
+    if tail != "jit":
+        return None
+    kws = _astutil.call_keywords(call)
+    if "donate_argnums" not in kws:
+        return None
+    return _astutil.const_int_tuple(kws["donate_argnums"])
+
+
+class DonationChecker(Checker):
+    name = "donation-hazard"
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        donors: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            pos = _donated_positions(node.value)
+            if not pos:
+                continue
+            for tgt in node.targets:
+                tail = _astutil.attr_tail(tgt)
+                if tail:
+                    donors[tail] = pos
+        # functions RETURNING a donated jit are donors under their name
+        for _, fn in _astutil.iter_functions(ctx.tree):
+            for n in _astutil.walk_shallow(fn):
+                if isinstance(n, ast.Return) \
+                        and isinstance(n.value, ast.Call):
+                    pos = _donated_positions(n.value)
+                    if pos:
+                        donors[fn.name] = pos
+        if not donors:
+            return []
+
+        findings: List[Finding] = []
+        for qual, fn in _astutil.iter_functions(ctx.tree):
+            findings.extend(self._scan(ctx, qual, fn, donors))
+        return findings
+
+    def _scan(self, ctx: FileContext, qual: str, fn: ast.AST,
+              donors: Dict[str, Tuple[int, ...]]) -> List[Finding]:
+        events: List[Tuple[Tuple[int, int], str, str]] = []
+        calls: List[Tuple[Tuple[int, int], ast.Call,
+                          Tuple[int, ...]]] = []
+        for n in _astutil.walk_shallow(fn):
+            if isinstance(n, ast.Name):
+                kind = "store" if isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    else "load"
+                events.append(((n.lineno, n.col_offset), kind, n.id))
+            elif isinstance(n, ast.Attribute):
+                kind = "store" if isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    else "load"
+                events.append(((n.lineno, n.col_offset), kind,
+                               "." + n.attr))
+            elif isinstance(n, ast.Call):
+                tail = _astutil.attr_tail(n.func)
+                if tail in donors:
+                    calls.append(((n.end_lineno or n.lineno,
+                                   n.end_col_offset or 0),
+                                  n, donors[tail]))
+        if not calls:
+            return []
+        events.sort(key=lambda e: e[0])
+
+        findings: List[Finding] = []
+        for end_pos, call, positions in calls:
+            for p in positions:
+                if p >= len(call.args):
+                    continue
+                arg = call.args[p]
+                if isinstance(arg, ast.Name):
+                    key = arg.id
+                elif isinstance(arg, ast.Attribute):
+                    key = "." + arg.attr
+                else:
+                    continue
+                # the sanctioned rebind ``c, y = f(c, ...)`` stores the
+                # target textually BEFORE the call's end; a store
+                # anywhere on the dispatch statement's lines counts
+                if any(kind == "store" and name == key
+                       and call.lineno <= pos_key[0] <= end_pos[0]
+                       for pos_key, kind, name in events):
+                    continue
+                for pos_key, kind, name in events:
+                    if pos_key <= end_pos or name != key:
+                        continue
+                    if kind == "store":
+                        break           # rebound: donation-correct
+                    findings.append(Finding(
+                        self.name, ctx.relpath, pos_key[0],
+                        f"`{key.lstrip('.')}` used after being donated "
+                        f"(arg {p} of the dispatch at line "
+                        f"{call.lineno}) in `{qual}` — the buffer is "
+                        "dead after the call; rebind it from the "
+                        "result first"))
+                    break
+        return findings

@@ -1,0 +1,118 @@
+"""host-sync-in-hot-path: device->host synchronization reachable from an
+annotated hot path.
+
+Hot-path roots are functions carrying ``# mxtpu-lint: hot-path`` on (or
+directly above) their ``def`` line — the serving decode/verify loops,
+``FusedUpdater.step``, ``CompiledLoop`` chunk dispatch.  Reachability is
+the same-module call graph: a reference (call or function-as-value, e.g.
+a ``lax.scan`` body) to another function defined in the module pulls it
+into the hot set, and a nested ``def`` inside a hot function is hot.
+
+Sync indicators flagged inside hot functions:
+
+* ``.item()`` / ``.block_until_ready()``
+* ``jax.device_get(...)``
+* ``np.asarray(...)`` / ``np.array(...)`` (any numpy alias)
+* ``float(x)`` / ``int(x)`` of a bare name or subscript (the classic
+  scalar pull; ``float(cfg.attr)`` of config attributes is not flagged)
+
+Intentional sync boundaries (a streaming token emit, a returned host
+scalar) carry an inline pragma or a baseline entry with a reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from . import _astutil
+from .core import Checker, FileContext, Finding
+
+NP_ALIASES = {"np", "_np", "numpy", "onp"}
+NP_SYNC_ATTRS = {"asarray", "array"}
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync-in-hot-path"
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        funcs = dict(_astutil.iter_functions(ctx.tree))
+        if not funcs:
+            return []
+        by_bare: Dict[str, List[str]] = {}
+        for q, node in funcs.items():
+            by_bare.setdefault(node.name, []).append(q)
+
+        roots = [q for q, node in funcs.items()
+                 if self._is_marked(ctx, node)]
+        if not roots:
+            return []
+
+        hot: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            q = stack.pop()
+            if q in hot:
+                continue
+            hot.add(q)
+            node = funcs[q]
+            # nested defs execute in the hot function's dynamic extent
+            for sub_q, sub in funcs.items():
+                if sub_q.startswith(q + ".") \
+                        and sub_q.count(".") == q.count(".") + 1:
+                    stack.append(sub_q)
+            # any reference to a module function's bare name is an edge
+            for n in _astutil.walk_shallow(node):
+                bare = None
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, ast.Load):
+                    bare = n.id
+                elif isinstance(n, ast.Attribute):
+                    bare = n.attr
+                if bare and bare in by_bare:
+                    stack.extend(by_bare[bare])
+
+        findings: List[Finding] = []
+        for q in sorted(hot):
+            findings.extend(self._scan(ctx, q, funcs[q]))
+        return findings
+
+    @staticmethod
+    def _is_marked(ctx: FileContext, node: ast.AST) -> bool:
+        cand = {node.lineno, node.lineno - 1}
+        for dec in getattr(node, "decorator_list", ()):
+            cand.add(dec.lineno - 1)
+        return bool(cand & ctx.hot_lines)
+
+    def _scan(self, ctx: FileContext, qual: str,
+              node: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(n: ast.AST, what: str):
+            out.append(Finding(
+                self.name, ctx.relpath, n.lineno,
+                f"{what} in hot path `{qual}` forces a device->host "
+                "sync"))
+
+        for n in _astutil.walk_shallow(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "item" and not n.args and not n.keywords:
+                    flag(n, "`.item()`")
+                elif fn.attr == "block_until_ready":
+                    flag(n, "`.block_until_ready()`")
+                elif fn.attr == "device_get":
+                    flag(n, "`jax.device_get`")
+                elif fn.attr in NP_SYNC_ATTRS \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id in NP_ALIASES:
+                    flag(n, f"`{fn.value.id}.{fn.attr}`")
+            elif isinstance(fn, ast.Name):
+                if fn.id == "device_get":
+                    flag(n, "`device_get`")
+                elif fn.id in ("float", "int") and len(n.args) == 1 \
+                        and isinstance(n.args[0],
+                                       (ast.Name, ast.Subscript)):
+                    flag(n, f"`{fn.id}()` of a device value")
+        return out

@@ -1,0 +1,188 @@
+"""lock-discipline: no blocking work under a lock, consistent ordering.
+
+Rule 1 — **no blocking calls while holding a lock**.  A ``with
+<lock>:`` body must be pure bookkeeping; anything that can park the
+thread (an untimed ``queue.get()``, ``Thread.join()``, ``Event.wait()``,
+a socket read, an HTTP round-trip, an engine dispatch, ``retry_call``,
+``time.sleep``) starves every other thread contending on the lock — in
+the batcher that includes the watchdog, which needs ``_cv`` to even
+decide whether the worker is wedged.
+
+Rule 2 — **consistent acquisition order**.  When one ``with`` statement
+nests inside another's body, the (outer, inner) lock-name pair is
+recorded; if the reversed pair appears anywhere else in the project the
+two sites can deadlock against each other and both are flagged (in
+``finalize``, so the pairing is project-wide).
+
+Locks are recognized structurally (assignment from
+``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore``) and by
+name (``lock``/``cv``/``cond``/``mutex`` or a ``_lock``/``_cv``/
+``_cond``/``_mutex`` suffix).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import _astutil
+from .core import Checker, FileContext, Finding
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_LOCK_BARE = {"lock", "cv", "cond", "mutex"}
+_LOCK_SUFFIXES = ("_lock", "_cv", "_cond", "_mutex")
+
+# attr calls that block when given no timeout argument
+_BLOCK_IF_UNTIMED = {"get", "join", "wait", "acquire", "result"}
+# attr calls that block, period
+_BLOCK_ALWAYS = {"recv", "recv_into", "accept", "makefile", "getresponse",
+                 "urlopen", "sleep", "retry_call"}
+# engine dispatch entry points (device round-trips); blocking when the
+# receiver chain mentions an engine
+_ENGINE_DISPATCH = {"prefill", "decode", "verify", "spec_step", "predict",
+                    "warmup", "reset"}
+
+
+def _lockish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return low in _LOCK_BARE or low.endswith(_LOCK_SUFFIXES)
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+
+    def __init__(self):
+        # (outer, inner) -> list of (relpath, line) witnesses
+        self._orders: Dict[Tuple[str, str],
+                           List[Tuple[str, int]]] = {}
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        structural = self._structural_locks(ctx)
+        findings: List[Finding] = []
+        for qual, fn in _astutil.iter_functions(ctx.tree):
+            findings.extend(self._scan(ctx, qual, fn, structural))
+        return findings
+
+    @staticmethod
+    def _structural_locks(ctx: FileContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            if _astutil.attr_tail(node.value.func) in _LOCK_FACTORIES:
+                for tgt in node.targets:
+                    tail = _astutil.attr_tail(tgt)
+                    if tail:
+                        names.add(tail)
+        return names
+
+    def _lock_name(self, item: ast.withitem,
+                   structural: Set[str]) -> Optional[str]:
+        expr = item.context_expr
+        # with lock.acquire_timeout(...) style: look at the receiver
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+            if isinstance(expr, ast.Attribute):
+                expr = expr.value
+        tail = _astutil.attr_tail(expr)
+        if tail and (tail in structural or _lockish(tail)):
+            return tail
+        return None
+
+    def _scan(self, ctx: FileContext, qual: str, fn: ast.AST,
+              structural: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, held: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    locks = [n for n in
+                             (self._lock_name(i, structural)
+                              for i in child.items) if n]
+                    for inner in locks:
+                        for outer in held:
+                            if outer != inner:
+                                self._orders.setdefault(
+                                    (outer, inner), []).append(
+                                    (ctx.relpath, child.lineno))
+                    new_held = held + tuple(l for l in locks
+                                            if l not in held)
+                    if locks and held:
+                        pass  # nested acquire itself is fine; order
+                        # conflicts surface in finalize()
+                    visit(child, new_held)
+                    continue
+                if held and isinstance(child, ast.Call):
+                    what = self._blocking(child)
+                    if what:
+                        findings.append(Finding(
+                            self.name, ctx.relpath, child.lineno,
+                            f"{what} while holding `{held[-1]}` in "
+                            f"`{qual}` — blocking under a lock starves "
+                            "every thread contending on it"))
+                visit(child, held)
+
+        visit(fn, ())
+        return findings
+
+    @staticmethod
+    def _blocking(call: ast.Call) -> Optional[str]:
+        fn = call.func
+        tail = _astutil.attr_tail(fn)
+        if tail is None:
+            return None
+        kws = {kw.arg for kw in call.keywords}
+        if tail in _BLOCK_IF_UNTIMED and isinstance(fn, ast.Attribute):
+            # a positional or keyword timeout makes these bounded
+            if not call.args and "timeout" not in kws \
+                    and "block" not in kws:
+                recv = _astutil.attr_tail(fn.value) or ""
+                if tail == "acquire" or tail == "result":
+                    return f"untimed `.{tail}()`"
+                if tail == "get" and not _lockish(recv):
+                    return "untimed `.get()` (queue read)"
+                if tail == "join":
+                    return "untimed `.join()`"
+                # cv.wait() under `with cv:` releases the lock — the
+                # canonical condition-variable pattern, not a hazard
+                if tail == "wait" and not _lockish(recv):
+                    return "untimed `.wait()`"
+            return None
+        if tail in _BLOCK_ALWAYS:
+            if tail == "sleep":
+                chain = _astutil.attr_parts(fn)
+                if chain[:1] not in (["time"], ["sleep"]) \
+                        and tail != chain[-1]:
+                    return None
+                return "`time.sleep`" if len(chain) > 1 else "`sleep`"
+            if tail == "retry_call":
+                return "`retry_call` (retry loop with backoff sleeps)"
+            return f"blocking I/O `.{tail}()`"
+        if tail in _ENGINE_DISPATCH and isinstance(fn, ast.Attribute):
+            chain = [p.lower() for p in _astutil.attr_parts(fn)[:-1]]
+            if any("engine" in p for p in chain):
+                return f"engine dispatch `.{tail}()` (device round-trip)"
+        return None
+
+    def finalize(self, ctxs) -> List[Finding]:
+        findings: List[Finding] = []
+        for (outer, inner), sites in sorted(self._orders.items()):
+            rev = self._orders.get((inner, outer))
+            if not rev or (inner, outer) < (outer, inner):
+                continue  # report each conflicting pair once
+            path, line = sites[0]
+            rpath, rline = rev[0]
+            findings.append(Finding(
+                self.name, path, line,
+                f"lock order `{outer}` -> `{inner}` here conflicts with "
+                f"`{inner}` -> `{outer}` at {rpath}:{rline} — the two "
+                "sites can deadlock against each other"))
+        self._orders.clear()
+        return findings

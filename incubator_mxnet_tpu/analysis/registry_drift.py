@@ -1,0 +1,256 @@
+"""registry-drift: code and docs must agree on the public registries.
+
+Three registries are cross-checked **in both directions** against their
+documentation:
+
+* **env vars** — ``MXNET_*`` string literals in the package vs the
+  tables in ``docs/env_var.md``.  A name used in code but absent from
+  the doc is undocumented surface; a doc row with no code reference is
+  a stale promise.
+* **metrics** — first-argument literals of
+  ``registry.counter/gauge/histogram(...)`` calls (the only way a
+  ``mxtpu_*`` series is born) vs the metric tables in
+  ``docs/observability.md``.
+* **fault sites** — literals reaching ``fault.inject(...)`` /
+  ``fault.take(...)``, ``site=``/``*_site=`` keywords and defaults, and
+  ``*_SITE`` constants, vs the site table in ``docs/robustness.md``.
+
+This is a ``finalize``-only checker: it needs the whole file set.  When
+the docs tree is absent (fixture runs, vendored copies) it is silent.
+Doc-side findings point at the table row; code-side findings point at
+the first code occurrence.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import _astutil
+from .core import Checker, FileContext, Finding
+
+_ENV_RE = re.compile(r"\bMXNET_[A-Z][A-Z0-9_]*\b")
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_SITE_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_.]*$")
+
+
+def _doc_table_cells(lines: Sequence[str]) -> List[Tuple[str, int]]:
+    """First-column cell text of every markdown table row (1-based
+    line numbers); header/separator rows included — callers filter."""
+    out: List[Tuple[str, int]] = []
+    for i, line in enumerate(lines, start=1):
+        s = line.strip()
+        if not s.startswith("|"):
+            continue
+        cells = [c.strip() for c in s.strip("|").split("|")]
+        if cells and cells[0] and not set(cells[0]) <= {"-", ":", " "}:
+            out.append((cells[0], i))
+    return out
+
+
+def _strip_md(cell: str) -> str:
+    return cell.replace("`", "").strip()
+
+
+class RegistryDriftChecker(Checker):
+    name = "registry-drift"
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> List[Finding]:
+        if not ctxs:
+            return []
+        root = ctxs[0].root
+        docs = os.path.join(root, "docs")
+        if not os.path.isdir(docs):
+            return []
+        findings: List[Finding] = []
+        findings.extend(self._check_env(ctxs, root))
+        findings.extend(self._check_metrics(ctxs, root))
+        findings.extend(self._check_faults(ctxs, root))
+        return findings
+
+    @staticmethod
+    def _read_doc(root: str, rel: str) -> Optional[List[str]]:
+        path = os.path.join(root, rel)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return f.read().splitlines()
+        except OSError:
+            return None
+
+    # -- env vars -------------------------------------------------------
+    def _check_env(self, ctxs, root) -> List[Finding]:
+        doc_rel = "docs/env_var.md"
+        lines = self._read_doc(root, doc_rel)
+        if lines is None:
+            return []
+        # code side: full MXNET_* names in string literals (fragments
+        # used for prefix-building end with "_" and are skipped)
+        code: Dict[str, Tuple[str, int]] = {}
+        for ctx in ctxs:
+            for value, lineno in _astutil.string_constants(ctx.tree):
+                for m in _ENV_RE.finditer(value):
+                    name = m.group(0)
+                    if name.endswith("_"):
+                        continue
+                    code.setdefault(name, (ctx.relpath, lineno))
+        # doc side: table rows whose first cell is an env var name
+        doc: Dict[str, int] = {}
+        for cell, lineno in _doc_table_cells(lines):
+            for m in _ENV_RE.finditer(_strip_md(cell)):
+                doc.setdefault(m.group(0), lineno)
+
+        findings: List[Finding] = []
+        for name in sorted(code):
+            if name not in doc:
+                path, lineno = code[name]
+                findings.append(Finding(
+                    self.name, path, lineno,
+                    f"env var `{name}` read in code but missing from "
+                    f"{doc_rel} — undocumented public surface"))
+        for name in sorted(doc):
+            if name not in code:
+                findings.append(Finding(
+                    self.name, doc_rel, doc[name],
+                    f"env var `{name}` documented in {doc_rel} but "
+                    "never read by the code — stale row"))
+        return findings
+
+    # -- metrics --------------------------------------------------------
+    def _check_metrics(self, ctxs, root) -> List[Finding]:
+        doc_rel = "docs/observability.md"
+        lines = self._read_doc(root, doc_rel)
+        if lines is None:
+            return []
+        code: Dict[str, Tuple[str, int]] = {}
+        for ctx in ctxs:
+            # registration idioms: registry.counter(...) /
+            # _telemetry.gauge(...), plus local aliases
+            # ``c = registry.counter`` used as ``c("mxtpu_...", ...)``
+            aliases = set()
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    pairs = [(tgt, node.value)]
+                    if isinstance(tgt, ast.Tuple) \
+                            and isinstance(node.value, ast.Tuple) \
+                            and len(tgt.elts) == len(node.value.elts):
+                        pairs = list(zip(tgt.elts, node.value.elts))
+                    for t, v in pairs:
+                        if isinstance(t, ast.Name) \
+                                and isinstance(v, ast.Attribute) \
+                                and v.attr in _METRIC_FACTORIES:
+                            aliases.add(t.id)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                is_factory = (isinstance(fn, ast.Attribute)
+                              and fn.attr in _METRIC_FACTORIES) \
+                    or (isinstance(fn, ast.Name) and fn.id in aliases)
+                if not is_factory:
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                    if name.startswith("mxtpu_"):
+                        code.setdefault(name,
+                                        (ctx.relpath, node.lineno))
+        doc: Dict[str, int] = {}
+        metric_re = re.compile(r"\bmxtpu_[a-z0-9_]+\b")
+        for cell, lineno in _doc_table_cells(lines):
+            text = _strip_md(cell).split("{")[0].strip()
+            m = metric_re.fullmatch(text)
+            if m:
+                doc.setdefault(m.group(0), lineno)
+        doc_text = "\n".join(lines)
+
+        findings: List[Finding] = []
+        for name in sorted(code):
+            # code->doc: a mention anywhere in the doc is enough
+            if name not in doc and name not in doc_text:
+                path, lineno = code[name]
+                findings.append(Finding(
+                    self.name, path, lineno,
+                    f"metric `{name}` registered in code but absent "
+                    f"from {doc_rel} — undocumented series"))
+        for name in sorted(doc):
+            if name not in code:
+                findings.append(Finding(
+                    self.name, doc_rel, doc[name],
+                    f"metric `{name}` documented in {doc_rel} but never "
+                    "registered — stale row"))
+        return findings
+
+    # -- fault sites ----------------------------------------------------
+    def _check_faults(self, ctxs, root) -> List[Finding]:
+        doc_rel = "docs/robustness.md"
+        lines = self._read_doc(root, doc_rel)
+        if lines is None:
+            return []
+        code: Dict[str, Tuple[str, int]] = {}
+
+        def add(value, relpath, lineno):
+            if isinstance(value, str) and _SITE_RE.match(value):
+                code.setdefault(value, (relpath, lineno))
+
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    tail = _astutil.attr_tail(node.func)
+                    if tail in ("inject", "take") and node.args \
+                            and isinstance(node.args[0], ast.Constant):
+                        add(node.args[0].value, ctx.relpath,
+                            node.lineno)
+                    for kw in node.keywords:
+                        if kw.arg and (kw.arg == "site"
+                                       or kw.arg.endswith("_site")) \
+                                and isinstance(kw.value, ast.Constant):
+                            add(kw.value.value, ctx.relpath,
+                                kw.value.lineno)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    args = node.args
+                    defaults = list(args.defaults)
+                    pos = args.posonlyargs + args.args
+                    for a, d in zip(pos[len(pos) - len(defaults):],
+                                    defaults):
+                        if a.arg.endswith("_site") \
+                                and isinstance(d, ast.Constant):
+                            add(d.value, ctx.relpath, d.lineno)
+                    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                        if a.arg.endswith("_site") \
+                                and isinstance(d, ast.Constant):
+                            add(d.value, ctx.relpath, d.lineno)
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Constant):
+                    for tgt in node.targets:
+                        tail = _astutil.attr_tail(tgt)
+                        if tail and tail.endswith("_SITE"):
+                            add(node.value.value, ctx.relpath,
+                                node.lineno)
+
+        doc: Dict[str, int] = {}
+        for cell, lineno in _doc_table_cells(lines):
+            text = _strip_md(cell)
+            if _SITE_RE.match(text):
+                doc.setdefault(text, lineno)
+        doc_text = "\n".join(lines)
+
+        findings: List[Finding] = []
+        for name in sorted(code):
+            if name not in doc and f"`{name}`" not in doc_text:
+                path, lineno = code[name]
+                findings.append(Finding(
+                    self.name, path, lineno,
+                    f"fault site `{name}` instrumented in code but "
+                    f"absent from {doc_rel} — operators can't target "
+                    "it"))
+        for name in sorted(doc):
+            if name not in code:
+                findings.append(Finding(
+                    self.name, doc_rel, doc[name],
+                    f"fault site `{name}` documented in {doc_rel} but "
+                    "never instrumented — stale row"))
+        return findings

@@ -10,6 +10,7 @@ framework's knobs.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
@@ -85,6 +86,40 @@ def getenv_bool(name: str, default: bool = False) -> bool:
     if v is None or v == "":
         return default
     return str(v).lower() not in ("0", "false", "off", "no", "")
+
+
+# Reference env vars accepted for compatibility but with no separate
+# effect on TPU (docs/env_var.md explains each): XLA fuses/bulks
+# unconditionally, PJRT owns the memory pool, collectives and
+# accumulation/determinism policy are XLA's.  Setting one logs a
+# one-time notice instead of silently ignoring it.
+COMPAT_ACCEPTED_ENV = (
+    "MXNET_EXEC_BULK_EXEC_TRAIN",
+    "MXNET_EXEC_BULK_EXEC_INFERENCE",
+    "MXNET_EXEC_ENABLE_ADDTO",
+    "MXNET_PROFILER_MODE",
+    "MXNET_GPU_MEM_POOL_TYPE",
+    "MXNET_GPU_MEM_POOL_RESERVE",
+    "MXNET_KVSTORE_BIGARRAY_BOUND",
+    "MXNET_KVSTORE_USETREE",
+    "MXNET_SAFE_ACCUMULATION",
+    "MXNET_ENFORCE_DETERMINISM",
+)
+
+_compat_env_logged = False
+
+
+def log_compat_env_once() -> list:
+    """One-time notice for set-but-ignored reference env vars; returns
+    the names that were set (import-time hook, also handy in tests)."""
+    global _compat_env_logged
+    seen = [n for n in COMPAT_ACCEPTED_ENV if getenv(n) not in (None, "")]
+    if seen and not _compat_env_logged:
+        logging.getLogger("incubator_mxnet_tpu").info(
+            "accepted for compatibility (no separate effect on TPU): %s",
+            ", ".join(seen))
+    _compat_env_logged = True
+    return seen
 
 
 # ---------------------------------------------------------------------------

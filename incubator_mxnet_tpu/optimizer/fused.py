@@ -206,6 +206,7 @@ class FusedUpdater:
         self._z_params = None       # [(param_index, weight NDArray)]
 
     # -- per-step host side --------------------------------------------
+    # mxtpu-lint: hot-path
     def step(self, updatable, guard: bool):
         """Apply one fused update to ``updatable`` (list of
         ``(index, Parameter)``).

@@ -1,0 +1,33 @@
+"""Version-tolerant ``shard_map``.
+
+Call sites in this package write the modern API — ``jax.shard_map(f,
+mesh=..., in_specs=..., out_specs=..., check_vma=..., axis_names=...)``.
+jax 0.4.x only ships ``jax.experimental.shard_map.shard_map`` whose
+equivalents are spelled ``check_rep`` and ``auto`` (the COMPLEMENT of
+``axis_names``: axes left automatic instead of axes made manual), so
+this wrapper translates the kwargs instead of forking every call site.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: bool = True,
+              axis_names: Optional[Any] = None):
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        _sm = None
+    if _sm is not None:
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _sm(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _esm
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=bool(check_vma), auto=auto)

@@ -134,6 +134,7 @@ class CompiledLoop(SPMDTrainer):
             donate_argnums=donate))
 
     # ------------------------------------------------------------------
+    # mxtpu-lint: hot-path
     def step_chunk(self, batches):
         """Run ``len(batches)`` consecutive train steps as ONE compiled
         dispatch.  ``batches`` is a sequence of per-step batch tuples

@@ -140,7 +140,7 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ._shmap import shard_map
 
     if axis not in mesh.shape:
         raise MXNetError(f"mesh has no axis {axis!r}")
@@ -521,7 +521,7 @@ class PipelineTrainer(_SPMDTrainer):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ._shmap import shard_map
 
         mesh, S, M = self._mesh, self._S, self._M
         pipe, data = self._pipe_axis, self._data_axis
@@ -641,15 +641,18 @@ class PipelineTrainer(_SPMDTrainer):
         fv_sh = tuple(v.sharding for v in self._first_vals)
         lv_sh = tuple(v.sharding for v in self._last_vals)
         sv_sh = {k: v.sharding for k, v in self._stacked.items()}
-        return jax.jit(pure_step,
-                       out_shardings=(None, fv_sh, sv_sh, lv_sh, None),
-                       donate_argnums=donate)
+        from .. import telemetry as _telemetry
+        return _telemetry.instrument_jit(
+            "pipeline:1f1b",
+            jax.jit(pure_step,
+                    out_shardings=(None, fv_sh, sv_sh, lv_sh, None),
+                    donate_argnums=donate))
 
     def _build_step_gpipe(self):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ._shmap import shard_map
 
         mesh, S, M = self._mesh, self._S, self._M
         pipe, data = self._pipe_axis, self._data_axis
@@ -722,9 +725,12 @@ class PipelineTrainer(_SPMDTrainer):
         fv_sh = tuple(v.sharding for v in self._first_vals)
         lv_sh = tuple(v.sharding for v in self._last_vals)
         sv_sh = {k: v.sharding for k, v in self._stacked.items()}
-        return jax.jit(pure_step,
-                       out_shardings=(None, fv_sh, sv_sh, lv_sh, None),
-                       donate_argnums=donate)
+        from .. import telemetry as _telemetry
+        return _telemetry.instrument_jit(
+            "pipeline:gpipe",
+            jax.jit(pure_step,
+                    out_shardings=(None, fv_sh, sv_sh, lv_sh, None),
+                    donate_argnums=donate))
 
     def step(self, *batch):
         """One pipelined train step (ids, labels); returns the scalar

@@ -1051,6 +1051,7 @@ class ContinuousBatcher(DynamicBatcher):
         if self._maybe_finished(req):
             self._free_slot(slot, req, "finished")
 
+    # mxtpu-lint: hot-path
     def _decode_once(self, gen: int, live):
         """ONE decode dispatch for every slot (free slots ride along at
         position 0); emit each live slot's token and free finished slots
@@ -1088,10 +1089,12 @@ class ContinuousBatcher(DynamicBatcher):
         self._degraded = False
         self.breaker.record_success()
         for s, r in live:
-            self._emit(r, int(nxt[s]))
+            # the stream boundary: ONE scalar pull per emitted token
+            self._emit(r, int(nxt[s]))  # mxtpu-lint: disable=host-sync-in-hot-path
             if self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
 
+    # mxtpu-lint: hot-path
     def _spec_once(self, gen: int, live):
         """ONE speculative step for every slot: k draft dispatches plus
         ONE k+1-wide verify advance each live slot by 1..k+1 tokens.
@@ -1144,7 +1147,10 @@ class ContinuousBatcher(DynamicBatcher):
         step_accepted = 0
         for s, r in live:
             n_emit = 0
+            # the stream boundary: scalar pulls gate each emitted token
+            # mxtpu-lint: disable=host-sync-in-hot-path
             for j in range(int(accepted[s]) + 1):
+                # mxtpu-lint: disable=host-sync-in-hot-path
                 self._emit(r, int(burst[s, j]))
                 n_emit += 1
                 if self._maybe_finished(r):
@@ -1266,8 +1272,13 @@ class ContinuousBatcher(DynamicBatcher):
                 if self._slots[s] is r:
                     self._slots[s] = None
             _m.SLOTS_IN_USE.set(0, model=self.name)
-            if gen == self._worker_gen:
-                self.engine.reset()
+            current = gen == self._worker_gen
+        # reset OUTSIDE _cv: it dispatches to the device and can wedge,
+        # and the watchdog needs _cv to even diagnose a wedged worker.
+        # A superseded worker (gen bumped after the check) skips reset
+        # anyway; the restart path re-warms the engine itself.
+        if current:
+            self.engine.reset()
         for _, r in live:
             self._fail(r, err)
 

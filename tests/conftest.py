@@ -46,3 +46,10 @@ def _amp_isolation():
     from incubator_mxnet_tpu.contrib import amp
     if amp._state["initialized"] or amp._patched:
         amp._reset()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight test excluded from the tier-1 CPU run "
+        "(-m 'not slow'); the full suite still runs them")

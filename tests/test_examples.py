@@ -3,6 +3,7 @@ example trainings in tests/tutorials + nightly).  Each example runs as a
 user would — a fresh subprocess on CPU with tiny configs."""
 import os
 import subprocess
+import pytest
 import sys
 
 
@@ -32,6 +33,7 @@ def test_example_quantize_lenet():
     assert "int8" in out and "agreement" in out
 
 
+@pytest.mark.slow
 def test_example_transformer_short():
     out = _run("example/machine_translation/train_transformer.py",
                "--cpu", "--steps", "6", "--seq-len", "8",
@@ -39,6 +41,7 @@ def test_example_transformer_short():
     assert "greedy reversal accuracy" in out
 
 
+@pytest.mark.slow
 def test_example_gpt_short():
     out = _run("example/language_model/train_gpt.py",
                "--cpu", "--steps", "6", "--seq-len", "12",

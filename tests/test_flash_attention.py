@@ -222,7 +222,7 @@ def test_flash_pallas_backward_matches_xla_oracle(T, causal):
 
 def _ring_variant(use_flash, causal, mask, q, k, v):
     import jax
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel._shmap import shard_map
     from jax.sharding import PartitionSpec as P
     from functools import partial
     from incubator_mxnet_tpu import parallel
@@ -241,7 +241,11 @@ def _ring_variant(use_flash, causal, mask, q, k, v):
                      out_specs=spec, check_vma=False)(q, k, v)
 
 
-@pytest.mark.parametrize("mode", ["dense", "causal", "masked"])
+@pytest.mark.parametrize("mode", [
+    "dense",
+    pytest.param("causal", marks=pytest.mark.slow),
+    pytest.param("masked", marks=pytest.mark.slow),
+])
 def test_blockwise_ring_matches_einsum_ring(mode):
     import jax
     import jax.numpy as jnp
@@ -340,7 +344,11 @@ def test_blockwise_ring_tile_aligned_forward():
                                rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("mode", ["dense", "causal", "masked"])
+@pytest.mark.parametrize("mode", [
+    "dense",
+    pytest.param("causal", marks=pytest.mark.slow),
+    pytest.param("masked", marks=pytest.mark.slow),
+])
 def test_ulysses_flash_matches_einsum(mode):
     """The Ulysses all-to-all path with the flash kernel on the gathered
     full-sequence block vs its einsum local attention — fwd + grads."""

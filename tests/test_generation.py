@@ -107,6 +107,7 @@ def test_derive_prefill_buckets():
 
 
 # ------------------------------------------------------------- engine
+@pytest.mark.slow
 def test_prefill_decode_matches_full_reforward_every_step():
     """The cached path must reproduce a full re-forward of the growing
     context at EVERY decode step — one wrong K/V write or position

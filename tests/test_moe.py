@@ -212,6 +212,7 @@ def test_ep_rules_from_block_instance_with_custom_prefix():
         moe.ep_rules("expert", block=gluon.nn.Dense(2, in_units=2))
 
 
+@pytest.mark.slow
 def test_gpt_moe_model_family_trains_expert_parallel():
     """MoE as a first-class GPT option: GPTModel(moe_experts=E) returns
     (logits, aux); MoELoss folds the aux term into the objective; two

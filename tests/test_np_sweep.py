@@ -469,6 +469,7 @@ def test_np_audit_clean():
 # are numpy's, and first moments match theory under a fixed seed
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_np_random_distribution_tail():
     r = np.random
     mx.random.seed(123)

@@ -163,6 +163,7 @@ def test_ring_attention_causal():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_under_jit_and_grad():
     import jax
     import jax.numpy as jnp
@@ -254,6 +255,7 @@ def test_ulysses_mask():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_under_jit_and_grad():
     import jax
     import jax.numpy as jnp
@@ -374,6 +376,7 @@ def test_sp_impl_env_routes_model_attention(monkeypatch):
         _sdpa(q, k, v, H, seq_axis="seq", mesh=mesh)
 
 
+@pytest.mark.slow
 def test_gpt_spmd_dp_tp_sp_matches_single_device():
     """The GPT family trains under a 3-axis data x model x seq mesh with
     CAUSAL ring attention inside the compiled step, matching the 1-device

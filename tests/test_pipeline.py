@@ -157,6 +157,7 @@ def _gpt_and_batch(seed=11, B=8, T=16, V=64):
     return net, ids, labels
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_trains_and_matches_1dev():
     """Two optimizer steps through a dp2 x pipe2 GPipe schedule must
     reproduce the 1-device losses (sync-SPMD semantics) AND genuinely
@@ -259,6 +260,7 @@ def test_pipeline_trainer_validation():
                              pipeline_axis="pipe")
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_four_stages_middle_stage_logic():
     """S=4 exercises pure middle stages (neither embed owner nor loss
     owner) — the tick masking unique to 0 < stage < S-1."""
@@ -333,6 +335,7 @@ def test_pipeline_trainer_1f1b_matches_1dev():
             rtol=2e-5, atol=2e-6, err_msg=name)
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_1f1b_four_stages():
     """S=4 1F1B: pure middle stages exercise both masked lanes (neither
     head-loss owner nor embed owner) and the deeper stash."""
@@ -380,6 +383,7 @@ def test_pipeline_schedule_validation():
                              pipeline_schedule="zigzag")
 
 
+@pytest.mark.slow
 def test_pipeline_3d_dp_pipe_tensor_matches_1dev():
     """3D parallelism: dp2 x pipe2 x model2 — cells stacked over pipe,
     their matmuls ALSO tensor-sharded over 'model' via tp_rules
@@ -416,6 +420,7 @@ def test_pipeline_3d_dp_pipe_tensor_matches_1dev():
     assert abs(l2 - o2) <= 1e-3 * max(1.0, abs(o2)), (l2, o2)
 
 
+@pytest.mark.slow
 def test_pipeline_3d_1f1b_matches_1dev():
     """The 1F1B schedule under the same 3D mesh (its hand-written
     backward must coexist with GSPMD's auto tensor axis)."""

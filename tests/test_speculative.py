@@ -342,6 +342,7 @@ def test_paged_engine_parity_with_forced_pallas_verify(monkeypatch):
 
 
 # =========================================== HTTP surface + preload
+@pytest.mark.slow
 def test_http_spec_fields_and_preload():
     eng = _spec_pair(True, max_slots=2)
     srv = ModelServer(port=0)

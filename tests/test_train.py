@@ -2,6 +2,7 @@
 tests/python/train/test_mlp.py, test_conv.py — small models must reach an
 accuracy threshold in a few epochs)."""
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import gluon
@@ -80,6 +81,7 @@ def test_lenet_convergence():
     assert _accuracy(net, X, y) > 0.9
 
 
+@pytest.mark.slow
 def test_lstm_sequence_classification():
     """Sequence task: classify by which half has larger mean."""
     rng = np.random.default_rng(2)
