@@ -607,6 +607,18 @@ def _bench_serve(on_accel, kind, dev):
 
     speedup = round(batched["requests_per_sec"]
                     / max(unbatched["requests_per_sec"], 1e-9), 3)
+    # device-plane corroboration: the dispatch ledger's per-site counts
+    # and wall-time percentiles for this engine, plus the per-owner
+    # memory attribution (params:bench-serve registered at build)
+    from incubator_mxnet_tpu import telemetry_device
+    ledger = {
+        site: {"dispatches": e["dispatches"],
+               "seconds_p50": e["seconds_p50"],
+               "seconds_p99": e["seconds_p99"],
+               "compiled": e["compiled"]}
+        for site, e in telemetry.dispatch_ledger(
+            prefix="serving:bench-serve").items()}
+    mem = telemetry_device.sample()
     # steady-state SLO view of the batched run (every submit() outcome
     # landed in the rolling window; serving/slo.py)
     from incubator_mxnet_tpu.serving import slo as _slo
@@ -622,6 +634,12 @@ def _bench_serve(on_accel, kind, dev):
         "batched": batched,
         "batches_dispatched": int(n_bat),
         "mean_batch_size": round(n_req / n_bat, 2),
+        "dispatch_ledger": ledger,
+        "device_memory": {
+            "owners": {k: int(v) for k, v in mem["owners"].items()},
+            "live_array_bytes": int(mem["live_array_bytes"]),
+            "unattributed_bytes": int(mem["unattributed_bytes"]),
+        },
         "speedup": speedup,
         "speedup_floor": 2.0,
         "floor_ok": bool(speedup >= 2.0),

@@ -108,6 +108,15 @@ usage: ci/run_tests.sh <function>
                         equal the arithmetic sum of replica counters;
                         exactly ONE incident bundle written, naming the
                         request ids that failed on the hung replica
+  device_obs_smoke      device-plane drill: 3 replicas (one with an
+                        attached draft model) + router under 16
+                        streaming clients — mxtpu_dispatches_per_token
+                        reads exactly 1.0 on the plain replicas and
+                        < 1.0 on the spec replica; GET /programs
+                        fan-out shows compiled == expected on every
+                        replica; federated kv:gen owner bytes on the
+                        router /metrics; one POST /debug/profile
+                        fan-out returns an artifact per replica
   multichip_dryrun      8-virtual-device full-train-step compile+run
   static                mxtpu-lint static analysis (host-sync, donation,
                         closed-program-set, lock-discipline,
@@ -1191,6 +1200,14 @@ fleet_obs_smoke() {
     JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py all \
         --cache-dir "$cc" \
         --incident-dir /tmp/mxtpu_fleet_obs_incidents
+}
+
+device_obs_smoke() {
+    local cc=/tmp/mxtpu_device_obs_cc
+    rm -rf "$cc"
+    JAX_PLATFORMS=cpu python tools/device_obs_smoke.py all \
+        --cache-dir "$cc" \
+        --profile-dir /tmp/mxtpu_device_obs_profiles
 }
 
 multichip_dryrun() {

@@ -45,10 +45,15 @@ def stats_main():
                     [--serve [--port N]] [--slo] [--flight-dump PATH]
                     script.py [args...]
         mxtpu-stats --fleet http://router:9000 [--slo] [--out PATH]
+        mxtpu-stats --fleet URL --memory | --programs | --profile SECS
 
     With ``--fleet`` no script runs: the federated fleet view is pulled
-    from a running ``mxtpu-router`` instead — its aggregated ``/metrics``
-    exposition (or merged ``/slo`` with ``--slo``) printed to stdout or
+    from a running ``mxtpu-router`` (or a single replica) instead — its
+    aggregated ``/metrics`` exposition, merged ``/slo`` with ``--slo``,
+    the device-memory breakdown with ``--memory``, the runtime
+    program-set inventory with ``--programs``, or an on-demand profiler
+    capture (``POST /debug/profile``, fanned out to every replica when
+    URL is a router) with ``--profile SECONDS`` — printed to stdout or
     ``--out``.
 
     Otherwise the script runs in-process (as ``__main__``) with the telemetry
@@ -87,6 +92,19 @@ def stats_main():
                          "mxtpu-router at URL instead of running a "
                          "script (aggregated /metrics, or merged /slo "
                          "with --slo)")
+    ap.add_argument("--memory", action="store_true",
+                    help="with --fleet: fetch the device-memory "
+                         "breakdown (GET /memory — per-owner HBM "
+                         "attribution) instead of /metrics")
+    ap.add_argument("--programs", action="store_true",
+                    help="with --fleet: fetch the runtime program-set "
+                         "inventory (GET /programs — dispatch ledger + "
+                         "expected-vs-compiled accounting)")
+    ap.add_argument("--profile", metavar="SECONDS", type=float,
+                    default=None,
+                    help="with --fleet: trigger an on-demand profiler "
+                         "capture (POST /debug/profile?seconds=) and "
+                         "print the per-replica artifact paths")
     ap.add_argument("script", nargs="?", default=None,
                     help="python script to run")
     ap.add_argument("args", nargs=argparse.REMAINDER,
@@ -95,6 +113,9 @@ def stats_main():
 
     if ns.fleet:
         sys.exit(_fleet_stats(ns))
+    if ns.memory or ns.programs or ns.profile is not None:
+        ap.error("--memory/--programs/--profile need --fleet URL "
+                 "(they query a running server)")
     if ns.script is None:
         ap.error("a script is required unless --fleet URL is given")
 
@@ -143,16 +164,34 @@ def stats_main():
 
 
 def _fleet_stats(ns) -> int:
-    """``mxtpu-stats --fleet URL``: fetch the router's federated view."""
+    """``mxtpu-stats --fleet URL``: fetch the router's federated view
+    (``/metrics`` by default; ``--slo``/``--memory``/``--programs``
+    pick the JSON views, ``--profile SECONDS`` triggers a capture)."""
     from urllib.error import URLError
-    from urllib.request import urlopen
+    from urllib.request import Request, urlopen
 
     base = ns.fleet.rstrip("/")
     if "://" not in base:
         base = "http://" + base
-    path = "/slo" if ns.slo else "/metrics"
+    timeout = 10.0
+    req = None
+    if ns.profile is not None:
+        # the capture blocks server-side for the window plus profiler
+        # startup and trace serialization; wait them out
+        path = f"/debug/profile?seconds={ns.profile}"
+        timeout = float(ns.profile) + max(30.0, 2.0 * float(ns.profile))
+        req = Request(base + path, data=b"{}", method="POST",
+                      headers={"Content-Type": "application/json"})
+    elif ns.memory:
+        path = "/memory"
+    elif ns.programs:
+        path = "/programs"
+    elif ns.slo:
+        path = "/slo"
+    else:
+        path = "/metrics"
     try:
-        with urlopen(base + path, timeout=10.0) as resp:
+        with urlopen(req or (base + path), timeout=timeout) as resp:
             text = resp.read().decode("utf-8", "replace")
     except (URLError, OSError) as e:
         sys.stderr.write(f"mxtpu-stats: --fleet {base}{path}: {e}\n")

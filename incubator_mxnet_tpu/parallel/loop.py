@@ -165,6 +165,11 @@ class CompiledLoop(SPMDTrainer):
                     self._tr_vals, self._aux_vals, self._opt_state,
                     step0, rngs, *flat)
         self._step_count += kc
+        # k steps rode ONE compiled dispatch — the chunked-loop economy
+        # the dispatch ledger should corroborate (mxtpu_dispatches_total
+        # site "loop" grows by 1 while the step counter grows by kc)
+        _telemetry.gauge("mxtpu_optimizer_dispatches_per_step").set(
+            1.0 / kc)
         if self._skip_nonfinite:
             self._pending_skipped.append(skipped)
             self._drain_skipped(block=False)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import re
 import time as _time
+import weakref as _weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -29,6 +30,7 @@ import numpy as _np
 from ..base import MXNetError
 from ..context import current_context
 from .. import telemetry as _telemetry
+from .. import telemetry_device as _telemetry_device
 from ..ndarray.ndarray import NDArray
 from ..gluon.block import functional_call
 from . import mesh as mesh_mod
@@ -311,6 +313,20 @@ class SPMDTrainer:
             raise MXNetError(f"accum_steps={accum_steps} must be >= 1")
         self._step_count = 0
         self._jit_cache = {}
+        # device-plane attribution (telemetry_device): report THIS
+        # trainer's live optimizer state — zero1: the 1/N flat shard —
+        # under owner "optimizer".  weakref so the registration never
+        # keeps a discarded trainer's state trees alive.
+        wref = _weakref.ref(self)
+
+        def _opt_state_bytes():
+            tr = wref()
+            if tr is None:
+                return 0
+            from . import zero1 as _z1mod
+            return _z1mod.per_replica_state_bytes(tr._opt_state)
+
+        _telemetry_device.register_owner("optimizer", _opt_state_bytes)
 
     def _make_state_shardings(self):
         """Per-leaf shardings for the optimizer state: each leaf keeps
@@ -518,6 +534,8 @@ class SPMDTrainer:
         loss, self._tr_vals, self._aux_vals, self._opt_state = \
             self._jit_cache[key](self._tr_vals, self._aux_vals,
                                  self._opt_state, step_arr, rng, *sharded)
+        # the whole step (fwd + bwd + update) is ONE compiled program
+        _telemetry.gauge("mxtpu_optimizer_dispatches_per_step").set(1)
         return loss
 
     def _build_key(self, arrs):

@@ -74,6 +74,7 @@ from ..base import MXNetError, getenv, getenv_int
 from ..ndarray.ndarray import NDArray
 from .. import fault as _fault
 from .. import telemetry as _telemetry
+from .. import telemetry_device as _tdev
 from . import lifecycle as _lc
 from . import metrics as _m
 from . import slo as _slo
@@ -792,6 +793,13 @@ class ContinuousBatcher(DynamicBatcher):
         self._spec_emitted = 0
         self._spec_accepted = 0
         self._spec_drafted = 0
+        # dispatch economy: one batcher step = ONE target-model dispatch
+        # (draft decodes ride on the draft model's own ledger).  Tokens
+        # are per-slot-normalized, so plain decode reads exactly 1.0 and
+        # speculation reads 1/tokens-per-slot-per-dispatch (< 1.0 when
+        # the draft earns its keep) — docs/observability.md.
+        self._dpt_dispatches = 0
+        self._dpt_tokens = 0.0
         self._kv_starved_sweeps = 0
         self._kv_starve_threshold = max(1, getenv_int(
             "MXNET_SERVE_KV_STARVE_SWEEPS", 3))
@@ -1088,6 +1096,11 @@ class ContinuousBatcher(DynamicBatcher):
             else 0.8 * self._avg_batch_seconds + 0.2 * dt
         self._degraded = False
         self.breaker.record_success()
+        self._dpt_dispatches += 1
+        self._dpt_tokens += 1.0     # one token per live slot, per slot
+        _m.DISPATCHES_PER_TOKEN.set(
+            self._dpt_dispatches / max(self._dpt_tokens, 1e-9),
+            model=self.name)
         for s, r in live:
             # the stream boundary: ONE scalar pull per emitted token
             self._emit(r, int(nxt[s]))  # mxtpu-lint: disable=host-sync-in-hot-path
@@ -1174,6 +1187,11 @@ class ContinuousBatcher(DynamicBatcher):
         _m.SPEC_ACCEPT_RATE.set(
             self._spec_accepted / max(1, self._spec_drafted),
             model=self.name)
+        self._dpt_dispatches += 1
+        self._dpt_tokens += step_emitted / max(1, len(live))
+        _m.DISPATCHES_PER_TOKEN.set(
+            self._dpt_dispatches / max(self._dpt_tokens, 1e-9),
+            model=self.name)
 
     # -- step-boundary helpers ------------------------------------------
     def _emit(self, req: _GenRequest, tok: int):
@@ -1259,6 +1277,13 @@ class ContinuousBatcher(DynamicBatcher):
         fallback — the cache is shared and may have been consumed by
         donation — so fail every rider, free all slots, and reset the
         cache so the next admission starts clean."""
+        if _tdev.is_oom(err):
+            # RESOURCE_EXHAUSTED: name the implicated requests on the
+            # oom flight dump (the engine funnel already reported the
+            # failure itself, but only the batcher knows the riders)
+            _tdev.report_oom(
+                "serving.infer", err, model=self.name,
+                request_ids=[r.request_id for _, r in live])
         _telemetry.FAULT.publish(
             site="serving.infer", event="fallback",
             kind=type(err).__name__, model=self.name,
@@ -1320,6 +1345,10 @@ class ContinuousBatcher(DynamicBatcher):
                 "prefill_buckets": list(self.engine.prefill_buckets),
                 "kv_cache_bytes": int(self.engine.cache_bytes),
                 "kv_starved": self.kv_starved,
+                "dispatches_per_token":
+                    self._dpt_dispatches
+                    / max(self._dpt_tokens, 1e-9)
+                    if self._dpt_dispatches else None,
             })
             if getattr(self.engine, "draft", None) is not None:
                 out.update({

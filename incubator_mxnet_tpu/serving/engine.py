@@ -27,6 +27,7 @@ The jit is wrapped in :func:`telemetry.instrument_jit` under
 from __future__ import annotations
 
 import warnings
+import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -35,6 +36,7 @@ from ..base import MXNetError
 from ..context import current_context
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telemetry
+from .. import telemetry_device as _telemetry_device
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
            "derive_prefill_buckets", "ensure_compile_cache"]
@@ -114,6 +116,40 @@ def _canon_specs(input_specs):
     return out
 
 
+def _register_device_observers(engine) -> None:
+    """Enroll an engine in the device-observability plane
+    (telemetry_device): a program-inventory callback (``GET /programs``,
+    flight dumps) and per-owner memory attribution (params, and the KV
+    cache for generation engines).  All weak — a telemetry registration
+    must never keep a dead engine's caches alive; a collected engine
+    reports empty/zero until a successor with the same name replaces
+    the registration."""
+    wref = weakref.ref(engine)
+
+    def inventory():
+        eng = wref()
+        return eng.program_inventory() if eng is not None else {}
+
+    def param_bytes():
+        eng = wref()
+        if eng is None:
+            return 0
+        try:
+            pv, av = eng._param_fn()
+            return sum(int(v.size) * v.dtype.itemsize
+                       for vals in (pv, av) for v in vals)
+        except Exception:
+            return 0
+
+    _telemetry_device.register_inventory(engine.name, inventory)
+    _telemetry_device.register_owner("params:" + engine.name, param_bytes)
+    if hasattr(engine, "cache_bytes"):
+        def kv_bytes():
+            eng = wref()
+            return eng.cache_bytes if eng is not None else 0
+        _telemetry_device.register_owner("kv:" + engine.name, kv_bytes)
+
+
 class InferenceEngine:
     """A model as a bucketed set of compiled inference programs.
 
@@ -151,6 +187,7 @@ class InferenceEngine:
                                                self._jit)
         self._shapes_seen = set()
         self._warmup_done = False
+        _register_device_observers(self)
 
     @property
     def input_dtypes(self):
@@ -215,15 +252,22 @@ class InferenceEngine:
         self._shapes_seen.add(tuple(v.shape for v in in_vals))
         param_vals, aux_vals = self._param_fn()
         key = _random.new_key(self._ctx)
-        with _telemetry.trace_span("serve.infer", cat="serving",
-                                   model=self.name,
-                                   batch=int(in_vals[0].shape[0])):
-            # donation is advisory on CPU; silence the per-call notice
-            with warnings.catch_warnings():
-                warnings.filterwarnings(
-                    "ignore", message="Some donated buffers were not usable")
-                return self._call(in_vals, tuple(param_vals),
-                                  tuple(aux_vals), key)
+        try:
+            with _telemetry.trace_span("serve.infer", cat="serving",
+                                       model=self.name,
+                                       batch=int(in_vals[0].shape[0])):
+                # donation is advisory on CPU; silence the per-call notice
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    return self._call(in_vals, tuple(param_vals),
+                                      tuple(aux_vals), key)
+        except Exception as e:
+            if _telemetry_device.is_oom(e):
+                _telemetry_device.report_oom("serving." + self.name, e,
+                                             model=self.name)
+            raise
 
     def predict(self, arrays: Sequence) -> List:
         """Run one batch: pad up to the next bucket, dispatch ONE
@@ -280,6 +324,21 @@ class InferenceEngine:
             return int(self._jit._cache_size())
         except Exception:
             return len(self._shapes_seen)
+
+    def program_inventory(self) -> dict:
+        """Runtime program-set inventory (``GET /programs``, flight
+        dumps): expected vs compiled program counts plus this engine's
+        dispatch-ledger row (dispatch count, wall-time stats,
+        last-dispatch age)."""
+        site = "serving:" + self.name
+        ledger = _telemetry.dispatch_ledger(prefix=site)
+        return {
+            "model": self.name,
+            "expected_programs": len(self.buckets) or None,
+            "compiled_programs": self.compiled_programs(),
+            "warm": self.warm,
+            "programs": {k: v for k, v in ledger.items() if k == site},
+        }
 
     def __repr__(self):
         return (f"<InferenceEngine {self.name!r}: inputs="
@@ -603,6 +662,7 @@ class GenerationEngine:
         self.spec_k = 0
         self._warmup_done = False
         self.reset()
+        _register_device_observers(self)
 
     # -- parameters -----------------------------------------------------
     def _settle_params(self):
@@ -1007,6 +1067,9 @@ class GenerationEngine:
             self._cache = tuple(jnp.zeros((N, H, bs, D), jnp.float32)
                                 for _ in range(2 * self.num_layers))
             self.pool.reset()
+            # bytes behind one block across all layers — lets the pool
+            # report occupancy in bytes (device-memory attribution)
+            self.pool.block_bytes = self.cache_bytes // self.num_blocks
             self._slot_blocks = [[] for _ in range(self.max_slots)]
             self._tables = _np.zeros(
                 (self.max_slots, self.max_blocks_per_slot), _np.int32)
@@ -1042,10 +1105,21 @@ class GenerationEngine:
         param_vals, aux_vals = self._param_fn()
         from .. import random as _random
         key = _random.new_key(self._ctx)
-        with warnings.catch_warnings():
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            return call(self._cache, *args, param_vals, aux_vals, key)
+        try:
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                return call(self._cache, *args, param_vals, aux_vals, key)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED here is the device running out of HBM
+            # mid-dispatch: publish the oom FAULT so the flight recorder
+            # writes one debounced postmortem carrying the memory
+            # breakdown, program inventory, and per-slot KV occupancy.
+            if _telemetry_device.is_oom(e):
+                _telemetry_device.report_oom("serving." + self.name, e,
+                                             model=self.name)
+            raise
 
     def prefill(self, tokens, slot: int,
                 reserve_tokens: Optional[int] = None) -> int:
@@ -1356,6 +1430,42 @@ class GenerationEngine:
         out.update(self.pool.stats())
         return out
 
+    def slot_occupancy(self) -> List[dict]:
+        """Per-slot KV occupancy (paged mode; ``[]`` dense): blocks held
+        and reserved token capacity per live slot — the flight-dump view
+        of who holds the pool when an OOM hits."""
+        if not self.paged:
+            return []
+        out = []
+        for slot, blocks in enumerate(self._slot_blocks):
+            if blocks:
+                out.append({"slot": slot, "blocks": len(blocks),
+                            "reserved_tokens":
+                                len(blocks) * self.block_size})
+        return out
+
+    def program_inventory(self) -> dict:
+        """Runtime program-set inventory (``GET /programs``, merged into
+        ``/v1/models``, woven into flight dumps): the closed-set
+        accounting (expected vs AOT-compiled programs) next to the
+        per-program dispatch-ledger rows — what actually ran, how often,
+        how long ago — plus per-slot KV occupancy.  Recurses into an
+        attached draft engine."""
+        prefix = "serving:" + self.name + ":"
+        inv = {
+            "model": self.name,
+            "expected_programs": self.expected_programs,
+            "compiled_programs": self.compiled_programs(),
+            "warm": self.warm,
+            "paged": self.paged,
+            "spec_k": self.spec_k if self.draft is not None else 0,
+            "programs": _telemetry.dispatch_ledger(prefix=prefix),
+            "slots": self.slot_occupancy(),
+        }
+        if self.draft is not None:
+            inv["draft"] = self.draft.program_inventory()
+        return inv
+
     # -- warmup / introspection -----------------------------------------
     @property
     def expected_programs(self) -> int:
@@ -1405,6 +1515,17 @@ class GenerationEngine:
         self.reset()
         if self.draft is not None:
             self.draft.warmup()
+        # closed-set accounting must balance HERE, loudly: a warmup that
+        # compiled more programs than expected_programs predicts means
+        # the program set is not closed (a per-request shape leaked into
+        # a trace); fewer means the inventory over-promises and the
+        # readiness gate would wait forever on real cache misses.
+        compiled = self.compiled_programs()
+        if compiled and compiled != self.expected_programs:
+            raise MXNetError(
+                f"{self.name}: program accounting drift after warmup — "
+                f"compiled {compiled} programs, expected "
+                f"{self.expected_programs} (closed program set violated)")
         self._warmup_done = True
         return self.expected_programs
 

@@ -76,6 +76,10 @@ class BlockPool:
         self.block_size = int(block_size)
         self.prefix_cache = bool(prefix_cache)
         self._model = model
+        # device bytes behind one block (set by the owning engine once
+        # its cache arrays exist) — lets stats() speak bytes, the unit
+        # the device-memory plane attributes in (telemetry_device)
+        self.block_bytes = 0
         self._lock = threading.RLock()
         self.hits = 0            # blocks reused from the prefix cache
         self.evictions = 0       # idle cached blocks reclaimed (LRU)
@@ -364,7 +368,7 @@ class BlockPool:
         with self._lock:
             total = self.num_blocks - 1
             in_use = total - len(self._free) - len(self._idle)
-            return {
+            out = {
                 "kv_block_size": self.block_size,
                 "kv_blocks_total": total,
                 "kv_blocks_in_use": in_use,
@@ -375,3 +379,7 @@ class BlockPool:
                 "prefix_cache_evictions": self.evictions,
                 "rewinds": self.rewinds,
             }
+            if self.block_bytes:
+                out["kv_bytes_total"] = total * self.block_bytes
+                out["kv_bytes_in_use"] = in_use * self.block_bytes
+            return out

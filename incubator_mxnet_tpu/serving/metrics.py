@@ -173,6 +173,11 @@ SPEC_ACCEPT_RATE = _telemetry.registry.gauge(
     "mxtpu_spec_accept_rate",
     "fraction of drafted tokens the target accepted, cumulative per "
     "model (tune MXNET_SPEC_K down when this drops)")
+DISPATCHES_PER_TOKEN = _telemetry.registry.gauge(
+    "mxtpu_dispatches_per_token",
+    "target-model dispatches per emitted token, cumulative per model "
+    "(per-slot normalized: exactly 1.0 for plain decode, < 1.0 when "
+    "speculation amortizes dispatches over accepted bursts)")
 
 # SLO plane (serving/slo.py; docs/observability.md) -------------------------
 SLO_AVAILABILITY = _telemetry.registry.gauge(

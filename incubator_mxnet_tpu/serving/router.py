@@ -1160,6 +1160,79 @@ class Router:
         handler.send_json(503, {"error": "no eligible replica"},
                           headers={"Retry-After": 1})
 
+    def fanout_get(self, path: str,
+                   timeout: Optional[float] = None) -> dict:
+        """GET ``path`` on EVERY eligible replica in parallel and return
+        ``{replica_id: body}`` — the fleet view behind the router's
+        ``/programs`` and ``/memory`` routes (one replica's answer is
+        not the fleet's: program sets and memory are per-process)."""
+        reps = self._eligible()
+        out: dict = {}
+
+        def one(rep):
+            try:
+                status, body = self._get_json(
+                    rep, path,
+                    self.upstream_timeout if timeout is None
+                    else timeout)
+                out[rep.id] = body if status == 200 \
+                    else {"error": f"HTTP {status}", "status": status}
+            except OSError as e:
+                out[rep.id] = {"error": str(e)}
+
+        threads = [threading.Thread(target=one, args=(r,), daemon=True)
+                   for r in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    def profile_fanout(self, seconds: float) -> dict:
+        """``POST /debug/profile`` fan-out: trigger one on-demand
+        profiler capture on every eligible replica in parallel and
+        collect the per-replica artifact paths.  Replica-side capture
+        blocks for the window plus profiler startup and trace
+        serialization (the FIRST capture in a process costs seconds on
+        its own), so the upstream timeout is the window plus a generous
+        margin — never the router's default."""
+        reps = self._eligible()
+        results: dict = {}
+        timeout = float(seconds) + max(30.0, 2.0 * float(seconds))
+
+        def one(rep):
+            conn = self._connect(rep, timeout)
+            try:
+                try:
+                    conn.request(
+                        "POST", f"/debug/profile?seconds={seconds}",
+                        body=b"{}",
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (OSError, http.client.HTTPException) as e:
+                    results[rep.id] = {"error": str(e)}
+                    return
+                try:
+                    body = json.loads(data.decode("utf-8")) \
+                        if data else {}
+                except (ValueError, UnicodeDecodeError):
+                    body = {}
+                if resp.status != 200:
+                    body.setdefault("error", f"HTTP {resp.status}")
+                    body["status"] = resp.status
+                results[rep.id] = body
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=one, args=(r,), daemon=True)
+                   for r in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {"seconds": float(seconds), "replicas": results}
+
     # -- drain orchestration ---------------------------------------------
     def _admin(self, rep: Replica, path: str) -> None:
         conn = self._connect(rep)
@@ -1317,6 +1390,10 @@ class _RouterHandler(BaseJSONHandler):
                                               for r in router.replicas]})
         elif path == "/v1/models":
             router.forward_get(self, path)
+        elif path in ("/programs", "/memory"):
+            # per-replica fan-out: program sets and device memory are
+            # per-process facts — no single replica speaks for the fleet
+            self.send_json(200, {"replicas": router.fanout_get(path)})
         elif path == "/slo":
             self.send_json(200, router.fleet_slo())
         elif path == "/trace":
@@ -1339,12 +1416,27 @@ class _RouterHandler(BaseJSONHandler):
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
                                 "/readyz /replicas /metrics /slo "
+                                "/programs /memory "
                                 "/trace?request_id=<rid>\n")
 
     def _post(self):
         router: Router = self.server.router
         path = self.path.split("?", 1)[0]
         rid = self.request_id()
+        if path == "/debug/profile":
+            # fan the capture out to every eligible replica and return
+            # one artifact path per replica (each replica enforces its
+            # own single-capture guard — a busy one answers 409 inline)
+            from urllib.parse import parse_qs, urlsplit
+            params = parse_qs(urlsplit(self.path).query)
+            try:
+                seconds = float(params.get("seconds", ["1.0"])[0])
+            except ValueError:
+                self.send_json(400, {"error":
+                                     "seconds must be a number"})
+                return
+            self.send_json(200, router.profile_fanout(seconds))
+            return
         if path in ("/admin/drain", "/admin/undrain"):
             try:
                 body = self.read_json()

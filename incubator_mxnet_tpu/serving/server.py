@@ -51,6 +51,17 @@ Routes (JSON tensors everywhere):
 * ``GET /trace`` — the span tree, bounded (``?limit=``/``?since=``)
   with per-request lookup (``?request_id=``); same contract as the
   telemetry exporter's route (shared via ``telemetry_http.trace_body``).
+* ``GET /programs`` — the runtime program-set inventory: the dispatch
+  ledger (per-site dispatch counts, wall-time percentiles, compile
+  time, last-dispatch age) plus every engine's expected-vs-compiled
+  accounting — the closed-program-set contract, checkable at runtime.
+* ``GET /memory`` — device-memory breakdown: per-device bytes-in-use /
+  peak watermarks plus the per-owner attribution
+  (``kv:<model>`` / ``params:<model>`` / ``optimizer``) and the
+  unattributed residue (telemetry_device).
+* ``POST /debug/profile?seconds=`` — on-demand ``jax.profiler``
+  capture; blocks for the (clamped) window and answers with the
+  artifact directory, 409 while another capture runs.
 * ``POST /admin/drain`` / ``POST /admin/undrain`` — the rolling-update
   pair: drain flips ``/readyz`` to 503 (port stays open, in-flight
   finishes) so a router pulls the replica; undrain takes traffic again.
@@ -82,6 +93,7 @@ from ..base import MXNetError, getenv_int
 from ..http_util import BaseJSONHandler, HTTPServerBase, \
     start_http_server, stop_http_server
 from .. import telemetry as _telemetry
+from .. import telemetry_device as _telemetry_device
 from .. import telemetry_ring as _ring
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .engine import GenerationEngine, InferenceEngine
@@ -134,6 +146,15 @@ class _Handler(BaseJSONHandler):
         elif path == "/flight":
             from .. import telemetry_http
             self.send_json(200, telemetry_http.flight_body())
+        elif path == "/programs":
+            # the runtime program-set inventory: dispatch ledger plus
+            # every engine's expected-vs-compiled accounting — the
+            # closed-program-set contract, observable at runtime
+            self.send_json(200, ms.program_report())
+        elif path == "/memory":
+            # refresh + return the device-memory breakdown (per-device
+            # watermarks, per-owner attribution, unattributed residue)
+            self.send_json(200, _telemetry_device.sample())
         elif path == "/metrics.json":
             from .. import telemetry_http
             self.send_json(200, telemetry_http.metrics_state_body())
@@ -144,7 +165,7 @@ class _Handler(BaseJSONHandler):
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
                                 "/readyz /metrics /metrics.json /slo "
-                                "/trace /flight\n")
+                                "/trace /flight /programs /memory\n")
 
     def _remote_trace(self):
         """Adopt the router's ``X-Trace-Id`` hop as the remote parent of
@@ -161,6 +182,30 @@ class _Handler(BaseJSONHandler):
     def _post(self):
         ms = self.server.model_server
         path = self.path.split("?", 1)[0]
+        if path == "/debug/profile":
+            # on-demand jax.profiler capture: blocks THIS handler
+            # thread for the (clamped) window, then names the artifact
+            # directory; a second concurrent capture answers 409
+            from urllib.parse import parse_qs, urlsplit
+            params = parse_qs(urlsplit(self.path).query)
+            try:
+                seconds = float(params.get("seconds", ["1.0"])[0])
+            except ValueError:
+                self.send_json(400, {"error":
+                                     "seconds must be a number"})
+                return
+            try:
+                artifact = _telemetry_device.capture_profile(seconds)
+            except _telemetry_device.CaptureBusy as e:
+                self.send_json(409, {"error": str(e)},
+                               headers=_retry_after_header(1.0))
+                return
+            except Exception as e:
+                self.send_json(500, {"error": f"profiler capture "
+                                     f"failed: {e}"})
+                return
+            self.send_json(200, {"profile": artifact})
+            return
         if path == "/admin/drain":
             # flip to DRAINING without closing the port: /readyz answers
             # 503 so the router/balancer stops sending, in-flight work
@@ -419,7 +464,23 @@ class ModelServer:
     def model_stats(self) -> dict:
         with self._lock:
             items = sorted(self._models.items())
-        return {n: b.stats() for n, b in items}
+        out = {}
+        for n, b in items:
+            st = b.stats()
+            inv = getattr(b.engine, "program_inventory", None)
+            if inv is not None:
+                try:        # program accounting rides /v1/models too
+                    st["programs"] = inv()
+                except Exception as e:
+                    st["programs"] = {"error": repr(e)}
+            out[n] = st
+        return out
+
+    def program_report(self) -> dict:
+        """``GET /programs``: the dispatch ledger plus every registered
+        engine's expected-vs-compiled program accounting (also the
+        ``programs`` provider in flight dumps — telemetry_device)."""
+        return _telemetry_device.program_report()
 
     # -- health ---------------------------------------------------------
     def model_state(self, name: str) -> str:
@@ -612,6 +673,9 @@ class ModelServer:
         # the serving section of every dump
         _ring.recorder.start()
         _ring.recorder.register_provider("serving", self._flight_state)
+        # background device-memory gauge sampler (no-op unless
+        # MXNET_DEVICE_MEM_INTERVAL_SECONDS > 0 — scrapes refresh too)
+        _telemetry_device.start_sampler()
         return self
 
     def _flight_state(self) -> dict:
@@ -693,6 +757,7 @@ class ModelServer:
         if self._http is not None:
             _ring.recorder.unregister_provider("serving")
             _ring.recorder.stop()
+            _telemetry_device.stop_sampler()
         stop_http_server(self._http)
         self._http = None
         with self._lock:

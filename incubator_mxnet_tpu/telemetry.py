@@ -92,6 +92,7 @@ __all__ = [
     "start", "stop", "enabled", "reset",
     "snapshot", "render_prometheus", "counters_flat", "dump",
     "instrument_jit", "sample_device_memory",
+    "dispatch_ledger", "reset_dispatch_ledger",
     "TPU_PEAK_FLOPS", "tpu_peak_flops", "cpu_peak_flops",
     "device_peak_flops",
 ]
@@ -1109,6 +1110,138 @@ def sample_device_memory() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Dispatch ledger (device-plane observability; docs/observability.md)
+# ---------------------------------------------------------------------------
+# One entry per instrument_jit site, ALWAYS on: per-dispatch count, a
+# bounded wall-time reservoir, compile accounting (while the collector
+# observes), the wall clock of the last dispatch, and a live handle to
+# the pjit cache size.  This is the runtime program-set inventory — the
+# dynamic counterpart of mxtpu-lint's static closed-program-set check:
+# a site whose cache keeps growing after warmup, or a compiled program
+# that is never dispatched, shows up here at runtime.
+_LEDGER_RESERVOIR = 512
+
+
+class _LedgerEntry:
+    __slots__ = ("site", "dispatches", "seconds_sum", "seconds_max",
+                 "samples", "compiles", "compile_seconds", "last_t",
+                 "size_fn", "lock", "_key")
+
+    def __init__(self, site: str):
+        self.site = site
+        self.dispatches = 0
+        self.seconds_sum = 0.0
+        self.seconds_max = 0.0
+        self.samples = deque(maxlen=_LEDGER_RESERVOIR)
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.last_t: Optional[float] = None
+        self.size_fn: Optional[Callable[[], int]] = None
+        self.lock = threading.Lock()
+        self._key = (("site", site),)   # precomputed counter label key
+
+    def record(self, dt: float) -> None:
+        c = _ledger_dispatches
+        with c._lock:
+            c._values[self._key] = c._values.get(self._key, 0.0) + 1.0
+        _ledger_seconds.observe(dt)
+        with self.lock:
+            self.dispatches += 1
+            self.seconds_sum += dt
+            if dt > self.seconds_max:
+                self.seconds_max = dt
+            self.samples.append(dt)
+            self.last_t = time.time()
+
+    def record_compile(self, dt: float) -> None:
+        with self.lock:
+            self.compiles += 1
+            self.compile_seconds += dt
+
+    def _reset(self) -> None:
+        with self.lock:
+            self.dispatches = 0
+            self.seconds_sum = 0.0
+            self.seconds_max = 0.0
+            self.samples.clear()
+            self.compiles = 0
+            self.compile_seconds = 0.0
+            self.last_t = None
+
+
+_ledger_dispatches = registry.counter(
+    "mxtpu_dispatches_total",
+    "compiled-program dispatches, by instrumented jit site")
+_ledger_seconds = registry.histogram(
+    "mxtpu_dispatch_seconds",
+    "host wall seconds per compiled-program dispatch (all sites)")
+_ledger: Dict[str, _LedgerEntry] = {}
+_ledger_lock = threading.Lock()
+
+
+def _ledger_entry(site: str) -> _LedgerEntry:
+    e = _ledger.get(site)
+    if e is None:
+        with _ledger_lock:
+            e = _ledger.setdefault(site, _LedgerEntry(site))
+    return e
+
+
+def dispatch_ledger(prefix: Optional[str] = None) -> Dict[str, dict]:
+    """JSON-ready snapshot of the per-site dispatch ledger: dispatch
+    count, wall-time stats over the bounded reservoir, compile count and
+    blocking seconds (counted while the collector observes), seconds
+    since the last dispatch, and — when the wrapped pjit exposes its
+    cache — the number of executables currently compiled at the site.
+    ``prefix`` filters sites (e.g. ``"serving:gen"`` for one engine's
+    programs)."""
+    now = time.time()
+    out: Dict[str, dict] = {}
+    for site in sorted(_ledger):
+        if prefix is not None and not site.startswith(prefix):
+            continue
+        e = _ledger[site]
+        with e.lock:
+            data = sorted(e.samples)
+            d = {
+                "site": site,
+                "dispatches": e.dispatches,
+                "seconds_sum": round(e.seconds_sum, 6),
+                "seconds_max": round(e.seconds_max, 6),
+                "compiles": e.compiles,
+                "compile_seconds": round(e.compile_seconds, 6),
+                "last_dispatch_age_s": None if e.last_t is None
+                else round(now - e.last_t, 3),
+            }
+        if data:
+            d["seconds_p50"] = round(
+                data[min(len(data) - 1,
+                         int(round(0.5 * (len(data) - 1))))], 6)
+            d["seconds_p99"] = round(
+                data[min(len(data) - 1,
+                         int(round(0.99 * (len(data) - 1))))], 6)
+        size_fn = e.size_fn
+        compiled = None
+        if size_fn is not None:
+            try:
+                compiled = int(size_fn())
+            except Exception:
+                compiled = None
+        d["compiled"] = compiled
+        out[site] = d
+    return out
+
+
+def reset_dispatch_ledger() -> None:
+    """Zero every ledger entry in place (test hygiene; the entries stay
+    registered — instrument_jit wrappers hold direct references)."""
+    with _ledger_lock:
+        entries = list(_ledger.values())
+    for e in entries:
+        e._reset()
+
+
+# ---------------------------------------------------------------------------
 # Compile instrumentation + cost accountant
 # ---------------------------------------------------------------------------
 def _arg_signature(args, kwargs):
@@ -1152,12 +1285,21 @@ def instrument_jit(where: str, jitted: Callable) -> Callable:
       cost subscriber is attached.
     * **Span tracer** — the dispatch is wrapped in a ``jit:<where>`` span
       while tracing is active, so compiled-call time nests under the
-      caller's step/forward span in the flame graph."""
+      caller's step/forward span in the flame graph.
+
+    Independent of all three consumers, every call lands in the
+    process-wide **dispatch ledger** (:func:`dispatch_ledger`): per-site
+    dispatch counts, host wall-time histograms and last-dispatch age —
+    the always-on runtime program inventory.  Cost on the unobserved
+    fast path: two ``perf_counter`` reads and two dict updates per
+    dispatch."""
     size_fn = getattr(jitted, "_cache_size", None)
     lower_fn = getattr(jitted, "lower", None)
     state = {"first": True}
     costs: Dict[tuple, tuple] = {}
     span_name = "jit:" + where
+    ledger = _ledger_entry(where)
+    ledger.size_fn = size_fn       # latest wrapper wins (re-created jits)
 
     def _cost(args, kwargs):
         try:
@@ -1185,7 +1327,10 @@ def instrument_jit(where: str, jitted: Callable) -> Callable:
         costing = bool(XLA_COST.subscribers)
         tracing = tracer.active
         if not (observing or costing or tracing):
-            return jitted(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            ledger.record(time.perf_counter() - t0)
+            return out
         flops = nbytes = 0.0
         if costing:
             flops, nbytes = _cost(args, kwargs)
@@ -1205,6 +1350,7 @@ def instrument_jit(where: str, jitted: Callable) -> Callable:
             if sp is not None:
                 tracer._end(sp)
         dt = time.perf_counter() - t0
+        ledger.record(dt)
         if observing:
             grew = None
             if before is not None:
@@ -1215,6 +1361,7 @@ def instrument_jit(where: str, jitted: Callable) -> Callable:
             if grew is None:
                 grew = state["first"]
             if grew:
+                ledger.record_compile(dt)
                 COMPILE.publish(where=where, event="miss", seconds=dt)
             else:
                 COMPILE.publish(where=where, event="hit")
@@ -1484,9 +1631,10 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Zero all metric values, drop recorded spans, restart the MFU
-    window."""
+    window, zero the dispatch ledger."""
     registry.reset()
     tracer.clear()
+    reset_dispatch_ledger()
     _mfu.update(flops=0.0, last_t=None, last_flops=0.0, peak=None)
 
 

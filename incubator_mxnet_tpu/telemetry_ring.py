@@ -17,6 +17,7 @@ breaker trip         ``event="breaker", kind="OPEN"``
 non-finite skip      ``event="skipped_step"``
 SIGTERM drain        ``event="shutdown"``
 worker crash         ``event="crash"``
+device OOM           ``event="oom"`` (RESOURCE_EXHAUSTED dispatch)
 ===================  =======================================
 
 A dump is the ring contents plus a full metrics snapshot plus whatever
@@ -76,6 +77,7 @@ _TRIGGERS = {
     "skipped_step": "nonfinite_skip",
     "shutdown": "sigterm_drain",
     "crash": "worker_crash",
+    "oom": "resource_exhausted",
 }
 
 

@@ -24,6 +24,22 @@ except AttributeError:
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8")
 
+# Optional persistent XLA compilation cache for local iteration: tier-1
+# wall time on a small CPU box is dominated by serialized XLA compiles,
+# and warm re-runs can skip them (probe: test_model_zoo 36s -> 13s).
+# STRICTLY opt-in (MXNET_TEST_COMPILE_CACHE=1): with the cache enabled,
+# a handful of bit-identity tests (checkpoint resume, zero1 interop,
+# fused-vs-unfused optimizer) observe different executable numerics on
+# cache hits, so CI runs cold.  Test-only knob, deliberately not in
+# docs/env_var.md (the registry lint scopes that file to package code).
+if os.environ.get("MXNET_TEST_COMPILE_CACHE", "0") == "1":
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("MXNET_TEST_COMPILE_CACHE_DIR",
+                       "/tmp/mxtpu_test_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import numpy as _np  # noqa: E402
 import pytest  # noqa: E402
 
