@@ -117,6 +117,15 @@ usage: ci/run_tests.sh <function>
                         replica; federated kv:gen owner bytes on the
                         router /metrics; one POST /debug/profile
                         fan-out returns an artifact per replica
+  health_smoke          health-plane drill (three parts): a golden
+                        poisoned run plane-OFF (skip guard eats an
+                        injected gradient NaN), the same run under
+                        MXNET_HEALTH_PLANE=1 — the detector names the
+                        first non-finite leaf at the exact poisoned
+                        step and the flight recorder writes exactly
+                        ONE debounced training_anomaly dump carrying
+                        the attribution — then a bit-identical param
+                        compare across the two runs
   multichip_dryrun      8-virtual-device full-train-step compile+run
   static                mxtpu-lint static analysis (host-sync, donation,
                         closed-program-set, lock-discipline,
@@ -1208,6 +1217,17 @@ device_obs_smoke() {
     JAX_PLATFORMS=cpu python tools/device_obs_smoke.py all \
         --cache-dir "$cc" \
         --profile-dir /tmp/mxtpu_device_obs_profiles
+}
+
+health_smoke() {
+    local dir=/tmp/mxtpu_health_smoke
+    rm -rf "$dir"
+    mkdir -p "$dir/flight"
+    JAX_PLATFORMS=cpu python tools/health_smoke.py golden --out "$dir"
+    MXNET_HEALTH_PLANE=1 MXNET_FLIGHT_DUMP_DIR="$dir/flight" \
+        JAX_PLATFORMS=cpu python tools/health_smoke.py poisoned \
+        --out "$dir"
+    JAX_PLATFORMS=cpu python tools/health_smoke.py check --out "$dir"
 }
 
 multichip_dryrun() {

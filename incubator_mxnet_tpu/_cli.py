@@ -45,13 +45,15 @@ def stats_main():
                     [--serve [--port N]] [--slo] [--flight-dump PATH]
                     script.py [args...]
         mxtpu-stats --fleet http://router:9000 [--slo] [--out PATH]
-        mxtpu-stats --fleet URL --memory | --programs | --profile SECS
+        mxtpu-stats --fleet URL --memory | --programs | --health |
+                    --profile SECS
 
     With ``--fleet`` no script runs: the federated fleet view is pulled
     from a running ``mxtpu-router`` (or a single replica) instead — its
     aggregated ``/metrics`` exposition, merged ``/slo`` with ``--slo``,
     the device-memory breakdown with ``--memory``, the runtime
-    program-set inventory with ``--programs``, or an on-demand profiler
+    program-set inventory with ``--programs``, the health-plane report
+    with ``--health``, or an on-demand profiler
     capture (``POST /debug/profile``, fanned out to every replica when
     URL is a router) with ``--profile SECONDS`` — printed to stdout or
     ``--out``.
@@ -100,6 +102,11 @@ def stats_main():
                     help="with --fleet: fetch the runtime program-set "
                          "inventory (GET /programs — dispatch ledger + "
                          "expected-vs-compiled accounting)")
+    ap.add_argument("--health", action="store_true",
+                    help="with --fleet: fetch the health-plane report "
+                         "(GET /health — anomaly state, StepHealth ring "
+                         "tail, per-model decode stats; worst-replica "
+                         "rollup when URL is a router)")
     ap.add_argument("--profile", metavar="SECONDS", type=float,
                     default=None,
                     help="with --fleet: trigger an on-demand profiler "
@@ -113,9 +120,9 @@ def stats_main():
 
     if ns.fleet:
         sys.exit(_fleet_stats(ns))
-    if ns.memory or ns.programs or ns.profile is not None:
-        ap.error("--memory/--programs/--profile need --fleet URL "
-                 "(they query a running server)")
+    if ns.memory or ns.programs or ns.health or ns.profile is not None:
+        ap.error("--memory/--programs/--health/--profile need --fleet "
+                 "URL (they query a running server)")
     if ns.script is None:
         ap.error("a script is required unless --fleet URL is given")
 
@@ -165,8 +172,9 @@ def stats_main():
 
 def _fleet_stats(ns) -> int:
     """``mxtpu-stats --fleet URL``: fetch the router's federated view
-    (``/metrics`` by default; ``--slo``/``--memory``/``--programs``
-    pick the JSON views, ``--profile SECONDS`` triggers a capture)."""
+    (``/metrics`` by default; ``--slo``/``--memory``/``--programs``/
+    ``--health`` pick the JSON views, ``--profile SECONDS`` triggers a
+    capture)."""
     from urllib.error import URLError
     from urllib.request import Request, urlopen
 
@@ -186,6 +194,8 @@ def _fleet_stats(ns) -> int:
         path = "/memory"
     elif ns.programs:
         path = "/programs"
+    elif ns.health:
+        path = "/health"
     elif ns.slo:
         path = "/slo"
     else:

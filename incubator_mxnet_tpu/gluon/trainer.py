@@ -237,6 +237,14 @@ class Trainer:
         the counter (monitors do; the training loop never needs to)."""
         self._drain_nonfinite(block=True)
 
+    def sync_health(self):
+        """Block until pending health-plane device stats are folded into
+        the StepHealth ring (health.py) — exact records/anomalies for a
+        monitor about to read them.  No-op with ``MXNET_HEALTH_PLANE``
+        off or when the fused path never engaged."""
+        if self._fused is not None and self._fused._health is not None:
+            self._fused._health.sync()
+
     def _grads_nonfinite(self) -> bool:
         # one fused check, one host sync (amp.all_finite)
         from ..contrib.amp.loss_scaler import all_finite

@@ -1,0 +1,433 @@
+"""In-program health plane: device-side training/decode statistics with
+zero host syncs on the hot path.
+
+Whole-step capture (fused optimizer, SPMD step, CompiledLoop chunks)
+amortized the host out of the training loop — and blinded it: when a
+chunk skips a non-finite step today the host learns only a COUNT, not
+which parameter leaf went non-finite, what the grad norms looked like,
+or when the loss started drifting.  This module computes that evidence
+INSIDE the donated programs and surfaces it asynchronously:
+
+* :func:`train_step_health` — per-leaf gradient L2 norms, a per-leaf
+  finite mask, update/weight ratios and the loss / global-grad-norm
+  scalars, traced as pure EXTRA outputs of the step/chunk program.  The
+  inputs are firewalled behind ``jax.lax.optimization_barrier`` so the
+  stats cannot fuse into (and re-associate) the update arithmetic —
+  enabling the plane is bit-exact on params (the zero1 all-gather
+  precedent; asserted by tests/test_health.py).
+* :class:`HealthMonitor` — the host-side companion: device stat trees
+  queue per dispatch and drain only when already finished
+  (``is_ready()``, the ``CompiledLoop._drain_skipped`` pattern) or at
+  explicit sync points, so the mxtpu-lint host-sync checker stays
+  clean.  Drained records fold into :data:`telemetry.health_ring` (the
+  bounded StepHealth ring) and feed the anomaly detector.
+* the anomaly detector — loss spike vs a rolling window
+  (``MXNET_HEALTH_SPIKE_FACTOR`` x window mean), grad-norm explosion
+  (``MXNET_HEALTH_GRADNORM_FACTOR``), and first-nonfinite-leaf
+  attribution by tree path.  Every anomaly publishes the ``HEALTH``
+  topic, bumps ``mxtpu_health_anomalies`` and fires a debounced FAULT
+  ``event="anomaly"`` — which the flight recorder maps to a
+  ``training_anomaly`` dump whose payload (the ``health`` provider
+  below) names the exact offending leaf, the step, the last-k
+  StepHealth records, and the dispatch-ledger context.
+* :func:`decode_health` — the serving twin: per-decode-step logit max /
+  entropy / finite-check ride the decode outputs
+  (``serving/engine.py``); the continuous batcher turns a non-finite
+  row into a ``nonfinite_generation`` anomaly naming the implicated
+  request ids.
+
+Everything is gated by ``MXNET_HEALTH_PLANE`` (default off): with the
+plane off the compiled programs are byte-identical to before this
+module existed.  Knobs (docs/env_var.md): ``MXNET_HEALTH_PLANE``,
+``MXNET_HEALTH_RING``, ``MXNET_HEALTH_WINDOW``,
+``MXNET_HEALTH_SPIKE_FACTOR``, ``MXNET_HEALTH_GRADNORM_FACTOR``.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+import weakref as _weakref
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from .base import getenv_bool, getenv_float, getenv_int
+from . import telemetry as _telemetry
+
+__all__ = ["enabled", "train_step_health", "decode_health",
+           "HealthMonitor", "serving_anomaly", "sync", "last_anomaly",
+           "report", "reset"]
+
+#: anomalies of one kind re-fire the FAULT dump trigger at most once per
+#: this many seconds (a NaN plateau flags every step; one incident, one
+#: artifact) — the flight recorder debounces per-reason on top
+_FAULT_DEBOUNCE_S = 5.0
+
+#: spike detection needs this many finite in-window samples first
+_MIN_WINDOW = 8
+
+
+def enabled() -> bool:
+    """``MXNET_HEALTH_PLANE``: trace health stats into the compiled
+    step/chunk/decode programs (default off — programs unchanged)."""
+    return getenv_bool("MXNET_HEALTH_PLANE", False)
+
+
+def window_size() -> int:
+    """``MXNET_HEALTH_WINDOW``: rolling-window length (steps) for the
+    loss-spike / grad-explosion baselines."""
+    return max(2, getenv_int("MXNET_HEALTH_WINDOW", 32))
+
+
+def spike_factor() -> float:
+    """``MXNET_HEALTH_SPIKE_FACTOR``: loss > factor x window mean flags
+    a ``loss_spike`` anomaly."""
+    return getenv_float("MXNET_HEALTH_SPIKE_FACTOR", 4.0)
+
+
+def gradnorm_factor() -> float:
+    """``MXNET_HEALTH_GRADNORM_FACTOR``: global grad norm > factor x
+    window mean flags a ``grad_norm_explosion`` anomaly."""
+    return getenv_float("MXNET_HEALTH_GRADNORM_FACTOR", 10.0)
+
+
+# ---------------------------------------------------------------------------
+# In-program stat computation (traced; pure extra outputs)
+# ---------------------------------------------------------------------------
+def train_step_health(grads: Sequence, weights: Sequence,
+                      new_weights: Sequence, loss=None) -> Dict[str, object]:
+    """Trace per-leaf health stats over aligned leaf lists.
+
+    Returns a dict of device arrays (all f32/bool, so pulling them
+    never perturbs or retains the training dtypes):
+
+    * ``grad_norms``   (n,) per-leaf L2 norm of the raw gradient
+    * ``finite``       (n,) per-leaf all-finite mask
+    * ``update_ratios``(n,) ||w' - w|| / (||w|| + eps) — 0 on a
+      guard-skipped step, the update signature of a frozen leaf
+    * ``grad_norm``    ()  global L2 norm
+    * ``loss``         ()  (only when ``loss`` is given)
+
+    Inputs pass through ``optimization_barrier`` first: the barrier
+    keeps this reduction tree OUT of the update arithmetic's fusion
+    clusters, so XLA cannot re-contract the update's multiply-add
+    chains around it — enabling the plane stays bit-exact on params.
+    """
+    import jax
+    import jax.numpy as jnp
+    f32 = jnp.float32
+    gs = [jax.lax.optimization_barrier(g) for g in grads]
+    ws = [jax.lax.optimization_barrier(w) for w in weights]
+    nws = [jax.lax.optimization_barrier(w) for w in new_weights]
+    gsq = [jnp.sum(jnp.square(g.astype(f32))) for g in gs]
+    eps = jnp.asarray(1e-12, f32)
+    ratios = []
+    for w, nw in zip(ws, nws):
+        w32 = w.astype(f32)
+        d = nw.astype(f32) - w32
+        ratios.append(jnp.sqrt(jnp.sum(jnp.square(d)))
+                      / (jnp.sqrt(jnp.sum(jnp.square(w32))) + eps))
+    norms = jnp.sqrt(jnp.stack(gsq))
+    out = {
+        "grad_norms": norms,
+        # derived from the sum of squares instead of a dedicated
+        # isfinite pass over every leaf: NaN/Inf propagate through the
+        # reduction, so a leaf is flagged iff its norm is non-finite
+        # (grads large enough to overflow the f32 square ARE the
+        # explosion this mask exists to catch)
+        "finite": jnp.isfinite(norms),
+        "update_ratios": jnp.stack(ratios),
+        "grad_norm": jnp.sqrt(jnp.sum(jnp.stack(gsq))),
+    }
+    if loss is not None:
+        out["loss"] = jax.lax.optimization_barrier(loss).astype(f32)
+    return out
+
+
+def decode_health(logits):
+    """Trace per-slot decode health from last-position logits (S, V):
+    returns ``(logit_max (S,), entropy (S,) nats, finite (S,))``.  Same
+    barrier firewall as :func:`train_step_health` — the decode argmax
+    stays bit-identical with the plane on."""
+    import jax
+    import jax.numpy as jnp
+    lg = jax.lax.optimization_barrier(logits).astype(jnp.float32)
+    m = jnp.max(lg, axis=-1)
+    z = lg - m[..., None]
+    e = jnp.exp(z)
+    s = jnp.sum(e, axis=-1)
+    ent = jnp.log(s) - jnp.sum(e * z, axis=-1) / s
+    fin = jnp.all(jnp.isfinite(lg), axis=-1)
+    return m, ent, fin
+
+
+# ---------------------------------------------------------------------------
+# Host-side monitor: async drain + anomaly detection
+# ---------------------------------------------------------------------------
+_lock = threading.Lock()
+_monitors: List["_weakref.ref"] = []
+_last_anomaly: Optional[dict] = None
+_serving_fault: Dict[str, float] = {}
+
+
+def _register(mon: "HealthMonitor") -> None:
+    with _lock:
+        _monitors[:] = [r for r in _monitors if r() is not None]
+        _monitors.append(_weakref.ref(mon))
+
+
+def sync() -> None:
+    """Block until every live monitor's pending device stats are drained
+    — records exact in the ring, detector caught up.  Call at
+    checkpoint/eval boundaries; the training loop never needs to."""
+    with _lock:
+        refs = list(_monitors)
+    for r in refs:
+        mon = r()
+        if mon is not None:
+            mon.sync()
+
+
+def last_anomaly() -> Optional[dict]:
+    """The most recent anomaly (any monitor), or None."""
+    return _last_anomaly
+
+
+def reset() -> None:
+    """Forget the last anomaly and drop monitor debounce state (test
+    hygiene; live monitors and the ring survive — clear the ring via
+    ``telemetry.health_ring.clear()``)."""
+    global _last_anomaly
+    with _lock:
+        refs = list(_monitors)
+        _last_anomaly = None
+        _serving_fault.clear()
+    for r in refs:
+        mon = r()
+        if mon is not None:
+            mon._last_fault.clear()
+
+
+def serving_anomaly(model: str, step: int,
+                    request_ids: Sequence[str],
+                    detail: str = "") -> None:
+    """Record a serving-side ``nonfinite_generation`` anomaly: a decode
+    dispatch produced non-finite final-position logits for the
+    implicated request ids (continuous batcher, serving/batcher.py).
+    Same plumbing as the training monitors — HEALTH topic,
+    ``mxtpu_health_anomalies`` and the debounced FAULT
+    ``event="anomaly"`` that yields one ``training_anomaly`` flight
+    dump per incident."""
+    global _last_anomaly
+    kind = "nonfinite_generation"
+    info = {"kind": kind, "step": int(step), "src": str(model),
+            "leaf": None, "request_ids": [str(r) for r in request_ids],
+            "detail": detail or f"non-finite decode logits for "
+                                f"{len(request_ids)} request(s)",
+            "time_unix": round(_time.time(), 3)}
+    with _lock:
+        _last_anomaly = info
+    _telemetry.counter(
+        "mxtpu_health_anomalies",
+        "training/decode anomalies the health plane detected, "
+        "by kind").inc(kind=kind, src=str(model))
+    _telemetry.HEALTH.publish(**info)
+    now = _time.monotonic()
+    key = f"{model}:{kind}"
+    with _lock:
+        if now - _serving_fault.get(key, -1e9) < _FAULT_DEBOUNCE_S:
+            return
+        _serving_fault[key] = now
+    _telemetry.FAULT.publish(site=f"health.{model}", event="anomaly",
+                             kind=kind, step=int(step),
+                             request_ids=list(info["request_ids"]))
+
+
+class HealthMonitor:
+    """Per-trainer host companion of the in-program stats.
+
+    ``submit(step0, k, stats)`` queues one dispatch's device stat tree
+    (``k`` inner steps starting at ``step0 + 1``) and opportunistically
+    drains whatever already finished — ``is_ready()`` only, never a
+    blocking pull, so submitting from a hot path costs a list append.
+    ``sync()`` blocks (boundary use).  Folding a record updates the
+    StepHealth ring, the ``mxtpu_health_*`` series and the anomaly
+    detector."""
+
+    def __init__(self, leaf_names: Sequence[str], src: str = "trainer"):
+        self.names = [str(n) for n in leaf_names]
+        self.src = str(src)
+        self._pending: List[tuple] = []
+        n = window_size()
+        self._loss_win: deque = deque(maxlen=n)
+        self._gnorm_win: deque = deque(maxlen=n)
+        self._last_fault: Dict[str, float] = {}
+        _register(self)
+
+    # -- drain ----------------------------------------------------------
+    def submit(self, step0: int, k: int, stats: Dict[str, object]) -> None:
+        self._pending.append((int(step0), int(k), stats))
+        self.drain(block=False)
+
+    def drain(self, block: bool = False) -> None:
+        rest = []
+        for step0, k, stats in self._pending:
+            probe = stats["grad_norms"]
+            ready = block or not hasattr(probe, "is_ready") \
+                or probe.is_ready()
+            if ready:
+                self._fold(step0, k, stats)
+            else:
+                rest.append((step0, k, stats))
+        self._pending = rest
+
+    def sync(self) -> None:
+        self.drain(block=True)
+
+    # -- folding + detection (boundary time, off the hot path) ----------
+    def _fold(self, step0: int, k: int, stats: Dict[str, object]) -> None:
+        host = {kk: _np.asarray(v) for kk, v in stats.items()}
+        n = len(self.names)
+        gns = host["grad_norms"].reshape(k, n)
+        fins = host["finite"].reshape(k, n)
+        upds = host["update_ratios"].reshape(k, n)
+        gnorm = host["grad_norm"].reshape(k)
+        loss = host["loss"].reshape(k) if "loss" in host else None
+        for i in range(k):
+            step = step0 + 1 + i
+            fin_row = fins[i]
+            all_fin = bool(fin_row.all())
+            rec = {
+                "step": step,
+                "src": self.src,
+                "loss": float(loss[i]) if loss is not None else None,
+                "grad_norm": float(gnorm[i]),
+                "max_update_ratio": float(upds[i].max()) if n else 0.0,
+                "finite": all_fin,
+            }
+            if not all_fin:
+                bad = int(_np.argmin(fin_row))
+                rec["nonfinite_leaf"] = self.names[bad]
+            _telemetry.health_ring.record(rec)
+            self._publish_metrics(rec)
+            self._detect(rec)
+
+    def _publish_metrics(self, rec: dict) -> None:
+        _telemetry.counter(
+            "mxtpu_health_steps",
+            "train steps folded into the StepHealth ring "
+            "(health plane on)").inc(src=self.src)
+        _telemetry.gauge(
+            "mxtpu_health_grad_norm",
+            "global gradient L2 norm of the most recent drained "
+            "step").set(rec["grad_norm"], src=self.src)
+        _telemetry.gauge(
+            "mxtpu_health_update_ratio_max",
+            "largest per-leaf ||dw||/||w|| of the most recent drained "
+            "step (0 = guard-skipped or frozen)").set(
+            rec["max_update_ratio"], src=self.src)
+        if rec["loss"] is not None:
+            _telemetry.gauge(
+                "mxtpu_health_loss",
+                "training loss of the most recent drained step").set(
+                rec["loss"], src=self.src)
+
+    def _detect(self, rec: dict) -> None:
+        step = rec["step"]
+        if not rec["finite"]:
+            leaf = rec.get("nonfinite_leaf")
+            self._anomaly("nonfinite", step, rec, leaf=leaf,
+                          detail=f"first non-finite gradient leaf "
+                                 f"{leaf!r} at step {step}")
+            # a non-finite step must not poison the rolling baselines
+            return
+        loss, gnorm = rec["loss"], rec["grad_norm"]
+        if loss is not None and _np.isfinite(loss) \
+                and len(self._loss_win) >= _MIN_WINDOW:
+            mean = sum(self._loss_win) / len(self._loss_win)
+            if mean > 0 and loss > spike_factor() * mean:
+                self._anomaly(
+                    "loss_spike", step, rec,
+                    detail=f"loss {loss:.4g} > {spike_factor():g}x "
+                           f"rolling mean {mean:.4g}")
+        if _np.isfinite(gnorm) and len(self._gnorm_win) >= _MIN_WINDOW:
+            mean = sum(self._gnorm_win) / len(self._gnorm_win)
+            if mean > 0 and gnorm > gradnorm_factor() * mean:
+                self._anomaly(
+                    "grad_norm_explosion", step, rec,
+                    detail=f"grad norm {gnorm:.4g} > "
+                           f"{gradnorm_factor():g}x rolling mean "
+                           f"{mean:.4g}")
+        if loss is not None and _np.isfinite(loss):
+            self._loss_win.append(float(loss))
+        if _np.isfinite(gnorm):
+            self._gnorm_win.append(float(gnorm))
+
+    def _anomaly(self, kind: str, step: int, rec: dict,
+                 leaf: Optional[str] = None, detail: str = "") -> None:
+        global _last_anomaly
+        info = {"kind": kind, "step": step, "src": self.src,
+                "leaf": leaf, "detail": detail, "record": dict(rec),
+                "time_unix": round(_time.time(), 3)}
+        with _lock:
+            _last_anomaly = info
+        _telemetry.counter(
+            "mxtpu_health_anomalies",
+            "training/decode anomalies the health plane detected, "
+            "by kind").inc(kind=kind, src=self.src)
+        _telemetry.HEALTH.publish(**info)
+        now = _time.monotonic()
+        if now - self._last_fault.get(kind, -1e9) < _FAULT_DEBOUNCE_S:
+            return
+        self._last_fault[kind] = now
+        # the flight recorder maps event="anomaly" to one debounced
+        # training_anomaly dump; its "health" provider (below) carries
+        # the leaf/step attribution and the ring tail
+        _telemetry.FAULT.publish(site=f"health.{self.src}",
+                                 event="anomaly", kind=kind, step=step,
+                                 leaf=leaf)
+
+
+# ---------------------------------------------------------------------------
+# Reporting (GET /health, mxtpu-stats --health, flight dumps)
+# ---------------------------------------------------------------------------
+def report(last: int = 16) -> dict:
+    """JSON-ready health summary: detector status, anomaly counts, the
+    last anomaly and the StepHealth ring tail."""
+    anom = _telemetry.counter(
+        "mxtpu_health_anomalies",
+        "training/decode anomalies the health plane detected, "
+        "by kind").sample()
+    if isinstance(anom, dict):
+        total = float(anom.get("total", 0.0))
+        by = dict(anom.get("by", {}))
+    else:
+        total = float(anom)
+        by = {}
+    return {
+        "enabled": enabled(),
+        "status": "anomalous" if total else "ok",
+        "anomaly_total": total,
+        "anomalies": by,
+        "last_anomaly": _last_anomaly,
+        "ring": _telemetry.health_ring.entries(last=last),
+        "ring_depth": len(_telemetry.health_ring),
+    }
+
+
+def _flight_provider() -> dict:
+    """The ``health`` section of every flight dump: for a
+    ``training_anomaly`` artifact this is the forensics — the offending
+    leaf and step, the last-k StepHealth records and the dispatch-ledger
+    context of the programs that produced them."""
+    return {
+        "last_anomaly": _last_anomaly,
+        "ring": _telemetry.health_ring.entries(last=32),
+        "dispatch_ledger": _telemetry.dispatch_ledger(),
+    }
+
+
+from . import telemetry_ring as _ring  # noqa: E402  (no cycle: ring
+#                                         imports telemetry only)
+_ring.recorder.register_provider("health", _flight_provider)

@@ -51,6 +51,7 @@ import math
 import warnings
 from typing import Dict, Optional, Tuple
 
+from .. import health as _health
 from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from .optimizer import SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, LAMB, \
@@ -204,6 +205,10 @@ class FusedUpdater:
         self._z_state = None        # per-segment flat sharded state
         self._z_defs = None         # per-segment state treedefs
         self._z_params = None       # [(param_index, weight NDArray)]
+        # health plane (health.py): per-leaf stats as extra outputs of
+        # the fused dispatch, drained asynchronously.  Monitor created
+        # lazily on the first step (leaf names come from updatable).
+        self._health = None
 
     # -- per-step host side --------------------------------------------
     # mxtpu-lint: hot-path
@@ -300,6 +305,11 @@ class FusedUpdater:
         else:
             extras = ()
 
+        health_on = _health.enabled()
+        if health_on and self._health is None:
+            self._health = _health.HealthMonitor(
+                [p.name for _, p in updatable], src="fused")
+
         clip = opt.clip_gradient
         clip_on = bool(clip and clip > 0)
         if rule in ("sgd", "nag"):
@@ -320,10 +330,10 @@ class FusedUpdater:
             return self._step_zero1(
                 updatable, ws_nd, gs_nd, ws, gs, lrs, wds, extras, rule,
                 baked, tuple(mp_pattern), tuple(wd_pattern), clip_on,
-                guard, opt)
+                guard, opt, health_on)
 
         key = (rule, n, baked, tuple(mp_pattern), tuple(wd_pattern),
-               clip_on, guard)
+               clip_on, guard, health_on)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._cache[key] = self._build(key)
@@ -333,10 +343,16 @@ class FusedUpdater:
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            new_ws, new_sts, new_gs, flag = fn(
-                ws, gs, sts, lrs, wds, extras,
-                np.float32(opt.rescale_grad),
-                np.float32(clip if clip_on else 0.0))
+            out = fn(ws, gs, sts, lrs, wds, extras,
+                     np.float32(opt.rescale_grad),
+                     np.float32(clip if clip_on else 0.0))
+        if health_on:
+            new_ws, new_sts, new_gs, flag, hstats = out
+            # the fused path never sees the loss; the record carries
+            # grad/update stats only (loss rides the spmd/loop planes)
+            self._health.submit(opt.num_update - 1, 1, hstats)
+        else:
+            new_ws, new_sts, new_gs, flag = out
 
         for k, (i, _) in enumerate(updatable):
             ws_nd[k]._set_data(new_ws[k])
@@ -406,7 +422,7 @@ class FusedUpdater:
 
     def _step_zero1(self, updatable, ws_nd, gs_nd, ws, gs, lrs, wds,
                     extras, rule, baked, mp_pattern, wd_pattern, clip_on,
-                    guard, opt):
+                    guard, opt, health_on=False):
         import numpy as np
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
@@ -416,7 +432,7 @@ class FusedUpdater:
         shapes = tuple(tuple(map(int, w.shape)) for w in ws)
         wdts = tuple(np.dtype(w.dtype).str for w in ws)
         key = ("z1", rule, n, baked, mp_pattern, wd_pattern, clip_on,
-               guard, shapes, wdts)
+               guard, shapes, wdts, health_on)
         if self._z_state is not None and self._z_key != key:
             # param set / patterns changed under us — re-partition from
             # the materialized truth
@@ -464,10 +480,14 @@ class FusedUpdater:
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
-            new_ws, new_z, new_gs, flag = fn(
-                ws_m, gs_m, self._z_state, lrs, wds, extras,
-                np.float32(opt.rescale_grad),
-                np.float32(opt.clip_gradient if clip_on else 0.0))
+            out = fn(ws_m, gs_m, self._z_state, lrs, wds, extras,
+                     np.float32(opt.rescale_grad),
+                     np.float32(opt.clip_gradient if clip_on else 0.0))
+        if health_on:
+            new_ws, new_z, new_gs, flag, hstats = out
+            self._health.submit(opt.num_update - 1, 1, hstats)
+        else:
+            new_ws, new_z, new_gs, flag = out
         self._z_state = new_z
         # weights return to their eager (single-device) homes so the
         # next forward pass is undisturbed; these copies are plain
@@ -502,7 +522,7 @@ class FusedUpdater:
         from ..parallel import zero1 as _z1
 
         (_, rule, n, baked, mp_pattern, wd_pattern, clip_on, guard,
-         shapes, wdts) = key
+         shapes, wdts, health_on) = key
         spec, treedefs = self._z_spec, self._z_defs
         shard = NamedSharding(self._z_mesh, PartitionSpec("data"))
         repl = NamedSharding(self._z_mesh, PartitionSpec())
@@ -586,12 +606,18 @@ class FusedUpdater:
                 for k, arr in _z1.unflatten_segment(seg, out_w):
                     new_ws[k] = arr
             new_ws, new_z = tuple(new_ws), tuple(new_z)
+            # stats over the FULL (replicated) grads/weights — the
+            # all-gathered new_ws is already final here, so the zero1
+            # and replicated planes report identical leaf attribution
+            h = _health.train_step_health(gs, ws, new_ws) \
+                if health_on else None
             if not guard:
-                return new_ws, new_z, None, None
+                return (new_ws, new_z, None, None) \
+                    + ((h,) if health_on else ())
             return (new_ws, new_z,
                     tuple(jnp.where(allfin, g, jnp.zeros_like(g))
                           for g in gs),
-                    allfin)
+                    allfin) + ((h,) if health_on else ())
 
         jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if guard else (0, 2))
         return _telemetry.instrument_jit("zero1_update", jitted)
@@ -603,7 +629,8 @@ class FusedUpdater:
         from . import cores
         from ..contrib.amp.loss_scaler import all_finite_flag
 
-        rule, n, baked, mp_pattern, wd_pattern, clip_on, guard = key
+        rule, n, baked, mp_pattern, wd_pattern, clip_on, guard, \
+            health_on = key
 
         def fn(ws, gs, states, lrs, wds, extras, rescale, clip):
             # guard decides on the RAW grads (pre-rescale), exactly like
@@ -690,17 +717,24 @@ class FusedUpdater:
                 new_ws.append(nw.astype(w.dtype))
             new_ws, new_sts = tuple(new_ws), tuple(new_sts)
             if not guard:
-                return new_ws, new_sts, None, None
+                h = _health.train_step_health(gs, ws, new_ws) \
+                    if health_on else None
+                return (new_ws, new_sts, None, None) \
+                    + ((h,) if health_on else ())
             ok = jnp.asarray(True) if allfin is None else allfin
             # grads gate to ZERO on a skipped step (the eager guard
             # zeroes them so grad_req='add' does not re-poison the next
             # step); on a clean step they pass through into fresh
             # buffers (theirs were donated)
-            return (tuple(jnp.where(ok, a, b) for a, b in zip(new_ws, ws)),
+            out_ws = tuple(jnp.where(ok, a, b)
+                           for a, b in zip(new_ws, ws))
+            h = _health.train_step_health(gs, ws, out_ws) \
+                if health_on else None
+            return (out_ws,
                     jax.tree.map(lambda a, b: jnp.where(ok, a, b),
                                  new_sts, states),
                     tuple(jnp.where(ok, g, jnp.zeros_like(g)) for g in gs),
-                    ok)
+                    ok) + ((h,) if health_on else ())
 
         jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if guard else (0, 2))
         return _telemetry.instrument_jit("fused_update", jitted)

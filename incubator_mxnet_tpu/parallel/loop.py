@@ -39,6 +39,7 @@ import pickle
 import time as _time
 
 from ..base import MXNetError, getenv_int
+from .. import health as _health
 from .. import telemetry as _telemetry
 from .spmd import SPMDTrainer, _fetch_full, _placed_copy
 
@@ -79,6 +80,8 @@ class CompiledLoop(SPMDTrainer):
         # when ready (is_ready) — no host sync on the hot path
         self._pending_skipped = []
         self._chunk_cache = {}
+        if self._health is not None:
+            self._health.src = "loop"
 
     # ------------------------------------------------------------------
     def _build_chunk(self, kc, nb):
@@ -88,6 +91,7 @@ class CompiledLoop(SPMDTrainer):
         opt = self._opt
         grad_of = self._make_grad_fn()
         guard = self._skip_nonfinite
+        health_on = self._health is not None
 
         def body(carry, x):
             tr, aux, opt_state, step, skipped = carry
@@ -111,7 +115,15 @@ class CompiledLoop(SPMDTrainer):
                     new_aux = keep(new_aux, tuple(aux))
                     skipped = skipped + jnp.where(ok, 0, 1).astype(
                         jnp.int32)
-            return (new_tr, new_aux, new_opt, step, skipped), loss
+            ys = loss
+            if health_on:
+                # per-inner-step stats ride the scan ys (stacked to
+                # leading axis kc); computed AFTER the guard so a
+                # skipped step reports update_ratio 0 while its raw
+                # grads still carry the non-finite evidence
+                ys = (loss, _health.train_step_health(
+                    list(grads), list(tr), list(new_tr), loss=loss))
+            return (new_tr, new_aux, new_opt, step, skipped), ys
 
         def pure_chunk(tr_vals, aux_vals, opt_state, step0, rngs, *flat):
             # stack the kc per-step batches step-major INSIDE the
@@ -122,16 +134,21 @@ class CompiledLoop(SPMDTrainer):
                 for j in range(nb))
             carry = (tr_vals, tuple(aux_vals), opt_state, step0,
                      jnp.zeros((), jnp.int32))
-            (new_tr, new_aux, new_opt, _, skipped), losses = jax.lax.scan(
+            (new_tr, new_aux, new_opt, _, skipped), ys = jax.lax.scan(
                 body, carry, (rngs,) + xs)
-            return losses, new_tr, new_aux, new_opt, skipped
+            if health_on:
+                losses, hstats = ys
+                return (losses, new_tr, new_aux, new_opt, skipped,
+                        hstats)
+            return ys, new_tr, new_aux, new_opt, skipped
 
         donate = (0, 1, 2) if self._donate else ()
+        outsh = (None, self._tr_shardings, self._aux_shardings,
+                 self._state_out_shardings(), None)
+        if health_on:
+            outsh += (None,)
         return _telemetry.instrument_jit("loop", jax.jit(
-            pure_chunk,
-            out_shardings=(None, self._tr_shardings, self._aux_shardings,
-                           self._state_out_shardings(), None),
-            donate_argnums=donate))
+            pure_chunk, out_shardings=outsh, donate_argnums=donate))
 
     # ------------------------------------------------------------------
     # mxtpu-lint: hot-path
@@ -160,10 +177,17 @@ class CompiledLoop(SPMDTrainer):
             if key not in self._chunk_cache:
                 self._chunk_cache[key] = self._build_chunk(kc, nb)
             step0 = jnp.asarray(self._step_count, jnp.int32)
-            losses, self._tr_vals, self._aux_vals, self._opt_state, \
-                skipped = self._chunk_cache[key](
-                    self._tr_vals, self._aux_vals, self._opt_state,
-                    step0, rngs, *flat)
+            if self._health is not None:
+                losses, self._tr_vals, self._aux_vals, self._opt_state, \
+                    skipped, hstats = self._chunk_cache[key](
+                        self._tr_vals, self._aux_vals, self._opt_state,
+                        step0, rngs, *flat)
+                self._health.submit(self._step_count, kc, hstats)
+            else:
+                losses, self._tr_vals, self._aux_vals, self._opt_state, \
+                    skipped = self._chunk_cache[key](
+                        self._tr_vals, self._aux_vals, self._opt_state,
+                        step0, rngs, *flat)
         self._step_count += kc
         # k steps rode ONE compiled dispatch — the chunked-loop economy
         # the dispatch ledger should corroborate (mxtpu_dispatches_total
@@ -224,6 +248,8 @@ class CompiledLoop(SPMDTrainer):
                 owned.close()
         if self._skip_nonfinite:
             self.sync_nonfinite_guard()
+        if self._health is not None:
+            self._health.sync()
         if not losses:
             return _np.zeros((0,), _np.float32)
         return _np.concatenate([_np.asarray(x) for x in losses])

@@ -29,6 +29,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..context import current_context
+from .. import health as _health
 from .. import telemetry as _telemetry
 from .. import telemetry_device as _telemetry_device
 from ..ndarray.ndarray import NDArray
@@ -313,6 +314,13 @@ class SPMDTrainer:
             raise MXNetError(f"accum_steps={accum_steps} must be >= 1")
         self._step_count = 0
         self._jit_cache = {}
+        # health plane (health.py): per-leaf grad norms / finite mask /
+        # update ratios + loss traced as extra step outputs, drained at
+        # step boundaries.  Captured at construction so the jit cache
+        # never mixes program shapes.
+        self._health = _health.HealthMonitor(
+            [p.name for p in self._trainable], src="spmd") \
+            if _health.enabled() else None
         # device-plane attribution (telemetry_device): report THIS
         # trainer's live optimizer state — zero1: the 1/N flat shard —
         # under owner "optimizer".  weakref so the registration never
@@ -470,20 +478,26 @@ class SPMDTrainer:
         import jax
         opt = self._opt
         grad_of = self._make_grad_fn()
+        health_on = self._health is not None
 
         def pure_step(tr_vals, aux_vals, opt_state, step, rng, *batch):
             *xs, label = batch
             loss, new_aux, grads = grad_of(tr_vals, aux_vals, rng, xs,
                                            label)
             new_tr, new_opt = opt.update(tr_vals, grads, opt_state, step)
+            if health_on:
+                h = _health.train_step_health(list(grads), list(tr_vals),
+                                              list(new_tr), loss=loss)
+                return loss, new_tr, new_aux, new_opt, h
             return loss, new_tr, new_aux, new_opt
 
         donate = (0, 1, 2) if self._donate else ()
+        outsh = (None, self._tr_shardings, self._aux_shardings,
+                 self._state_out_shardings())
+        if health_on:
+            outsh += (None,)
         return _telemetry.instrument_jit("spmd", jax.jit(
-            pure_step,
-            out_shardings=(None, self._tr_shardings, self._aux_shardings,
-                           self._state_out_shardings()),
-            donate_argnums=donate))
+            pure_step, out_shardings=outsh, donate_argnums=donate))
 
     def _shard_batch(self, arr):
         import jax
@@ -531,9 +545,18 @@ class SPMDTrainer:
         self._step_count += 1
         step_arr = jnp.asarray(self._step_count, jnp.int32)
         rng = _random.new_key()
-        loss, self._tr_vals, self._aux_vals, self._opt_state = \
-            self._jit_cache[key](self._tr_vals, self._aux_vals,
-                                 self._opt_state, step_arr, rng, *sharded)
+        if self._health is not None:
+            loss, self._tr_vals, self._aux_vals, self._opt_state, hst = \
+                self._jit_cache[key](self._tr_vals, self._aux_vals,
+                                     self._opt_state, step_arr, rng,
+                                     *sharded)
+            # queued device stats; drained only when already finished
+            self._health.submit(self._step_count - 1, 1, hst)
+        else:
+            loss, self._tr_vals, self._aux_vals, self._opt_state = \
+                self._jit_cache[key](self._tr_vals, self._aux_vals,
+                                     self._opt_state, step_arr, rng,
+                                     *sharded)
         # the whole step (fwd + bwd + update) is ONE compiled program
         _telemetry.gauge("mxtpu_optimizer_dispatches_per_step").set(1)
         return loss
@@ -546,6 +569,8 @@ class SPMDTrainer:
         Parameters, gathered onto each Parameter's own device so eager
         execution keeps working."""
         import jax
+        if self._health is not None:
+            self._health.sync()
         fetch = _fetch_full
         for p, v in zip(self._trainable, self._tr_vals):
             dev = p.data().ctx.jax_device()

@@ -73,6 +73,7 @@ from typing import List, Optional, Sequence
 from ..base import MXNetError, getenv, getenv_int
 from ..ndarray.ndarray import NDArray
 from .. import fault as _fault
+from .. import health as _health
 from .. import telemetry as _telemetry
 from .. import telemetry_device as _tdev
 from . import lifecycle as _lc
@@ -803,6 +804,10 @@ class ContinuousBatcher(DynamicBatcher):
         self._kv_starved_sweeps = 0
         self._kv_starve_threshold = max(1, getenv_int(
             "MXNET_SERVE_KV_STARVE_SWEEPS", 3))
+        # health plane (health.py): last folded decode-step stats and the
+        # running nonfinite-generation count, surfaced in stats()/health
+        self._decode_health_last: Optional[dict] = None
+        self._nonfinite_generations = 0
         super().__init__(engine, **kw)
 
     # -- KV-capacity starvation (the ``kv:<model>`` readiness blocker) --
@@ -1101,11 +1106,48 @@ class ContinuousBatcher(DynamicBatcher):
         _m.DISPATCHES_PER_TOKEN.set(
             self._dpt_dispatches / max(self._dpt_tokens, 1e-9),
             model=self.name)
+        self._fold_decode_health(live)
         for s, r in live:
             # the stream boundary: ONE scalar pull per emitted token
             self._emit(r, int(nxt[s]))  # mxtpu-lint: disable=host-sync-in-hot-path
             if self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
+
+    def _fold_decode_health(self, live):
+        """Health plane: fold the dispatch's device-side logit stats
+        (``engine.last_decode_health``) into the ``mxtpu_health_*``
+        series and — on a non-finite row — a ``nonfinite_generation``
+        anomaly naming the implicated request ids.  The token pull in
+        ``engine.decode`` already synced this dispatch, so these reads
+        retire without a device round-trip."""
+        hd = getattr(self.engine, "last_decode_health", lambda: None)()
+        if hd is None or not live:
+            return
+        import numpy as _np
+        lmax, ent, fin = hd
+        # same emit boundary as the token pull above
+        lmax = _np.asarray(lmax)  # mxtpu-lint: disable=host-sync-in-hot-path
+        ent = _np.asarray(ent)    # mxtpu-lint: disable=host-sync-in-hot-path
+        fin = _np.asarray(fin)    # mxtpu-lint: disable=host-sync-in-hot-path
+        slots = [s for s, _ in live]
+        self._decode_health_last = {
+            "step": self._step,
+            "logit_max": float(lmax[slots].max()),
+            "entropy_mean": float(ent[slots].mean()),
+            "finite": bool(fin[slots].all()),
+        }
+        _m.HEALTH_LOGIT_MAX.set(self._decode_health_last["logit_max"],
+                                model=self.name)
+        _m.HEALTH_DECODE_ENTROPY.set(
+            self._decode_health_last["entropy_mean"], model=self.name)
+        bad = [r.request_id for s, r in live if not bool(fin[s])]
+        if bad:
+            self._nonfinite_generations += 1
+            _m.NONFINITE_GENERATIONS.inc(model=self.name)
+            _health.serving_anomaly(
+                self.name, self._step, bad,
+                detail=f"non-finite decode logits at step {self._step} "
+                       f"for request(s) {', '.join(bad)}")
 
     # mxtpu-lint: hot-path
     def _spec_once(self, gen: int, live):
@@ -1365,5 +1407,9 @@ class ContinuousBatcher(DynamicBatcher):
             ks = getattr(self.engine, "kv_stats", None)
             if ks is not None:
                 out.update(ks())
+            if self._decode_health_last is not None:
+                out["decode_health"] = dict(self._decode_health_last)
+                out["nonfinite_generations"] = \
+                    self._nonfinite_generations
         out.pop("max_delay_ms", None)
         return out

@@ -37,6 +37,7 @@ from ..context import current_context
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telemetry
 from .. import telemetry_device as _telemetry_device
+from .. import health as _health
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
            "derive_prefill_buckets", "ensure_compile_cache"]
@@ -626,6 +627,11 @@ class GenerationEngine:
             self.num_blocks = 0
             self.pool = None
         self._warming = False
+        # health plane (health.py): captured at construction so the jit
+        # cache never mixes output arities — flipping MXNET_HEALTH_PLANE
+        # mid-process takes effect on the next engine, not this one
+        self._health_on = _health.enabled()
+        self._last_decode_health = None
         self._settle_params()
         if self.paged:
             self._prefill_jit = jax.jit(self._prefill_paged_pure,
@@ -776,6 +782,8 @@ class GenerationEngine:
 
         logits = self._with_params(param_vals, aux_vals, key, body)
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        if self._health_on:
+            return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
         return tuple(caches), nxt
 
     def _verify_pure(self, cache, tokens, positions,
@@ -994,6 +1002,8 @@ class GenerationEngine:
 
         logits = self._with_params(param_vals, aux_vals, key, body)
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        if self._health_on:
+            return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
         return tuple(caches), nxt
 
     def _verify_paged_pure(self, cache, tokens, positions, tables,
@@ -1237,12 +1247,23 @@ class GenerationEngine:
         if self.paged:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
-            cache, nxt = self._guarded(self._decode, lt, pos,
-                                       self._tables_dev)
+            out = self._guarded(self._decode, lt, pos, self._tables_dev)
         else:
-            cache, nxt = self._guarded(self._decode, lt, pos)
+            out = self._guarded(self._decode, lt, pos)
+        if self._health_on:
+            cache, nxt, self._last_decode_health = out
+        else:
+            cache, nxt = out
         self._cache = cache
         return _np.asarray(nxt)
+
+    def last_decode_health(self):
+        """Device arrays from the most recent decode dispatch when the
+        health plane is on (``(logit_max (S,), entropy (S,), finite
+        (S,))`` — see :func:`health.decode_health`), else None.  The
+        token read in :meth:`decode` already synced the dispatch, so
+        pulling these is free of extra device round-trips."""
+        return self._last_decode_health
 
     # -- speculative decoding -------------------------------------------
     def attach_draft(self, draft: "GenerationEngine",

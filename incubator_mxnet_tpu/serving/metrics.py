@@ -64,6 +64,10 @@ SPEC_ACCEPTED_TOKENS = _telemetry.registry.counter(
     "mxtpu_spec_accepted_tokens",
     "drafted tokens the target model accepted and emitted (excludes "
     "the guaranteed bonus token per dispatch)")
+NONFINITE_GENERATIONS = _telemetry.registry.counter(
+    "mxtpu_health_nonfinite_generations",
+    "decode steps whose logits contained a non-finite value for at "
+    "least one live slot (health plane, MXNET_HEALTH_PLANE=1)")
 
 # router (serving/router.py; labeled by replica where it matters) ----------
 ROUTER_REQUESTS = _telemetry.registry.counter(
@@ -173,6 +177,15 @@ SPEC_ACCEPT_RATE = _telemetry.registry.gauge(
     "mxtpu_spec_accept_rate",
     "fraction of drafted tokens the target accepted, cumulative per "
     "model (tune MXNET_SPEC_K down when this drops)")
+HEALTH_LOGIT_MAX = _telemetry.registry.gauge(
+    "mxtpu_health_logit_max",
+    "max final-position logit across live slots in the most recent "
+    "decode dispatch (health plane; drifting up signals divergence)")
+HEALTH_DECODE_ENTROPY = _telemetry.registry.gauge(
+    "mxtpu_health_decode_entropy",
+    "mean final-position softmax entropy (nats) across live slots in "
+    "the most recent decode dispatch (health plane; near-zero = "
+    "degenerate repetition, near log(vocab) = noise)")
 DISPATCHES_PER_TOKEN = _telemetry.registry.gauge(
     "mxtpu_dispatches_per_token",
     "target-model dispatches per emitted token, cumulative per model "

@@ -1188,6 +1188,35 @@ class Router:
             t.join()
         return out
 
+    def fleet_health(self) -> dict:
+        """``GET /health`` federation: every replica's health-plane
+        report plus a worst-replica rollup — ``status`` is anomalous if
+        ANY replica is, ``fleet_anomaly_total`` sums the per-replica
+        counts, and ``worst`` names the replica with the most anomalies
+        (its last anomaly inlined) so one request answers "is any
+        replica's numerics going sideways, and which one"."""
+        replicas = self.fanout_get("/health")
+        total = 0.0
+        worst_id, worst_count, worst_last = None, -1.0, None
+        for rid, body in replicas.items():
+            if not isinstance(body, dict) or "error" in body:
+                continue
+            count = float(body.get("anomaly_total", 0.0) or 0.0)
+            total += count
+            if count > worst_count:
+                worst_id, worst_count = rid, count
+                worst_last = body.get("last_anomaly")
+        out = {
+            "status": "anomalous" if total else "ok",
+            "fleet_anomaly_total": total,
+            "replicas": replicas,
+        }
+        if worst_id is not None:
+            out["worst"] = {"replica": worst_id,
+                            "anomaly_total": max(worst_count, 0.0),
+                            "last_anomaly": worst_last}
+        return out
+
     def profile_fanout(self, seconds: float) -> dict:
         """``POST /debug/profile`` fan-out: trigger one on-demand
         profiler capture on every eligible replica in parallel and
@@ -1396,6 +1425,10 @@ class _RouterHandler(BaseJSONHandler):
             self.send_json(200, {"replicas": router.fanout_get(path)})
         elif path == "/slo":
             self.send_json(200, router.fleet_slo())
+        elif path == "/health":
+            # health-plane federation: per-replica reports plus the
+            # worst-replica rollup (anomaly counts are per-process)
+            self.send_json(200, router.fleet_health())
         elif path == "/trace":
             vals = params.get("request_id")
             rid = vals[-1] if vals else None
@@ -1416,7 +1449,7 @@ class _RouterHandler(BaseJSONHandler):
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
                                 "/readyz /replicas /metrics /slo "
-                                "/programs /memory "
+                                "/health /programs /memory "
                                 "/trace?request_id=<rid>\n")
 
     def _post(self):

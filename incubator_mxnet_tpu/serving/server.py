@@ -140,6 +140,10 @@ class _Handler(BaseJSONHandler):
             self.send_json(200, {"models": ms.model_stats()})
         elif path == "/slo":
             self.send_json(200, _slo.tracker.snapshot())
+        elif path == "/health":
+            # health-plane forensics (health.py): anomaly state, ring
+            # tail, per-model decode stats — not liveness (/healthz)
+            self.send_json(200, ms.health_report())
         elif path == "/trace":
             from .. import telemetry_http
             self.send_json(200, telemetry_http.trace_body(params))
@@ -165,7 +169,8 @@ class _Handler(BaseJSONHandler):
         else:
             self.send_text(404, "not found: try /v1/models /healthz "
                                 "/readyz /metrics /metrics.json /slo "
-                                "/trace /flight /programs /memory\n")
+                                "/health /trace /flight /programs "
+                                "/memory\n")
 
     def _remote_trace(self):
         """Adopt the router's ``X-Trace-Id`` hop as the remote parent of
@@ -483,6 +488,30 @@ class ModelServer:
         return _telemetry_device.program_report()
 
     # -- health ---------------------------------------------------------
+    def health_report(self) -> dict:
+        """``GET /health``: the health-plane summary (health.report —
+        detector status, anomaly counts, last anomaly, StepHealth ring
+        tail) plus each generation model's latest decode-step stats.
+        Distinct from ``/healthz`` (liveness) and ``/readyz``
+        (routability): this is the FORENSIC view — what the in-program
+        stats say about the numerics."""
+        from .. import health as _health
+        body = _health.report()
+        with self._lock:
+            batchers = dict(self._models)
+        models = {}
+        for n, b in sorted(batchers.items()):
+            dh = getattr(b, "_decode_health_last", None)
+            if dh is not None:
+                models[n] = {
+                    "decode_health": dict(dh),
+                    "nonfinite_generations":
+                        getattr(b, "_nonfinite_generations", 0),
+                }
+        if models:
+            body["models"] = models
+        return body
+
     def model_state(self, name: str) -> str:
         """One model's serving state, folding in async-warmup progress
         (STARTING while compiling, UNHEALTHY if warmup failed)."""

@@ -83,7 +83,7 @@ from .base import MXNetError, getenv, getenv_bool
 __all__ = [
     "Topic", "EventBus", "bus",
     "OP_DISPATCH", "OP_TIMED", "SYNC", "TRANSFER", "COMPILE", "KVSTORE",
-    "TRAINER", "DATALOADER", "SPAN", "XLA_COST", "FAULT",
+    "TRAINER", "DATALOADER", "SPAN", "XLA_COST", "FAULT", "HEALTH",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "counter", "gauge", "histogram",
     "merge_states", "render_prometheus_state",
@@ -93,6 +93,7 @@ __all__ = [
     "snapshot", "render_prometheus", "counters_flat", "dump",
     "instrument_jit", "sample_device_memory",
     "dispatch_ledger", "reset_dispatch_ledger",
+    "StepHealthRing", "health_ring",
     "TPU_PEAK_FLOPS", "tpu_peak_flops", "cpu_peak_flops",
     "device_peak_flops",
 ]
@@ -217,8 +218,15 @@ bus = EventBus()
 #                                       flops / bytes-accessed
 #   FAULT(site=, event=, kind=, ...)  — resilience plane (fault.py): event
 #                                       in {"injected","retry","giveup",
-#                                       "skipped_step","fallback"}; retry
-#                                       adds attempt=/seconds=
+#                                       "skipped_step","fallback","anomaly"};
+#                                       retry adds attempt=/seconds=
+#   HEALTH(kind=, step=, src=, ...)   — health plane (health.py): one
+#                                       detected training/decode anomaly;
+#                                       kind in {"nonfinite","loss_spike",
+#                                       "grad_norm_explosion",
+#                                       "nonfinite_generation"}, leaf=
+#                                       names the first offending
+#                                       parameter by tree path
 OP_DISPATCH = bus.topic("op.dispatch")
 OP_TIMED = bus.topic("op.timed")
 SYNC = bus.topic("op.sync")
@@ -230,6 +238,7 @@ DATALOADER = bus.topic("dataloader")
 SPAN = bus.topic("span")
 XLA_COST = bus.topic("xla.cost")
 FAULT = bus.topic("fault")
+HEALTH = bus.topic("health")
 
 
 # ---------------------------------------------------------------------------
@@ -1242,6 +1251,61 @@ def reset_dispatch_ledger() -> None:
 
 
 # ---------------------------------------------------------------------------
+# StepHealth ring (health plane, health.py)
+# ---------------------------------------------------------------------------
+class StepHealthRing:
+    """Bounded ring of per-train-step health records.
+
+    One record per inner step, folded in by :class:`health.HealthMonitor`
+    at chunk/step boundaries (the stats themselves are computed inside
+    the donated programs — see health.py).  A record is a JSON-ready
+    dict: ``step``, ``src``, ``loss`` (None on the eager fused path,
+    which never sees the loss), ``grad_norm``, ``max_update_ratio``,
+    ``finite`` and — when not finite — ``nonfinite_leaf``, the first
+    offending parameter by tree path.
+
+    Capacity comes from ``MXNET_HEALTH_RING`` (default 256; re-read on
+    :meth:`clear` so tests can resize)."""
+
+    def __init__(self, size: Optional[int] = None):
+        self._size = size
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self._capacity())
+
+    def _capacity(self) -> int:
+        from .base import getenv_int
+        n = self._size if self._size is not None \
+            else getenv_int("MXNET_HEALTH_RING", 256)
+        return max(1, int(n))
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+
+    def entries(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)
+        return out[-int(last):] if last else out
+
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = deque(maxlen=self._capacity())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+#: process-wide StepHealth ring — the training twin of the flight
+#: recorder's activity ring; telemetry.reset() clears it
+health_ring = StepHealthRing()
+
+
+# ---------------------------------------------------------------------------
 # Compile instrumentation + cost accountant
 # ---------------------------------------------------------------------------
 def _arg_signature(args, kwargs):
@@ -1635,6 +1699,7 @@ def reset() -> None:
     registry.reset()
     tracer.clear()
     reset_dispatch_ledger()
+    health_ring.clear()
     _mfu.update(flops=0.0, last_t=None, last_flops=0.0, peak=None)
 
 

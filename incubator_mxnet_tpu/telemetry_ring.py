@@ -18,6 +18,7 @@ non-finite skip      ``event="skipped_step"``
 SIGTERM drain        ``event="shutdown"``
 worker crash         ``event="crash"``
 device OOM           ``event="oom"`` (RESOURCE_EXHAUSTED dispatch)
+training anomaly     ``event="anomaly"`` (health plane, health.py)
 ===================  =======================================
 
 A dump is the ring contents plus a full metrics snapshot plus whatever
@@ -78,6 +79,7 @@ _TRIGGERS = {
     "shutdown": "sigterm_drain",
     "crash": "worker_crash",
     "oom": "resource_exhausted",
+    "anomaly": "training_anomaly",
 }
 
 

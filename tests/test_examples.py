@@ -27,6 +27,7 @@ def test_example_sparse_linear():
     assert "train accuracy" in out
 
 
+@pytest.mark.slow
 def test_example_quantize_lenet():
     out = _run("example/quantization/quantize_lenet.py", "--cpu",
                "--epochs", "4")

@@ -93,6 +93,7 @@ class TestGenerate:
                          top_k=10, seed=7, use_cache=True).asnumpy()
         np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.slow
     def test_sampling_determinism_and_spread(self):
         net = _tiny()
         prompt = mx.nd.array(np.random.randint(1, 60, (1, 4)),
@@ -135,6 +136,7 @@ class TestGenerate:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_overfits_tiny_corpus(self):
         """LM loss on a repeated sequence must drop fast."""
         net = _tiny()
@@ -174,6 +176,7 @@ class TestTraining:
             assert any(re.search(pat, n) for pat, _ in rules), n
 
 
+@pytest.mark.slow
 def test_generate_top_p_nucleus():
     """Nucleus sampling: with top_p covering only the single dominant
     token, sampling degenerates to greedy; cached == full-prefix; and

@@ -273,6 +273,7 @@ def test_paged_midflight_join_parity():
         bat.close()
 
 
+@pytest.mark.slow
 def test_closed_program_set_survives_hits_and_joins():
     _, _, paged = _pair()
     warmed = paged.warmup()
@@ -317,6 +318,7 @@ def test_failed_prefill_does_not_poison_prefix_cache(monkeypatch):
 
 
 # -------------------------------------------- prefix cache saves prefill
+@pytest.mark.slow
 def test_prefix_hit_cuts_prefill_flops():
     _, _, paged = _pair()
     events = []

@@ -60,11 +60,13 @@ def test_resnet_bottleneck_thumbnail_forward():
     assert np.isfinite(y.asnumpy()).all()
 
 
+@pytest.mark.slow
 def test_mobilenet_v2_forward():
     net, y = _forward("mobilenet_v2_0_25", 64)
     assert np.isfinite(y.asnumpy()).all()
 
 
+@pytest.mark.slow
 def test_mobilenet_v3_forward():
     net, y = _forward("mobilenet_v3_small", 64)
     assert np.isfinite(y.asnumpy()).all()
@@ -75,6 +77,7 @@ def test_squeezenet_forward():
     assert np.isfinite(y.asnumpy()).all()
 
 
+@pytest.mark.slow
 def test_resnet18_hybridize_and_train_step():
     """End-to-end: hybridized zoo model trains one step."""
     from incubator_mxnet_tpu import gluon, autograd

@@ -53,6 +53,7 @@ class TestTransformer:
         np.testing.assert_allclose(base[0, :4], out2[0, :4],
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_label_smoothing_loss_and_grads(self):
         net = _tiny_transformer()
         loss_fn = tr.LabelSmoothingCELoss(50, eps=0.1, pad=0)
@@ -97,6 +98,7 @@ class TestTransformer:
         assert (out[:, 0] == 2).all()
         assert out.dtype == np.int32
 
+    @pytest.mark.slow
     def test_train_smoke_loss_decreases(self):
         # memorize a tiny copy task: target = source
         mx.random.seed(0)
@@ -262,6 +264,7 @@ class TestSSD:
         assert cls_pred.shape == (2, N, 4)       # 3 classes + background
         assert box_pred.shape == (2, N * 4)
 
+    @pytest.mark.slow
     def test_targets_and_loss_backward(self):
         net, x, label = self._net_and_data()
         loss_fn = ssd_mod.SSDLoss(3)
@@ -336,6 +339,7 @@ class TestYOLOv3:
         assert o.sum() == 1.0
         assert box_t.asnumpy()[0][o == 0].sum() == 0.0
 
+    @pytest.mark.slow
     def test_loss_backward(self):
         net, x, label = self._net_and_data()
         loss_fn = yolo_mod.YOLOv3Loss()

@@ -342,6 +342,7 @@ def test_upsampling_grad():
 
 
 # --------------------------------------------------- contrib/detection ops
+@pytest.mark.slow
 def test_contrib_grads():
     from incubator_mxnet_tpu.ndarray import contrib as C
     tu.check_numeric_gradient(

@@ -424,6 +424,7 @@ def test_gpt_spmd_dp_tp_sp_matches_single_device():
     assert abs(loss - loss1) <= 1e-3 * max(1.0, abs(loss1)), (loss, loss1)
 
 
+@pytest.mark.slow
 def test_backward_do_mirror_equivalence(monkeypatch):
     """MXNET_BACKWARD_DO_MIRROR (layer remat under jax.checkpoint) must
     not change the numbers: two SPMD training steps with mirror on == off
@@ -509,6 +510,7 @@ def test_mirror_actually_inserts_remat(monkeypatch):
 # FSDP (ZeRO-3-class) parameter sharding over the data axis (round 5)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_fsdp_rules_shard_and_match_1dev():
     """fsdp_rules shards every big weight over the data axis (each
     device stores 1/N), GSPMD compiles the all-gather/reduce-scatter
@@ -587,6 +589,7 @@ def test_fsdp_rules_small_params_replicated():
 # lax.scan, one optimizer update — large effective batch, small memory
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_accum_steps_matches_full_batch():
     """accum_steps=4 must produce the same losses/updates as the plain
     full-batch step (mean of microbatch grads == full-batch grad for

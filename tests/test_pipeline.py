@@ -45,6 +45,7 @@ def test_gpipe_matches_sequential(S, M):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpipe_gradients_match_sequential():
     """d loss / d stage params through the pipeline == autodiff of the
     sequential composition (scan + ppermute transpose correctly)."""
@@ -199,6 +200,7 @@ def test_pipeline_trainer_trains_and_matches_1dev():
             rtol=2e-5, atol=2e-6, err_msg=name)
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_one_microbatch_degenerates():
     """M=1 is sequential layer-parallelism (pure bubble) but must still
     be numerically exact."""

@@ -193,7 +193,8 @@ def test_spec_k_env_default(monkeypatch):
 
 
 # ====================================== closed compiled-program set
-@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("paged", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_verify_adds_exactly_one_program(paged):
     eng = _spec_pair(paged, max_slots=2)
     eng.warmup()
@@ -210,7 +211,8 @@ def test_verify_adds_exactly_one_program(paged):
 
 
 # ============================= batcher matrix: spec x paged x prefix x join
-@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("paged", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_spec_batcher_matrix_mid_flight_joins(paged):
     import threading
     import time as _time

@@ -45,6 +45,7 @@ def test_mlp_convergence():
     assert _accuracy(net, X, y) > 0.9
 
 
+@pytest.mark.slow
 def test_lenet_convergence():
     """LeNet on synthetic 'digit' images: class k = bright kxk corner
     block.  (reference: example/gluon/mnist workalike at toy scale.)"""

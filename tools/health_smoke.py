@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Health-plane smoke (ci/run_tests.sh health_smoke).
+
+Trains a tiny deterministic regression model through an injected
+gradient NaN (``trainer.grad:nonfinite@POISON_STEP``, skip guard on) in
+two modes driven by the CI script:
+
+* ``golden``   — plane OFF.  The skip guard eats the poisoned step and
+  the run finishes; final params land in ``golden.npz``.  Reference
+  trajectory.
+* ``poisoned`` — the SAME run with ``MXNET_HEALTH_PLANE=1`` and a fresh
+  ``MXNET_FLIGHT_DUMP_DIR``.  Asserts the forensics contract: the
+  detector attributes the anomaly to the first updatable leaf at
+  exactly POISON_STEP, the flight recorder writes exactly ONE debounced
+  ``training_anomaly`` dump whose ``health`` provider names that leaf
+  and step, and the StepHealth ring carries one non-finite record.
+* ``check``    — loads both param sets and asserts they are
+  BIT-IDENTICAL: the health plane observed the incident without
+  perturbing a single bit, and training resumed cleanly past it.
+
+Batches are a pure function of the step index, so the two processes see
+exactly the same data — any divergence is the plane leaking into the
+update arithmetic, not noise.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+TOTAL_STEPS = 12
+POISON_STEP = 5
+BATCH = 8
+FEATS = 3
+
+
+def batch_for(step):
+    import incubator_mxnet_tpu as mx
+    rng = np.random.default_rng(2000 + step)
+    x = rng.standard_normal((BATCH, FEATS)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+def train():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    from incubator_mxnet_tpu import fault
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    fault.install_plan(f"trainer.grad:nonfinite@{POISON_STEP}")
+    mx.random.seed(42)
+    net = nn.Dense(1, prefix="net_")            # fixed prefix: names
+    net.initialize()                            # match across processes
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 0.05}, fused=True,
+                      skip_nonfinite=True)
+    for step in range(1, TOTAL_STEPS + 1):
+        x, y = batch_for(step)
+        with ag.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(BATCH)
+    trainer.sync_health()
+    params = {k: p.data().asnumpy()
+              for k, p in sorted(net.collect_params().items())}
+    return net, trainer, params
+
+
+def run_golden(out):
+    assert not os.environ.get("MXNET_HEALTH_PLANE"), \
+        "golden must run plane-off"
+    _, _, params = train()
+    np.savez(os.path.join(out, "golden.npz"), **params)
+    print(f"health_smoke golden ok: {TOTAL_STEPS} steps, "
+          f"{len(params)} leaves")
+
+
+def run_poisoned(out):
+    from incubator_mxnet_tpu import health, telemetry
+    assert health.enabled(), "poisoned mode needs MXNET_HEALTH_PLANE=1"
+    dump_dir = os.environ.get("MXNET_FLIGHT_DUMP_DIR")
+    assert dump_dir, "poisoned mode needs a fresh MXNET_FLIGHT_DUMP_DIR"
+    from incubator_mxnet_tpu import telemetry_ring
+    telemetry_ring.recorder.start()
+    _, trainer, params = train()
+    np.savez(os.path.join(out, "poisoned.npz"), **params)
+
+    first_leaf = trainer._updatable[0][1].name  # _poison_grads hits it
+    anom = health.last_anomaly()
+    assert anom is not None, "health_smoke: no anomaly detected"
+    assert anom["kind"] == "nonfinite", anom
+    assert anom["step"] == POISON_STEP, anom
+    assert anom["leaf"] == first_leaf, anom
+    bad = [r for r in telemetry.health_ring.entries()
+           if not r["finite"]]
+    assert len(bad) == 1 and bad[0]["step"] == POISON_STEP, bad
+    assert bad[0]["nonfinite_leaf"] == first_leaf, bad
+
+    # exactly ONE debounced training_anomaly artifact, and its health
+    # provider carries the leaf+step attribution
+    deadline = time.monotonic() + 10
+    dumps = []
+    while time.monotonic() < deadline:
+        dumps = glob.glob(
+            os.path.join(dump_dir, "flight_*_training_anomaly.json"))
+        if dumps:
+            break
+        time.sleep(0.05)
+    assert dumps, "health_smoke: no training_anomaly flight dump"
+    time.sleep(0.3)                             # a second writer would
+    dumps = glob.glob(                          # have landed by now
+        os.path.join(dump_dir, "flight_*_training_anomaly.json"))
+    assert len(dumps) == 1, f"expected ONE dump, got {dumps}"
+    with open(dumps[0]) as f:
+        payload = json.load(f)
+    h = payload["health"]
+    assert payload["reason"] == "training_anomaly"
+    assert h["last_anomaly"]["leaf"] == first_leaf, h["last_anomaly"]
+    assert h["last_anomaly"]["step"] == POISON_STEP, h["last_anomaly"]
+    assert any(r.get("nonfinite_leaf") == first_leaf for r in h["ring"])
+    print(f"health_smoke poisoned ok: anomaly {anom['kind']} leaf="
+          f"{anom['leaf']} step={anom['step']}, 1 dump at {dumps[0]}")
+
+
+def run_check(out):
+    golden = np.load(os.path.join(out, "golden.npz"))
+    poisoned = np.load(os.path.join(out, "poisoned.npz"))
+    assert sorted(golden.files) == sorted(poisoned.files)
+    for k in golden.files:
+        assert np.array_equal(golden[k], poisoned[k]), \
+            f"health_smoke: leaf {k} diverged with the plane on"
+    print(f"health_smoke check ok: {len(golden.files)} leaves "
+          f"bit-identical across plane-off/plane-on poisoned runs")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["golden", "poisoned", "check"])
+    ap.add_argument("--out", required=True)
+    ns = ap.parse_args()
+    os.makedirs(ns.out, exist_ok=True)
+    {"golden": run_golden, "poisoned": run_poisoned,
+     "check": run_check}[ns.mode](ns.out)
+
+
+if __name__ == "__main__":
+    main()
