@@ -99,6 +99,17 @@ usage: ci/run_tests.sh <function>
                         downtime, zero mid-stream errors; prefix-affine
                         routing beats random placement on fleet-wide
                         mxtpu_prefix_cache_hits
+  autoscale_smoke       self-healing fleet drill (two parts): the
+                        supervisor's replica is SIGKILLed — restart
+                        with exponential backoff, counted in
+                        mxtpu_supervise_restarts, then quarantined
+                        (flap breaker) with an incident bundle on the
+                        third kill; a supervised fleet rides a diurnal
+                        load curve 1→4→1 while a chaos thread SIGKILLs
+                        random replicas — zero failed client requests,
+                        every scale-down routed through the router's
+                        drain, mxtpu_supervise_*/mxtpu_autoscale_*
+                        series on the router /metrics
   fleet_obs_smoke       observability drill: 3 telemetry-enabled
                         replicas + router, 16 streaming clients, a
                         serving.infer:hang wedge on one replica —
@@ -1201,6 +1212,14 @@ router_smoke() {
     local cc=/tmp/mxtpu_router_smoke_cc
     rm -rf "$cc"
     JAX_PLATFORMS=cpu python tools/router_smoke.py all --cache-dir "$cc"
+}
+
+autoscale_smoke() {
+    local cc=/tmp/mxtpu_autoscale_smoke_cc
+    local logs=/tmp/mxtpu_autoscale_smoke_logs
+    rm -rf "$cc" "$logs"
+    JAX_PLATFORMS=cpu python tools/autoscale_smoke.py all \
+        --cache-dir "$cc" --log-dir "$logs"
 }
 
 fleet_obs_smoke() {

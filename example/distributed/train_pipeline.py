@@ -50,7 +50,14 @@ def main():
         # virtual CPU mesh (same path the test suite and the driver
         # dryrun use); MUST be configured before any jax.devices() call
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n_dev)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_dev)
+        except AttributeError:
+            # pre-0.4.38 jax: the XLA flag read at backend creation
+            # (which hasn't happened yet) does the same thing
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={n_dev}")
     elif len(jax.devices()) < n_dev:
         raise SystemExit(f"--accel needs {n_dev} devices, have "
                          f"{len(jax.devices())}")

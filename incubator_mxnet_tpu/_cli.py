@@ -472,6 +472,105 @@ def serve_main():
     sys.exit(lifecycle.run_until_shutdown(srv))
 
 
+def supervise_main():
+    """``mxtpu-supervise`` — self-healing serve fleet: supervise
+    ``mxtpu-serve`` replica processes behind an embedded router, with
+    crash/hang detection, restart-with-backoff, flap quarantine, and
+    signal-driven autoscaling (docs/robustness.md "Self-healing
+    fleet")::
+
+        mxtpu-supervise --replicas 2 --min-replicas 1 --max-replicas 4 \\
+                        [--router-port N] [--compile-cache DIR]
+                        [--log-dir DIR] [--no-autoscale]
+                        [--autoscale-interval F]
+                        -- --gen-model g=/models/gpt.json --preload
+
+    Everything after ``--`` is passed to each ``mxtpu-serve`` replica
+    verbatim (do NOT pass ``--port``/``--host`` there — the supervisor
+    allocates a port per replica slot and binds replicas to
+    127.0.0.1).  ``--command`` replaces the replica command wholesale
+    with a shell-split template whose ``{port}`` placeholder receives
+    the slot port (drills supervise arbitrary servers this way).
+    Knobs default from ``MXNET_SUPERVISE_*`` / ``MXNET_AUTOSCALE_*``
+    (docs/env_var.md)."""
+    import argparse
+    import shlex
+
+    argv = sys.argv[1:]
+    serve_args: list = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, serve_args = argv[:split], argv[split + 1:]
+
+    ap = argparse.ArgumentParser(
+        prog="mxtpu-supervise",
+        description="supervise + autoscale an mxtpu-serve fleet behind "
+                    "an embedded mxtpu-router")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="initial fleet size (default 1; raised to "
+                         "--min-replicas if smaller)")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscale floor (default "
+                         "MXNET_AUTOSCALE_MIN_REPLICAS or 1)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscale ceiling (default "
+                         "MXNET_AUTOSCALE_MAX_REPLICAS or 4)")
+    ap.add_argument("--router-port", type=int, default=0,
+                    help="router listen port (default 0: ephemeral)")
+    ap.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="shared MXNET_COMPILE_CACHE_DIR for every "
+                         "replica — scale-up cold starts reuse warm "
+                         "compiled artifacts")
+    ap.add_argument("--log-dir", metavar="DIR", default=None,
+                    help="per-replica stdout/stderr logs land here "
+                         "(default: discarded)")
+    ap.add_argument("--no-autoscale", action="store_true",
+                    help="supervise a fixed-size fleet (restarts and "
+                         "quarantine only)")
+    ap.add_argument("--autoscale-interval", type=float, default=None,
+                    help="seconds between policy evaluations (default "
+                         "MXNET_AUTOSCALE_INTERVAL_SECONDS or 10)")
+    ap.add_argument("--command", default=None,
+                    help="replica command template with a {port} "
+                         "placeholder (shell-split; replaces the "
+                         "default mxtpu-serve invocation)")
+    ns = ap.parse_args(argv)
+    if ns.command is not None and serve_args:
+        ap.error("--command and '-- <mxtpu-serve args>' are exclusive")
+    if ns.command is None and not serve_args:
+        ap.error("replica command missing: pass '-- <mxtpu-serve args>' "
+                 "or --command 'prog --port {port}'")
+
+    from .serving import AutoscalePolicy, Supervisor, lifecycle
+
+    if ns.command is not None:
+        command = shlex.split(ns.command)
+    else:
+        # re-enter this interpreter's serve_main so the supervisor works
+        # from a source checkout without installed console scripts
+        command = [sys.executable, "-c",
+                   "from incubator_mxnet_tpu._cli import serve_main; "
+                   "serve_main()"] + serve_args \
+            + ["--host", "127.0.0.1", "--port", "{port}"]
+    child_env = {}
+    if ns.compile_cache is not None:
+        child_env["MXNET_COMPILE_CACHE_DIR"] = ns.compile_cache
+    policy = AutoscalePolicy(min_replicas=ns.min_replicas,
+                             max_replicas=ns.max_replicas)
+    sup = Supervisor(command, replicas=ns.replicas, policy=policy,
+                     autoscale=not ns.no_autoscale,
+                     router_port=ns.router_port,
+                     child_env=child_env, log_dir=ns.log_dir,
+                     autoscale_interval_seconds=ns.autoscale_interval)
+    sup.start()
+    sys.stderr.write(
+        f"mxtpu-supervise: router on http://0.0.0.0:{sup.router.port} "
+        f"over {sup.alive_count()} replica(s); autoscale "
+        f"{'off' if ns.no_autoscale else 'on'} "
+        f"[{policy.min_replicas}, {policy.max_replicas}]\n")
+    sys.exit(lifecycle.run_until_shutdown(sup))
+
+
 def router_main():
     """``mxtpu-router`` — fault-tolerant front tier over a fleet of
     ``mxtpu-serve`` replicas (see docs/serving.md "Serving a fleet")::

@@ -20,7 +20,11 @@ first-class, *testable* runtime concept instead:
   gradient — consumed by the trainer's guard via :func:`take`), and
   ``hang`` (a long stall, default 3600 s, modeling a wedged dispatch —
   the serving watchdog drill injects it at ``serving.infer`` to prove
-  hung-worker detection and recovery; docs/robustness.md).
+  hung-worker detection and recovery; docs/robustness.md), and ``crash``
+  (hard process death via ``os._exit`` with a configurable exit code,
+  modeling a preempted host or OOM-killed replica — the supervisor
+  drill injects it inside ``mxtpu-serve`` children to prove
+  restart-with-backoff without cooperating with the victim).
   Injection is deterministic: each site keeps a call counter and a rule
   names the 1-based call indices it fires on, so a test or CI run can
   say "the 2nd kvstore push fails" and get exactly that.
@@ -62,7 +66,12 @@ __all__ = [
     "TRANSIENT",
 ]
 
-KINDS = ("ioerror", "latency", "nonfinite", "hang")
+KINDS = ("ioerror", "latency", "nonfinite", "hang", "crash")
+
+#: Exit code an injected ``crash`` dies with unless the rule names one —
+#: distinctive on purpose so a supervisor log line or waitpid status is
+#: attributable to the plan rather than to a real SIGKILL/OOM.
+CRASH_EXIT_CODE = 86
 
 
 class FaultInjected(IOError):
@@ -80,7 +89,8 @@ class FaultRule:
     """One parsed plan rule: which ``kind`` fires at ``site`` on which
     1-based call indices."""
 
-    __slots__ = ("site", "kind", "seconds", "message", "every", "lo", "hi")
+    __slots__ = ("site", "kind", "seconds", "message", "exit_code",
+                 "every", "lo", "hi")
 
     def __init__(self, site: str, kind: str, arg: Optional[str],
                  calls: str):
@@ -92,6 +102,7 @@ class FaultRule:
         self.kind = kind
         self.seconds = None
         self.message = None
+        self.exit_code = None
         if kind in ("latency", "hang"):
             try:
                 self.seconds = float(arg) if arg \
@@ -102,6 +113,13 @@ class FaultRule:
                     f"number of seconds")
         elif kind == "ioerror":
             self.message = arg
+        elif kind == "crash":
+            try:
+                self.exit_code = int(arg) if arg else CRASH_EXIT_CODE
+            except ValueError:
+                raise MXNetError(
+                    f"fault rule {site!r}: crash arg {arg!r} is not an "
+                    f"integer exit code")
         self.every = None
         self.lo = self.hi = None
         try:
@@ -127,7 +145,12 @@ class FaultRule:
     def __repr__(self):
         calls = f"every={self.every}" if self.every is not None else (
             str(self.lo) if self.lo == self.hi else f"{self.lo}-{self.hi}")
-        arg = "" if self.seconds is None else f":{self.seconds}"
+        if self.seconds is not None:
+            arg = f":{self.seconds}"
+        elif self.exit_code is not None:
+            arg = f":{self.exit_code}"
+        else:
+            arg = ""
         return f"{self.site}:{self.kind}{arg}@{calls}"
 
 
@@ -207,11 +230,12 @@ def site_calls(site: str) -> int:
 
 def inject(site: str, **ctx) -> None:
     """Poll ``site`` against the plan: sleep for ``latency`` rules, raise
-    :class:`FaultInjected` for ``ioerror`` rules.  A single attribute
-    check when no plan is installed — safe on hot paths.  Extra ``ctx``
-    kwargs (``model=``, ``request_id=``, ...) ride along on the FAULT
-    event so an injected failure is attributable to the request that
-    hit it (docs/observability.md)."""
+    :class:`FaultInjected` for ``ioerror`` rules, die hard
+    (``os._exit``) for ``crash`` rules.  A single attribute check when
+    no plan is installed — safe on hot paths.  Extra ``ctx`` kwargs
+    (``model=``, ``request_id=``, ...) ride along on the FAULT event so
+    an injected failure is attributable to the request that hit it
+    (docs/observability.md)."""
     plan = _plan
     if plan is None:
         return
@@ -224,6 +248,26 @@ def inject(site: str, **ctx) -> None:
             _telemetry.FAULT.publish(site=site, event="injected",
                                      kind=r.kind, **ctx)
             raise FaultInjected(site, r)
+        elif r.kind == "crash":
+            # Process death must be ungraceful by design: no atexit, no
+            # finally blocks, no flushing of higher layers — exactly what
+            # a preempted host looks like to a supervisor.  The FAULT
+            # event is published best-effort first so a same-process
+            # subscriber (e.g. the flight recorder) can see it before
+            # the lights go out.
+            _telemetry.FAULT.publish(site=site, event="injected",
+                                     kind=r.kind, exit_code=r.exit_code,
+                                     **ctx)
+            import os as _os
+            import sys as _sys
+            try:
+                _sys.stderr.write(
+                    f"fault: injected crash at {site} "
+                    f"(exit {r.exit_code})\n")
+                _sys.stderr.flush()
+            except Exception:
+                pass
+            _os._exit(r.exit_code)
         # 'nonfinite' rules are consumed via take() at numeric sites
 
 

@@ -27,6 +27,13 @@ N replicas with health-aware least-loaded balancing, breaker-based
 outlier ejection, retry-with-failover, SSE passthrough, zero-downtime
 drain orchestration, and rendezvous-hash prefix-affine routing for
 the paged KV prefix cache (docs/serving.md "Serving a fleet").
+Membership is dynamic (``POST``/``DELETE /admin/replicas``), and
+:class:`Supervisor` (``supervisor.py``, CLI ``mxtpu-supervise``)
+closes the loop: it owns the replica processes — spawn, ``/readyz``
+health-gating, crash/hang detection, restart-with-backoff, flap
+quarantine — and autoscales the fleet off the router's own federated
+signals through the pure :func:`scale_decision` policy
+(docs/robustness.md "Self-healing fleet").
 
 Generation serving rides the same layers: :class:`GenerationEngine`
 (paged KV cache over a :class:`~.kvcache.BlockPool` — fixed-size
@@ -53,12 +60,16 @@ from .kvcache import BlockPool, blocks_for
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .server import ModelServer
 from .router import Router, Replica, UpstreamError, NoReplicaAvailable
+from .supervisor import (Supervisor, AutoscalePolicy, ScaleSignals,
+                         ScaleAction, scale_decision, FlapBreaker)
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
            "derive_prefill_buckets", "BlockPool", "blocks_for",
            "DynamicBatcher",
            "ContinuousBatcher", "QueueFullError", "ModelServer",
            "Router", "Replica", "UpstreamError", "NoReplicaAvailable",
+           "Supervisor", "AutoscalePolicy", "ScaleSignals",
+           "ScaleAction", "scale_decision", "FlapBreaker",
            "metrics", "lifecycle",
            "CircuitBreaker", "Watchdog", "DeadlineExceeded",
            "BreakerOpen", "Draining", "RequestAborted", "Cancelled",

@@ -116,6 +116,47 @@ ROUTER_FEDERATION_STALE = _telemetry.registry.gauge(
 ROUTER_TRACE_FANOUT = _telemetry.registry.counter(
     "mxtpu_router_trace_fanout",
     "replica /trace fetches made while stitching fleet traces")
+ROUTER_MEMBERSHIP = _telemetry.registry.counter(
+    "mxtpu_router_membership_changes",
+    "fleet membership changes (POST/DELETE /admin/replicas), by "
+    "action=join|leave")
+
+# supervisor + autoscaler (serving/supervisor.py; control-plane series,
+# rendered once on the router /metrics — docs/observability.md) -----------
+SUPERVISE_SPAWNS = _telemetry.registry.counter(
+    "mxtpu_supervise_spawns",
+    "replica processes spawned by mxtpu-supervise (first launches and "
+    "restarts alike)")
+SUPERVISE_RESTARTS = _telemetry.registry.counter(
+    "mxtpu_supervise_restarts",
+    "replica restarts after a detected crash or hang (exit, /healthz "
+    "timeout), per replica slot")
+SUPERVISE_QUARANTINES = _telemetry.registry.counter(
+    "mxtpu_supervise_quarantines",
+    "replica slots quarantined by the flap breaker "
+    "(MXNET_SUPERVISE_MAX_RESTARTS within the window)")
+SUPERVISE_REPLICAS = _telemetry.registry.gauge(
+    "mxtpu_supervise_replicas",
+    "supervised replica processes currently alive")
+AUTOSCALE_EVENTS = _telemetry.registry.counter(
+    "mxtpu_autoscale_events",
+    "executed scale actions, by action=up|down (scale-down always "
+    "routes through /admin/drain)")
+AUTOSCALE_DECISIONS = _telemetry.registry.counter(
+    "mxtpu_autoscale_decisions",
+    "autoscale policy evaluations, by action=up|down|hold")
+AUTOSCALE_TARGET = _telemetry.registry.gauge(
+    "mxtpu_autoscale_target_replicas",
+    "fleet size the autoscaler is currently steering toward")
+AUTOSCALE_BURN = _telemetry.registry.gauge(
+    "mxtpu_autoscale_burn_rate",
+    "worst-model fleet SLO burn rate the last policy evaluation saw")
+AUTOSCALE_QUEUE = _telemetry.registry.gauge(
+    "mxtpu_autoscale_queue_depth",
+    "fleet-summed serve queue depth the last policy evaluation saw")
+AUTOSCALE_KV = _telemetry.registry.gauge(
+    "mxtpu_autoscale_kv_utilization",
+    "worst-replica KV-cache utilization the last policy evaluation saw")
 
 # histograms ---------------------------------------------------------------
 BATCH_SIZE = _telemetry.registry.histogram(

@@ -93,6 +93,12 @@ __all__ = ["Router", "Replica", "UpstreamError", "NoReplicaAvailable",
 
 FAULT_SITE = "router.upstream"
 
+# metric families owned by the control process (router + an in-process
+# supervisor/autoscaler): rendered once from the local registry, stripped
+# from replica snapshots so shared-registry test fleets don't double-count
+CONTROL_PLANE_PREFIXES = ("mxtpu_router_", "mxtpu_supervise_",
+                          "mxtpu_autoscale_")
+
 
 def default_incident_dir() -> str:
     """Where correlated incident bundles land:
@@ -396,10 +402,14 @@ class Router:
         if eject_cooldown_seconds is None:
             eject_cooldown_seconds = float(
                 getenv("MXNET_ROUTER_EJECT_COOLDOWN_SECONDS", 2.0))
+        # kept as attributes: replicas added after construction
+        # (add_replica / POST /admin/replicas) get the same breaker knobs
+        self.eject_threshold = int(eject_threshold)
+        self.eject_cooldown_seconds = float(eject_cooldown_seconds)
         self._replicas: List[Replica] = []
         for spec in replicas:
-            rep = Replica(spec, eject_threshold=eject_threshold,
-                          eject_cooldown_seconds=eject_cooldown_seconds)
+            rep = Replica(spec, eject_threshold=self.eject_threshold,
+                          eject_cooldown_seconds=self.eject_cooldown_seconds)
             if all(r.id != rep.id for r in self._replicas):
                 self._replicas.append(rep)
         self._lock = threading.Lock()
@@ -565,14 +575,15 @@ class Router:
 
     @staticmethod
     def _strip_router_series(state: dict) -> dict:
-        """Drop ``mxtpu_router_*`` families from a replica snapshot.
-        The router's own series are rendered exactly once from its
-        local registry; a replica that happens to share a registry with
-        a router (in-process tests) or fronts a nested router must not
-        double-count them in fleet sums."""
+        """Drop control-plane families (``mxtpu_router_*`` and the
+        supervisor's ``mxtpu_supervise_*``/``mxtpu_autoscale_*``) from a
+        replica snapshot.  Those series are rendered exactly once from
+        the control process's local registry; a replica that happens to
+        share a registry with a router (in-process tests) or fronts a
+        nested router must not double-count them in fleet sums."""
         return {kind: {name: v for name, v in
                        (state or {}).get(kind, {}).items()
-                       if not name.startswith("mxtpu_router_")}
+                       if not name.startswith(CONTROL_PLANE_PREFIXES)}
                 for kind in ("counters", "gauges", "histograms")}
 
     def _federation_view(self):
@@ -611,14 +622,15 @@ class Router:
         return fleet
 
     def render_fleet_metrics(self) -> str:
-        """The federated ``GET /metrics`` body: the router's own
-        ``mxtpu_router_*`` series (local registry, rendered once) +
-        fleet sums and per-replica series for everything the replicas
-        report."""
+        """The federated ``GET /metrics`` body: the control plane's own
+        series (``mxtpu_router_*`` plus, when a supervisor shares the
+        process, ``mxtpu_supervise_*``/``mxtpu_autoscale_*`` — local
+        registry, rendered once) + fleet sums and per-replica series
+        for everything the replicas report."""
         self._federate_maybe()
         local = _telemetry.registry.export_state()
         local = {kind: {name: v for name, v in local[kind].items()
-                        if name.startswith("mxtpu_router_")}
+                        if name.startswith(CONTROL_PLANE_PREFIXES)}
                  for kind in ("counters", "gauges", "histograms")}
         return _telemetry.render_prometheus_state(local) + \
             _telemetry.render_prometheus_state(self.fleet_metrics_state())
@@ -1317,6 +1329,59 @@ class Router:
         return {"replica": rep.id, "draining": False,
                 "eligible": rep.eligible()}
 
+    # -- dynamic membership ----------------------------------------------
+    def add_replica(self, spec: str) -> dict:
+        """Join ``spec`` (``host:port``) to the fleet at runtime
+        (``POST /admin/replicas``).  Idempotent — re-adding a member is
+        a no-op, so a supervisor can retry registration blindly.  The
+        newcomer is polled synchronously before this returns: it enters
+        the routing tables with a real health verdict, and rendezvous
+        hashing keeps the prefix-affinity remap to ~1/N keys."""
+        rep = Replica(spec, eject_threshold=self.eject_threshold,
+                      eject_cooldown_seconds=self.eject_cooldown_seconds)
+        with self._lock:
+            existing = next((r for r in self._replicas
+                             if r.id == rep.id), None)
+            if existing is None:
+                # copy-on-write: readers iterate the old list lock-free
+                self._replicas = self._replicas + [rep]
+        if existing is not None:
+            return {"replica": existing.id, "added": False,
+                    "eligible": existing.eligible(),
+                    "replicas": len(self._replicas)}
+        self._poll(rep)             # route with a view, not a guess
+        _m.ROUTER_MEMBERSHIP.inc(action="join")
+        _telemetry.FAULT.publish(site="router.admin", event="membership",
+                                 kind="join", replica=rep.id)
+        return {"replica": rep.id, "added": True,
+                "eligible": rep.eligible(),
+                "replicas": len(self._replicas)}
+
+    def remove_replica(self, rid: str,
+                       wait_seconds: Optional[float] = None,
+                       drain: bool = True) -> dict:
+        """Leave the fleet (``DELETE /admin/replicas/<id>``):
+        drain-then-remove, so membership changes are zero-downtime by
+        construction.  ``drain=False`` skips the drain for a member
+        that is already dead (a supervisor removing a quarantined
+        corpse has nothing to wait for)."""
+        rep = self.replica(rid)     # KeyError → HTTP 404
+        drained = None
+        if drain:
+            drained = self.drain_replica(rid, wait_seconds=wait_seconds)
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r.id != rid]
+            self._federation.pop(rid, None)
+        _m.ROUTER_MEMBERSHIP.inc(action="leave")
+        _telemetry.FAULT.publish(site="router.admin", event="membership",
+                                 kind="leave", replica=rep.id)
+        out = {"replica": rep.id, "removed": True,
+               "replicas": len(self._replicas)}
+        if drained is not None:
+            out["drained"] = drained["drained"]
+            out["inflight"] = drained["inflight"]
+        return out
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Router":
         if self._http is not None:
@@ -1395,6 +1460,38 @@ class _RouterHandler(BaseJSONHandler):
     def do_POST(self):  # noqa: N802
         self.guard(self._post)
 
+    def do_DELETE(self):  # noqa: N802
+        self.guard(self._delete)
+
+    def _delete(self):
+        from urllib.parse import parse_qs, urlsplit
+        router: Router = self.server.router
+        split = urlsplit(self.path)
+        params = parse_qs(split.query)
+        path = split.path.rstrip("/")
+        if not path.startswith("/admin/replicas/"):
+            self.send_text(404,
+                           "not found: DELETE /admin/replicas/<id>\n")
+            return
+        rid = path[len("/admin/replicas/"):]
+        drain = params.get("drain", ["1"])[-1] not in ("0", "false")
+        wait = params.get("wait_seconds")
+        try:
+            wait_seconds = float(wait[-1]) if wait else None
+        except ValueError:
+            self.send_json(400, {"error":
+                                 "wait_seconds must be a number"})
+            return
+        try:
+            out = router.remove_replica(rid, wait_seconds=wait_seconds,
+                                        drain=drain)
+        except KeyError:
+            self.send_json(404, {
+                "error": f"unknown replica {rid!r}",
+                "replicas": [r.id for r in router.replicas]})
+            return
+        self.send_json(200, out)
+
     def _get(self):
         from urllib.parse import parse_qs, urlsplit
         router: Router = self.server.router
@@ -1469,6 +1566,26 @@ class _RouterHandler(BaseJSONHandler):
                                      "seconds must be a number"})
                 return
             self.send_json(200, router.profile_fanout(seconds))
+            return
+        if path == "/admin/replicas":
+            try:
+                body = self.read_json()
+            except ValueError as e:
+                self.send_json(400, {"error": str(e)})
+                return
+            spec = body.get("replica") if isinstance(body, dict) \
+                else None
+            if not spec:
+                self.send_json(400, {
+                    "error": 'expected {"replica": "host:port"}',
+                    "replicas": [r.id for r in router.replicas]})
+                return
+            try:
+                out = router.add_replica(str(spec))
+            except MXNetError as e:    # unparseable host:port
+                self.send_json(400, {"error": str(e)})
+                return
+            self.send_json(200, out)
             return
         if path in ("/admin/drain", "/admin/undrain"):
             try:

@@ -1,0 +1,456 @@
+"""Self-healing fleet tests (docs/robustness.md "Self-healing fleet").
+
+The autoscaling policy, flap breaker, and signal extractors are pure
+functions of injected inputs, so the policy surface is enumerated as
+tables: hysteresis dead band, cooldown, min/max clamps, below-min
+repair beating the cooldown, burn→queue→kv up-pressure precedence, and
+the flap breaker's windowed restart budget.  The process-supervision
+paths (spawn, health-gated router registration, crash restart,
+quarantine, executed scale actions) run against real subprocesses — a
+tiny stdlib HTTP fake that answers ``/readyz``/``/healthz`` like
+``mxtpu-serve``, so no jax import in the children keeps it fast.  The
+full-stack version (real replicas, SSE load, chaos SIGKILLs) is
+``ci/run_tests.sh autoscale_smoke``.
+
+Also here: the ``crash`` fault kind (parse, repr, and a real
+``os._exit`` in a subprocess) and the router's dynamic-membership
+admin API the supervisor builds on.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.serving import (AutoscalePolicy, FlapBreaker,
+                                         Router, ScaleSignals, Supervisor,
+                                         scale_decision)
+from incubator_mxnet_tpu.serving import supervisor as sup_mod
+from incubator_mxnet_tpu.serving.supervisor import (_fleet_burn,
+                                                    _fleet_gauge_sum,
+                                                    _kv_utilization)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.clear_plan()
+    telemetry.reset()
+    yield
+    fault.clear_plan()
+    telemetry.reset()
+
+
+# ------------------------------------------------------ policy tables
+_POLICY = dict(min_replicas=1, max_replicas=4, burn_up=1.0, burn_down=0.25,
+               queue_up=8.0, queue_down=1.0, kv_up=0.85,
+               cooldown_seconds=30.0)
+
+# (case, signals-kwargs, want_action, want_target, want_reason)
+_DECISION_TABLE = [
+    # below-min repair beats everything, cooldown included
+    ("below_min_beats_cooldown",
+     dict(replicas=0, now=1.0, last_scale_time=0.0), "up", 1, "below_min"),
+    # cooldown gates every other opinion, however loud the signals
+    ("cooldown_blocks_up",
+     dict(replicas=2, burn_rate=5.0, queue_depth=100.0, now=10.0,
+          last_scale_time=0.0), "hold", 2, "cooldown"),
+    ("cooldown_blocks_down",
+     dict(replicas=3, now=29.0, last_scale_time=0.0), "hold", 3,
+     "cooldown"),
+    ("cooldown_expiry_boundary",
+     dict(replicas=2, burn_rate=5.0, now=30.0, last_scale_time=0.0),
+     "up", 3, "burn"),
+    # up-pressure precedence: burn > queue > kv, reason names the winner
+    ("burn_up", dict(replicas=2, burn_rate=1.0, now=100.0), "up", 3,
+     "burn"),
+    ("burn_beats_queue",
+     dict(replicas=2, burn_rate=2.0, queue_depth=1000.0, now=100.0),
+     "up", 3, "burn"),
+    ("queue_up_is_per_replica",
+     dict(replicas=2, queue_depth=16.0, now=100.0), "up", 3, "queue"),
+    ("queue_below_per_replica_threshold",
+     dict(replicas=4, queue_depth=16.0, now=100.0), "hold", 4, "steady"),
+    ("queue_beats_kv",
+     dict(replicas=2, queue_depth=16.0, kv_utilization=0.99, now=100.0),
+     "up", 3, "queue"),
+    ("kv_up", dict(replicas=2, kv_utilization=0.85, now=100.0), "up", 3,
+     "kv"),
+    # max clamp: pressure at the ceiling degrades to hold, never beyond
+    ("at_max_holds",
+     dict(replicas=4, burn_rate=9.0, queue_depth=1000.0,
+          kv_utilization=1.0, now=100.0), "hold", 4, "at_max"),
+    # scale-down wants EVERY signal calm
+    ("down_when_all_calm",
+     dict(replicas=3, burn_rate=0.25, queue_depth=3.0,
+          kv_utilization=0.5, now=100.0), "down", 2, "idle"),
+    ("burn_blocks_down",
+     dict(replicas=3, burn_rate=0.26, now=100.0), "hold", 3, "steady"),
+    ("queue_blocks_down",
+     dict(replicas=3, queue_depth=3.1, now=100.0), "hold", 3, "steady"),
+    # min clamp: a calm one-replica fleet stays put
+    ("min_blocks_down",
+     dict(replicas=1, now=100.0), "hold", 1, "steady"),
+    # the dead band between the thresholds: hysteresis holds steady
+    ("dead_band_burn",
+     dict(replicas=2, burn_rate=0.5, now=100.0), "hold", 2, "steady"),
+    ("dead_band_queue",
+     dict(replicas=2, queue_depth=8.0, now=100.0), "hold", 2, "steady"),
+    # one step at a time, whatever the magnitude
+    ("one_step_up",
+     dict(replicas=1, burn_rate=100.0, queue_depth=1e6, now=100.0),
+     "up", 2, "burn"),
+]
+
+
+@pytest.mark.parametrize("case,sig,action,target,reason", _DECISION_TABLE,
+                         ids=[row[0] for row in _DECISION_TABLE])
+def test_scale_decision_table(case, sig, action, target, reason):
+    act = scale_decision(ScaleSignals(**sig), AutoscalePolicy(**_POLICY))
+    assert (act.action, act.target, act.reason) == (action, target, reason)
+
+
+def test_scale_decision_default_policy_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOSCALE_MAX_REPLICAS", "2")
+    monkeypatch.setenv("MXNET_AUTOSCALE_BURN_UP", "0.5")
+    act = scale_decision(ScaleSignals(replicas=2, burn_rate=0.5, now=100.0))
+    assert (act.action, act.reason) == ("hold", "at_max")
+
+
+def test_policy_validation():
+    with pytest.raises(MXNetError, match="min_replicas"):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(MXNetError, match="max_replicas"):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+# (case, (max_restarts, window), record-times, want-per-record)
+_FLAP_TABLE = [
+    ("budget_blown_on_excess", (2, 10.0), [0.0, 1.0, 2.0],
+     [False, False, True]),
+    ("window_prunes_old_events", (2, 10.0), [0.0, 1.0, 20.0, 21.0, 22.0],
+     [False, False, False, False, True]),
+    ("single_restart_budget", (1, 60.0), [0.0, 5.0], [False, True]),
+    ("slow_flap_never_trips", (2, 5.0), [0.0, 10.0, 20.0, 30.0],
+     [False, False, False, False]),
+]
+
+
+@pytest.mark.parametrize("case,cfg,times,want", _FLAP_TABLE,
+                         ids=[row[0] for row in _FLAP_TABLE])
+def test_flap_breaker_table(case, cfg, times, want):
+    br = FlapBreaker(max_restarts=cfg[0], window_seconds=cfg[1])
+    assert [br.record(t) for t in times] == want
+
+
+def test_flap_breaker_count_prunes():
+    br = FlapBreaker(max_restarts=5, window_seconds=10.0)
+    for t in (0.0, 1.0, 2.0):
+        br.record(t)
+    assert br.count(2.0) == 3
+    assert br.count(11.5) == 1          # 0.0 and 1.0 aged out
+
+
+# --------------------------------------------- signal extraction helpers
+def test_fleet_gauge_sum_skips_replica_series():
+    state = {"gauges": {"mxtpu_serve_queue_depth": {"values": {
+        'model="gen"': 7.0,                       # fleet-merged series
+        'model="gen",replica="127.0.0.1:1"': 4.0,  # per-replica duplicate
+        'model="gen",replica="127.0.0.1:2"': 3.0,
+    }}}}
+    assert _fleet_gauge_sum(state, "mxtpu_serve_queue_depth") == 7.0
+    assert _fleet_gauge_sum(state, "missing") == 0.0
+    assert _fleet_gauge_sum({}, "x") == 0.0
+
+
+def test_kv_utilization_worst_replica():
+    state = {"gauges": {
+        "mxtpu_kv_blocks_in_use": {"values": {
+            'model="gen",replica="a:1"': 9.0,
+            'model="gen",replica="b:2"': 2.0,
+            'model="gen"': 11.0,                   # fleet sum: ignored
+        }},
+        "mxtpu_kv_blocks_total": {"values": {
+            'model="gen",replica="a:1"': 10.0,
+            'model="gen",replica="b:2"': 10.0,
+            'model="gen",replica="c:3"': 0.0,      # zero pool: skipped
+            'model="gen"': 20.0,
+        }}}}
+    assert _kv_utilization(state) == pytest.approx(0.9)
+    assert _kv_utilization({}) == 0.0
+
+
+def test_fleet_burn_worst_model():
+    body = {"models": {"gen": {"burn_rate": 0.4},
+                       "clf": {"burn_rate": 1.2},
+                       "weird": "not-a-dict"}}
+    assert _fleet_burn(body) == pytest.approx(1.2)
+    assert _fleet_burn({}) == 0.0
+    assert _fleet_burn({"models": {}}) == 0.0
+
+
+# ------------------------------------------------------ crash fault kind
+def test_crash_rule_parse_and_repr():
+    fault.install_plan("x.y:crash:7@2")
+    rules = fault.current_plan().rules["x.y"]
+    assert rules[0].kind == "crash" and rules[0].exit_code == 7
+    assert "x.y:crash:7@2" in repr(rules[0])
+    fault.install_plan("x.y:crash")     # default exit code
+    assert (fault.current_plan().rules["x.y"][0].exit_code
+            == fault.CRASH_EXIT_CODE)
+    with pytest.raises(MXNetError):
+        fault.install_plan("x.y:crash:notanint")
+
+
+def test_crash_kind_hard_exits_subprocess():
+    code = ("from incubator_mxnet_tpu import fault\n"
+            "fault.install_plan('drill.site:crash:86')\n"
+            "try:\n"
+            "    fault.inject('drill.site')\n"
+            "finally:\n"
+            "    print('finally-ran')\n"          # os._exit skips this
+            "print('survived')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=_REPO,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 86, (proc.returncode, proc.stderr[-500:])
+    assert "survived" not in proc.stdout
+    assert "finally-ran" not in proc.stdout      # a real hard death
+    assert "injected crash" in proc.stderr
+
+
+# ----------------------------------------------- supervised fake fleet
+# a stdlib replica: /readyz + /healthz like mxtpu-serve, zero jax import
+_FAKE = r"""
+import http.server, json, sys
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps({}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def log_message(self, *a):
+        pass
+http.server.ThreadingHTTPServer(("127.0.0.1", int(sys.argv[1])),
+                                H).serve_forever()
+"""
+_FAKE_CMD = [sys.executable, "-c", _FAKE, "{port}"]
+
+
+def _mk_sup(**kw):
+    kw.setdefault("replicas", 1)
+    kw.setdefault("autoscale", False)
+    kw.setdefault("interval_seconds", 0.05)
+    kw.setdefault("ready_timeout", 30)
+    kw.setdefault("backoff_base", 0.05)
+    kw.setdefault("backoff_max", 0.2)
+    return Supervisor(_FAKE_CMD, **kw)
+
+
+def _wait(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_supervisor_requires_port_placeholder():
+    with pytest.raises(MXNetError, match="port"):
+        Supervisor([sys.executable, "-c", "pass"])
+
+
+def test_supervisor_rejects_fleet_above_max():
+    with pytest.raises(MXNetError, match="max_replicas"):
+        _mk_sup(replicas=5, policy=AutoscalePolicy(max_replicas=4))
+
+
+def test_supervisor_health_gates_and_restarts():
+    """Spawn → /readyz gate → router registration; SIGKILL → restart on
+    the SAME port (stable membership), counted as a restart."""
+    sup = _mk_sup(max_restarts=5, restart_window_seconds=60)
+    try:
+        sup.start()
+        slot = sup.slots()[0]
+        assert slot.state == sup_mod.RUNNING
+        router = sup.router
+        assert router is not None
+        assert router.replica(slot.id).id == slot.id     # registered
+        old_pid = slot.pid
+        os.kill(slot.pid, signal.SIGKILL)
+        _wait(lambda: slot.restarts == 1 and slot.state == sup_mod.RUNNING,
+              30, "restart after SIGKILL")
+        assert slot.pid != old_pid
+        assert slot.id == f"{slot.host}:{slot.port}"     # same identity
+        assert router.replica(slot.id).id == slot.id     # still a member
+        snap = sup.state()
+        assert snap["slots"][0]["restarts"] == 1
+        assert snap["alive"] == 1
+    finally:
+        sup.stop()
+    assert not sup.slots()[0].alive()
+
+
+def test_supervisor_quarantines_flapping_slot():
+    sup = _mk_sup(max_restarts=1, restart_window_seconds=60)
+    try:
+        sup.start()
+        slot = sup.slots()[0]
+        for kill in range(2):
+            # gate on the restart counter, not just RUNNING: the state
+            # only flips once the watch loop notices the death
+            _wait(lambda k=kill: slot.restarts == k
+                  and slot.state == sup_mod.RUNNING, 30,
+                  f"slot RUNNING before kill {kill + 1}")
+            os.kill(slot.pid, signal.SIGKILL)
+        _wait(lambda: slot.state == sup_mod.QUARANTINED, 30, "quarantine")
+        with pytest.raises(KeyError):
+            sup.router.replica(slot.id)          # removed from the router
+        assert sup.active_count() == 0
+        # a quarantined corpse stays dead: no respawn on later sweeps
+        time.sleep(0.3)
+        assert slot.state == sup_mod.QUARANTINED and not slot.alive()
+    finally:
+        sup.stop()
+
+
+def test_supervisor_executes_scale_actions_with_drain():
+    """Force up/down decisions through injected signals: up spawns and
+    health-gates a NEW member; down drains the newest RUNNING member
+    out of the router before killing it."""
+    events = []
+    telemetry.FAULT.subscribe(
+        lambda *a, **kw: events.append(kw), passive=True)
+    sup = _mk_sup(policy=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                         cooldown_seconds=0.0))
+
+    def force(**sig):
+        sig.setdefault("replicas", sup.active_count())
+        sig.setdefault("now", time.monotonic())
+        sup.collect_signals = lambda: ScaleSignals(**sig)
+        return sup.autoscale_once()
+
+    try:
+        sup.start()
+        act = force(burn_rate=5.0)
+        assert (act.action, act.reason) == ("up", "burn")
+        assert sup.active_count() == 2
+        _wait(lambda: sup.alive_count() == 2, 30, "scale-up member ready")
+        second = sup.slots()[1]
+        assert sup.router.replica(second.id).id == second.id
+        act = force(replicas=2)                  # all calm → down
+        assert act.action == "down"
+        _wait(lambda: sup.alive_count() == 1, 30, "scale-down executed")
+        assert second.state == sup_mod.STOPPED and not second.alive()
+        with pytest.raises(KeyError):
+            sup.router.replica(second.id)
+        drains = [e for e in events
+                  if e.get("site") == "router.admin"
+                  and e.get("event") == "drain" and e.get("kind") == "begin"]
+        assert any(e.get("replica") == second.id for e in drains), \
+            "scale-down did not route through the router drain"
+        act = force(replicas=1)
+        assert (act.action, act.reason) == ("hold", "steady")  # min clamp
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------- router dynamic membership
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _fake_member(sup_style_port=0):
+    """One bare stdlib fake replica process; returns (proc, 'host:port')."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = subprocess.Popen([sys.executable, "-c", _FAKE, str(port)])
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return proc, f"127.0.0.1:{port}"
+        except OSError:
+            time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("fake member never became ready")
+
+
+def test_admin_replicas_join_and_leave_http():
+    a_proc, a_id = _fake_member()
+    b_proc, b_id = _fake_member()
+    router = Router([a_id], port=0, host="127.0.0.1",
+                    health_interval=0.05).start()
+    try:
+        # join
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("POST", "/admin/replicas",
+                     body=json.dumps({"replica": b_id}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and out["added"] is True
+        assert {r["id"] for r in _get_json(router.port,
+                                           "/replicas")["replicas"]} \
+            == {a_id, b_id}
+        # idempotent re-join
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("POST", "/admin/replicas",
+                     body=json.dumps({"replica": b_id}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and out["added"] is False
+        # leave (drain-first default)
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=30)
+        conn.request("DELETE", f"/admin/replicas/{b_id}?wait_seconds=5")
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and out["removed"] is True
+        assert out["replica"] == b_id
+        assert {r["id"] for r in _get_json(router.port,
+                                           "/replicas")["replicas"]} \
+            == {a_id}
+        # unknown member → 404
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("DELETE", "/admin/replicas/127.0.0.1:1")
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 404
+        by = telemetry.registry.counter(
+            "mxtpu_router_membership_changes").sample()["by"]
+        assert by.get("action=join", 0) >= 1
+        assert by.get("action=leave", 0) >= 1
+    finally:
+        router.stop()
+        for p in (a_proc, b_proc):
+            p.kill()
+            p.wait()
