@@ -71,6 +71,14 @@ usage: ci/run_tests.sh <function>
                         /metrics, and a serving.infer:hang wedged
                         mid-verify fails its riders with ids on the
                         terminal SSE error and recovers via the watchdog
+  decode_scan_smoke     scanned decode-burst drill: 16 streaming clients
+                        through a router over a preloaded replica with
+                        default MXNET_DECODE_SCAN_STEPS=8; asserts every
+                        stream is bit-identical to a no-scan golden run,
+                        the router-federated mxtpu_dispatches_per_token
+                        reads < 0.2, and a serving.infer:hang wedged
+                        mid-burst fails its rider (id on the terminal
+                        SSE error) and recovers via the watchdog
   paged_smoke           paged KV-cache drill: under an EQUAL cache-byte
                         budget (dense 4x128 positions == paged 32x16
                         blocks), 16 streaming clients with a shared
@@ -1005,6 +1013,156 @@ print(f"spec_smoke ok: {CLIENTS} streams bit-identical to no-draft "
       f"(accept rate {stats['spec_accept_rate']:.2f}), hang drill "
       f"failed rider 'spec-hang' after {len(toks_h)} tokens and "
       f"recovered")
+EOF
+}
+
+decode_scan_smoke() {
+    MXNET_SERVE_HANG_SECONDS=0.5 \
+    MXNET_SERVE_BREAKER_COOLDOWN_SECONDS=0.3 \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, telemetry
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import (GenerationEngine, ModelServer,
+                                         Router)
+
+telemetry.start()
+CLIENTS, NEW = 16, 48
+SYSTEM = list(range(1, 33))            # shared 32-token system prompt
+PROMPTS = [SYSTEM + [40 + i % 8, i % 5] for i in range(CLIENTS)]
+
+def build(name, max_slots, scan_steps):
+    mx.random.seed(3)
+    net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+                   num_heads=2, max_length=256, dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.6))
+    net(mx.nd.array(np.zeros((1, 2), np.int32)))
+    return GenerationEngine(net, name=name, max_slots=max_slots,
+                            max_len=256, paged=True, block_size=16,
+                            scan_steps=scan_steps)
+
+# -- golden: the SAME weights, bursts disabled ------------------------
+golden_eng = build("golden", 1, 0)
+golden = [golden_eng.generate(p, max_new_tokens=NEW) for p in PROMPTS]
+del golden_eng
+
+# -- replica with the default burst depth + a router on top -----------
+engine = build("gen", CLIENTS, 8)      # every client fits: steady state
+assert engine.scan_steps == 8, engine.scan_steps
+srv = ModelServer(port=0)
+srv.add_model("gen", engine)
+srv.preload()                          # burst program warm pre-bind
+assert engine.warm, "decode_scan_smoke: preload left a cold model"
+srv.start()
+router = Router([f"127.0.0.1:{srv.port}"], port=0, host="127.0.0.1",
+                health_interval=0.1, upstream_timeout=60.0,
+                retry_deadline=60.0, federate_seconds=0.2)
+router.start()
+url = f"http://127.0.0.1:{router.port}"
+direct = f"http://127.0.0.1:{srv.port}"
+
+def stream(base, prompt, n, rid):
+    req = urllib.request.Request(
+        base + "/v1/models/gen:generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": n,
+                         "stream": True}).encode(),
+        headers={"x-request-id": rid})
+    r = urllib.request.urlopen(req, timeout=180)
+    toks, finals = [], []
+    for line in r:
+        line = line.strip()
+        if line.startswith(b"data:"):
+            d = json.loads(line.split(b":", 1)[1])
+            if "token" in d:
+                toks.append(d["token"])
+            else:
+                finals.append(d)
+    return toks, finals, r.headers.get("X-Request-Id")
+
+# -- 1. 16 streaming clients through the router, bit-identical --------
+results, errors = {}, []
+def run(i):
+    try:
+        results[i] = stream(url, PROMPTS[i], NEW, f"scan-{i}")
+    except Exception as e:
+        errors.append(f"scan-{i}: {e!r}")
+
+threads = [threading.Thread(target=run, args=(i,)) for i in range(CLIENTS)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not errors, "decode_scan_smoke: " + "; ".join(errors[:3])
+for i in range(CLIENTS):
+    toks, finals, rid = results[i]
+    assert rid == f"scan-{i}", \
+        f"decode_scan_smoke: X-Request-Id lost: {rid!r}"
+    assert toks == golden[i], \
+        f"decode_scan_smoke: client {i} diverged from no-scan golden: " \
+        f"{toks[:8]}... != {golden[i][:8]}..."
+st = json.load(urllib.request.urlopen(
+    direct + "/v1/models", timeout=10))["models"]["gen"]
+assert st["decode_scan_steps"] == 8, st
+assert st["decode_burst_dispatches"] > 0, \
+    "decode_scan_smoke: no burst dispatch was ever taken"
+
+# -- 2. router-federated dispatch economy: < 0.2 at steady state ------
+router._federate_maybe(force=True)
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+m = re.search(r'mxtpu_dispatches_per_token\{model="gen"\}'
+              r'\s+([0-9.eE+-]+)', prom)
+assert m, "decode_scan_smoke: dispatches-per-token not federated:\n" + \
+    "\n".join(l for l in prom.splitlines() if "dispatches_per" in l)
+dpt = float(m.group(1))
+assert dpt < 0.2, \
+    f"decode_scan_smoke: federated dispatches_per_token {dpt} >= 0.2 " \
+    f"— the scan is not amortizing the host out of the token path"
+
+# -- 3. wedge a burst dispatch mid-stream; the rider must fail loudly
+#       with its id, then the watchdog restart must recover -----------
+fault.install_plan("serving.infer:hang:30@3")
+toks_h, finals_h, rid_h = stream(direct, PROMPTS[0], 100, "scan-hang")
+assert rid_h == "scan-hang"
+assert 0 < len(toks_h) < 100, \
+    f"decode_scan_smoke: hang drill emitted {len(toks_h)} tokens"
+assert finals_h and "error" in finals_h[-1], \
+    f"decode_scan_smoke: no terminal error event: {finals_h}"
+assert finals_h[-1]["request_id"] == "scan-hang"
+fault.clear_plan()
+
+recovered = None
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline and recovered is None:
+    time.sleep(0.2)
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            direct + "/v1/models/gen:generate",
+            data=json.dumps({"tokens": PROMPTS[1],
+                             "max_new_tokens": NEW}).encode()), timeout=60)
+        recovered = json.loads(r.read())["tokens"]
+    except urllib.error.HTTPError as e:
+        e.read()                       # 503 while the breaker cools down
+assert recovered == golden[1], \
+    "decode_scan_smoke: post-restart output != golden"
+st = json.load(urllib.request.urlopen(
+    direct + "/v1/models", timeout=10))["models"]["gen"]
+assert st["watchdog_restarts"] == 1, st
+router.stop()
+srv.stop()
+telemetry.stop()
+print(f"decode_scan_smoke ok: {CLIENTS} streams bit-identical to "
+      f"no-scan golden, federated dispatches_per_token {dpt:.3f} "
+      f"(k=8), hang drill failed rider 'scan-hang' after "
+      f"{len(toks_h)} tokens and recovered")
 EOF
 }
 

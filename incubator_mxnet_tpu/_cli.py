@@ -217,7 +217,8 @@ def _fleet_stats(ns) -> int:
 
 
 def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
-                            paged=None, block_size=None):
+                            paged=None, block_size=None,
+                            scan_steps=None):
     """Build a :class:`serving.GenerationEngine` from a ``--gen-model``
     JSON config: architecture kwargs for ``models.gpt.GPTModel`` plus a
     ``"params"`` weights file (``Block.save_parameters`` format,
@@ -245,10 +246,12 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
     cfg_paged = cfg.pop("paged", None)
     cfg_bs = cfg.pop("block_size", None)
     cfg_spec_k = cfg.pop("spec_k", None)    # draft configs only
+    cfg_scan = cfg.pop("scan_steps", None)
     max_slots = cfg_slots if max_slots is None else max_slots
     max_len = cfg_len if max_len is None else max_len
     paged = cfg_paged if paged is None else paged
     block_size = cfg_bs if block_size is None else block_size
+    scan_steps = cfg_scan if scan_steps is None else scan_steps
     cfg.setdefault("dropout", 0.0)      # serving never trains
     net = GPTModel(**cfg)
     net.initialize(init.Normal(0.02))
@@ -260,7 +263,8 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
         net.load_parameters(params)
     engine = GenerationEngine(net, name=name, max_slots=max_slots,
                               max_len=max_len, paged=paged,
-                              block_size=block_size)
+                              block_size=block_size,
+                              scan_steps=scan_steps)
     # surfaced by serve_main when this config backs a --gen-draft
     engine._cfg_spec_k = cfg_spec_k
     return engine
@@ -365,6 +369,11 @@ def serve_main():
     ap.add_argument("--gen-block-size", type=int, default=None,
                     help="tokens per paged KV block (default "
                          "MXNET_KV_BLOCK_SIZE or 16)")
+    ap.add_argument("--gen-scan-steps", type=int, default=None,
+                    help="decode steps captured per scanned burst "
+                         "dispatch, 0 disables the burst program "
+                         "(default config or MXNET_DECODE_SCAN_STEPS "
+                         "or 8)")
     ap.add_argument("--gen-draft", action="append", default=[],
                     metavar="NAME=CONFIG.json",
                     help="attach a draft model to generation model NAME "
@@ -435,7 +444,8 @@ def serve_main():
             name, cfg_path, max_slots=ns.gen_slots,
             max_len=ns.gen_max_len,
             paged=None if ns.gen_paged is None else bool(ns.gen_paged),
-            block_size=ns.gen_block_size)
+            block_size=ns.gen_block_size,
+            scan_steps=ns.gen_scan_steps)
         if name in drafts:
             # the draft mirrors the target's slot/sequence geometry so
             # its cache rolls back in lock-step with the target's

@@ -2,7 +2,8 @@
 annotated hot path.
 
 Hot-path roots are functions carrying ``# mxtpu-lint: hot-path`` on (or
-directly above) their ``def`` line — the serving decode/verify loops,
+directly above) their ``def`` line — the serving decode/verify/burst
+loops (``_decode_once``, ``_decode_burst_once``, ``_spec_once``),
 ``FusedUpdater.step``, ``CompiledLoop`` chunk dispatch.  Reachability is
 the same-module call graph: a reference (call or function-as-value, e.g.
 a ``lax.scan`` body) to another function defined in the module pulls it

@@ -633,6 +633,15 @@ def decode_attention(q, k, v, positions, scale=None):
     are stale garbage by the continuous-batching contract) and returns
     (S, H, D).
 
+    The position mask is the load-bearing contract for **scanned decode
+    bursts** (``GenerationEngine.decode_burst``): ``positions`` may be a
+    traced value riding a ``lax.scan`` carry — per-slot, data-dependent,
+    frozen for finished slots — not just a host constant.  Every
+    implementation below masks strictly by comparison against
+    ``positions`` (never by python-level slicing on its value), so a
+    frozen slot keeps attending over exactly its old prefix and stale
+    bytes past the write head stay invisible at any scan step.
+
     Dispatch mirrors :func:`flash_attention`: a Pallas online-softmax
     kernel when T is tile-aligned and K+V fit the VMEM budget, otherwise
     the lax fallback.  On CPU the lax path is the default — decode runs
@@ -768,6 +777,12 @@ def paged_decode_attention(q, k_pages, v_pages, tables, positions,
     table, padded with the null block 0; ``positions`` (S,) int32: each
     slot's current write head in logical token coordinates.  Attends over
     logical positions ``<= positions[s]`` and returns (S, H, D).
+
+    Same scanned-burst contract as :func:`decode_attention`:
+    ``positions`` (and the write head it masks) may be carry-traced
+    inside ``lax.scan``, so all masking is comparison-based against the
+    traced value — a slot frozen mid-burst attends over exactly its old
+    prefix while its redirected null-block writes stay invisible.
 
     The lax gather reference is the default (and the CPU path); the
     Pallas kernel — the table-driven gather XLA has no good lowering

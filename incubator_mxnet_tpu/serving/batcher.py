@@ -675,6 +675,31 @@ class _GenRequest:
         self._q.put(("tok", int(tok)))
         return gap
 
+    def _emit_burst(self, toks) -> float:
+        """Append a whole decode burst and flush it to the stream as
+        individual ``("tok", t)`` events under ONE queue-lock
+        acquisition — a k-token burst costs one notify pass instead of
+        k ``put()`` round-trips on the consumer's mutex.  ``Queue`` is
+        unbounded here so skipping ``not_full`` is safe; the manual
+        bookkeeping mirrors ``Queue.put`` exactly (``not_empty`` shares
+        ``mutex``).  The inter-burst gap is amortized evenly across the
+        burst's tokens so the token-latency SLI keeps per-token units."""
+        now = time.monotonic()
+        if self.t_first is None:
+            self.t_first = now
+        n = len(toks)
+        gap = (now - self.t_emit) / max(1, n)
+        for _ in range(n):
+            _m.TOKEN_LATENCY.observe(gap)
+        self.t_emit = now
+        self.tokens_out.extend(toks)
+        q = self._q
+        with q.mutex:
+            q.queue.extend(("tok", t) for t in toks)
+            q.unfinished_tasks += n
+            q.not_empty.notify(n)
+        return gap
+
     def _finish(self, error=None) -> None:
         if self.event.is_set():
             return
@@ -764,8 +789,11 @@ class ContinuousBatcher(DynamicBatcher):
       finished, was cancelled, or crossed its deadline
       (``mxtpu_serve_deadline_exceeded{stage="decode"}``); admit queued
       requests into the freed slots (one ``prefill`` dispatch each,
-      emitting the first token); then advance ALL live slots one token
-      with a single ``decode`` dispatch;
+      emitting the first token); then advance ALL live slots with a
+      single ``decode`` dispatch — one token per step, or up to
+      ``engine.scan_steps`` tokens when :meth:`_burst_ready` sees
+      steady state (no queued joins, cancels, or near deadlines) and
+      the scanned ``decode_burst`` program takes over;
     * tokens stream back per-request as they are produced
       (:meth:`_GenRequest.stream`), so a late-arriving request emits its
       first token while earlier requests are still decoding — the
@@ -796,11 +824,16 @@ class ContinuousBatcher(DynamicBatcher):
         self._spec_drafted = 0
         # dispatch economy: one batcher step = ONE target-model dispatch
         # (draft decodes ride on the draft model's own ledger).  Tokens
-        # are per-slot-normalized, so plain decode reads exactly 1.0 and
-        # speculation reads 1/tokens-per-slot-per-dispatch (< 1.0 when
-        # the draft earns its keep) — docs/observability.md.
+        # are per-slot-normalized, so per-step decode reads exactly 1.0,
+        # the scanned burst path approaches 1/scan_steps at steady
+        # state, and speculation reads 1/tokens-per-slot-per-dispatch
+        # (< 1.0 when the draft earns its keep) — docs/observability.md.
         self._dpt_dispatches = 0
         self._dpt_tokens = 0.0
+        # multi-token burst dispatches taken (engine.scan_steps >= 1 and
+        # _burst_ready said steady state) — drives dispatches_per_token
+        # toward 1/k; docs/serving.md "Multi-token decode bursts"
+        self._burst_dispatches = 0
         self._kv_starved_sweeps = 0
         self._kv_starve_threshold = max(1, getenv_int(
             "MXNET_SERVE_KV_STARVE_SWEEPS", 3))
@@ -1041,6 +1074,8 @@ class ContinuousBatcher(DynamicBatcher):
             if live:
                 if getattr(self.engine, "draft", None) is not None:
                     self._spec_once(gen, live)
+                elif self._burst_ready(live):
+                    self._decode_burst_once(gen, live)
                 else:
                     self._decode_once(gen, live)
 
@@ -1112,6 +1147,100 @@ class ContinuousBatcher(DynamicBatcher):
             self._emit(r, int(nxt[s]))  # mxtpu-lint: disable=host-sync-in-hot-path
             if self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
+
+    def _burst_ready(self, live) -> bool:
+        """Steady-state gate for the multi-token burst path.  The
+        k-step scanned dispatch is opaque to the scheduler — no join,
+        cancel, or deadline check can land mid-burst — so only take it
+        when none of that boundary work could be pending: the queue is
+        empty (an admit would otherwise wait up to k tokens for its
+        slot), no rider has asked to cancel, and every live deadline
+        clears a conservative k×(per-dispatch EWMA) worst case.  Any
+        `no` falls back to the per-step path, which is always correct —
+        the gate only trades throughput for boundary granularity."""
+        k = int(getattr(self.engine, "scan_steps", 0) or 0)
+        if k < 1:
+            return False
+        with self._cv:
+            if self._queue:
+                return False
+        horizon = time.monotonic() \
+            + k * max(self._avg_batch_seconds, 1e-4)
+        for _, r in live:
+            if r._cancelled:
+                return False
+            if r.deadline is not None and r.deadline <= horizon:
+                return False
+        return True
+
+    # mxtpu-lint: hot-path
+    def _decode_burst_once(self, gen: int, live):
+        """ONE scanned dispatch advances every live slot by up to
+        ``engine.scan_steps`` tokens with in-program termination (a
+        finished slot freezes inside the scan — see
+        ``GenerationEngine.decode_burst``); fan each slot's emitted
+        prefix out to its SSE queue as a batch and free finished slots.
+        Token-for-token identical to k calls of :meth:`_decode_once` —
+        only the dispatch grouping and the emit batching change."""
+        import numpy as _np
+        S = int(self.engine.max_slots)
+        last = _np.zeros(S, _np.int32)
+        pos = _np.zeros(S, _np.int32)
+        bud = _np.ones(S, _np.int32)
+        eos = _np.full(S, -1, _np.int32)
+        act = _np.zeros(S, bool)
+        for s, r in live:
+            last[s] = r.tokens_out[-1]
+            pos[s] = r.n + len(r.tokens_out) - 1
+            bud[s] = r.budget - len(r.tokens_out)
+            if r.eos_id is not None:
+                eos[s] = int(r.eos_id)
+            act[s] = True
+        rids = [r.request_id for _, r in live]
+        _m.BATCHES.inc(model=self.name)
+        _m.BATCH_SIZE.observe(len(live))
+
+        def run():
+            _fault.inject("serving.infer", model=self.name,
+                          request_ids=rids)
+            if self._current_gen() != gen:
+                raise _lc.RequestAborted(
+                    f"{self.name}: stale worker generation")
+            return self.engine.decode_burst(last, pos, bud, eos, act)
+
+        t0 = time.monotonic()
+        try:
+            toks, emitted = _fault.retry_call(
+                run, site="serving.infer", policy=self.retry_policy)
+        except Exception as e:
+            self._decode_failed(gen, live, e)
+            return
+        dt = time.monotonic() - t0
+        _m.DECODE_STEP.observe(dt)
+        self._avg_batch_seconds = dt if self._avg_batch_seconds <= 0.0 \
+            else 0.8 * self._avg_batch_seconds + 0.2 * dt
+        self._degraded = False
+        self.breaker.record_success()
+        self._fold_decode_health(live)
+        self._burst_dispatches += 1
+        total = 0
+        for s, r in live:
+            # the stream boundary: one bounded pull per rider burst
+            n = int(emitted[s])  # mxtpu-lint: disable=host-sync-in-hot-path
+            if n < 1:
+                continue
+            # mxtpu-lint: disable=host-sync-in-hot-path
+            self._emit_burst(r, [int(t) for t in toks[:n, s]])
+            total += n
+            if self._maybe_finished(r):
+                self._free_slot(s, r, "finished")
+        _m.DECODE_BURST_TOKENS.observe(total)
+        # dispatch economy: ONE dispatch bought up to k tokens per slot
+        self._dpt_dispatches += 1
+        self._dpt_tokens += total / max(1, len(live))
+        _m.DISPATCHES_PER_TOKEN.set(
+            self._dpt_dispatches / max(self._dpt_tokens, 1e-9),
+            model=self.name)
 
     def _fold_decode_health(self, live):
         """Health plane: fold the dispatch's device-side logit stats
@@ -1242,6 +1371,18 @@ class ContinuousBatcher(DynamicBatcher):
         _m.GENERATE_TOKENS.inc(model=self.name)
         # feed the token-latency SLI (MXNET_SERVE_SLO_TOKEN_P99_MS)
         _slo.tracker.record_token(self.name, gap)
+
+    def _emit_burst(self, req: _GenRequest, toks):
+        """Burst-path twin of :meth:`_emit`: one queue flush for the
+        whole burst, but the SLI and counters stay per-token — each of
+        the n tokens records the amortized gap, so ``token_window``
+        counts and the p99 keep their per-token meaning."""
+        gap = req._emit_burst(toks)
+        n = len(toks)
+        self._tokens_emitted += n
+        _m.GENERATE_TOKENS.inc(n, model=self.name)
+        for _ in range(n):
+            _slo.tracker.record_token(self.name, gap)
 
     def _maybe_finished(self, req: _GenRequest) -> bool:
         if len(req.tokens_out) >= req.budget:
@@ -1382,6 +1523,9 @@ class ContinuousBatcher(DynamicBatcher):
                 "slots_in_use": sum(1 for r in self._slots
                                     if r is not None),
                 "decode_steps": self._step,
+                "decode_scan_steps":
+                    int(getattr(self.engine, "scan_steps", 0) or 0),
+                "decode_burst_dispatches": self._burst_dispatches,
                 "tokens_emitted": self._tokens_emitted,
                 "peak_slots_in_use": self._peak_slots,
                 "prefill_buckets": list(self.engine.prefill_buckets),

@@ -559,6 +559,7 @@ class GenerationEngine:
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
+                 scan_steps: Optional[int] = None,
                  ctx=None):
         import jax
         from ..base import getenv_int, getenv_bool
@@ -627,6 +628,17 @@ class GenerationEngine:
             self.num_blocks = 0
             self.pool = None
         self._warming = False
+        # multi-token decode bursts (docs/serving.md): lax.scan
+        # ``scan_steps`` decode steps into ONE dispatch with in-program
+        # termination.  0 disables the burst program entirely; the value
+        # is baked into the trace at first dispatch, so it must be set
+        # (ctor / attach_draft) BEFORE warmup.
+        self.scan_steps = int(scan_steps if scan_steps is not None
+                              else getenv_int("MXNET_DECODE_SCAN_STEPS",
+                                              8))
+        if self.scan_steps < 0:
+            raise MXNetError(
+                f"scan_steps must be >= 0: {self.scan_steps}")
         # health plane (health.py): captured at construction so the jit
         # cache never mixes output arities — flipping MXNET_HEALTH_PLANE
         # mid-process takes effect on the next engine, not this one
@@ -643,6 +655,8 @@ class GenerationEngine:
                 self._prefill_ext_jit)
             self._decode_jit = jax.jit(self._decode_paged_pure,
                                        donate_argnums=(0,))
+            self._decode_burst_jit = jax.jit(self._decode_burst_paged_pure,
+                                             donate_argnums=(0,))
             self._verify_jit = jax.jit(self._verify_paged_pure,
                                        donate_argnums=(0,))
         else:
@@ -652,12 +666,17 @@ class GenerationEngine:
             self._prefill_ext = None
             self._decode_jit = jax.jit(self._decode_pure,
                                        donate_argnums=(0,))
+            self._decode_burst_jit = jax.jit(self._decode_burst_pure,
+                                             donate_argnums=(0,))
             self._verify_jit = jax.jit(self._verify_pure,
                                        donate_argnums=(0,))
         self._prefill = _telemetry.instrument_jit(
             "serving:" + self.name + ":prefill", self._prefill_jit)
         self._decode = _telemetry.instrument_jit(
             "serving:" + self.name + ":decode", self._decode_jit)
+        self._decode_burst = _telemetry.instrument_jit(
+            "serving:" + self.name + ":decode_burst",
+            self._decode_burst_jit)
         self._verify = _telemetry.instrument_jit(
             "serving:" + self.name + ":verify", self._verify_jit)
         # speculative decoding: a draft engine attached via attach_draft
@@ -785,6 +804,93 @@ class GenerationEngine:
         if self._health_on:
             return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
         return tuple(caches), nxt
+
+    def _decode_burst_pure(self, cache, last_tokens, positions, budgets,
+                           eos_ids, done0, param_vals, aux_vals, key):
+        """``scan_steps`` decode steps captured as ONE program
+        (:func:`jax.lax.scan` over the exact :meth:`_decode_pure` cell
+        body) with in-program termination riding the carry.
+
+        Per slot: ``budgets`` (S,) int32 caps the tokens this burst may
+        emit (the request's remaining budget), ``eos_ids`` (S,) int32 is
+        the stop token (-1: none), ``done0`` (S,) bool marks slots that
+        must not emit at all (free slots).  A slot whose step hits EOS or
+        exhausts its budget flips ``done``; from then on its
+        ``(last_token, position)`` carry is FROZEN, so every subsequent
+        step recomputes — and rewrites, bit-for-bit — the same K/V at
+        the same position (per-slot rows are independent, so the rewrite
+        is exactly idempotent and a mid-burst EOS cannot corrupt the
+        cache).  Live slots are untouched by their neighbors' freezes:
+        the token stream is bit-identical to ``scan_steps`` per-step
+        :meth:`_decode_pure` dispatches.
+
+        Returns ``(cache', tokens (k, S), emitted (S,))`` — row ``j`` of
+        ``tokens`` is step ``j``'s argmax; slot ``s``'s valid prefix is
+        ``tokens[:emitted[s], s]``.  With the health plane on, the
+        per-step logit stats are folded across the burst in-program
+        (max / mean / all) to the same (S,) triplet one decode returns."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..kernels.flash_attention import decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S = last_tokens.shape[0]
+        C = H * D
+        k = int(self.scan_steps)
+        rows = jnp.arange(S)
+
+        def run_scan():
+            def step(carry, _):
+                caches, lt, pos, done, emitted = carry
+                caches = list(caches)
+                pos_nd = NDArray(pos.reshape(S, 1))
+                x = self.block.embed(NDArray(lt)) \
+                    + self.block.pos_embed(pos_nd)
+                h = self.block.drop(x)
+                for l, cell in enumerate(self._cells):
+                    at = cell.attention
+                    hn = cell.ln1(h)
+                    q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                    qh = q._data.reshape(S, H, D)
+                    knh = kn._data.reshape(S, H, D)
+                    vnh = vn._data.reshape(S, H, D)
+                    ck = caches[l].at[rows, :, pos].set(
+                        knh.astype(caches[l].dtype))
+                    cv = caches[L + l].at[rows, :, pos].set(
+                        vnh.astype(caches[L + l].dtype))
+                    caches[l], caches[L + l] = ck, cv
+                    attn = decode_attention(qh, ck, cv, pos)
+                    out_nd = NDArray(attn.reshape(S, 1, C).astype(
+                        h._data.dtype))
+                    h = h + at.dropout(at.proj(out_nd))
+                    h = h + cell._ffn_out(cell.ln2(h))
+                logits = self.block._project(self.block.ln_f(h))
+                lg = logits._data[:, 0, :]
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                emit = ~done
+                emitted2 = emitted + emit.astype(jnp.int32)
+                done2 = done | (emit & ((nxt == eos_ids)
+                                        | (emitted2 >= budgets)))
+                lt2 = jnp.where(done2[:, None], lt, nxt[:, None])
+                pos2 = jnp.where(done2, pos, pos + 1)
+                ys = (nxt,) if not self._health_on \
+                    else (nxt,) + _health.decode_health(lg)
+                return (tuple(caches), lt2, pos2, done2, emitted2), ys
+
+            carry0 = (cache, last_tokens, positions, done0,
+                      jnp.zeros(S, jnp.int32))
+            return lax.scan(step, carry0, None, length=k)
+
+        (caches, _, _, _, emitted), ys = self._with_params(
+            param_vals, aux_vals, key, run_scan)
+        if self._health_on:
+            toks, lmax, ent, fin = ys
+            # frozen steps replay their final live step's logits, so the
+            # fold is dominated by live emissions (max/all exact, mean
+            # slightly weighted toward the freeze value)
+            return (caches, toks, emitted,
+                    (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
+        (toks,) = ys
+        return caches, toks, emitted
 
     def _verify_pure(self, cache, tokens, positions,
                      param_vals, aux_vals, key):
@@ -1005,6 +1111,80 @@ class GenerationEngine:
         if self._health_on:
             return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
         return tuple(caches), nxt
+
+    def _decode_burst_paged_pure(self, cache, last_tokens, positions,
+                                 budgets, eos_ids, done0, tables,
+                                 param_vals, aux_vals, key):
+        """:meth:`_decode_burst_pure` over the paged layout: the scanned
+        step is the exact :meth:`_decode_paged_pure` cell body, and a
+        frozen (done) slot's K/V writes are redirected to the null block
+        0 — belt on top of the idempotent-rewrite argument, so a
+        finished slot's replayed steps can never touch a live block, its
+        own or (through any future sharing scheme) anyone else's.
+        Decode positions sit strictly past the shared prompt blocks, so
+        the burst composes with the BlockPool prefix cache unchanged."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..kernels.flash_attention import paged_decode_attention
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        S = last_tokens.shape[0]
+        C = H * D
+        bs = self.block_size
+        k = int(self.scan_steps)
+        rows = jnp.arange(S)
+
+        def run_scan():
+            def step(carry, _):
+                caches, lt, pos, done, emitted = carry
+                caches = list(caches)
+                blk = jnp.where(done, 0, tables[rows, pos // bs])  # (S,)
+                off = pos % bs                                     # (S,)
+                pos_nd = NDArray(pos.reshape(S, 1))
+                x = self.block.embed(NDArray(lt)) \
+                    + self.block.pos_embed(pos_nd)
+                h = self.block.drop(x)
+                for l, cell in enumerate(self._cells):
+                    at = cell.attention
+                    hn = cell.ln1(h)
+                    q, kn, vn = at.query(hn), at.key(hn), at.value(hn)
+                    qh = q._data.reshape(S, H, D)
+                    knh = kn._data.reshape(S, H, D)
+                    vnh = vn._data.reshape(S, H, D)
+                    ck = caches[l].at[blk, :, off].set(
+                        knh.astype(caches[l].dtype))
+                    cv = caches[L + l].at[blk, :, off].set(
+                        vnh.astype(caches[L + l].dtype))
+                    caches[l], caches[L + l] = ck, cv
+                    attn = paged_decode_attention(qh, ck, cv, tables, pos)
+                    out_nd = NDArray(attn.reshape(S, 1, C).astype(
+                        h._data.dtype))
+                    h = h + at.dropout(at.proj(out_nd))
+                    h = h + cell._ffn_out(cell.ln2(h))
+                logits = self.block._project(self.block.ln_f(h))
+                lg = logits._data[:, 0, :]
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                emit = ~done
+                emitted2 = emitted + emit.astype(jnp.int32)
+                done2 = done | (emit & ((nxt == eos_ids)
+                                        | (emitted2 >= budgets)))
+                lt2 = jnp.where(done2[:, None], lt, nxt[:, None])
+                pos2 = jnp.where(done2, pos, pos + 1)
+                ys = (nxt,) if not self._health_on \
+                    else (nxt,) + _health.decode_health(lg)
+                return (tuple(caches), lt2, pos2, done2, emitted2), ys
+
+            carry0 = (cache, last_tokens, positions, done0,
+                      jnp.zeros(S, jnp.int32))
+            return lax.scan(step, carry0, None, length=k)
+
+        (caches, _, _, _, emitted), ys = self._with_params(
+            param_vals, aux_vals, key, run_scan)
+        if self._health_on:
+            toks, lmax, ent, fin = ys
+            return (caches, toks, emitted,
+                    (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
+        (toks,) = ys
+        return caches, toks, emitted
 
     def _verify_paged_pure(self, cache, tokens, positions, tables,
                            param_vals, aux_vals, key):
@@ -1257,6 +1437,45 @@ class GenerationEngine:
         self._cache = cache
         return _np.asarray(nxt)
 
+    def decode_burst(self, last_tokens, positions, budgets, eos_ids,
+                     active):
+        """Advance every slot up to ``scan_steps`` positions in ONE
+        dispatch (docs/serving.md "Multi-token decode bursts"):
+        ``last_tokens``/``positions`` (S,) int32 as in :meth:`decode`,
+        ``budgets`` (S,) int32 the per-slot cap on tokens this burst may
+        emit, ``eos_ids`` (S,) int32 the per-slot stop token (-1: none),
+        ``active`` (S,) bool False for free slots.  Returns host arrays
+        ``(tokens (k, S) int32, emitted (S,) int32)``; slot ``s``'s
+        emitted tokens are ``tokens[:emitted[s], s]``, bit-identical to
+        the same number of per-step :meth:`decode` calls."""
+        import jax.numpy as jnp
+        k = int(self.scan_steps)
+        if k < 1:
+            raise MXNetError(
+                f"{self.name}: decode bursts disabled (scan_steps "
+                f"{self.scan_steps}; set MXNET_DECODE_SCAN_STEPS >= 1)")
+        S = self.max_slots
+        lt = jnp.asarray(_np.asarray(last_tokens, _np.int32).reshape(S, 1))
+        pos = jnp.asarray(_np.asarray(positions, _np.int32).reshape(S))
+        bud = jnp.asarray(_np.asarray(budgets, _np.int32).reshape(S))
+        eos = jnp.asarray(_np.asarray(eos_ids, _np.int32).reshape(S))
+        done0 = jnp.asarray(
+            ~_np.asarray(active, bool).reshape(S))
+        if self.paged:
+            if self._tables_dev is None:
+                self._tables_dev = jnp.asarray(self._tables)
+            out = self._guarded(self._decode_burst, lt, pos, bud, eos,
+                                done0, self._tables_dev)
+        else:
+            out = self._guarded(self._decode_burst, lt, pos, bud, eos,
+                                done0)
+        if self._health_on:
+            cache, toks, emitted, self._last_decode_health = out
+        else:
+            cache, toks, emitted = out
+        self._cache = cache
+        return _np.asarray(toks), _np.asarray(emitted)
+
     def last_decode_health(self):
         """Device arrays from the most recent decode dispatch when the
         health plane is on (``(logit_max (S,), entropy (S,), finite
@@ -1300,6 +1519,13 @@ class GenerationEngine:
             raise MXNetError(f"spec_k must be >= 1, got {k}")
         self.draft = draft
         self.spec_k = k
+        # scan the k autoregressive draft decodes into one dispatch
+        # (spec drops from k+1 to 2 dispatches per burst).  The draft's
+        # burst width must equal spec_k, so override its default here —
+        # before warmup bakes the trace.  scan_steps == 0 (the
+        # MXNET_DECODE_SCAN_STEPS kill switch) keeps the host loop.
+        if draft.scan_steps != 0:
+            draft.scan_steps = k
 
     def verify(self, tokens, positions):
         """Score ``spec_k + 1`` positions for EVERY slot in one dispatch:
@@ -1324,9 +1550,11 @@ class GenerationEngine:
         return _np.asarray(out)
 
     def spec_step(self, last_tokens, positions):
-        """One speculative step for EVERY slot: ``spec_k`` draft decode
-        dispatches propose tokens autoregressively, then ONE target
-        verify dispatch scores all ``spec_k + 1`` positions.  Greedy
+        """One speculative step for EVERY slot: the draft proposes
+        ``spec_k`` tokens autoregressively — ONE scanned draft dispatch
+        when its burst program is enabled (the default; ``spec_k`` host
+        dispatches otherwise) — then ONE target verify dispatch scores
+        all ``spec_k + 1`` positions.  Greedy
         acceptance: the longest prefix where draft argmax == target
         argmax, plus the target's bonus token.
 
@@ -1347,13 +1575,26 @@ class GenerationEngine:
         S = self.max_slots
         last = _np.asarray(last_tokens, _np.int32).reshape(S)
         pos = _np.asarray(positions, _np.int32).reshape(S)
-        drafted = _np.zeros((S, k), _np.int32)
-        lt, pv = last, pos
-        for j in range(k):
-            nxt = _np.asarray(self.draft.decode(lt, pv),
-                              _np.int32).reshape(S)
-            drafted[:, j] = nxt
-            lt, pv = nxt, pv + 1
+        if self.draft.scan_steps == k:
+            # one scanned dispatch replaces the k-step host loop below,
+            # bit-identically: done0 all-False with budgets k+1 and
+            # eos -1 can never flip a slot's done mask, so every slot —
+            # free ones included — advances (lt, pos) exactly as the
+            # loop's unconditional ``lt, pv = nxt, pv + 1`` does.
+            toks_ks, _ = self.draft.decode_burst(
+                last, pos,
+                budgets=_np.full(S, k + 1, _np.int32),
+                eos_ids=_np.full(S, -1, _np.int32),
+                active=_np.ones(S, bool))
+            drafted = _np.ascontiguousarray(toks_ks.T)         # (S, k)
+        else:
+            drafted = _np.zeros((S, k), _np.int32)
+            lt, pv = last, pos
+            for j in range(k):
+                nxt = _np.asarray(self.draft.decode(lt, pv),
+                                  _np.int32).reshape(S)
+                drafted[:, j] = nxt
+                lt, pv = nxt, pv + 1
         toks = _np.concatenate([last[:, None], drafted], axis=1)
         out = self.verify(toks, pos)
         match = out[:, :k] == drafted                          # (S, k)
@@ -1479,6 +1720,7 @@ class GenerationEngine:
             "compiled_programs": self.compiled_programs(),
             "warm": self.warm,
             "paged": self.paged,
+            "scan_steps": self.scan_steps,
             "spec_k": self.spec_k if self.draft is not None else 0,
             "programs": _telemetry.dispatch_ledger(prefix=prefix),
             "slots": self.slot_occupancy(),
@@ -1492,11 +1734,14 @@ class GenerationEngine:
     def expected_programs(self) -> int:
         """Size of the CLOSED program set: one prefill per bucket (plus
         one suffix-prefill per bucket when the prefix cache can hit),
-        ONE decode, and — with a draft attached — ONE verify (the
-        query width is baked from ``spec_k``, so no per-accept-length
-        programs exist)."""
+        ONE decode, ONE decode burst (when ``scan_steps >= 1`` — the
+        scan length is baked, budgets/eos/done are operands, so one
+        program serves every k-step burst), and — with a draft attached
+        — ONE verify (the query width is baked from ``spec_k``, so no
+        per-accept-length programs exist)."""
         per_bucket = 2 if self.prefix_cache_enabled else 1
         return per_bucket * len(self.prefill_buckets) + 1 \
+            + (1 if self.scan_steps >= 1 else 0) \
             + (1 if self.draft is not None else 0)
 
     def warmup(self) -> int:
@@ -1526,6 +1771,15 @@ class GenerationEngine:
                     self._cache = cache
             self.decode(_np.zeros(self.max_slots, _np.int32),
                         _np.zeros(self.max_slots, _np.int32))
+            if self.scan_steps >= 1:
+                # budgets of 1 exercise the in-program done path; the
+                # post-warmup reset wipes whatever the burst wrote
+                self.decode_burst(
+                    _np.zeros(self.max_slots, _np.int32),
+                    _np.zeros(self.max_slots, _np.int32),
+                    _np.ones(self.max_slots, _np.int32),
+                    _np.full(self.max_slots, -1, _np.int32),
+                    _np.ones(self.max_slots, bool))
             if self.draft is not None:
                 self.verify(
                     _np.zeros((self.max_slots, self.spec_k + 1),
@@ -1554,6 +1808,7 @@ class GenerationEngine:
         try:
             n = int(self._prefill_jit._cache_size()) \
                 + int(self._decode_jit._cache_size()) \
+                + int(self._decode_burst_jit._cache_size()) \
                 + int(self._verify_jit._cache_size())
             if self._prefill_ext_jit is not None:
                 n += int(self._prefill_ext_jit._cache_size())

@@ -29,6 +29,16 @@ Block id 0 is the reserved **null block**: block tables are padded with
 it and out-of-range scatter positions are redirected to it, so garbage
 writes from padded prefill rows land in a sink nobody ever attends to.
 
+**Burst write contract** (``GenerationEngine.decode_burst``): the
+scanned multi-token decode advances a slot at most ``budget`` positions
+past its current length, and every admit reserves
+``blocks_for(prompt + budget)`` up front — so the burst's furthest KV
+write (position ``prompt + budget - 1`` at the worst case) always lands
+inside the slot's reserved table and **no extra headroom is needed for
+any scan_steps**. Slots that finish mid-burst have their remaining
+in-scan writes redirected to the null block, the same sink padded
+prefill rows use.
+
 Eviction: a cached block whose refcount drops to 0 is *not* returned to
 the free list — it stays in the prefix cache, instantly reusable by the
 next request with the same prefix, and is only reclaimed (LRU) when the

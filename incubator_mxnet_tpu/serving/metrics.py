@@ -176,6 +176,11 @@ DECODE_STEP = _telemetry.registry.histogram(
     "mxtpu_generate_decode_step_seconds",
     "seconds per continuous-batching decode dispatch (all live slots "
     "advance one token)")
+DECODE_BURST_TOKENS = _telemetry.registry.histogram(
+    "mxtpu_decode_burst_tokens",
+    "tokens emitted per scanned decode-burst dispatch, summed across "
+    "live slots (ceiling is scan_steps x slots; a thin tail means "
+    "in-program termination is cutting bursts short)")
 SPEC_STEP = _telemetry.registry.histogram(
     "mxtpu_spec_step_seconds",
     "seconds per speculative step (k draft dispatches plus one verify; "
@@ -230,8 +235,10 @@ HEALTH_DECODE_ENTROPY = _telemetry.registry.gauge(
 DISPATCHES_PER_TOKEN = _telemetry.registry.gauge(
     "mxtpu_dispatches_per_token",
     "target-model dispatches per emitted token, cumulative per model "
-    "(per-slot normalized: exactly 1.0 for plain decode, < 1.0 when "
-    "speculation amortizes dispatches over accepted bursts)")
+    "(per-slot normalized: exactly 1.0 for per-step decode, <= "
+    "1/scan_steps at steady state on the scanned burst path, and "
+    "1/(accepted burst) when speculation amortizes the verify "
+    "dispatch)")
 
 # SLO plane (serving/slo.py; docs/observability.md) -------------------------
 SLO_AVAILABILITY = _telemetry.registry.gauge(

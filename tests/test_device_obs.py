@@ -132,17 +132,43 @@ def test_closed_program_set_spec_and_dispatches_per_token():
         b.close()
 
 
-def test_dispatches_per_token_plain_is_exactly_one():
-    b = ContinuousBatcher(_engine(name="obsp"), name="obsp")
+def test_dispatches_per_token_per_step_is_exactly_one():
+    # scan_steps=0 disables the burst program: every decode dispatch
+    # advances every live slot by exactly one token, so per-slot
+    # normalization makes the ratio exactly 1.0
+    b = ContinuousBatcher(_engine(name="obsp", scan_steps=0),
+                          name="obsp")
     try:
         b.submit([3, 7, 11], max_new_tokens=6)
         b.submit([5, 5], max_new_tokens=4)
         st = b.stats()
-        # one decode dispatch advances every live slot by one token —
-        # per-slot normalization makes the ratio exactly 1.0
+        assert st["decode_scan_steps"] == 0
+        assert st["decode_burst_dispatches"] == 0
         assert st["dispatches_per_token"] == pytest.approx(1.0)
         g = telemetry.registry.get("mxtpu_dispatches_per_token")
         assert g.sample()["model=obsp"] == pytest.approx(1.0)
+    finally:
+        b.close()
+
+
+def test_dispatches_per_token_burst_approaches_one_over_k():
+    # default-on burst path: once the lone stream reaches steady state
+    # (no joins pending) each dispatch buys up to scan_steps tokens —
+    # the cumulative ratio must land at <= 1/k plus the measurement
+    # tolerance from the per-step prefix before bursts engage
+    b = ContinuousBatcher(_engine(name="obsb", max_len=128,
+                                  scan_steps=8), name="obsb")
+    try:
+        out = b.submit([3, 7, 11], max_new_tokens=100)
+        assert len(out) == 100
+        st = b.stats()
+        assert st["decode_scan_steps"] == 8
+        assert st["decode_burst_dispatches"] > 0
+        assert st["dispatches_per_token"] <= 0.2
+        g = telemetry.registry.get("mxtpu_dispatches_per_token")
+        assert g.sample()["model=obsb"] <= 0.2
+        h = telemetry.registry.get("mxtpu_decode_burst_tokens")
+        assert h.sample()["count"] == st["decode_burst_dispatches"]
     finally:
         b.close()
 

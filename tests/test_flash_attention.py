@@ -241,8 +241,11 @@ def _ring_variant(use_flash, causal, mask, q, k, v):
                      out_specs=spec, check_vma=False)(q, k, v)
 
 
+# whole matrix rides the slow tier: multi-device ring emulation pays
+# ~15s/mode in shard_map compiles on the 1-CPU tier-1 box; the ring
+# path keeps cheap tier-1 coverage via test_parallel's ring tests
 @pytest.mark.parametrize("mode", [
-    "dense",
+    pytest.param("dense", marks=pytest.mark.slow),
     pytest.param("causal", marks=pytest.mark.slow),
     pytest.param("masked", marks=pytest.mark.slow),
 ])
@@ -344,8 +347,11 @@ def test_blockwise_ring_tile_aligned_forward():
                                rtol=2e-4, atol=2e-4)
 
 
+# whole matrix rides the slow tier (~12s/mode of all-to-all shard_map
+# compiles); the Ulysses path keeps tier-1 coverage via test_parallel's
+# test_ulysses_matches_local / test_ulysses_causal_matches_ring
 @pytest.mark.parametrize("mode", [
-    "dense",
+    pytest.param("dense", marks=pytest.mark.slow),
     pytest.param("causal", marks=pytest.mark.slow),
     pytest.param("masked", marks=pytest.mark.slow),
 ])

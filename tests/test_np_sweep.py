@@ -606,7 +606,16 @@ def _fuzz_shapes(rng):
     return shape, partner
 
 
-@pytest.mark.parametrize("seed", range(8))
+# the three heaviest seed-slices ride the slow tier so tier-1 keeps
+# fuzz coverage (5 slices, ~1000 cases) inside the CPU time budget
+@pytest.mark.parametrize("seed", [
+    pytest.param(0, marks=pytest.mark.slow),
+    1,
+    pytest.param(2, marks=pytest.mark.slow),
+    3, 4,
+    pytest.param(5, marks=pytest.mark.slow),
+    6, 7,
+])
 def test_np_fuzz_parity(seed):
     """~200 randomized cases per seed-slice: every elementwise/binary/
     reduction bucket name gets random shapes/dtypes/broadcast partners,

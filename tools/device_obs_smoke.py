@@ -7,10 +7,13 @@ router — two plain, one serving with an attached draft model
 (speculative decoding) — under 16 looping streaming clients.  Asserts
 the tentpole contracts end to end, over HTTP:
 
-* **Dispatch economy** — every plain replica's
-  ``mxtpu_dispatches_per_token`` gauge reads exactly 1.0 (one decode
-  dispatch advances every live slot by one token); the spec replica's
-  reads < 1.0 (accepted draft bursts amortize target dispatches).
+* **Dispatch economy** — the per-step replica
+  (``MXNET_DECODE_SCAN_STEPS=0``) reads exactly 1.0 on
+  ``mxtpu_dispatches_per_token`` (one decode dispatch advances every
+  live slot by one token); the burst replica (default scan_steps)
+  reads < 1.0 (scanned bursts amortize dispatches over up to k
+  tokens); the spec replica's reads < 1.0 (accepted draft bursts
+  amortize target dispatches).
 * **Closed program set at runtime** — the router's ``GET /programs``
   fan-out shows every replica's engine with ``compiled_programs ==
   expected_programs`` after warmup, and dispatch-ledger rows for the
@@ -67,7 +70,7 @@ def run_replica(port):
     sys.exit(lifecycle.run_until_shutdown(srv))
 
 
-def _spawn(cache_dir, profile_dir, spec=False):
+def _spawn(cache_dir, profile_dir, spec=False, scan0=False):
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                MXNET_COMPILE_CACHE_DIR=cache_dir,
@@ -76,6 +79,8 @@ def _spawn(cache_dir, profile_dir, spec=False):
                MXNET_DRAIN_SECONDS="5")
     if spec:
         env["MXNET_SMOKE_SPEC"] = "1"
+    if scan0:
+        env["MXNET_DECODE_SCAN_STEPS"] = "0"
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "replica"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
@@ -164,12 +169,13 @@ def _client_loop(idx, router_port, stop, results):
 def run_drill(cache_dir, profile_dir):
     from incubator_mxnet_tpu.serving import Router
 
-    kids = [_spawn(cache_dir, profile_dir),
+    kids = [_spawn(cache_dir, profile_dir, scan0=True),
             _spawn(cache_dir, profile_dir),
             _spawn(cache_dir, profile_dir, spec=True)]
     ports = [p for _, p in kids]
     spec_id = f"127.0.0.1:{ports[2]}"
-    plain_ids = [f"127.0.0.1:{p}" for p in ports[:2]]
+    step_id = f"127.0.0.1:{ports[0]}"   # per-step: scan_steps=0
+    burst_id = f"127.0.0.1:{ports[1]}"  # scanned bursts, default k
     for _, port in kids:
         _wait_ready(port)
 
@@ -219,10 +225,12 @@ def run_drill(cache_dir, profile_dir):
             f"some replica never decoded: {dpt}"
 
         # -- contract 1: dispatch economy, per replica --------------------
-        for rid in plain_ids:
-            assert abs(dpt[rid] - 1.0) < 1e-6, \
-                (f"plain replica {rid}: dispatches-per-token {dpt[rid]} "
-                 f"!= 1.0")
+        assert abs(dpt[step_id] - 1.0) < 1e-6, \
+            (f"per-step replica {step_id}: dispatches-per-token "
+             f"{dpt[step_id]} != 1.0")
+        assert dpt[burst_id] < 0.999, \
+            (f"burst replica {burst_id}: dispatches-per-token "
+             f"{dpt[burst_id]} not < 1.0 — bursts never engaged")
         assert dpt[spec_id] < 0.999, \
             (f"spec replica {spec_id}: dispatches-per-token "
              f"{dpt[spec_id]} not < 1.0 — the draft earned nothing")
@@ -237,7 +245,8 @@ def run_drill(cache_dir, profile_dir):
                  f"expected {inv['expected_programs']}")
             ran = [s for s, row in inv["programs"].items()
                    if row["dispatches"] > 0]
-            assert any(s.endswith(":decode") or s.endswith(":verify")
+            assert any(s.endswith((":decode", ":decode_burst",
+                                   ":verify"))
                        for s in ran), f"{rid}: no decode ran: {ran}"
 
         # -- contract 3: federated HBM attribution ------------------------
@@ -272,8 +281,8 @@ def run_drill(cache_dir, profile_dir):
             f"replicas shared a capture artifact: {artifacts}"
 
         print(f"device_obs_smoke ok: {results['done']} streams; "
-              f"dispatches-per-token plain="
-              f"{[round(dpt[r], 4) for r in plain_ids]} "
+              f"dispatches-per-token per-step={dpt[step_id]:.4f} "
+              f"burst={dpt[burst_id]:.4f} "
               f"spec={dpt[spec_id]:.4f}; closed program set verified on "
               f"{len(progs['replicas'])} replicas; federated kv:gen "
               f"bytes {kv_sum:.0f}; {len(artifacts)} profile artifacts")
