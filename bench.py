@@ -685,6 +685,11 @@ def _bench_generate(on_accel, kind, dev):
     PR the draft's k proposal decodes run as ONE scanned burst dispatch
     (2 dispatches per spec round instead of k+1), so this axis
     re-records against the PR 14 host-loop-draft record (2.44x on CPU).
+    The sampling plane re-records it once more at temperature 0.7:
+    Gumbel-coupled stochastic acceptance is asserted bit-identical to
+    the no-draft sampled run over the same key stream, and the sampled
+    accept rate is recorded next to greedy's (accept rate vs
+    temperature).
 
     The fourth axis, ``decode_scan``, measures the whole-decode-loop
     capture (docs/serving.md "Multi-token decode bursts"): the same
@@ -694,14 +699,21 @@ def _bench_generate(on_accel, kind, dev):
     are asserted bit-identical; recorded are tokens/sec for both legs
     plus each batcher's ``dispatches_per_token``, with floors
     speedup >= 1.2x and burst dispatches_per_token <= 0.2 (the
-    docs/serving.md dispatch-economy bar for k=8)."""
+    docs/serving.md dispatch-economy bar for k=8).
+
+    The fifth axis, ``sampling``, runs the same steady-state load
+    greedy vs stochastically sampled (temperature 0.8, top-p 0.9,
+    fixed per-request seeds).  Sampling operands are traced inputs of
+    the SAME compiled programs, so the recorded ``overhead_pct`` floor
+    is <= 10%; the fixed seeds double as a replay-contract assertion
+    (identical outputs across repeats)."""
     import threading
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import telemetry
     from incubator_mxnet_tpu.models.gpt import GPTModel
     from incubator_mxnet_tpu.serving import ContinuousBatcher, \
-        GenerationEngine
+        GenerationEngine, SamplingParams
 
     clients = 16
     if on_accel:
@@ -928,12 +940,14 @@ def _bench_generate(on_accel, kind, dev):
     spec_eng.attach_draft(draft_eng, spec_k=spec_k)
     spec_eng.warmup()
 
-    spec_calls = {"n": 0}
+    spec_calls = {"n": 0, "accepted": 0}
     _orig_spec_step = spec_eng.spec_step
 
     def _counting_spec_step(last, pos):
         spec_calls["n"] += 1
-        return _orig_spec_step(last, pos)
+        out = _orig_spec_step(last, pos)
+        spec_calls["accepted"] += int(out[1][0])
+        return out
 
     spec_eng.spec_step = _counting_spec_step
     spec_prompt = [int(t) for t in rng.integers(1, sV, size=8)]
@@ -952,7 +966,7 @@ def _bench_generate(on_accel, kind, dev):
         plain_seq = spec_eng.generate(spec_prompt, max_new_tokens=s_new,
                                       speculative=False)
     plain_dt = (time.perf_counter() - t0) / reps
-    spec_calls["n"] = 0
+    spec_calls["n"] = spec_calls["accepted"] = 0
     t0 = time.perf_counter()
     for _ in range(reps):
         spec_seq = spec_eng.generate(spec_prompt, max_new_tokens=s_new,
@@ -965,7 +979,27 @@ def _bench_generate(on_accel, kind, dev):
     # tokens per verify dispatch: everything after the prefill token
     # came out of a spec_step burst
     tpd = (len(spec_seq) - 1) * reps / max(spec_calls["n"], 1)
+    greedy_accept = spec_calls["accepted"] / max(
+        spec_calls["n"] * spec_k, 1)
     spec_speedup = round(plain_dt / max(spec_dt, 1e-9), 3)
+
+    # stochastic spec at temperature 0.7: Gumbel-coupled acceptance
+    # keys every draw off (seed, position), so the spec run emits the
+    # SAME tokens as the no-draft sampled run at any accept rate --
+    # asserted bit-identical, and the accept rate recorded next to
+    # greedy's gives the accept-rate-vs-temperature picture
+    samp = SamplingParams(temperature=0.7, top_p=0.95, seed=4242)
+    samp_plain = spec_eng.generate(spec_prompt, max_new_tokens=s_new,
+                                   speculative=False, sampling=samp)
+    spec_calls["n"] = spec_calls["accepted"] = 0
+    samp_spec = spec_eng.generate(spec_prompt, max_new_tokens=s_new,
+                                  speculative=True, sampling=samp)
+    if samp_spec != samp_plain:
+        raise RuntimeError(
+            "sampled speculative != no-draft sampled sequence (Gumbel-"
+            "coupled acceptance must preserve the keyed sample stream)")
+    samp_accept = spec_calls["accepted"] / max(
+        spec_calls["n"] * spec_k, 1)
     spec_axis = {
         "spec_k": spec_k,
         # attach_draft sizes the draft's scanned proposal burst to
@@ -978,6 +1012,10 @@ def _bench_generate(on_accel, kind, dev):
         "plain_tokens_per_sec": round(len(plain_seq) / plain_dt, 1),
         "spec_tokens_per_sec": round(len(spec_seq) / spec_dt, 1),
         "accepted_tokens_per_dispatch": round(tpd, 3),
+        "accept_rate_greedy": round(greedy_accept, 3),
+        "sampling": {"temperature": 0.7, "top_p": 0.95, "seed": 4242,
+                     "accept_rate": round(samp_accept, 3),
+                     "outputs_identical_to_no_draft": True},
         "outputs_identical": True,
         "speedup": spec_speedup,
         "speedup_floor": 1.3,
@@ -1037,6 +1075,64 @@ def _bench_generate(on_accel, kind, dev):
         "floor_ok": bool(scan_speedup >= 1.2 and scan_dpt <= 0.2),
     }
 
+    # -- sampling: the same 16-client steady-state load, greedy vs
+    # per-request stochastic sampling (temperature 0.8, top-p 0.9,
+    # fixed per-request seeds).  The sampling operands ride the SAME
+    # compiled programs as traced inputs — no new programs, no host
+    # branching — so the only cost is the in-program Gumbel-max
+    # epilogue; the floor holds sampled throughput within 10% of
+    # greedy.  The legs alternate through ONE batcher, best-of-3 each
+    # (sequential per-arm phases charge host drift to whichever arm
+    # runs second — the train_loop health axis lesson), and the fixed
+    # seeds double as a replay-contract assertion ---------------------
+    engine.reset()
+    samp_bat = ContinuousBatcher(engine, name="bench-sampling")
+
+    def sampling_pass(sampler):
+        t1 = time.perf_counter()
+        reqs = [samp_bat.submit_async(p, max_new_tokens=new_tokens,
+                                      sampling=sampler(i))
+                for i, p in enumerate(prompts)]
+        got = [r.result(timeout=300) for r in reqs]
+        return got, sum(len(o) for o in got) / (time.perf_counter() - t1)
+
+    def _samp(i):
+        return SamplingParams(temperature=0.8, top_p=0.9, seed=9000 + i)
+
+    def _greedy(i):
+        return None
+
+    try:
+        sampling_pass(_greedy)          # settle jit caches / step EWMA
+        sampling_pass(_samp)
+        greedy_tps = sampled_tps = 0.0
+        sam_outs = None
+        for _ in range(3):
+            _, g = sampling_pass(_greedy)
+            got, s = sampling_pass(_samp)
+            if sam_outs is not None and got != sam_outs:
+                raise RuntimeError(
+                    "seeded sampled outputs changed across repeats "
+                    "(replay contract broken)")
+            sam_outs = got
+            greedy_tps = max(greedy_tps, g)
+            sampled_tps = max(sampled_tps, s)
+    finally:
+        samp_bat.close()
+    overhead_pct = round(
+        (greedy_tps - sampled_tps) / max(greedy_tps, 1e-9) * 100, 2)
+    sampling_axis = {
+        "temperature": 0.8,
+        "top_p": 0.9,
+        "greedy_tokens_per_sec": round(greedy_tps, 1),
+        "sampled_tokens_per_sec": round(sampled_tps, 1),
+        "overhead_pct": overhead_pct,
+        "distinct_outputs": len({tuple(o) for o in sam_outs}),
+        "seeded_replay_identical": True,
+        "floor": "overhead_pct <= 10.0",
+        "floor_ok": bool(overhead_pct <= 10.0),
+    }
+
     return {
         "model": f"gpt_{L}L_{U}u_{heads}h",
         "clients": clients,
@@ -1056,10 +1152,12 @@ def _bench_generate(on_accel, kind, dev):
         "prefix_prefill_savings": prefix_axis,
         "speculative_decoding": spec_axis,
         "decode_scan": scan_axis,
+        "sampling": sampling_axis,
         "floor_ok": bool(speedup >= 3.0 and streams_axis["floor_ok"]
                          and prefix_axis["floor_ok"]
                          and spec_axis["floor_ok"]
-                         and scan_axis["floor_ok"]),
+                         and scan_axis["floor_ok"]
+                         and sampling_axis["floor_ok"]),
     }
 
 

@@ -79,6 +79,16 @@ usage: ci/run_tests.sh <function>
                         reads < 0.2, and a serving.infer:hang wedged
                         mid-burst fails its rider (id on the terminal
                         SSE error) and recovers via the watchdog
+  sampling_smoke        sampling-plane drill: 16 streaming sampled
+                        clients through a router over a preloaded
+                        burst replica — every done event echoes its
+                        seed, two identical-seed requests are
+                        byte-identical, a stop sequence completed
+                        mid-burst trims the over-generated tail, and
+                        sampled speculative decoding is bit-identical
+                        to the no-draft run with the
+                        mxtpu_spec_accept_rate{mode="sampled"} gauge
+                        federated on the router /metrics
   paged_smoke           paged KV-cache drill: under an EQUAL cache-byte
                         budget (dense 4x128 positions == paged 32x16
                         blocks), 16 streaming clients with a shared
@@ -1163,6 +1173,158 @@ print(f"decode_scan_smoke ok: {CLIENTS} streams bit-identical to "
       f"no-scan golden, federated dispatches_per_token {dpt:.3f} "
       f"(k=8), hang drill failed rider 'scan-hang' after "
       f"{len(toks_h)} tokens and recovered")
+EOF
+}
+
+sampling_smoke() {
+    MXNET_SPEC_K=4 \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.models.gpt import GPTModel
+from incubator_mxnet_tpu.serving import (GenerationEngine, ModelServer,
+                                         Router, SamplingParams)
+
+telemetry.start()
+CLIENTS, NEW = 16, 24
+SYSTEM = list(range(1, 17))            # shared 16-token system prompt
+PROMPTS = [SYSTEM + [40 + i % 8, i % 5] for i in range(CLIENTS)]
+
+def build(name, max_slots, scan_steps):
+    mx.random.seed(3)
+    net = GPTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+                   num_heads=2, max_length=128, dropout=0.0)
+    net.initialize(init=mx.init.Normal(0.6))
+    net(mx.nd.array(np.zeros((1, 2), np.int32)))
+    return GenerationEngine(net, name=name, max_slots=max_slots,
+                            max_len=128, paged=True, block_size=16,
+                            scan_steps=scan_steps)
+
+# "gen": burst replica; "spec": target+draft (identical weights) ------
+gen = build("gen", CLIENTS, 8)
+tgt = build("spec", 4, 0)
+dr = build("spec-draft", 4, 0)
+tgt.attach_draft(dr)                   # k from MXNET_SPEC_K=4
+srv = ModelServer(port=0)
+srv.add_model("gen", gen)
+srv.add_model("spec", tgt)
+srv.preload()
+srv.start()
+router = Router([f"127.0.0.1:{srv.port}"], port=0, host="127.0.0.1",
+                health_interval=0.1, upstream_timeout=60.0,
+                retry_deadline=60.0, federate_seconds=0.2)
+router.start()
+url = f"http://127.0.0.1:{router.port}"
+direct = f"http://127.0.0.1:{srv.port}"
+
+def post(model, body, rid=None, base=None):
+    req = urllib.request.Request(
+        (base or url) + f"/v1/models/{model}:generate",
+        data=json.dumps(body).encode(),
+        headers={"x-request-id": rid} if rid else {})
+    return urllib.request.urlopen(req, timeout=120)
+
+def stream(model, body, rid):
+    r = post(model, dict(body, stream=True), rid)
+    toks, finals = [], []
+    for line in r:
+        line = line.strip()
+        if line.startswith(b"data:"):
+            d = json.loads(line.split(b":", 1)[1])
+            if "token" in d:
+                toks.append(d["token"])
+            else:
+                finals.append(d)
+    return toks, finals, r.headers.get("X-Request-Id")
+
+# -- 1. 16 streaming SAMPLED clients through the router ---------------
+results, errors = {}, []
+def run(i):
+    try:
+        results[i] = stream("gen", {
+            "tokens": PROMPTS[i], "max_new_tokens": NEW,
+            "temperature": 0.8, "top_p": 0.9, "seed": 1000 + i},
+            f"smp-{i}")
+    except Exception as e:
+        errors.append(f"smp-{i}: {e!r}")
+
+threads = [threading.Thread(target=run, args=(i,)) for i in range(CLIENTS)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not errors, "sampling_smoke: " + "; ".join(errors[:3])
+for i in range(CLIENTS):
+    toks, finals, rid = results[i]
+    assert rid == f"smp-{i}", f"sampling_smoke: X-Request-Id lost: {rid!r}"
+    assert len(toks) == NEW and finals[-1].get("seed") == 1000 + i, \
+        f"sampling_smoke: client {i} malformed: {len(toks)} toks, " \
+        f"{finals[-1]}"
+assert len({tuple(results[i][0]) for i in range(CLIENTS)}) > 1, \
+    "sampling_smoke: every seed produced identical output"
+
+# -- 2. two identical-seed requests are byte-identical ----------------
+body0 = {"tokens": PROMPTS[0], "max_new_tokens": NEW,
+         "temperature": 0.8, "top_p": 0.9, "seed": 1000}
+r1 = json.loads(post("gen", body0).read())
+r2 = json.loads(post("gen", body0).read())
+assert r1["tokens"] == r2["tokens"] == results[0][0], \
+    "sampling_smoke: identical-seed replay diverged"
+assert r1["seed"] == 1000, r1
+
+# -- 3. stop sequence completed mid-burst: tail trimmed ---------------
+base = json.loads(post("gen", {"tokens": PROMPTS[1],
+                               "max_new_tokens": NEW,
+                               "temperature": 0.8,
+                               "seed": 77}).read())["tokens"]
+stopped = json.loads(post("gen", {"tokens": PROMPTS[1],
+                                  "max_new_tokens": NEW,
+                                  "temperature": 0.8, "seed": 77,
+                                  "stop": [base[3:5]]}).read())["tokens"]
+assert stopped == base[:5], \
+    f"sampling_smoke: stop trim wrong: {stopped} vs {base[:5]}"
+st = json.load(urllib.request.urlopen(
+    direct + "/v1/models", timeout=10))["models"]["gen"]
+assert st["stop_hits"] >= 1 and st["decode_burst_dispatches"] > 0, st
+
+# -- 4. sampled spec preserves the no-draft stream; accept-rate gauge
+#       carries mode="sampled" on the federated /metrics --------------
+golden_eng = build("golden", 1, 0)
+sp = SamplingParams(temperature=0.7, top_p=0.95, seed=4242)
+want = golden_eng.generate(PROMPTS[2], NEW, sampling=sp)
+got = json.loads(post("spec", {"tokens": PROMPTS[2],
+                               "max_new_tokens": NEW,
+                               "temperature": 0.7, "top_p": 0.95,
+                               "seed": 4242}).read())
+assert got["tokens"] == want, \
+    f"sampling_smoke: sampled spec diverged from no-draft run: " \
+    f"{got['tokens'][:8]}... != {want[:8]}..."
+assert got["draft_tokens"] > 0, got
+router._federate_maybe(force=True)
+prom = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+m = re.search(r'mxtpu_spec_accept_rate\{[^}]*mode="sampled"[^}]*\}'
+              r'\s+([0-9.eE+-]+)', prom)
+assert m, "sampling_smoke: no mode=\"sampled\" accept-rate gauge:\n" + \
+    "\n".join(l for l in prom.splitlines() if "accept_rate" in l)
+rate = float(m.group(1))
+assert 0.0 <= rate <= 1.0, rate
+assert re.search(r'mxtpu_sample_requests\{[^}]*mode="sampled"',
+                 prom), "sampling_smoke: mxtpu_sample_requests missing"
+router.stop()
+srv.stop()
+telemetry.stop()
+print(f"sampling_smoke ok: {CLIENTS} sampled streams through the "
+      f"router, identical-seed replay byte-identical, stop trimmed "
+      f"{st['stop_trimmed_tokens']} burst-tail tokens, sampled spec "
+      f"bit-identical to no-draft (accept rate {rate:.2f})")
 EOF
 }
 

@@ -247,6 +247,7 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
     cfg_bs = cfg.pop("block_size", None)
     cfg_spec_k = cfg.pop("spec_k", None)    # draft configs only
     cfg_scan = cfg.pop("scan_steps", None)
+    cfg_lp = cfg.pop("logprobs_topn", None)
     max_slots = cfg_slots if max_slots is None else max_slots
     max_len = cfg_len if max_len is None else max_len
     paged = cfg_paged if paged is None else paged
@@ -264,7 +265,8 @@ def _load_generation_engine(name, cfg_path, max_slots=None, max_len=None,
     engine = GenerationEngine(net, name=name, max_slots=max_slots,
                               max_len=max_len, paged=paged,
                               block_size=block_size,
-                              scan_steps=scan_steps)
+                              scan_steps=scan_steps,
+                              logprobs_topn=cfg_lp)
     # surfaced by serve_main when this config backs a --gen-draft
     engine._cfg_spec_k = cfg_spec_k
     return engine

@@ -41,7 +41,12 @@ blocks, per-slot block tables, refcounted prefix sharing; dense mode
 via ``MXNET_KV_PAGED=0`` — with a prefill/decode split) behind a
 :class:`ContinuousBatcher` (per-slot join/leave, one decode dispatch
 per step over all live requests, pool-capacity admission) behind
-``POST /v1/models/<name>:generate`` with SSE streaming.
+``POST /v1/models/<name>:generate`` with SSE streaming.  The sampling
+plane (``sampling.py``) threads per-slot :class:`SamplingParams`
+through those same compiled programs as traced operands — stochastic
+decoding, seeded replay, speculative sampling, per-token logprobs,
+multi-token stop sequences, and JSON-mode constrained output
+(docs/serving.md "Sampling").
 
 Importing this package registers the ``mxtpu_serve_*`` metrics on the
 shared telemetry registry, so they appear on every exporter
@@ -57,6 +62,7 @@ from .lifecycle import (
 from .engine import InferenceEngine, GenerationEngine, derive_buckets, \
     derive_prefill_buckets
 from .kvcache import BlockPool, blocks_for
+from .sampling import SamplingParams, JsonMaskMachine
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .server import ModelServer
 from .router import Router, Replica, UpstreamError, NoReplicaAvailable
@@ -65,6 +71,7 @@ from .supervisor import (Supervisor, AutoscalePolicy, ScaleSignals,
 
 __all__ = ["InferenceEngine", "GenerationEngine", "derive_buckets",
            "derive_prefill_buckets", "BlockPool", "blocks_for",
+           "SamplingParams", "JsonMaskMachine",
            "DynamicBatcher",
            "ContinuousBatcher", "QueueFullError", "ModelServer",
            "Router", "Replica", "UpstreamError", "NoReplicaAvailable",

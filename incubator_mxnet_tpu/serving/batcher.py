@@ -79,6 +79,8 @@ from .. import telemetry_device as _tdev
 from . import lifecycle as _lc
 from . import metrics as _m
 from . import slo as _slo
+from .sampling import (SamplingParams, JsonMaskMachine, stop_trim,
+                       derive_candidate_seed)
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "QueueFullError"]
 
@@ -635,15 +637,31 @@ class _GenRequest:
                  "tokens_out", "t_submit", "t_first", "t_emit",
                  "deadline", "model", "request_id", "trace_ctx",
                  "slot", "_q", "_cancelled",
-                 "accepted_tokens", "draft_tokens")
+                 "accepted_tokens", "draft_tokens",
+                 "sampling", "seed", "logprobs_n", "logprobs_out",
+                 "stops", "_machine")
 
     def __init__(self, tokens, budget, eos_id=None, deadline=None,
-                 model="?", request_id=None, trace_ctx=None):
+                 model="?", request_id=None, trace_ctx=None,
+                 sampling=None):
         import queue as _pyqueue
         self.tokens = tokens            # prompt, np int32 1-D
         self.n = int(tokens.shape[0])
         self.budget = int(budget)       # max tokens to emit
         self.eos_id = eos_id
+        # sampling plane (serving/sampling.py): the validated
+        # SamplingParams (None: greedy), the EFFECTIVE seed (client's or
+        # server-generated — echoed so any sampled response replays),
+        # the clamped per-token logprobs top-N with its output list
+        # (entry i describes tokens_out[i]; appended BEFORE the token is
+        # queued so the streaming thread may index it immediately), the
+        # stop token-id sequences, and the constrained-output machine
+        self.sampling = sampling
+        self.seed = sampling.seed if sampling is not None else None
+        self.logprobs_n = int(sampling.logprobs) if sampling else 0
+        self.logprobs_out: List[dict] = []
+        self.stops = tuple(sampling.stop) if sampling else ()
+        self._machine: Optional[JsonMaskMachine] = None
         self.event = threading.Event()
         self.error = None
         self.tokens_out: List[int] = []
@@ -775,6 +793,69 @@ class _GenRequest:
                 self.cancel()
 
 
+class _MultiGenRequest:
+    """n>1 candidate fan-out: one handle over ``n`` independent child
+    :class:`_GenRequest` streams, each decoding in its own slot under a
+    derived seed (candidate 0 keeps the request seed, so an ``n=1``
+    replay of the echoed seed reproduces it byte-for-byte).  The
+    ``result()``/``request_id`` surface stays _GenRequest-shaped for
+    back-compat — ``result()`` returns candidate 0's tokens,
+    ``results()`` all of them."""
+
+    def __init__(self, children, request_id: str):
+        self.children = list(children)
+        self.request_id = request_id
+
+    @property
+    def seed(self):
+        return self.children[0].seed
+
+    @property
+    def request_ids(self):
+        return [r.request_id for r in self.children]
+
+    @property
+    def accepted_tokens(self) -> int:
+        return sum(r.accepted_tokens for r in self.children)
+
+    @property
+    def draft_tokens(self) -> int:
+        return sum(r.draft_tokens for r in self.children)
+
+    @property
+    def logprobs_n(self) -> int:
+        return self.children[0].logprobs_n
+
+    @property
+    def logprobs_out(self):
+        return self.children[0].logprobs_out
+
+    @property
+    def done(self) -> bool:
+        return all(r.done for r in self.children)
+
+    def cancel(self) -> None:
+        for r in self.children:
+            r.cancel()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        return self.results(timeout)[0]
+
+    def results(self, timeout: Optional[float] = None) -> List[List[int]]:
+        """Block for every candidate; returns their token lists in
+        candidate order.  The first child error re-raises (remaining
+        candidates are cancelled — a half-failed fan-out has no
+        well-defined response)."""
+        out = []
+        try:
+            for r in self.children:
+                out.append(r.result(timeout))
+        except Exception:
+            self.cancel()
+            raise
+        return out
+
+
 class ContinuousBatcher(DynamicBatcher):
     """Continuous-batching front-end over one
     :class:`serving.engine.GenerationEngine`.
@@ -808,13 +889,23 @@ class ContinuousBatcher(DynamicBatcher):
       shows a request's whole decode lifetime.
     """
 
-    def __init__(self, engine, **kw):
+    def __init__(self, engine, token_strs=None, **kw):
         kw.setdefault("max_batch_size", engine.max_slots)
         self._slots: List[Optional[_GenRequest]] = \
             [None] * int(engine.max_slots)
         self._step = 0
         self._tokens_emitted = 0
         self._peak_slots = 0
+        # sampling plane: token id -> string mapping for the
+        # constrained-output (json_mode) machine (default: byte-level,
+        # materialized lazily on the first constrained request), stop
+        # limits, and host-side stop/trim accounting
+        self._token_strs = list(token_strs) if token_strs is not None \
+            else None
+        self._max_stops = max(1, getenv_int("MXNET_SAMPLING_MAX_STOPS",
+                                            4))
+        self._stop_hits = 0
+        self._stop_trimmed = 0
         # speculative decoding totals (see serving/metrics.py): verify
         # dispatches, tokens emitted from them, and draft proposals made
         self._spec_dispatches = 0
@@ -878,19 +969,80 @@ class ContinuousBatcher(DynamicBatcher):
         return 0.0
 
     # -- submit ---------------------------------------------------------
+    def _token_strings(self):
+        """Token id -> string mapping for the constrained-output
+        machine (ctor ``token_strs``; default byte-level, materialized
+        on the first constrained request)."""
+        if self._token_strs is None:
+            vs = int(getattr(self.engine, "vocab_size", 0) or 0)
+            self._token_strs = [chr(i) for i in range(vs)]
+        return self._token_strs
+
     def submit_async(self, tokens, max_new_tokens: int = 32,
                      timeout_ms: Optional[float] = None,
                      request_id: Optional[str] = None,
-                     eos_id: Optional[int] = None) -> _GenRequest:
+                     eos_id: Optional[int] = None,
+                     sampling: Optional[SamplingParams] = None):
         """Enqueue one generation request; returns a handle whose
         ``stream()`` yields tokens as they are produced and whose
         ``result()`` blocks for the full list.  Raises
         :class:`QueueFullError` under backpressure, ``BreakerOpen``
         while the breaker is OPEN, ``ValueError`` for an unservable
-        prompt/budget."""
-        import numpy as _np
+        prompt/budget or out-of-range sampling parameters.
+
+        ``sampling`` (None: greedy) is validated here, its ``logprobs``
+        clamped to the engine's baked top-N, and — for a sampled
+        request without a client seed — an effective seed is generated
+        and stored on the handle (``req.seed``) so the response is
+        replayable.  ``sampling.n > 1`` fans out into ``n`` independent
+        single-candidate children over distinct slots (derived seeds;
+        candidate 0 keeps the request seed) behind one
+        :class:`_MultiGenRequest` handle."""
+        from dataclasses import replace as _dc_replace
         if request_id is None:
             request_id = _telemetry.new_request_id()
+        if sampling is not None:
+            sampling = sampling.validate(
+                max_stops=self._max_stops,
+                max_n=int(self.engine.max_slots))
+            lp_cap = int(getattr(self.engine, "logprobs_topn", 0) or 0)
+            if sampling.logprobs > lp_cap:
+                sampling = _dc_replace(sampling, logprobs=lp_cap)
+            if sampling.sampled and sampling.seed is None:
+                import os as _os
+                sampling = _dc_replace(
+                    sampling,
+                    seed=int.from_bytes(_os.urandom(8), "big") >> 1)
+        if sampling is not None and sampling.n > 1:
+            base = sampling.seed
+            children: List[_GenRequest] = []
+            try:
+                for i in range(sampling.n):
+                    child = _dc_replace(
+                        sampling, n=1,
+                        seed=derive_candidate_seed(base, i)
+                        if base is not None else None)
+                    children.append(self._submit_one(
+                        tokens, max_new_tokens, timeout_ms=timeout_ms,
+                        request_id=f"{request_id}.{i}", eos_id=eos_id,
+                        sampling=child))
+            except Exception:
+                for c in children:   # no half-admitted fan-outs
+                    c.cancel()
+                raise
+            return _MultiGenRequest(children, request_id)
+        return self._submit_one(tokens, max_new_tokens,
+                                timeout_ms=timeout_ms,
+                                request_id=request_id, eos_id=eos_id,
+                                sampling=sampling)
+
+    def _submit_one(self, tokens, max_new_tokens: int = 32,
+                    timeout_ms: Optional[float] = None,
+                    request_id: Optional[str] = None,
+                    eos_id: Optional[int] = None,
+                    sampling: Optional[SamplingParams] = None) \
+            -> _GenRequest:
+        import numpy as _np
         _fault.inject("serving.queue", model=self.name,
                       request_id=request_id)
         self.breaker.allow()
@@ -912,7 +1064,11 @@ class ContinuousBatcher(DynamicBatcher):
         req = _GenRequest(toks, budget, eos_id=eos_id,
                           deadline=_lc.deadline_from_ms(timeout_ms),
                           model=self.name, request_id=request_id,
-                          trace_ctx=_telemetry.tracer.current())
+                          trace_ctx=_telemetry.tracer.current(),
+                          sampling=sampling)
+        if sampling is not None and sampling.json_mode:
+            req._machine = JsonMaskMachine(self._token_strings())
+            _m.SAMPLE_CONSTRAINED.inc(model=self.name)
         with self._cv:
             if self._closed:
                 raise MXNetError(f"batcher {self.name!r} is closed")
@@ -938,13 +1094,18 @@ class ContinuousBatcher(DynamicBatcher):
             _m.QUEUE_DEPTH.set(len(self._queue), model=self.name)
             self._cv.notify_all()
         _m.REQUESTS.inc(model=self.name)
+        _m.SAMPLED_REQUESTS.inc(
+            model=self.name,
+            mode="sampled" if (sampling is not None and sampling.sampled)
+            else "greedy")
         return req
 
     def submit(self, tokens, max_new_tokens: int = 32,
                timeout: Optional[float] = None,
                timeout_ms: Optional[float] = None,
                request_id: Optional[str] = None,
-               eos_id: Optional[int] = None) -> List[int]:
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> List[int]:
         """Synchronous generation: enqueue, wait, return all emitted
         tokens.  (SLO accounting happens worker-side at finish, for the
         streaming and sync paths alike; admission failures are recorded
@@ -957,7 +1118,8 @@ class ContinuousBatcher(DynamicBatcher):
             try:
                 req = self.submit_async(
                     tokens, max_new_tokens, timeout_ms=timeout_ms,
-                    request_id=request_id, eos_id=eos_id)
+                    request_id=request_id, eos_id=eos_id,
+                    sampling=sampling)
             except Exception:
                 _slo.tracker.record(self.name, 0.0, ok=False)
                 raise
@@ -1072,9 +1234,14 @@ class ContinuousBatcher(DynamicBatcher):
                 live = [(s, r) for s, r in enumerate(self._slots)
                         if r is not None]
             if live:
-                if getattr(self.engine, "draft", None) is not None:
+                # constrained slots update their vocab mask host-side
+                # at every emit boundary — the k+1-wide spec verify
+                # (like the burst scan) would sample past a stale mask
+                dyn = any(r._machine is not None for _, r in live)
+                if not dyn and \
+                        getattr(self.engine, "draft", None) is not None:
                     self._spec_once(gen, live)
-                elif self._burst_ready(live):
+                elif not dyn and self._burst_ready(live):
                     self._decode_burst_once(gen, live)
                 else:
                     self._decode_once(gen, live)
@@ -1087,6 +1254,13 @@ class ContinuousBatcher(DynamicBatcher):
                                    request_id=req.request_id,
                                    prompt_tokens=req.n):
             try:
+                # sampling state rides the slot: params (and the
+                # constraint mask row, for json_mode) must be installed
+                # BEFORE prefill so the first sampled token is keyed
+                self.engine.set_slot_sampling(slot, req.sampling)
+                if req._machine is not None:
+                    self.engine.update_slot_bias(
+                        slot, req._machine.mask(budget=req.budget))
                 first = self.engine.prefill(
                     req.tokens, slot, reserve_tokens=req.n + req.budget)
             except Exception as e:
@@ -1095,7 +1269,12 @@ class ContinuousBatcher(DynamicBatcher):
                         self._slots[slot] = None
                 self._fail(req, e)
                 return
+        lp = getattr(self.engine, "last_prefill_logprobs",
+                     lambda: None)()
+        if lp is not None:
+            self._push_logprobs(req, lp[0], lp[1])
         self._emit(req, first)
+        self._advance_machine(slot, req, first)
         if self._maybe_finished(req):
             self._free_slot(slot, req, "finished")
 
@@ -1142,9 +1321,14 @@ class ContinuousBatcher(DynamicBatcher):
             self._dpt_dispatches / max(self._dpt_tokens, 1e-9),
             model=self.name)
         self._fold_decode_health(live)
+        lp = self.engine.last_logprobs()    # (S, N) pair or None
         for s, r in live:
+            if lp is not None:
+                self._push_logprobs(r, lp[0][s], lp[1][s])
             # the stream boundary: ONE scalar pull per emitted token
-            self._emit(r, int(nxt[s]))  # mxtpu-lint: disable=host-sync-in-hot-path
+            tok = int(nxt[s])  # mxtpu-lint: disable=host-sync-in-hot-path
+            self._emit(r, tok)
+            self._advance_machine(s, r, tok)
             if self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
 
@@ -1170,6 +1354,10 @@ class ContinuousBatcher(DynamicBatcher):
             if r._cancelled:
                 return False
             if r.deadline is not None and r.deadline <= horizon:
+                return False
+            # a constrained slot needs its mask refreshed at EVERY emit
+            # boundary — the k-step scan can't see host-side updates
+            if r._machine is not None:
                 return False
         return True
 
@@ -1223,6 +1411,7 @@ class ContinuousBatcher(DynamicBatcher):
         self.breaker.record_success()
         self._fold_decode_health(live)
         self._burst_dispatches += 1
+        lp = self.engine.last_logprobs()    # (k, S, N) pair or None
         total = 0
         for s, r in live:
             # the stream boundary: one bounded pull per rider burst
@@ -1230,9 +1419,30 @@ class ContinuousBatcher(DynamicBatcher):
             if n < 1:
                 continue
             # mxtpu-lint: disable=host-sync-in-hot-path
-            self._emit_burst(r, [int(t) for t in toks[:n, s]])
+            new = [int(t) for t in toks[:n, s]]
+            stopped = False
+            if r.stops:
+                # stop sequences are detected host-side AT the emit
+                # boundary: keep through the stop, discard the
+                # over-generated tail BEFORE anything reaches the
+                # client's stream
+                kept, stopped = stop_trim(r.tokens_out, new, r.stops)
+                if stopped:
+                    self._stop_hits += 1
+                    self._stop_trimmed += n - kept
+                    _m.SAMPLE_STOP_HITS.inc(model=self.name)
+                    _m.SAMPLE_STOP_TRIMMED.inc(n - kept,
+                                               model=self.name)
+                    new = new[:kept]
+                    n = kept
+            if lp is not None:
+                for j in range(n):
+                    self._push_logprobs(r, lp[0][j, s], lp[1][j, s])
+            self._emit_burst(r, new)
             total += n
-            if self._maybe_finished(r):
+            # `stopped` already counted the hit — bypass the endswith
+            # re-check in _maybe_finished to keep the counter honest
+            if stopped or self._maybe_finished(r):
                 self._free_slot(s, r, "finished")
         _m.DECODE_BURST_TOKENS.observe(total)
         # dispatch economy: ONE dispatch bought up to k tokens per slot
@@ -1327,6 +1537,8 @@ class ContinuousBatcher(DynamicBatcher):
         # the first token is a draft proposal the target kept — a
         # budget/eos cut mid-burst caps the accepted count to match.
         self._spec_dispatches += 1
+        lp = getattr(self.engine, "last_verify_logprobs",
+                     lambda: None)()     # (S, Q, N) pair or None
         step_emitted = 0
         step_accepted = 0
         for s, r in live:
@@ -1334,6 +1546,8 @@ class ContinuousBatcher(DynamicBatcher):
             # the stream boundary: scalar pulls gate each emitted token
             # mxtpu-lint: disable=host-sync-in-hot-path
             for j in range(int(accepted[s]) + 1):
+                if lp is not None:
+                    self._push_logprobs(r, lp[0][s, j], lp[1][s, j])
                 # mxtpu-lint: disable=host-sync-in-hot-path
                 self._emit(r, int(burst[s, j]))
                 n_emit += 1
@@ -1357,7 +1571,10 @@ class ContinuousBatcher(DynamicBatcher):
             self._spec_emitted / self._spec_slot_steps, model=self.name)
         _m.SPEC_ACCEPT_RATE.set(
             self._spec_accepted / max(1, self._spec_drafted),
-            model=self.name)
+            model=self.name,
+            mode="sampled" if any(
+                r.sampling is not None and r.sampling.sampled
+                for _, r in live) else "greedy")
         self._dpt_dispatches += 1
         self._dpt_tokens += step_emitted / max(1, len(live))
         _m.DISPATCHES_PER_TOKEN.set(
@@ -1365,10 +1582,38 @@ class ContinuousBatcher(DynamicBatcher):
             model=self.name)
 
     # -- step-boundary helpers ------------------------------------------
+    def _push_logprobs(self, req: _GenRequest, vals, ids):
+        """Append one per-token top-N logprobs record (sliced to the
+        request's clamp) alongside the token about to be emitted."""
+        n = req.logprobs_n
+        if n < 1 or vals is None:
+            return
+        # the engine stashed these as host numpy at the dispatch's own
+        # sync point (see engine.last_logprobs) — no device round-trip
+        req.logprobs_out.append({
+            "token_ids": [int(i) for i in ids[:n]],    # mxtpu-lint: disable=host-sync-in-hot-path
+            "logprobs": [float(v) for v in vals[:n]],  # mxtpu-lint: disable=host-sync-in-hot-path
+        })
+
+    def _advance_machine(self, slot: int, req: _GenRequest, tok: int):
+        """Constrained-output emit boundary: feed the token just
+        emitted to the request's grammar machine and install the next
+        step's vocab mask (a traced operand of the NEXT dispatch)."""
+        m = req._machine
+        if m is None:
+            return
+        # tok is the already-pulled host scalar from the emit boundary
+        m.advance(int(tok))  # mxtpu-lint: disable=host-sync-in-hot-path
+        if not m.done:
+            self.engine.update_slot_bias(
+                slot, m.mask(budget=req.budget - len(req.tokens_out)))
+
     def _emit(self, req: _GenRequest, tok: int):
         gap = req._emit(tok)
         self._tokens_emitted += 1
         _m.GENERATE_TOKENS.inc(model=self.name)
+        if req.sampling is not None and req.sampling.sampled:
+            _m.SAMPLE_TOKENS.inc(model=self.name)
         # feed the token-latency SLI (MXNET_SERVE_SLO_TOKEN_P99_MS)
         _slo.tracker.record_token(self.name, gap)
 
@@ -1381,14 +1626,28 @@ class ContinuousBatcher(DynamicBatcher):
         n = len(toks)
         self._tokens_emitted += n
         _m.GENERATE_TOKENS.inc(n, model=self.name)
+        if n and req.sampling is not None and req.sampling.sampled:
+            _m.SAMPLE_TOKENS.inc(n, model=self.name)
         for _ in range(n):
             _slo.tracker.record_token(self.name, gap)
 
     def _maybe_finished(self, req: _GenRequest) -> bool:
         if len(req.tokens_out) >= req.budget:
             return True
-        return req.eos_id is not None \
-            and req.tokens_out[-1] == int(req.eos_id)
+        if req.eos_id is not None \
+                and req.tokens_out[-1] == int(req.eos_id):
+            return True
+        if req._machine is not None and req._machine.done:
+            return True
+        if req.stops:
+            out = req.tokens_out
+            for stop in req.stops:
+                if len(out) >= len(stop) \
+                        and tuple(out[-len(stop):]) == stop:
+                    self._stop_hits += 1
+                    _m.SAMPLE_STOP_HITS.inc(model=self.name)
+                    return True
+        return False
 
     def _free_slot(self, slot: int, req: _GenRequest, reason: str):
         with self._cv:
@@ -1535,6 +1794,10 @@ class ContinuousBatcher(DynamicBatcher):
                     self._dpt_dispatches
                     / max(self._dpt_tokens, 1e-9)
                     if self._dpt_dispatches else None,
+                "logprobs_topn":
+                    int(getattr(self.engine, "logprobs_topn", 0) or 0),
+                "stop_hits": self._stop_hits,
+                "stop_trimmed_tokens": self._stop_trimmed,
             })
             if getattr(self.engine, "draft", None) is not None:
                 out.update({

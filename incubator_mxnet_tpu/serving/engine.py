@@ -560,6 +560,7 @@ class GenerationEngine:
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  scan_steps: Optional[int] = None,
+                 logprobs_topn: Optional[int] = None,
                  ctx=None):
         import jax
         from ..base import getenv_int, getenv_bool
@@ -645,6 +646,32 @@ class GenerationEngine:
         self._health_on = _health.enabled()
         self._last_decode_health = None
         self._settle_params()
+        # sampling plane (serving/sampling.py, docs/serving.md
+        # "Sampling"): per-slot temperature / top-k / top-p / bias row /
+        # RNG root key are TRACED OPERANDS of the same compiled
+        # programs — the defaults (temperature 0, zero bias) reproduce
+        # the pre-sampling greedy argmax bit-for-bit, and flipping any
+        # of them never recompiles.  The logprobs top-N is baked at
+        # construction like the health plane: it changes every
+        # program's output arity, so it must never vary per request
+        # (per-request N is a host-side slice up to this cap).
+        vs = getattr(block, "_vocab_size", None)
+        self.vocab_size = int(vs if vs is not None
+                              else self.block.embed.weight.shape[0])
+        self.logprobs_topn = max(0, min(
+            int(logprobs_topn if logprobs_topn is not None
+                else getenv_int("MXNET_SAMPLING_LOGPROBS_TOPN", 5)),
+            self.vocab_size))
+        self._samp_temp = _np.zeros(self.max_slots, _np.float32)
+        self._samp_topk = _np.zeros(self.max_slots, _np.int32)
+        self._samp_topp = _np.ones(self.max_slots, _np.float32)
+        self._samp_bias = _np.zeros((self.max_slots, self.vocab_size),
+                                    _np.float32)
+        self._samp_keys = _np.zeros((self.max_slots, 2), _np.uint32)
+        self._samp_dev = None
+        self._last_logprobs = None
+        self._last_prefill_logprobs = None
+        self._last_verify_logprobs = None
         if self.paged:
             self._prefill_jit = jax.jit(self._prefill_paged_pure,
                                         donate_argnums=(0,))
@@ -725,8 +752,134 @@ class GenerationEngine:
             for p, v in zip(all_params, saved):
                 p._data._set_data(v)
 
+    # -- sampling plane --------------------------------------------------
+    # Host side: per-slot numpy arrays mirrored to ONE cached device
+    # tuple (like _tables_dev), invalidated on any slot update.  Traced
+    # side: the token at sequence position t is sampled with
+    # ``step_keys(root, t)`` — the key depends only on (root, position),
+    # never on which program produced the logits, which is what makes
+    # seeded runs bit-identical across per-step decode, scanned bursts,
+    # and speculative verify (the Gumbel-coupled acceptance argument in
+    # :meth:`spec_step`).
+
+    def set_slot_sampling(self, slot: int, params=None) -> None:
+        """Install a request's sampling parameters into ``slot`` before
+        its prefill (``params`` None → greedy defaults).  Cascades to an
+        attached draft engine so draft proposals are drawn from the SAME
+        key stream — the coupling that stochastic speculation needs.
+        Slots are NOT auto-cleared on release: prefill() itself releases
+        a stale slot, so clearing there would clobber parameters set
+        just before admission.  Every join sets its slot explicitly."""
+        from .sampling import SamplingParams, root_key
+        s = int(slot)
+        if not 0 <= s < self.max_slots:
+            raise MXNetError(f"{self.name}: slot {s} out of range")
+        p = params if params is not None else SamplingParams()
+        self._samp_temp[s] = float(p.temperature)
+        self._samp_topk[s] = int(p.top_k)
+        self._samp_topp[s] = float(p.top_p)
+        row = _np.zeros(self.vocab_size, _np.float32)
+        if p.logit_bias:
+            for t, b in p.logit_bias.items():
+                if 0 <= int(t) < self.vocab_size:
+                    row[int(t)] = float(b)
+        self._samp_bias[s] = row
+        self._samp_keys[s] = root_key(p.seed or 0)
+        self._samp_dev = None
+        if self.draft is not None:
+            self.draft.set_slot_sampling(slot, params)
+
+    def update_slot_bias(self, slot: int, row) -> None:
+        """Replace ``slot``'s logit-bias row (constrained-output plane:
+        the batcher composes the request's static logit_bias with the
+        grammar machine's mask at each emit boundary; the new row is a
+        traced operand of the NEXT dispatch).  Cascades to the draft so
+        constrained slots never propose illegal tokens."""
+        s = int(slot)
+        self._samp_bias[s] = _np.asarray(row, _np.float32).reshape(
+            self.vocab_size)
+        self._samp_dev = None
+        if self.draft is not None:
+            self.draft.update_slot_bias(slot, row)
+
+    def last_logprobs(self):
+        """Device arrays from the most recent decode/burst dispatch when
+        ``logprobs_topn > 0``: ``(values, token ids)`` shaped (S, N) for
+        per-step decode or (k, S, N) for a burst; None when disabled.
+        Like :meth:`last_decode_health`, the token read already synced
+        the dispatch, so pulling these costs no extra round-trip."""
+        return self._last_logprobs
+
+    def last_prefill_logprobs(self):
+        """``(values, ids)`` each shaped (N,) for the most recent
+        prefill's first sampled token; None when disabled."""
+        return self._last_prefill_logprobs
+
+    def last_verify_logprobs(self):
+        """``(values, ids)`` each shaped (S, Q, N) for the most recent
+        verify dispatch; None when disabled."""
+        return self._last_verify_logprobs
+
+    def _samp_tuple(self):
+        """The (S,)-wide sampling operand tuple, device-cached."""
+        import jax.numpy as jnp
+        if self._samp_dev is None:
+            self._samp_dev = (jnp.asarray(self._samp_temp),
+                              jnp.asarray(self._samp_topk),
+                              jnp.asarray(self._samp_topp),
+                              jnp.asarray(self._samp_bias),
+                              jnp.asarray(self._samp_keys))
+        return self._samp_dev
+
+    def _slot_samp(self, slot: int):
+        """Per-slot scalar sampling operands for the prefill programs
+        (temp (), top_k (), top_p (), bias (V,), root (2,))."""
+        import jax.numpy as jnp
+        s = int(slot)
+        return (jnp.asarray(self._samp_temp[s]),
+                jnp.asarray(self._samp_topk[s]),
+                jnp.asarray(self._samp_topp[s]),
+                jnp.asarray(self._samp_bias[s]),
+                jnp.asarray(self._samp_keys[s]))
+
+    # traced helpers (called from inside the pure programs)
+    def _sample_prefill(self, last, first_pos, samp):
+        """First generated token from prefill logits ``last`` (V,);
+        ``first_pos`` is the sequence position it will occupy."""
+        from .sampling import _sample_row, step_keys, topn_logprobs
+        temp, topk, topp, bias, root = samp
+        skey = step_keys(root, first_pos)
+        first = _sample_row(last, temp, topk, topp, bias, skey)
+        lp = topn_logprobs(last, bias, self.logprobs_topn) \
+            if self.logprobs_topn else None
+        return first, lp
+
+    def _sample_step(self, lg, key_idx, samp):
+        """Next token per slot from decode logits ``lg`` (S, V);
+        ``key_idx`` (S,) the sequence positions the sampled tokens will
+        occupy (write-head + 1 — the burst scan's position carry feeds
+        this per step, which IS the in-program key split)."""
+        from .sampling import step_keys, sample_tokens
+        temps, topks, topps, biases, roots = samp
+        return sample_tokens(lg, temps, topks, topps, biases,
+                             step_keys(roots, key_idx))
+
+    def _sample_verify(self, logits, pos_q, samp):
+        """Per-position sampled tokens for the verify program: logits
+        (S, Q, V), ``pos_q`` (S, Q) the positions of the consumed
+        tokens; output (S, Q) — column j is the token AFTER consuming
+        position pos_q[:, j], keyed at pos_q + 1, so each column is
+        bit-identical to what per-step decode would sample there."""
+        import jax
+        from .sampling import _sample_row, step_keys
+        temps, topks, topps, biases, roots = samp
+        keys = step_keys(roots[:, None, :], pos_q + 1)
+        row = jax.vmap(_sample_row, in_axes=(0, None, None, None,
+                                             None, 0))
+        return jax.vmap(row)(logits, temps, topks, topps, biases, keys)
+
     # -- pure programs --------------------------------------------------
-    def _prefill_pure(self, cache, tokens, n_valid, slot,
+    def _prefill_pure(self, cache, tokens, n_valid, slot, samp,
                       param_vals, aux_vals, key):
         """tokens (1, Tb) int32 (zero-padded past ``n_valid``), scalar
         ``slot``: run the full-prefix forward (causal, so the first
@@ -758,10 +911,12 @@ class GenerationEngine:
             out[L + l] = lax.dynamic_update_slice(
                 out[L + l], vh.astype(out[L + l].dtype), (slot, 0, 0, 0))
         last = jnp.take(logits[0], n_valid - 1, axis=0)
-        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        first, lp = self._sample_prefill(last, n_valid, samp)
+        if lp is not None:
+            return tuple(out), first, lp
         return tuple(out), first
 
-    def _decode_pure(self, cache, last_tokens, positions,
+    def _decode_pure(self, cache, last_tokens, positions, samp,
                      param_vals, aux_vals, key):
         """One token for EVERY slot: last_tokens (S, 1) int32, positions
         (S,) int32 (the index each slot writes this step).  Free slots
@@ -800,13 +955,19 @@ class GenerationEngine:
             return logits._data
 
         logits = self._with_params(param_vals, aux_vals, key, body)
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        lg = logits[:, 0, :]
+        nxt = self._sample_step(lg, positions + 1, samp)
+        out = (tuple(caches), nxt)
         if self._health_on:
-            return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
-        return tuple(caches), nxt
+            out = out + (_health.decode_health(lg),)
+        if self.logprobs_topn:
+            from .sampling import topn_logprobs
+            out = out + (topn_logprobs(lg, samp[3], self.logprobs_topn),)
+        return out
 
     def _decode_burst_pure(self, cache, last_tokens, positions, budgets,
-                           eos_ids, done0, param_vals, aux_vals, key):
+                           eos_ids, done0, samp,
+                           param_vals, aux_vals, key):
         """``scan_steps`` decode steps captured as ONE program
         (:func:`jax.lax.scan` over the exact :meth:`_decode_pure` cell
         body) with in-program termination riding the carry.
@@ -865,7 +1026,9 @@ class GenerationEngine:
                     h = h + cell._ffn_out(cell.ln2(h))
                 logits = self.block._project(self.block.ln_f(h))
                 lg = logits._data[:, 0, :]
-                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # keyed at pos + 1 (the position this token will
+                # occupy): the carry IS the per-step key split
+                nxt = self._sample_step(lg, pos + 1, samp)
                 emit = ~done
                 emitted2 = emitted + emit.astype(jnp.int32)
                 done2 = done | (emit & ((nxt == eos_ids)
@@ -874,6 +1037,10 @@ class GenerationEngine:
                 pos2 = jnp.where(done2, pos, pos + 1)
                 ys = (nxt,) if not self._health_on \
                     else (nxt,) + _health.decode_health(lg)
+                if self.logprobs_topn:
+                    from .sampling import topn_logprobs
+                    ys = ys + topn_logprobs(lg, samp[3],
+                                            self.logprobs_topn)
                 return (tuple(caches), lt2, pos2, done2, emitted2), ys
 
             carry0 = (cache, last_tokens, positions, done0,
@@ -882,17 +1049,25 @@ class GenerationEngine:
 
         (caches, _, _, _, emitted), ys = self._with_params(
             param_vals, aux_vals, key, run_scan)
+        ys = list(ys)
+        if self.logprobs_topn:            # stacked (k, S, N) per burst
+            lpi = ys.pop()
+            lpv = ys.pop()
         if self._health_on:
             toks, lmax, ent, fin = ys
             # frozen steps replay their final live step's logits, so the
             # fold is dominated by live emissions (max/all exact, mean
             # slightly weighted toward the freeze value)
-            return (caches, toks, emitted,
-                    (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
-        (toks,) = ys
-        return caches, toks, emitted
+            out = (caches, toks, emitted,
+                   (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
+        else:
+            (toks,) = ys
+            out = (caches, toks, emitted)
+        if self.logprobs_topn:
+            out = out + ((lpv, lpi),)
+        return out
 
-    def _verify_pure(self, cache, tokens, positions,
+    def _verify_pure(self, cache, tokens, positions, samp,
                      param_vals, aux_vals, key):
         """The speculative-decode VERIFY program: a k+1-wide
         generalization of :meth:`_decode_pure`.  ``tokens`` (S, Q) int32
@@ -940,7 +1115,12 @@ class GenerationEngine:
             return logits._data
 
         logits = self._with_params(param_vals, aux_vals, key, body)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = self._sample_verify(logits, pos_q, samp)
+        if self.logprobs_topn:
+            from .sampling import topn_logprobs
+            lp = topn_logprobs(logits, samp[3][:, None, :],
+                               self.logprobs_topn)
+            return tuple(caches), nxt, lp
         return tuple(caches), nxt
 
     # -- pure programs, paged layout ------------------------------------
@@ -960,7 +1140,7 @@ class GenerationEngine:
         return lax.dynamic_update_slice(
             pool, hslice[None].astype(pool.dtype), (blk, 0, 0, 0))
 
-    def _prefill_paged_pure(self, cache, tokens, n_valid, table,
+    def _prefill_paged_pure(self, cache, tokens, n_valid, table, samp,
                             param_vals, aux_vals, key):
         """Prefix-cache MISS prefill: the exact dense prefill body (so
         paged == dense bit-for-bit), with the slot's K/V scattered into
@@ -993,10 +1173,12 @@ class GenerationEngine:
                 out[L + l] = self._scatter_block(
                     out[L + l], vh[:, j * bs:(j + 1) * bs], table, j, False)
         last = jnp.take(logits[0], n_valid - 1, axis=0)
-        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        first, lp = self._sample_prefill(last, n_valid, samp)
+        if lp is not None:
+            return tuple(out), first, lp
         return tuple(out), first
 
-    def _prefill_ext_pure(self, cache, tokens, n_valid, ctx, table,
+    def _prefill_ext_pure(self, cache, tokens, n_valid, ctx, table, samp,
                           param_vals, aux_vals, key):
         """Prefix-cache HIT prefill: ``ctx`` leading positions (always a
         multiple of block_size) already hold valid K/V in shared blocks;
@@ -1061,11 +1243,13 @@ class GenerationEngine:
 
         logits = self._with_params(param_vals, aux_vals, key, body)
         last = jnp.take(logits[0], n_valid - 1, axis=0)
-        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        first, lp = self._sample_prefill(last, ctx + n_valid, samp)
+        if lp is not None:
+            return tuple(caches), first, lp
         return tuple(caches), first
 
     def _decode_paged_pure(self, cache, last_tokens, positions, tables,
-                           param_vals, aux_vals, key):
+                           samp, param_vals, aux_vals, key):
         """The decode program, paged: identical to :meth:`_decode_pure`
         except each slot's K/V write lands in block ``tables[s, pos//bs]``
         at offset ``pos % bs`` and attention reads through
@@ -1107,13 +1291,18 @@ class GenerationEngine:
             return logits._data
 
         logits = self._with_params(param_vals, aux_vals, key, body)
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        lg = logits[:, 0, :]
+        nxt = self._sample_step(lg, positions + 1, samp)
+        out = (tuple(caches), nxt)
         if self._health_on:
-            return tuple(caches), nxt, _health.decode_health(logits[:, 0, :])
-        return tuple(caches), nxt
+            out = out + (_health.decode_health(lg),)
+        if self.logprobs_topn:
+            from .sampling import topn_logprobs
+            out = out + (topn_logprobs(lg, samp[3], self.logprobs_topn),)
+        return out
 
     def _decode_burst_paged_pure(self, cache, last_tokens, positions,
-                                 budgets, eos_ids, done0, tables,
+                                 budgets, eos_ids, done0, tables, samp,
                                  param_vals, aux_vals, key):
         """:meth:`_decode_burst_pure` over the paged layout: the scanned
         step is the exact :meth:`_decode_paged_pure` cell body, and a
@@ -1162,7 +1351,9 @@ class GenerationEngine:
                     h = h + cell._ffn_out(cell.ln2(h))
                 logits = self.block._project(self.block.ln_f(h))
                 lg = logits._data[:, 0, :]
-                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # keyed at pos + 1 (the position this token will
+                # occupy): the carry IS the per-step key split
+                nxt = self._sample_step(lg, pos + 1, samp)
                 emit = ~done
                 emitted2 = emitted + emit.astype(jnp.int32)
                 done2 = done | (emit & ((nxt == eos_ids)
@@ -1171,6 +1362,10 @@ class GenerationEngine:
                 pos2 = jnp.where(done2, pos, pos + 1)
                 ys = (nxt,) if not self._health_on \
                     else (nxt,) + _health.decode_health(lg)
+                if self.logprobs_topn:
+                    from .sampling import topn_logprobs
+                    ys = ys + topn_logprobs(lg, samp[3],
+                                            self.logprobs_topn)
                 return (tuple(caches), lt2, pos2, done2, emitted2), ys
 
             carry0 = (cache, last_tokens, positions, done0,
@@ -1179,14 +1374,22 @@ class GenerationEngine:
 
         (caches, _, _, _, emitted), ys = self._with_params(
             param_vals, aux_vals, key, run_scan)
+        ys = list(ys)
+        if self.logprobs_topn:
+            lpi = ys.pop()
+            lpv = ys.pop()
         if self._health_on:
             toks, lmax, ent, fin = ys
-            return (caches, toks, emitted,
-                    (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
-        (toks,) = ys
-        return caches, toks, emitted
+            out = (caches, toks, emitted,
+                   (lmax.max(axis=0), ent.mean(axis=0), fin.all(axis=0)))
+        else:
+            (toks,) = ys
+            out = (caches, toks, emitted)
+        if self.logprobs_topn:
+            out = out + ((lpv, lpi),)
+        return out
 
-    def _verify_paged_pure(self, cache, tokens, positions, tables,
+    def _verify_paged_pure(self, cache, tokens, positions, tables, samp,
                            param_vals, aux_vals, key):
         """The verify program, paged: :meth:`_verify_pure` with each
         slot's Q writes routed through its block table.  Positions past a
@@ -1237,7 +1440,12 @@ class GenerationEngine:
             return logits._data
 
         logits = self._with_params(param_vals, aux_vals, key, body)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = self._sample_verify(logits, pos_q, samp)
+        if self.logprobs_topn:
+            from .sampling import topn_logprobs
+            lp = topn_logprobs(logits, samp[3][:, None, :],
+                               self.logprobs_topn)
+            return tuple(caches), nxt, lp
         return tuple(caches), nxt
 
     # -- cache lifecycle ------------------------------------------------
@@ -1251,6 +1459,7 @@ class GenerationEngine:
         import jax.numpy as jnp
         if getattr(self, "draft", None) is not None:
             self.draft.reset()
+        self._samp_dev = None
         if self.paged:
             N, H, bs, D = (self.num_blocks, self.num_heads,
                            self.block_size, self.head_dim)
@@ -1311,6 +1520,19 @@ class GenerationEngine:
                                              model=self.name)
             raise
 
+    def _unpack_prefill(self, out) -> int:
+        """Rebind the cache and stash the prefill logprobs (arity is
+        baked by ``logprobs_topn``, exactly like the health plane)."""
+        if self.logprobs_topn:
+            cache, first, lp = out
+            self._last_prefill_logprobs = tuple(_np.asarray(a)
+                                                for a in lp)
+        else:
+            cache, first = out
+            self._last_prefill_logprobs = None
+        self._cache = cache
+        return int(first)
+
     def prefill(self, tokens, slot: int,
                 reserve_tokens: Optional[int] = None) -> int:
         """Admit a prompt into ``slot``: pad to the prompt-length bucket,
@@ -1355,12 +1577,12 @@ class GenerationEngine:
             with _telemetry.trace_span("serve.prefill", cat="serving",
                                        model=self.name, slot=int(slot),
                                        tokens=n, bucket=bucket):
-                cache, first = self._guarded(
+                out = self._guarded(
                     self._prefill, jnp.asarray(padded),
-                    jnp.asarray(n, jnp.int32), jnp.asarray(int(slot),
-                                                           jnp.int32))
-            self._cache = cache
-            return int(first)
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(int(slot), jnp.int32),
+                    self._slot_samp(slot))
+            return self._unpack_prefill(out)
         slot = int(slot)
         if self._slot_blocks[slot]:
             self.release_slot(slot)
@@ -1389,6 +1611,7 @@ class GenerationEngine:
     def _prefill_paged_dispatch(self, toks, n: int, m: int, row,
                                 slot: int) -> int:
         import jax.numpy as jnp
+        ss = self._slot_samp(slot)
         if m == 0:
             bucket = self.prefill_bucket_for(n)
             padded = _np.zeros((1, bucket), _np.int32)
@@ -1396,9 +1619,9 @@ class GenerationEngine:
             with _telemetry.trace_span("serve.prefill", cat="serving",
                                        model=self.name, slot=slot,
                                        tokens=n, bucket=bucket):
-                cache, first = self._guarded(
+                out = self._guarded(
                     self._prefill, jnp.asarray(padded),
-                    jnp.asarray(n, jnp.int32), jnp.asarray(row))
+                    jnp.asarray(n, jnp.int32), jnp.asarray(row), ss)
         else:
             sn = n - m
             bucket = self.prefill_bucket_for(sn)
@@ -1408,12 +1631,11 @@ class GenerationEngine:
                                        model=self.name, slot=slot,
                                        tokens=n, bucket=bucket,
                                        prefix_hit_tokens=m):
-                cache, first = self._guarded(
+                out = self._guarded(
                     self._prefill_ext, jnp.asarray(padded),
                     jnp.asarray(sn, jnp.int32), jnp.asarray(m, jnp.int32),
-                    jnp.asarray(row))
-        self._cache = cache
-        return int(first)
+                    jnp.asarray(row), ss)
+        return self._unpack_prefill(out)
 
     def decode(self, last_tokens, positions):
         """Advance EVERY slot one position in one dispatch: last_tokens
@@ -1427,13 +1649,18 @@ class GenerationEngine:
         if self.paged:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
-            out = self._guarded(self._decode, lt, pos, self._tables_dev)
+            out = self._guarded(self._decode, lt, pos, self._tables_dev,
+                                self._samp_tuple())
         else:
-            out = self._guarded(self._decode, lt, pos)
+            out = self._guarded(self._decode, lt, pos,
+                                self._samp_tuple())
+        out = list(out)
+        if self.logprobs_topn:
+            self._last_logprobs = tuple(_np.asarray(a)
+                                        for a in out.pop())
         if self._health_on:
-            cache, nxt, self._last_decode_health = out
-        else:
-            cache, nxt = out
+            self._last_decode_health = out.pop()
+        cache, nxt = out
         self._cache = cache
         return _np.asarray(nxt)
 
@@ -1465,14 +1692,18 @@ class GenerationEngine:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
             out = self._guarded(self._decode_burst, lt, pos, bud, eos,
-                                done0, self._tables_dev)
+                                done0, self._tables_dev,
+                                self._samp_tuple())
         else:
             out = self._guarded(self._decode_burst, lt, pos, bud, eos,
-                                done0)
+                                done0, self._samp_tuple())
+        out = list(out)
+        if self.logprobs_topn:          # (k, S, N) per burst step
+            self._last_logprobs = tuple(_np.asarray(a)
+                                        for a in out.pop())
         if self._health_on:
-            cache, toks, emitted, self._last_decode_health = out
-        else:
-            cache, toks, emitted = out
+            self._last_decode_health = out.pop()
+        cache, toks, emitted = out
         self._cache = cache
         return _np.asarray(toks), _np.asarray(emitted)
 
@@ -1519,6 +1750,12 @@ class GenerationEngine:
             raise MXNetError(f"spec_k must be >= 1, got {k}")
         self.draft = draft
         self.spec_k = k
+        # draft outputs are never surfaced (only target verify columns
+        # are emitted), so zero its logprobs top-N before its first
+        # dispatch bakes the output arity — spec bursts skip the extra
+        # per-step top_k work entirely
+        if draft.compiled_programs() == 0:
+            draft.logprobs_topn = 0
         # scan the k autoregressive draft decodes into one dispatch
         # (spec drops from k+1 to 2 dispatches per burst).  The draft's
         # burst width must equal spec_k, so override its default here —
@@ -1542,10 +1779,18 @@ class GenerationEngine:
         if self.paged:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
-            cache, out = self._guarded(self._verify, lt, pos,
-                                       self._tables_dev)
+            res = self._guarded(self._verify, lt, pos,
+                                self._tables_dev, self._samp_tuple())
         else:
-            cache, out = self._guarded(self._verify, lt, pos)
+            res = self._guarded(self._verify, lt, pos,
+                                self._samp_tuple())
+        if self.logprobs_topn:          # (S, Q, N) per verify
+            cache, out, lp = res
+            self._last_verify_logprobs = tuple(_np.asarray(a)
+                                               for a in lp)
+        else:
+            cache, out = res
+            self._last_verify_logprobs = None
         self._cache = cache
         return _np.asarray(out)
 
@@ -1554,13 +1799,28 @@ class GenerationEngine:
         ``spec_k`` tokens autoregressively — ONE scanned draft dispatch
         when its burst program is enabled (the default; ``spec_k`` host
         dispatches otherwise) — then ONE target verify dispatch scores
-        all ``spec_k + 1`` positions.  Greedy
-        acceptance: the longest prefix where draft argmax == target
-        argmax, plus the target's bonus token.
+        all ``spec_k + 1`` positions.
+
+        Acceptance is **Gumbel-coupled stochastic speculative
+        sampling**.  Both engines sample with the SAME per-slot root
+        key and position-indexed key stream (:meth:`set_slot_sampling`
+        cascades to the draft), so at every position they share one
+        gumbel noise vector; the verify program returns the target's
+        keyed sample at each position, and acceptance is the longest
+        prefix where the draft's sample equals the target's.  Every
+        emitted token is a target sample under the target's own
+        filtered distribution, and because the key depends only on
+        (root, position), each one is bit-identical to what a no-draft
+        sampled run emits at that position — at ANY accept rate.  This
+        is the shared-noise form of the accept/reject + residual
+        resample scheme (distributionally equivalent: the coupled
+        target sample IS the residual draw when the proposals
+        diverge), and greedy acceptance is its ``temperature == 0``
+        special case, where sample == argmax on both sides.
 
         Returns ``(out, accepted)``: ``out`` (S, spec_k + 1) int32 —
         ``out[s, :accepted[s] + 1]`` are this step's emitted tokens,
-        every one of them a target argmax (bit-identical to plain
+        every one of them a target sample (bit-identical to plain
         decode by construction); ``accepted`` (S,) int64 in
         ``[0, spec_k]`` counts the draft tokens accepted per slot.
         Rejected positions' K/V is rolled back: the cursor simply does
@@ -1763,12 +2023,12 @@ class GenerationEngine:
                 row = jnp.zeros(self.max_blocks_per_slot, jnp.int32)
                 for b in self.prefill_buckets:
                     sn = max(1, min(b, self.max_len - 1))
-                    cache, _ = self._guarded(
+                    self._unpack_prefill(self._guarded(
                         self._prefill_ext,
                         jnp.zeros((1, b), jnp.int32),
                         jnp.asarray(sn, jnp.int32),
-                        jnp.asarray(0, jnp.int32), row)
-                    self._cache = cache
+                        jnp.asarray(0, jnp.int32), row,
+                        self._slot_samp(0)))
             self.decode(_np.zeros(self.max_slots, _np.int32),
                         _np.zeros(self.max_slots, _np.int32))
             if self.scan_steps >= 1:
@@ -1825,13 +2085,16 @@ class GenerationEngine:
     # -- reference path --------------------------------------------------
     def generate(self, tokens, max_new_tokens: int = 32,
                  eos_id: Optional[int] = None,
-                 speculative: Optional[bool] = None):
+                 speculative: Optional[bool] = None,
+                 sampling=None):
         """Solo generation through the SERVING programs (slot 0) — the
         engine-level convenience used by tests and the bench; the
         continuous batcher drives the same programs for many slots.
         With a draft attached the speculative step loop is the default
         (``speculative=False`` forces plain decode); every emitted token
-        is a target argmax either way, so the outputs are identical."""
+        is a target sample either way, so the outputs are identical.
+        ``sampling`` is an optional :class:`~.sampling.SamplingParams`
+        (None: greedy) installed into slot 0 for the run."""
         toks = list(_np.asarray(tokens, _np.int32).reshape(-1))
         n = len(toks)
         budget = min(int(max_new_tokens), self.max_len - n)
@@ -1841,6 +2104,7 @@ class GenerationEngine:
                 f"{self.max_len})")
         spec = self.draft is not None if speculative is None \
             else bool(speculative) and self.draft is not None
+        self.set_slot_sampling(0, sampling)
         out = [self.prefill(toks, 0, reserve_tokens=n + budget)]
         try:
             lt = _np.zeros(self.max_slots, _np.int32)

@@ -69,6 +69,28 @@ NONFINITE_GENERATIONS = _telemetry.registry.counter(
     "decode steps whose logits contained a non-finite value for at "
     "least one live slot (health plane, MXNET_HEALTH_PLANE=1)")
 
+# sampling plane (serving/sampling.py; docs/serving.md "Sampling") ----------
+SAMPLED_REQUESTS = _telemetry.registry.counter(
+    "mxtpu_sample_requests",
+    "generation requests admitted, by mode=greedy|sampled "
+    "(sampled: temperature > 0)")
+SAMPLE_TOKENS = _telemetry.registry.counter(
+    "mxtpu_sample_tokens",
+    "tokens emitted by stochastically sampled (temperature > 0) "
+    "requests, per model")
+SAMPLE_CONSTRAINED = _telemetry.registry.counter(
+    "mxtpu_sample_constrained_requests",
+    "generation requests decoded under a constrained-output grammar "
+    "mask (json_mode), per model")
+SAMPLE_STOP_HITS = _telemetry.registry.counter(
+    "mxtpu_sample_stop_hits",
+    "generation requests finished by a multi-token stop sequence at "
+    "an emit boundary, per model")
+SAMPLE_STOP_TRIMMED = _telemetry.registry.counter(
+    "mxtpu_sample_stop_trimmed_tokens",
+    "over-generated burst-tail tokens discarded host-side past a stop "
+    "sequence (their K/V writes were already null-block-redirected)")
+
 # router (serving/router.py; labeled by replica where it matters) ----------
 ROUTER_REQUESTS = _telemetry.registry.counter(
     "mxtpu_router_requests",
@@ -222,7 +244,8 @@ SPEC_TOKENS_PER_DISPATCH = _telemetry.registry.gauge(
 SPEC_ACCEPT_RATE = _telemetry.registry.gauge(
     "mxtpu_spec_accept_rate",
     "fraction of drafted tokens the target accepted, cumulative per "
-    "model (tune MXNET_SPEC_K down when this drops)")
+    "model and by mode=greedy|sampled (sampled: any live slot decoding "
+    "at temperature > 0; tune MXNET_SPEC_K down when this drops)")
 HEALTH_LOGIT_MAX = _telemetry.registry.gauge(
     "mxtpu_health_logit_max",
     "max final-position logit across live slots in the most recent "

@@ -97,6 +97,7 @@ from .. import telemetry_device as _telemetry_device
 from .. import telemetry_ring as _ring
 from .batcher import ContinuousBatcher, DynamicBatcher, QueueFullError
 from .engine import GenerationEngine, InferenceEngine
+from .sampling import SamplingParams
 from . import lifecycle as _lc
 from . import metrics as _m
 from . import slo as _slo
@@ -314,25 +315,45 @@ class _Handler(BaseJSONHandler):
             req = ms.generate_request(name, payload, request_id=rid)
             if not stream:
                 toks = req.result()
-                self.send_json(200, {"tokens": toks, "count": len(toks),
-                                     "accepted_tokens":
-                                         int(req.accepted_tokens),
-                                     "draft_tokens":
-                                         int(req.draft_tokens),
-                                     "request_id": req.request_id})
+                body = {"tokens": toks, "count": len(toks),
+                        "accepted_tokens": int(req.accepted_tokens),
+                        "draft_tokens": int(req.draft_tokens),
+                        "request_id": req.request_id}
+                # the replay contract (docs/serving.md): a sampled
+                # response always echoes its effective seed
+                if req.seed is not None:
+                    body["seed"] = int(req.seed)
+                if getattr(req, "logprobs_n", 0):
+                    body["logprobs"] = list(req.logprobs_out)
+                children = getattr(req, "children", None)
+                if children is not None:
+                    body["candidates"] = [
+                        {"tokens": list(c.tokens_out),
+                         "seed": None if c.seed is None
+                         else int(c.seed),
+                         "request_id": c.request_id,
+                         **({"logprobs": list(c.logprobs_out)}
+                            if c.logprobs_n else {})}
+                        for c in children]
+                self.send_json(200, body)
                 return
             self.start_stream(200)
             try:
+                lp_n = int(getattr(req, "logprobs_n", 0) or 0)
                 for i, tok in enumerate(req.stream()):
-                    self.send_event({"token": int(tok), "index": i},
-                                    event="token")
-                self.send_event({"tokens": list(req.tokens_out),
-                                 "count": len(req.tokens_out),
-                                 "accepted_tokens":
-                                     int(req.accepted_tokens),
-                                 "draft_tokens": int(req.draft_tokens),
-                                 "request_id": req.request_id},
-                                event="done")
+                    ev = {"token": int(tok), "index": i}
+                    if lp_n and i < len(req.logprobs_out):
+                        ev["logprobs"] = req.logprobs_out[i]
+                    self.send_event(ev, event="token")
+                done_ev = {"tokens": list(req.tokens_out),
+                           "count": len(req.tokens_out),
+                           "accepted_tokens":
+                               int(req.accepted_tokens),
+                           "draft_tokens": int(req.draft_tokens),
+                           "request_id": req.request_id}
+                if req.seed is not None:
+                    done_ev["seed"] = int(req.seed)
+                self.send_event(done_ev, event="done")
             except (BrokenPipeError, ConnectionError, OSError):
                 req.cancel()            # client went away mid-stream
                 return
@@ -644,10 +665,36 @@ class ModelServer:
         eos_id = payload.get("eos_id")
         if eos_id is not None:
             eos_id = int(eos_id)
+        sampling = None
+        if any(k in payload for k in
+               ("temperature", "top_k", "top_p", "seed", "logprobs",
+                "stop", "n", "logit_bias", "json_mode")):
+            lb = payload.get("logit_bias")
+            if lb is not None:
+                if not isinstance(lb, dict):
+                    raise ValueError(
+                        '"logit_bias" must be an object mapping token '
+                        "id -> bias")
+                lb = {int(t): float(b) for t, b in lb.items()}
+            seed = payload.get("seed")
+            sampling = SamplingParams(
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                seed=int(seed) if seed is not None else None,
+                logprobs=int(payload.get("logprobs", 0)),
+                stop=tuple(payload.get("stop") or ()),
+                n=int(payload.get("n", 1)),
+                logit_bias=lb,
+                json_mode=bool(payload.get("json_mode", False)))
+            if sampling.n > 1 and bool(payload.get("stream", False)):
+                raise ValueError(
+                    "streaming and n > 1 cannot be combined; stream "
+                    "each candidate as its own request")
         try:
             return batcher.submit_async(
                 tokens, max_new_tokens=max_new, timeout_ms=timeout_ms,
-                request_id=request_id, eos_id=eos_id)
+                request_id=request_id, eos_id=eos_id, sampling=sampling)
         except Exception:
             _slo.tracker.record(name, 0.0, ok=False)
             raise

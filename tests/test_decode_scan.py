@@ -158,6 +158,7 @@ def test_burst_parity_matrix(k, paged):
     assert eng.compiled_programs() <= eng.expected_programs
 
 
+@pytest.mark.slow  # tier-1 budget rider: scan program-set closure stays in test_spec_draft_scan_parity_and_program_set
 def test_burst_program_joins_closed_set():
     # max_len=16 keeps the prefill bucket ladder (and so the warmup
     # compile bill) minimal — this test only counts programs

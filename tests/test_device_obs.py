@@ -101,6 +101,7 @@ def test_closed_program_set_dense(monkeypatch):
         eng.warmup()
 
 
+@pytest.mark.slow  # tier-1 budget rider: spec program-set closure stays in test_decode_scan, dpt contracts in device_obs_smoke + test_batcher_spec_stats_and_gauge
 def test_closed_program_set_spec_and_dispatches_per_token():
     tnet = _gpt()
     tgt = GenerationEngine(tnet, name="obst", max_slots=2, max_len=64)

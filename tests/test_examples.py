@@ -58,6 +58,7 @@ def test_example_moe_short():
     assert "final loss" in out
 
 
+@pytest.mark.slow  # tier-1 budget rider: pipeline schedule parity stays in test_pipeline
 def test_example_pipeline_short():
     out = _run("example/distributed/train_pipeline.py",
                "--schedule", "1f1b", "--dp", "2", "--stages", "2",
@@ -70,6 +71,7 @@ def test_example_pipeline_short():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-1 budget rider: sp attention parity stays in test_parallel
 def test_example_long_context_short():
     out = _run("example/distributed/train_long_context.py",
                "--dp", "2", "--sp", "4", "--seq-len", "64",

@@ -135,6 +135,7 @@ def test_engine_generate_matches_net_generate():
         assert eng.generate(prompt, max_new_tokens=16) == ref
 
 
+@pytest.mark.slow  # tier-1 budget rider: dense closed-set stays in test_device_obs::test_closed_program_set_dense
 def test_warmup_compiles_closed_program_set():
     _, eng = _engine()
     warmed = eng.warmup()

@@ -606,13 +606,14 @@ def _fuzz_shapes(rng):
     return shape, partner
 
 
-# the three heaviest seed-slices ride the slow tier so tier-1 keeps
-# fuzz coverage (5 slices, ~1000 cases) inside the CPU time budget
+# the heaviest seed-slices ride the slow tier so tier-1 keeps fuzz
+# coverage (3 slices, ~600 cases) inside the CPU time budget
 @pytest.mark.parametrize("seed", [
     pytest.param(0, marks=pytest.mark.slow),
-    1,
+    pytest.param(1, marks=pytest.mark.slow),
     pytest.param(2, marks=pytest.mark.slow),
-    3, 4,
+    pytest.param(3, marks=pytest.mark.slow),
+    4,
     pytest.param(5, marks=pytest.mark.slow),
     6, 7,
 ])

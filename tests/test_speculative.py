@@ -129,6 +129,7 @@ def test_rewind_refuses_cow_of_kept_positions():
 
 
 # ============================================ exact acceptance, engine
+@pytest.mark.slow  # tier-1 budget rider: spec bitwise parity stays covered by test_sampling (greedy is the T=0 row of its spec matrix) + test_decode_scan's spec parity
 @pytest.mark.parametrize("paged", [False, True])
 def test_accept_rate_one_bitwise_identical(paged):
     golden = _golden(PROMPTS)
@@ -137,6 +138,7 @@ def test_accept_rate_one_bitwise_identical(paged):
         assert eng.generate(p, max_new_tokens=12, speculative=True) == g
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("paged", [False, True])
 def test_adversarial_draft_still_bitwise_identical(paged):
     golden = _golden(PROMPTS)
@@ -155,6 +157,7 @@ def test_adversarial_draft_still_bitwise_identical(paged):
         assert eng.generate(p, max_new_tokens=12, speculative=True) == g
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("paged", [False, True])
 def test_independent_draft_bitwise_identical(paged):
     golden = _golden(PROMPTS)
@@ -193,8 +196,8 @@ def test_spec_k_env_default(monkeypatch):
 
 
 # ====================================== closed compiled-program set
-@pytest.mark.parametrize("paged", [
-    False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.slow  # program-set closure stays tier-1 via test_decode_scan::test_spec_draft_scan_parity_and_program_set
+@pytest.mark.parametrize("paged", [False, True])
 def test_verify_adds_exactly_one_program(paged):
     eng = _spec_pair(paged, max_slots=2)
     eng.warmup()
@@ -252,6 +255,7 @@ def test_spec_batcher_matrix_mid_flight_joins(paged):
         assert st["prefix_cache_hits"] > 0  # matrix includes prefix hits
 
 
+@pytest.mark.slow  # join-under-rollback stays tier-1 via test_spec_batcher_matrix_mid_flight_joins
 def test_joining_stream_unaffected_by_neighbor_rollback():
     """Slot A runs an adversarial draft (rollback EVERY step) while B
     joins mid-flight; B's stream must equal the plain golden."""
@@ -333,6 +337,7 @@ def test_verify_kernel_forced_pallas_interpret_parity(monkeypatch):
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # op-level verify kernel parity stays tier-1 in test_flash_attention
 def test_paged_engine_parity_with_forced_pallas_verify(monkeypatch):
     golden = _golden(PROMPTS)
     monkeypatch.setenv("MXNET_FA_DECODE_FORCE_PALLAS", "1")
